@@ -1,0 +1,52 @@
+"""gubernator_tpu — a TPU-native distributed rate-limiting framework.
+
+A from-scratch rebuild of the capabilities of mailgun/gubernator (reference:
+/root/reference, see SURVEY.md) designed TPU-first:
+
+- Rate-limit state for millions of keys lives in dense HBM column arrays
+  (struct-of-arrays) instead of a per-key heap LRU (reference: cache.go).
+- The token/leaky bucket state machines (reference: algorithms.go:24-336)
+  collapse into one batched, branchless, masked decision kernel applied per
+  batch window (ops/decide.py), optionally as a fused Pallas kernel.
+- Key-ownership sharding (reference: hash.go, replicated_hash.go) becomes a
+  sharded device mesh axis; GLOBAL/multi-region hit aggregation (reference:
+  global.go, multiregion.go) becomes a windowed psum over the mesh
+  (parallel/).
+- The host tier (gRPC/HTTP serving, batching window, membership) mirrors the
+  reference's split between serving and state mutation (service/).
+
+Timestamps and counters are int64 milliseconds, so 64-bit mode is enabled at
+import (TPU emulates int64 with int32 pairs; the decision kernel is
+bandwidth-bound, not ALU-bound, so this is acceptable and keeps exact parity
+with the reference's int64 wire types).
+"""
+
+import os as _os
+
+import jax as _jax
+
+if not _os.environ.get("GUBER_TPU_NO_X64"):
+    _jax.config.update("jax_enable_x64", True)
+
+from gubernator_tpu.types import (  # noqa: E402
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+    has_behavior,
+    hash_key,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Algorithm",
+    "Behavior",
+    "RateLimitReq",
+    "RateLimitResp",
+    "Status",
+    "has_behavior",
+    "hash_key",
+    "__version__",
+]

@@ -1,0 +1,4 @@
+from gubernator_tpu.models.keyspace import KeyDirectory
+from gubernator_tpu.models.engine import Engine
+
+__all__ = ["KeyDirectory", "Engine"]

@@ -1,0 +1,349 @@
+"""Single-host rate-limit engine: host batching over the device kernel.
+
+This is the TPU-native analogue of the reference's core request path
+(reference: gubernator.go:110-224 fan-out + algorithms.go under one mutex):
+instead of 1000 goroutines contending on a lock, a request batch becomes one
+device program. The engine owns:
+
+- the device key table (ops/decide.py TableState columns in HBM);
+- the host key directory (models/keyspace.py);
+- duplicate-key *rounds*: the reference's mutex serializes same-key requests
+  inside a batch; we split a window so each kernel call touches each slot at
+  most once, preserving exact sequential semantics (occurrence k of a key
+  goes to round k);
+- width bucketing: batches are padded to power-of-two widths so XLA compiles
+  a handful of programs, then reuses them;
+- the Store/Loader persistence hooks (store.py; reference: store.go).
+
+The engine is synchronous and thread-safe via one lock — the service layer
+(service/) puts the async micro-batching window in front of it.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gubernator_tpu.models.keyspace import KeyDirectory
+from gubernator_tpu.ops.decide import (
+    I32,
+    I64,
+    ReqBatch,
+    TableState,
+    decide,
+    make_table,
+)
+from gubernator_tpu.store import BucketSnapshot, Loader, Store
+from gubernator_tpu.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    has_behavior,
+    validate_request,
+)
+from gubernator_tpu.utils.gregorian import (
+    GregorianError,
+    gregorian_duration,
+    gregorian_expiration,
+)
+from gubernator_tpu.utils.interval import millisecond_now
+
+
+def _bucket_width(n: int, lo: int, hi: int) -> int:
+    w = lo
+    while w < n:
+        w *= 2
+    return min(w, hi)
+
+
+def _inject_rows(state: TableState, slot, algo, limit, remaining, duration,
+                 stamp, expire_at, status) -> TableState:
+    """Scatter host-provided rows into the table (store read-through/loader)."""
+    return TableState(
+        algo=state.algo.at[slot].set(algo, mode="drop"),
+        limit=state.limit.at[slot].set(limit, mode="drop"),
+        remaining=state.remaining.at[slot].set(remaining, mode="drop"),
+        duration=state.duration.at[slot].set(duration, mode="drop"),
+        stamp=state.stamp.at[slot].set(stamp, mode="drop"),
+        expire_at=state.expire_at.at[slot].set(expire_at, mode="drop"),
+        status=state.status.at[slot].set(status, mode="drop"),
+    )
+
+
+def _gather_rows(state: TableState, slot):
+    """Fetch rows for store write-through / snapshotting."""
+    g = jnp.maximum(slot, 0)
+    return (state.algo[g], state.limit[g], state.remaining[g],
+            state.duration[g], state.stamp[g], state.expire_at[g],
+            state.status[g])
+
+
+class EngineStats:
+    def __init__(self):
+        self.requests = 0
+        self.batches = 0
+        self.rounds = 0
+        self.over_limit = 0
+        self.errors = 0
+
+    def as_dict(self) -> Dict[str, int]:
+        return dict(requests=self.requests, batches=self.batches,
+                    rounds=self.rounds, over_limit=self.over_limit,
+                    errors=self.errors)
+
+
+class Engine:
+    """One device's (or host's) authoritative rate-limit state + kernel."""
+
+    def __init__(
+        self,
+        capacity: int = 1 << 20,
+        store: Optional[Store] = None,
+        loader: Optional[Loader] = None,
+        min_width: int = 64,
+        max_width: int = 4096,
+        donate: Optional[bool] = None,
+    ):
+        self.capacity = capacity
+        self.state = make_table(capacity)
+        self.directory = KeyDirectory(capacity)
+        self.store = store
+        self.loader = loader
+        self.min_width = min_width
+        # one kernel round must never need more distinct slots than exist
+        self.max_width = min(max_width, capacity)
+        self.stats = EngineStats()
+        self._lock = threading.Lock()
+        if donate is None:
+            from gubernator_tpu.utils.platform import donation_supported
+
+            donate = donation_supported()
+        donate_args = (0,) if donate else ()
+        self._decide = jax.jit(decide, donate_argnums=donate_args)
+        self._inject = jax.jit(_inject_rows, donate_argnums=donate_args)
+        self._gather = jax.jit(_gather_rows)
+        if loader is not None:
+            self.load_snapshot(loader.load())
+
+    # ------------------------------------------------------------------ API
+
+    def get_rate_limits(
+        self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        """Decide a batch. Exact per-key sequential semantics, any batch size."""
+        if now_ms is None:
+            now_ms = millisecond_now()
+        responses: List[Optional[RateLimitResp]] = [None] * len(requests)
+        work: List[Tuple[int, RateLimitReq, int, int]] = []
+        n_errors = 0
+        for i, r in enumerate(requests):
+            err = validate_request(r)
+            if err:
+                responses[i] = RateLimitResp(error=err)
+                n_errors += 1
+                continue
+            ge = gi = 0
+            if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+                try:
+                    local_now = _dt.datetime.fromtimestamp(now_ms / 1000.0)
+                    ge = gregorian_expiration(local_now, r.duration)
+                    gi = gregorian_duration(local_now, r.duration)
+                except GregorianError as e:
+                    responses[i] = RateLimitResp(error=str(e))
+                    n_errors += 1
+                    continue
+            work.append((i, r, ge, gi))
+
+        # occurrence-k of each key goes to round k: kernel calls stay
+        # collision-free while duplicate requests observe each other in order
+        rounds: List[List[Tuple[int, RateLimitReq, int, int]]] = []
+        occurrence: Dict[str, int] = {}
+        for item in work:
+            k = item[1].hash_key()
+            j = occurrence.get(k, 0)
+            occurrence[k] = j + 1
+            if len(rounds) <= j:
+                rounds.append([])
+            rounds[j].append(item)
+
+        with self._lock:
+            self.stats.requests += len(requests)
+            self.stats.batches += 1
+            self.stats.errors += n_errors
+            for round_work in rounds:
+                self.stats.rounds += 1
+                for start in range(0, len(round_work), self.max_width):
+                    self._apply_round(
+                        round_work[start:start + self.max_width], now_ms, responses)
+        return responses  # type: ignore[return-value]
+
+    # ------------------------------------------------------- persistence SPI
+
+    def load_snapshot(self, items) -> int:
+        """Seed table rows from a Loader (reference: gubernator.go:75-83)."""
+        items = list(items)
+        if not items:
+            return 0
+        n = 0
+        with self._lock:
+            for start in range(0, len(items), self.max_width):
+                chunk = items[start:start + self.max_width]
+                slots, _ = self.directory.lookup([it.key for it in chunk])
+                w = _bucket_width(len(chunk), self.min_width, self.max_width)
+                pad = w - len(chunk)
+                self.state = self._inject(
+                    self.state,
+                    jnp.asarray(slots + [-1] * pad, I32),
+                    jnp.asarray([it.algo for it in chunk] + [0] * pad, I32),
+                    jnp.asarray([it.limit for it in chunk] + [0] * pad, I64),
+                    jnp.asarray([it.remaining for it in chunk] + [0] * pad, I64),
+                    jnp.asarray([it.duration for it in chunk] + [0] * pad, I64),
+                    jnp.asarray([it.stamp for it in chunk] + [0] * pad, I64),
+                    jnp.asarray([it.expire_at for it in chunk] + [0] * pad, I64),
+                    jnp.asarray([it.status for it in chunk] + [0] * pad, I32),
+                )
+                n += len(chunk)
+        return n
+
+    def snapshot(self, include_expired: bool = False) -> List[BucketSnapshot]:
+        """Dump live rows (reference: gubernator.go:86-105 Close/save path)."""
+        out: List[BucketSnapshot] = []
+        now = millisecond_now()
+        with self._lock:
+            entries = self.directory.items()
+            for start in range(0, len(entries), self.max_width):
+                chunk = entries[start:start + self.max_width]
+                slots = jnp.asarray([s for _, s in chunk], I32)
+                cols = [np.asarray(c) for c in self._gather(self.state, slots)]
+                for j, (key, _) in enumerate(chunk):
+                    algo = int(cols[0][j])
+                    expire = int(cols[5][j])
+                    if algo < 0:
+                        continue
+                    if not include_expired and now > expire:
+                        continue
+                    out.append(BucketSnapshot(
+                        key=key, algo=algo, limit=int(cols[1][j]),
+                        remaining=int(cols[2][j]), duration=int(cols[3][j]),
+                        stamp=int(cols[4][j]), expire_at=expire,
+                        status=int(cols[6][j])))
+        return out
+
+    def close(self) -> None:
+        """Persist via the Loader, mirroring daemon shutdown
+        (reference: gubernator.go:86-105)."""
+        if self.loader is not None:
+            self.loader.save(self.snapshot())
+
+    # ------------------------------------------------------------- internals
+
+    def _apply_round(self, round_work, now_ms, responses) -> None:
+        n = len(round_work)
+        keys = [item[1].hash_key() for item in round_work]
+        slots, fresh = self.directory.lookup(keys)
+
+        if self.store is not None:
+            fresh = self._store_read_through(round_work, keys, slots, fresh, now_ms)
+
+        w = _bucket_width(n, self.min_width, self.max_width)
+        pad = w - n
+        reqs = ReqBatch(
+            slot=jnp.asarray(slots + [-1] * pad, I32),
+            hits=jnp.asarray([it[1].hits for it in round_work] + [0] * pad, I64),
+            limit=jnp.asarray([it[1].limit for it in round_work] + [0] * pad, I64),
+            duration=jnp.asarray([it[1].duration for it in round_work] + [0] * pad, I64),
+            algorithm=jnp.asarray(
+                [int(it[1].algorithm) for it in round_work] + [0] * pad, I32),
+            behavior=jnp.asarray(
+                [int(it[1].behavior) for it in round_work] + [0] * pad, I32),
+            greg_expire=jnp.asarray([it[2] for it in round_work] + [0] * pad, I64),
+            greg_interval=jnp.asarray([it[3] for it in round_work] + [0] * pad, I64),
+            fresh=jnp.asarray(fresh + [False] * pad, jnp.bool_),
+        )
+        self.state, resp = self._decide(self.state, reqs, now_ms)
+
+        status = np.asarray(resp.status[:n])
+        limit = np.asarray(resp.limit[:n])
+        remaining = np.asarray(resp.remaining[:n])
+        reset = np.asarray(resp.reset_time[:n])
+        for j, (i, _r, _ge, _gi) in enumerate(round_work):
+            st = int(status[j])
+            if st == 1:
+                self.stats.over_limit += 1
+            responses[i] = RateLimitResp(
+                status=st, limit=int(limit[j]), remaining=int(remaining[j]),
+                reset_time=int(reset[j]))
+
+        if self.store is not None:
+            self._store_write_through(round_work, keys, slots, now_ms)
+
+    def _store_read_through(self, round_work, keys, slots, fresh, now_ms):
+        """Consult the store for rows the table can't serve
+        (reference: algorithms.go:26-33)."""
+        slot_arr = jnp.asarray(slots, I32)
+        algo_c, _, _, _, _, exp_c, _ = (np.asarray(c) for c in
+                                        self._gather(self.state, slot_arr))
+        inj = {"slot": [], "algo": [], "limit": [], "remaining": [],
+               "duration": [], "stamp": [], "expire_at": [], "status": []}
+        fresh = list(fresh)
+        for j, (i, r, _ge, _gi) in enumerate(round_work):
+            live = not fresh[j] and int(algo_c[j]) >= 0 and now_ms <= int(exp_c[j])
+            if live and int(algo_c[j]) != int(r.algorithm):
+                # algorithm switch discards the old bucket everywhere
+                # (reference: algorithms.go:54-62)
+                self.store.remove(keys[j])
+                live = False
+            if live:
+                continue
+            item = self.store.get(r)
+            if item is None:
+                continue
+            inj["slot"].append(slots[j])
+            inj["algo"].append(item.algo)
+            inj["limit"].append(item.limit)
+            inj["remaining"].append(item.remaining)
+            inj["duration"].append(item.duration)
+            inj["stamp"].append(item.stamp)
+            inj["expire_at"].append(item.expire_at)
+            inj["status"].append(item.status)
+            fresh[j] = False  # the injected row is now live
+        if inj["slot"]:
+            m = len(inj["slot"])
+            w = _bucket_width(m, self.min_width, self.max_width)
+            pad = w - m
+            self.state = self._inject(
+                self.state,
+                jnp.asarray(inj["slot"] + [-1] * pad, I32),
+                jnp.asarray(inj["algo"] + [0] * pad, I32),
+                jnp.asarray(inj["limit"] + [0] * pad, I64),
+                jnp.asarray(inj["remaining"] + [0] * pad, I64),
+                jnp.asarray(inj["duration"] + [0] * pad, I64),
+                jnp.asarray(inj["stamp"] + [0] * pad, I64),
+                jnp.asarray(inj["expire_at"] + [0] * pad, I64),
+                jnp.asarray(inj["status"] + [0] * pad, I32),
+            )
+        return fresh
+
+    def _store_write_through(self, round_work, keys, slots, now_ms):
+        """Report post-decision rows (reference: algorithms.go:64-68,175-177);
+        discarded buckets get `remove` (reference: algorithms.go:37-39,57-59)."""
+        slot_arr = jnp.asarray(slots, I32)
+        cols = [np.asarray(c) for c in self._gather(self.state, slot_arr)]
+        for j, (i, r, _ge, _gi) in enumerate(round_work):
+            algo = int(cols[0][j])
+            if algo < 0:
+                # token RESET_REMAINING cleared the row
+                self.store.remove(keys[j])
+                self.directory.drop(keys[j])
+                continue
+            self.store.on_change(r, BucketSnapshot(
+                key=keys[j], algo=algo, limit=int(cols[1][j]),
+                remaining=int(cols[2][j]), duration=int(cols[3][j]),
+                stamp=int(cols[4][j]), expire_at=int(cols[5][j]),
+                status=int(cols[6][j])))

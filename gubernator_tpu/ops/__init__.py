@@ -1,0 +1,17 @@
+from gubernator_tpu.ops.decide import (
+    ReqBatch,
+    RespBatch,
+    TableState,
+    decide,
+    make_decide_jit,
+    make_table,
+)
+
+__all__ = [
+    "TableState",
+    "ReqBatch",
+    "RespBatch",
+    "decide",
+    "make_decide_jit",
+    "make_table",
+]

@@ -1,0 +1,344 @@
+"""The batched rate-limit decision kernel.
+
+This is the TPU-native replacement for the reference's per-key bucket state
+machines (reference: algorithms.go:24-336). Where the reference walks one
+request at a time through branchy Go code under a global cache mutex
+(reference: gubernator.go:327-347), here the whole batch window is a single
+branchless masked tensor program:
+
+    gather state rows -> compute token & leaky paths as mask lattices
+                      -> select -> scatter rows back
+
+State is struct-of-arrays in HBM: seven columns per slot. At 10M keys this is
+~440 MB — resident on one chip, shardable across a mesh (parallel/).
+
+Semantics are bit-exact with the reference's integer math (the reference's
+leaky bucket is already integer: ``rate = duration/limit`` and
+``leak = elapsed/rate`` are int64 divisions, algorithms.go:214,235), with a
+small set of deliberate bug-fix deviations documented in PARITY.md and
+mirrored by the oracle (ops/oracle.py) used to test this kernel.
+
+Batch-internal duplicate keys: the reference serializes all requests under a
+mutex, so two hits to one key in a window observe each other. A scatter with
+duplicate indices cannot express the OVER_LIMIT-doesn't-deduct rule
+(algorithms.go:125-129), so the engine (models/engine.py) splits a window
+into collision-free *rounds* — occurrence k of every key goes to round k.
+Almost all real windows are round-1-only.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+# State-column algorithm codes: table slots hold -1 when vacant.
+_VACANT = -1
+
+
+class TableState(NamedTuple):
+    """Struct-of-arrays bucket state; one row per key slot.
+
+    `stamp` is the token bucket's CreatedAt and the leaky bucket's UpdatedAt
+    (the reference keeps them in two different structs, store.go:11-24).
+    `status` persists the token bucket's sticky OVER_LIMIT
+    (algorithms.go:113-115).
+    """
+
+    algo: jax.Array  # i32[C]: -1 vacant, 0 token, 1 leaky
+    limit: jax.Array  # i64[C]
+    remaining: jax.Array  # i64[C]
+    duration: jax.Array  # i64[C] ms
+    stamp: jax.Array  # i64[C] unix ms
+    expire_at: jax.Array  # i64[C] unix ms (doubles as token ResetTime)
+    status: jax.Array  # i32[C]
+
+
+class ReqBatch(NamedTuple):
+    """One device-ready batch window of requests.
+
+    `slot` is the table row the host key-directory assigned; -1 marks padding
+    lanes (dropped on scatter). `fresh` is True when the directory newly
+    assigned (or recycled) the slot, so whatever the row holds is garbage.
+    `greg_expire`/`greg_interval` are host-precomputed calendar values, only
+    read when the DURATION_IS_GREGORIAN bit is set.
+    """
+
+    slot: jax.Array  # i32[B]
+    hits: jax.Array  # i64[B]
+    limit: jax.Array  # i64[B]
+    duration: jax.Array  # i64[B]
+    algorithm: jax.Array  # i32[B]
+    behavior: jax.Array  # i32[B]
+    greg_expire: jax.Array  # i64[B]
+    greg_interval: jax.Array  # i64[B]
+    fresh: jax.Array  # bool[B]
+
+
+class RespBatch(NamedTuple):
+    status: jax.Array  # i32[B]
+    limit: jax.Array  # i64[B]
+    remaining: jax.Array  # i64[B]
+    reset_time: jax.Array  # i64[B]
+
+
+def make_table(capacity: int) -> TableState:
+    """Fresh vacant table with `capacity` slots.
+
+    Each column gets its own buffer — sharing one zeros array across columns
+    breaks donation (the same buffer can't alias multiple outputs).
+    """
+    return TableState(
+        algo=jnp.full((capacity,), _VACANT, I32),
+        limit=jnp.zeros((capacity,), I64),
+        remaining=jnp.zeros((capacity,), I64),
+        duration=jnp.zeros((capacity,), I64),
+        stamp=jnp.zeros((capacity,), I64),
+        expire_at=jnp.zeros((capacity,), I64),
+        status=jnp.zeros((capacity,), I32),
+    )
+
+
+def _sel(default: jax.Array, *pairs) -> jax.Array:
+    """Chained masked select; later pairs win over earlier ones."""
+    out = default
+    for mask, val in pairs:
+        out = jnp.where(mask, val, out)
+    return out
+
+
+def decide(state: TableState, reqs: ReqBatch, now_ms: jax.Array) -> Tuple[TableState, RespBatch]:
+    """Apply one collision-free batch of requests to the table.
+
+    Pure function: returns the updated table and per-request responses.
+    All requests in the batch must target distinct slots (engine guarantees
+    via rounds); padding lanes carry slot == -1.
+    """
+    now = jnp.asarray(now_ms, I64)
+    slot = reqs.slot
+    active = slot >= 0
+    gslot = jnp.maximum(slot, 0)  # clipped gather index for padding lanes
+
+    st_algo = state.algo[gslot]
+    st_limit = state.limit[gslot]
+    st_rem = state.remaining[gslot]
+    st_dur = state.duration[gslot]
+    st_stamp = state.stamp[gslot]
+    st_exp = state.expire_at[gslot]
+    st_status = state.status[gslot]
+
+    r_hits = reqs.hits
+    r_limit = reqs.limit
+    r_dur = reqs.duration
+    is_tok = reqs.algorithm == Algorithm.TOKEN_BUCKET
+    greg = (reqs.behavior & Behavior.DURATION_IS_GREGORIAN) != 0
+    reset_rem = (reqs.behavior & Behavior.RESET_REMAINING) != 0
+    peek = r_hits == 0
+
+    OVER = jnp.asarray(Status.OVER_LIMIT, I32)
+    UNDER = jnp.asarray(Status.UNDER_LIMIT, I32)
+
+    # A slot is a hit only if occupied, unexpired (expiry-on-read,
+    # cache.go:140-165) and running the same algorithm (an algorithm switch
+    # recreates the bucket, algorithms.go:54-62,195-203).
+    occupied = active & (~reqs.fresh) & (st_algo >= 0)
+    alive = occupied & (now <= st_exp) & (st_algo == reqs.algorithm)
+
+    # ---------------- token bucket, existing row (algorithms.go:35-134) ----
+    tok_reset = alive & is_tok & reset_rem  # expire the bucket entirely
+    lim_changed = st_limit != r_limit
+    t_rem0 = jnp.where(lim_changed, jnp.minimum(st_rem, r_limit), st_rem)
+    dur_changed = st_dur != r_dur
+    t_new_exp = jnp.where(greg, reqs.greg_expire, st_stamp + r_dur)
+    # a duration change that lands the bucket in the past recreates it
+    # (algorithms.go:95-101)
+    tok_recreate = alive & is_tok & ~reset_rem & dur_changed & (t_new_exp < now)
+    tok_exists = alive & is_tok & ~reset_rem & ~tok_recreate
+    te_exp = jnp.where(dur_changed, t_new_exp, st_exp)
+    t_rem_zero = t_rem0 == 0
+    t_over_req = r_hits > t_rem0  # reject without deducting (algorithms.go:125-129)
+    t_deduct = (~peek) & (~t_rem_zero) & (~t_over_req)
+    te_rem = jnp.where(t_deduct, t_rem0 - r_hits, t_rem0)
+    te_status_resp = jnp.where((~peek) & (t_rem_zero | t_over_req), OVER, st_status)
+    # only draining to zero persists OVER on the row (algorithms.go:112-115)
+    te_status_store = jnp.where((~peek) & t_rem_zero, OVER, st_status)
+
+    # ---------------- token bucket, vacant/recreate (algorithms.go:136-178) -
+    tok_miss = active & is_tok & (~alive | tok_recreate)
+    m_exp = jnp.where(greg, reqs.greg_expire, now + r_dur)
+    m_over = r_hits > r_limit
+    # first request over the limit: reject but store an *undrained* bucket
+    # (algorithms.go:160-165)
+    m_rem = jnp.where(m_over, r_limit, r_limit - r_hits)
+
+    # ---------------- leaky bucket, existing row (algorithms.go:194-289) ----
+    leak_exists = alive & ~is_tok
+    l_rem0 = jnp.where(reset_rem, r_limit, st_rem)
+    l_dur = jnp.where(greg, reqs.greg_expire - now, r_dur)
+    l_rate = jnp.maximum(
+        jnp.where(greg, reqs.greg_interval, r_dur) // jnp.maximum(r_limit, 1), 1
+    )
+    elapsed = jnp.maximum(now - st_stamp, 0)
+    l_rem1 = jnp.minimum(r_limit, l_rem0 + elapsed // l_rate)
+    l_rem_zero = l_rem1 == 0
+    l_over_req = r_hits > l_rem1
+    l_deduct = (~peek) & (~l_rem_zero) & (~l_over_req)
+    le_rem = jnp.where(l_deduct, l_rem1 - r_hits, l_rem1)
+    # an empty bucket rejects *without* consuming the leak residue
+    # (UpdatedAt held back, algorithms.go:255-264)
+    le_stamp = jnp.where((~l_rem_zero) & (~peek), now, st_stamp)
+    le_status = jnp.where(l_rem_zero | ((~peek) & l_over_req), OVER, UNDER)
+    le_exp = jnp.where(l_deduct, now + l_dur, st_exp)
+
+    # ---------------- leaky bucket, vacant (algorithms.go:291-336) ----------
+    leak_miss = active & (~is_tok) & ~alive
+    lm_dur = jnp.where(greg, reqs.greg_expire - now, r_dur)
+    lm_rate = jnp.maximum(lm_dur // jnp.maximum(r_limit, 1), 1)
+    lm_over = r_hits > r_limit
+    lm_rem = jnp.where(lm_over, jnp.zeros_like(r_limit), r_limit - r_hits)
+
+    # ---------------- select new state ------------------------------------
+    n_algo = _sel(
+        st_algo,
+        (tok_exists | tok_miss, jnp.asarray(Algorithm.TOKEN_BUCKET, I32)),
+        (leak_exists | leak_miss, jnp.asarray(Algorithm.LEAKY_BUCKET, I32)),
+        (tok_reset, jnp.asarray(_VACANT, I32)),
+    )
+    touched = tok_exists | tok_miss | leak_exists | leak_miss
+    n_limit = jnp.where(touched, r_limit, st_limit)
+    n_rem = _sel(
+        st_rem,
+        (tok_exists, te_rem),
+        (tok_miss, m_rem),
+        (leak_exists, le_rem),
+        (leak_miss, lm_rem),
+    )
+    n_dur = _sel(
+        st_dur,
+        (tok_exists | tok_miss, r_dur),
+        (leak_exists, l_dur),
+        (leak_miss, lm_dur),
+    )
+    n_stamp = _sel(
+        st_stamp,
+        (tok_miss | leak_miss, now),
+        (leak_exists, le_stamp),
+    )
+    n_exp = _sel(
+        st_exp,
+        (tok_exists, te_exp),
+        (tok_miss, m_exp),
+        (leak_exists, le_exp),
+        (leak_miss, now + lm_dur),
+    )
+    n_status = _sel(
+        st_status,
+        (tok_exists, te_status_store),
+        (tok_miss | leak_miss, UNDER),
+    )
+
+    new_state = TableState(
+        algo=state.algo.at[slot].set(n_algo, mode="drop"),
+        limit=state.limit.at[slot].set(n_limit, mode="drop"),
+        remaining=state.remaining.at[slot].set(n_rem, mode="drop"),
+        duration=state.duration.at[slot].set(n_dur, mode="drop"),
+        stamp=state.stamp.at[slot].set(n_stamp, mode="drop"),
+        expire_at=state.expire_at.at[slot].set(n_exp, mode="drop"),
+        status=state.status.at[slot].set(n_status, mode="drop"),
+    )
+
+    # ---------------- select response --------------------------------------
+    z64 = jnp.zeros_like(r_limit)
+    resp = RespBatch(
+        status=_sel(
+            jnp.zeros_like(st_status),
+            (tok_exists, te_status_resp),
+            (tok_miss, jnp.where(m_over, OVER, UNDER)),
+            (leak_exists, le_status),
+            (leak_miss, jnp.where(lm_over, OVER, UNDER)),
+            (tok_reset, UNDER),
+        ),
+        limit=jnp.where(active, r_limit, z64),
+        remaining=_sel(
+            z64,
+            (tok_exists, te_rem),
+            (tok_miss, m_rem),
+            (leak_exists, le_rem),
+            (leak_miss, lm_rem),
+            (tok_reset, r_limit),
+        ),
+        reset_time=_sel(
+            z64,
+            (tok_exists, te_exp),
+            (tok_miss, m_exp),
+            (leak_exists, now + l_rate),
+            (leak_miss, now + lm_rate),
+            (tok_reset, z64),
+        ),
+    )
+    return new_state, resp
+
+
+def make_decide_jit(donate: bool = None):
+    """Compiled decide(). Donating the table keeps the 7 HBM columns in place
+    across windows instead of allocating a fresh ~56B/key copy per call —
+    but some backends reject donation, so probe unless told."""
+    if donate is None:
+        from gubernator_tpu.utils.platform import donation_supported
+
+        donate = donation_supported()
+    return jax.jit(decide, donate_argnums=(0,) if donate else ())
+
+
+def pad_batch(reqs: ReqBatch, to_size: int) -> ReqBatch:
+    """Pad a host-built batch to a bucketed size to bound recompilation."""
+    b = reqs.slot.shape[0]
+    if b == to_size:
+        return reqs
+    pad = to_size - b
+
+    def _pad(x, fill):
+        return jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+
+    return ReqBatch(
+        slot=_pad(reqs.slot, -1),
+        hits=_pad(reqs.hits, 0),
+        limit=_pad(reqs.limit, 0),
+        duration=_pad(reqs.duration, 0),
+        algorithm=_pad(reqs.algorithm, 0),
+        behavior=_pad(reqs.behavior, 0),
+        greg_expire=_pad(reqs.greg_expire, 0),
+        greg_interval=_pad(reqs.greg_interval, 0),
+        fresh=_pad(reqs.fresh, False),
+    )
+
+
+def batch_from_columns(
+    slot: Sequence[int],
+    hits: Sequence[int],
+    limit: Sequence[int],
+    duration: Sequence[int],
+    algorithm: Sequence[int],
+    behavior: Sequence[int],
+    greg_expire: Sequence[int],
+    greg_interval: Sequence[int],
+    fresh: Sequence[bool],
+) -> ReqBatch:
+    """Build a device batch from host lists (numpy staging happens in jnp)."""
+    return ReqBatch(
+        slot=jnp.asarray(slot, I32),
+        hits=jnp.asarray(hits, I64),
+        limit=jnp.asarray(limit, I64),
+        duration=jnp.asarray(duration, I64),
+        algorithm=jnp.asarray(algorithm, I32),
+        behavior=jnp.asarray(behavior, I32),
+        greg_expire=jnp.asarray(greg_expire, I64),
+        greg_interval=jnp.asarray(greg_interval, I64),
+        fresh=jnp.asarray(fresh, jnp.bool_),
+    )

@@ -1,0 +1,107 @@
+"""Persistence SPI: Store (continuous) and Loader (startup/shutdown).
+
+Mirrors the reference's pluggable persistence interfaces
+(reference: store.go:29-58): users who want rate-limit state to survive
+restarts implement one of these; the framework ships only in-memory mocks,
+exactly like the reference.
+
+The unit of persistence is a `BucketSnapshot` — one row of the device key
+table in host form. The engine:
+
+- read-through: consults `Store.get` when a key misses the device table
+  (directory miss, expired or vacant row) and injects the returned row
+  before deciding (reference: algorithms.go:26-33,185-192);
+- write-through: calls `Store.on_change` with the post-decision row after
+  every mutating request (reference: algorithms.go:64-68,175-177);
+- calls `Store.remove` when a bucket is discarded (RESET_REMAINING or an
+  algorithm switch, reference: algorithms.go:37-39,57-59);
+- bulk `Loader.load` at startup and `Loader.save` at shutdown
+  (reference: gubernator.go:75-83,95-104).
+"""
+
+from __future__ import annotations
+
+import abc
+import dataclasses
+from typing import Iterable, List, Optional
+
+from gubernator_tpu.types import RateLimitReq
+
+
+@dataclasses.dataclass
+class BucketSnapshot:
+    """Host-side image of one key-table row (see ops/decide.py TableState)."""
+
+    key: str
+    algo: int  # 0 token, 1 leaky
+    limit: int
+    remaining: int
+    duration: int
+    stamp: int  # token CreatedAt / leaky UpdatedAt (unix ms)
+    expire_at: int  # unix ms
+    status: int = 0
+
+
+class Store(abc.ABC):
+    """Continuous write-through/read-through persistence."""
+
+    @abc.abstractmethod
+    def on_change(self, req: RateLimitReq, item: BucketSnapshot) -> None:
+        """Called after every mutation of the key's bucket."""
+
+    @abc.abstractmethod
+    def get(self, req: RateLimitReq) -> Optional[BucketSnapshot]:
+        """Called on a table miss; return the persisted row or None."""
+
+    @abc.abstractmethod
+    def remove(self, key: str) -> None:
+        """Called when a bucket is discarded."""
+
+
+class Loader(abc.ABC):
+    """Bulk snapshot persistence at startup/shutdown."""
+
+    @abc.abstractmethod
+    def load(self) -> Iterable[BucketSnapshot]:
+        """Yield rows to seed the table at startup."""
+
+    @abc.abstractmethod
+    def save(self, items: Iterable[BucketSnapshot]) -> None:
+        """Persist all live rows at shutdown."""
+
+
+class MockStore(Store):
+    """In-memory Store with call counting, for tests and as a template
+    (reference: store.go:60-92)."""
+
+    def __init__(self):
+        self.called = {"get": 0, "on_change": 0, "remove": 0}
+        self.data = {}
+
+    def on_change(self, req: RateLimitReq, item: BucketSnapshot) -> None:
+        self.called["on_change"] += 1
+        self.data[item.key] = item
+
+    def get(self, req: RateLimitReq) -> Optional[BucketSnapshot]:
+        self.called["get"] += 1
+        return self.data.get(req.hash_key())
+
+    def remove(self, key: str) -> None:
+        self.called["remove"] += 1
+        self.data.pop(key, None)
+
+
+class MockLoader(Loader):
+    """In-memory Loader with call counting (reference: store.go:94-130)."""
+
+    def __init__(self, contents: Optional[List[BucketSnapshot]] = None):
+        self.called = {"load": 0, "save": 0}
+        self.contents: List[BucketSnapshot] = list(contents or [])
+
+    def load(self) -> Iterable[BucketSnapshot]:
+        self.called["load"] += 1
+        return list(self.contents)
+
+    def save(self, items: Iterable[BucketSnapshot]) -> None:
+        self.called["save"] += 1
+        self.contents = list(items)

@@ -1,0 +1,142 @@
+"""Public wire-level types.
+
+Mirrors the reference proto contract (reference: proto/gubernator.proto:56-220,
+proto/peers.proto:28-57) so a gubernator client can talk to this service
+unchanged. Field numbers and enum values are part of the wire contract and
+must match; everything else here is our own.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, List, Optional
+
+
+class Algorithm(enum.IntEnum):
+    """Bucket algorithm selector (reference: proto/gubernator.proto:56-62)."""
+
+    TOKEN_BUCKET = 0
+    LEAKY_BUCKET = 1
+
+
+class Behavior(enum.IntFlag):
+    """Per-request behavior bitflags (reference: proto/gubernator.proto:65-131).
+
+    These ride on every request — the service itself is stateless with
+    respect to rate-limit configuration.
+    """
+
+    BATCHING = 0  # default; no-op flag
+    NO_BATCHING = 1
+    GLOBAL = 2
+    DURATION_IS_GREGORIAN = 4
+    RESET_REMAINING = 8
+    MULTI_REGION = 16
+
+
+class Status(enum.IntEnum):
+    """Rate limit decision (reference: proto/gubernator.proto:161-164)."""
+
+    UNDER_LIMIT = 0
+    OVER_LIMIT = 1
+
+
+def has_behavior(behavior: int, flag: Behavior) -> bool:
+    """True if `flag` is set (reference: gubernator.go:456-461)."""
+    return bool(behavior & flag)
+
+
+def set_behavior(behavior: int, flag: Behavior, on: bool) -> int:
+    """Return `behavior` with `flag` set or cleared (reference: gubernator.go:463-468)."""
+    return (behavior | flag) if on else (behavior & ~flag)
+
+
+def hash_key(name: str, unique_key: str) -> str:
+    """The canonical rate-limit key: ``name + "_" + unique_key``
+    (reference: client.go:33-35)."""
+    return name + "_" + unique_key
+
+
+@dataclasses.dataclass
+class RateLimitReq:
+    """One rate-limit request (reference: proto/gubernator.proto:134-159)."""
+
+    name: str = ""
+    unique_key: str = ""
+    hits: int = 0
+    limit: int = 0
+    duration: int = 0  # milliseconds, or a Gregorian interval code when
+    # Behavior.DURATION_IS_GREGORIAN is set
+    algorithm: int = Algorithm.TOKEN_BUCKET
+    behavior: int = 0
+
+    def hash_key(self) -> str:
+        return hash_key(self.name, self.unique_key)
+
+
+@dataclasses.dataclass
+class RateLimitResp:
+    """One rate-limit decision (reference: proto/gubernator.proto:166-180)."""
+
+    status: int = Status.UNDER_LIMIT
+    limit: int = 0
+    remaining: int = 0
+    reset_time: int = 0  # unix ms when the limit span resets
+    error: str = ""
+    metadata: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class HealthCheckResp:
+    """Service health (reference: proto/gubernator.proto:183-189)."""
+
+    status: str = "healthy"  # 'healthy' | 'unhealthy'
+    message: str = ""
+    peer_count: int = 0
+
+
+@dataclasses.dataclass
+class PeerInfo:
+    """One cluster member (reference: etcd.go:30-40)."""
+
+    address: str = ""
+    datacenter: str = ""
+    is_owner: bool = False  # True only for the local instance's own entry
+
+
+@dataclasses.dataclass
+class UpdatePeerGlobal:
+    """Owner-broadcast global rate-limit status (reference: proto/peers.proto:49-53)."""
+
+    key: str = ""
+    status: Optional[RateLimitResp] = None
+    algorithm: int = Algorithm.TOKEN_BUCKET
+
+
+# Batch caps (reference: gubernator.go:34, config.go:86-88).
+MAX_BATCH_SIZE = 1000
+
+
+def validate_request(req: RateLimitReq) -> str:
+    """Return an error string for an invalid request, else "".
+
+    (reference: gubernator.go:137-147 — empty unique_key / name are
+    per-request errors, not call failures.)
+    """
+    if not req.unique_key:
+        return "field 'unique_key' cannot be empty"
+    if not req.name:
+        return "field 'namespace' cannot be empty"
+    return ""
+
+
+def batch_error(n: int) -> Optional[str]:
+    """Whole-call error when a batch exceeds the cap (reference: gubernator.go:113-116)."""
+    if n > MAX_BATCH_SIZE:
+        return f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'"
+    return None
+
+
+GetRateLimitsReq = List[RateLimitReq]
+GetRateLimitsResp = List[RateLimitResp]

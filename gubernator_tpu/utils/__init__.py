@@ -1,0 +1,26 @@
+from gubernator_tpu.utils.gregorian import (
+    GREGORIAN_DAYS,
+    GREGORIAN_HOURS,
+    GREGORIAN_MINUTES,
+    GREGORIAN_MONTHS,
+    GREGORIAN_WEEKS,
+    GREGORIAN_YEARS,
+    GregorianError,
+    gregorian_duration,
+    gregorian_expiration,
+)
+from gubernator_tpu.utils.interval import Interval, millisecond_now
+
+__all__ = [
+    "GREGORIAN_MINUTES",
+    "GREGORIAN_HOURS",
+    "GREGORIAN_DAYS",
+    "GREGORIAN_WEEKS",
+    "GREGORIAN_MONTHS",
+    "GREGORIAN_YEARS",
+    "GregorianError",
+    "gregorian_duration",
+    "gregorian_expiration",
+    "Interval",
+    "millisecond_now",
+]

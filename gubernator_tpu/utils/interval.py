@@ -1,0 +1,54 @@
+"""Re-armable one-shot interval timer.
+
+All batching loops in the framework (request micro-batching, GLOBAL sync
+windows, broadcast windows) share this primitive: arm it when the first item
+enters an empty queue, flush when it fires or when the batch cap is reached
+(reference: interval.go:26-69 and its use at peer_client.go:243-283,
+global.go:73-112).
+
+Unlike a periodic ticker, `next()` schedules exactly one tick `interval`
+seconds later; nothing fires unless armed.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Optional
+
+
+def millisecond_now() -> int:
+    """Unix time in milliseconds (reference: client.go:62-65)."""
+    return time.time_ns() // 1_000_000
+
+
+class Interval:
+    def __init__(self, interval_s: float):
+        self._interval = interval_s
+        self._timer: Optional[threading.Timer] = None
+        self._lock = threading.Lock()
+        #: fires () when an armed tick elapses; consume with `.get()`
+        self.c: "queue.Queue[bool]" = queue.Queue()
+        self._closed = False
+
+    def next(self) -> None:
+        """Arm one tick `interval` seconds from now, replacing any armed tick."""
+        with self._lock:
+            if self._closed:
+                return
+            if self._timer is not None:
+                self._timer.cancel()
+            self._timer = threading.Timer(self._interval, self._fire)
+            self._timer.daemon = True
+            self._timer.start()
+
+    def _fire(self) -> None:
+        self.c.put(True)
+
+    def stop(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._timer is not None:
+                self._timer.cancel()
+                self._timer = None

@@ -1,0 +1,117 @@
+"""Host-side LRU cache with TTL + invalidation semantics.
+
+The device key table (models/keyspace.py) is the authoritative state store in
+this framework; this host LRU fills the remaining roles the reference's cache
+plays (reference: cache.go:32-220):
+
+- the non-owner local cache of GLOBAL rate-limit statuses
+  (reference: gubernator.go:226-264);
+- the `Cache` SPI surface for embedders;
+- hit/miss/size stats for metrics.
+
+Semantics mirrored from the reference: expiry-on-read (an expired item is a
+miss and is dropped), `invalid_at` soft invalidation, `update_expiration`,
+capacity eviction of the least-recently-used entry, and iteration for
+Loader.save snapshots. Default capacity 50k (reference: cache.go:82-84).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Any, Iterator, Optional
+
+from gubernator_tpu.utils.interval import millisecond_now
+
+
+@dataclasses.dataclass
+class CacheItem:
+    key: str = ""
+    value: Any = None
+    # unix ms when the item is dead and reads treat it as missing
+    expire_at: int = 0
+    # unix ms after which the item is *suspect* (used by async updates);
+    # 0 disables (reference: cache.go:69-76)
+    invalid_at: int = 0
+    algorithm: int = 0
+
+
+class LRUCache:
+    """Thread-safe LRU with TTL. Callers may also use .lock for multi-op
+    critical sections (the reference exposes Lock/Unlock on the interface,
+    cache.go:41-42)."""
+
+    def __init__(self, max_size: int = 50_000):
+        self._max = max_size if max_size > 0 else 50_000
+        self._od: "OrderedDict[str, CacheItem]" = OrderedDict()
+        self.lock = threading.RLock()
+        # stats for metrics exposition (reference: cache.go:45-51)
+        self.stat_hit = 0
+        self.stat_miss = 0
+        self.stat_unexpired_evictions = 0
+
+    def __len__(self) -> int:
+        with self.lock:
+            return len(self._od)
+
+    def add(self, item: CacheItem) -> bool:
+        """Insert/replace; returns True if the key already existed
+        (reference: cache.go:117-133)."""
+        with self.lock:
+            existed = item.key in self._od
+            self._od[item.key] = item
+            self._od.move_to_end(item.key)
+            if len(self._od) > self._max:
+                _, old = self._od.popitem(last=False)
+                if old.expire_at == 0 or old.expire_at > millisecond_now():
+                    self.stat_unexpired_evictions += 1
+            return existed
+
+    def get_item(self, key: str) -> Optional[CacheItem]:
+        """Expiry-on-read lookup (reference: cache.go:140-165)."""
+        with self.lock:
+            item = self._od.get(key)
+            if item is None:
+                self.stat_miss += 1
+                return None
+            now = millisecond_now()
+            if item.invalid_at != 0 and item.invalid_at < now:
+                self._od.pop(key, None)
+                self.stat_miss += 1
+                return None
+            if item.expire_at != 0 and item.expire_at < now:
+                self._od.pop(key, None)
+                self.stat_miss += 1
+                return None
+            self.stat_hit += 1
+            self._od.move_to_end(key)
+            return item
+
+    def peek(self, key: str) -> Optional[CacheItem]:
+        """Lookup without recency/stat effects."""
+        with self.lock:
+            return self._od.get(key)
+
+    def remove(self, key: str) -> None:
+        with self.lock:
+            self._od.pop(key, None)
+
+    def update_expiration(self, key: str, expire_at: int) -> bool:
+        """(reference: cache.go:96-102 UpdateExpiration)"""
+        with self.lock:
+            item = self._od.get(key)
+            if item is None:
+                return False
+            item.expire_at = expire_at
+            return True
+
+    def each(self) -> Iterator[CacheItem]:
+        """Snapshot iteration (reference: cache.go Each) — used by
+        Loader.save at shutdown."""
+        with self.lock:
+            items = list(self._od.values())
+        return iter(items)
+
+    def size(self) -> int:
+        return len(self)

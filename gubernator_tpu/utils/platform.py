@@ -1,0 +1,26 @@
+"""Backend capability probes.
+
+Buffer donation lets the decision kernel update the key table in place
+(~56 B/key saved per window at 10M keys), but not every PJRT backend
+supports it — notably CPU and tunneled single-chip TPU backends
+(jax 'axon') reject donated buffers at dispatch. Probe once with a
+throwaway array instead of hardcoding a platform list.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=None)
+def donation_supported() -> bool:
+    try:
+        f = jax.jit(lambda x: x + 1, donate_argnums=0)
+        y = f(jnp.zeros((8,), jnp.int64))
+        y.block_until_ready()
+        return True
+    except Exception:
+        return False

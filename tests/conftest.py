@@ -1,0 +1,21 @@
+"""Test env: force an 8-device virtual CPU mesh before JAX initializes.
+
+Mirrors the reference's test strategy of N real in-process servers on
+loopback (reference: cluster/cluster.go, functional_test.go:35-49) — here the
+"cluster" is 8 virtual XLA CPU devices, so mesh sharding and collectives run
+for real without TPU hardware.
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+if not os.environ.get("GUBER_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # Plugins (jaxtyping) may import jax before this conftest runs, freezing
+    # the env-derived default; override the live config too.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,344 @@
+"""Decision-kernel tests: scenario ports of the reference's functional tests
+plus randomized kernel-vs-oracle equivalence.
+
+Scenario sources: token bucket sequences (reference: functional_test.go:51-148),
+leaky bucket drain (:150-209), config hot-change (:347-433), RESET_REMAINING
+(:435-505). Times are simulated — the kernel takes `now` as an input, so no
+sleeps are needed (the reference sleeps real wall-clock).
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from gubernator_tpu.ops import decide, make_table
+from gubernator_tpu.ops.decide import batch_from_columns
+from gubernator_tpu.ops.oracle import oracle_decide
+from gubernator_tpu.types import Algorithm, Behavior, Status
+
+# One shared compiled kernel at a fixed padded batch width: eager-mode
+# per-primitive CPU compiles are pathologically slow, and prod always runs
+# jitted at bucketed widths anyway.
+_PAD = 8
+_DECIDE = jax.jit(decide)
+
+
+def padded_batch(cols):
+    n = len(cols["slot"])
+    pad = _PAD * ((n + _PAD - 1) // _PAD) - n
+    return batch_from_columns(
+        cols["slot"] + [-1] * pad,
+        cols["hits"] + [0] * pad,
+        cols["limit"] + [0] * pad,
+        cols["duration"] + [0] * pad,
+        cols["algorithm"] + [0] * pad,
+        cols["behavior"] + [0] * pad,
+        cols["greg_expire"] + [0] * pad,
+        cols["greg_interval"] + [0] * pad,
+        cols["fresh"] + [False] * pad,
+    )
+
+
+class Harness:
+    """Single-key-at-a-time harness: host slot directory over the kernel."""
+
+    def __init__(self, capacity=64):
+        self.state = make_table(capacity)
+        self.dir = {}
+
+    def hit(self, key, *, hits, limit, duration, algorithm=Algorithm.TOKEN_BUCKET,
+            behavior=0, now=0, greg_expire=0, greg_interval=0):
+        fresh = key not in self.dir
+        if fresh:
+            self.dir[key] = len(self.dir)
+        slot = self.dir[key]
+        reqs = padded_batch(dict(
+            slot=[slot], hits=[hits], limit=[limit], duration=[duration],
+            algorithm=[int(algorithm)], behavior=[int(behavior)],
+            greg_expire=[greg_expire], greg_interval=[greg_interval],
+            fresh=[fresh],
+        ))
+        self.state, resp = _DECIDE(self.state, reqs, now)
+        return (
+            int(resp.status[0]),
+            int(resp.limit[0]),
+            int(resp.remaining[0]),
+            int(resp.reset_time[0]),
+        )
+
+
+class TestTokenBucket:
+    def test_over_limit_sequence(self):
+        h = Harness()
+        now = 1_000_000
+        # limit 2 per 1s window: hit, hit, reject (functional_test.go:51-96)
+        assert h.hit("a", hits=1, limit=2, duration=1000, now=now) == (
+            Status.UNDER_LIMIT, 2, 1, now + 1000)
+        assert h.hit("a", hits=1, limit=2, duration=1000, now=now + 10)[:3] == (
+            Status.UNDER_LIMIT, 2, 0)
+        st, _, rem, _ = h.hit("a", hits=1, limit=2, duration=1000, now=now + 20)
+        assert (st, rem) == (Status.OVER_LIMIT, 0)
+        # after the window expires, the bucket refills
+        st, _, rem, reset = h.hit("a", hits=1, limit=2, duration=1000, now=now + 2000)
+        assert (st, rem, reset) == (Status.UNDER_LIMIT, 1, now + 3000)
+
+    def test_remaining_refill_on_new_window(self):
+        h = Harness()
+        now = 5_000_000
+        for i in range(5):
+            st, _, rem, _ = h.hit("k", hits=1, limit=5, duration=1000, now=now + i)
+            assert st == Status.UNDER_LIMIT
+            assert rem == 4 - i
+        st, *_ = h.hit("k", hits=1, limit=5, duration=1000, now=now + 10)
+        assert st == Status.OVER_LIMIT
+
+    def test_sticky_over_limit_on_peek(self):
+        h = Harness()
+        now = 1_000_000
+        h.hit("k", hits=1, limit=1, duration=60_000, now=now)
+        st, *_ = h.hit("k", hits=1, limit=1, duration=60_000, now=now + 1)
+        assert st == Status.OVER_LIMIT
+        # hits=0 peek reports the stored OVER_LIMIT (algorithms.go:107-115)
+        st, _, rem, _ = h.hit("k", hits=0, limit=1, duration=60_000, now=now + 2)
+        assert (st, rem) == (Status.OVER_LIMIT, 0)
+
+    def test_over_request_does_not_deduct(self):
+        h = Harness()
+        now = 1_000_000
+        h.hit("k", hits=10, limit=100, duration=60_000, now=now)
+        st, _, rem, _ = h.hit("k", hits=1000, limit=100, duration=60_000, now=now + 1)
+        assert (st, rem) == (Status.OVER_LIMIT, 90)
+        st, _, rem, _ = h.hit("k", hits=90, limit=100, duration=60_000, now=now + 2)
+        assert (st, rem) == (Status.UNDER_LIMIT, 0)
+
+    def test_first_request_over_limit(self):
+        h = Harness()
+        st, _, rem, _ = h.hit("k", hits=1000, limit=100, duration=60_000, now=1_000)
+        # rejected but stored undrained (algorithms.go:160-165)
+        assert (st, rem) == (Status.OVER_LIMIT, 100)
+        st, _, rem, _ = h.hit("k", hits=100, limit=100, duration=60_000, now=1_001)
+        assert (st, rem) == (Status.UNDER_LIMIT, 0)
+
+    def test_limit_hot_change(self):
+        h = Harness()
+        now = 1_000_000
+        h.hit("k", hits=1, limit=10, duration=60_000, now=now)
+        # raise limit: remaining preserved (functional_test.go:347-433)
+        st, lim, rem, _ = h.hit("k", hits=1, limit=20, duration=60_000, now=now + 1)
+        assert (st, lim, rem) == (Status.UNDER_LIMIT, 20, 8)
+        # lower limit below remaining: clamps
+        st, lim, rem, _ = h.hit("k", hits=1, limit=5, duration=60_000, now=now + 2)
+        assert (st, lim, rem) == (Status.UNDER_LIMIT, 5, 4)
+
+    def test_duration_hot_change(self):
+        h = Harness()
+        now = 1_000_000
+        _, _, _, reset0 = h.hit("k", hits=1, limit=10, duration=10_000, now=now)
+        assert reset0 == now + 10_000
+        # lengthen: new expiry anchored at CreatedAt (algorithms.go:86-104)
+        _, _, _, reset1 = h.hit("k", hits=1, limit=10, duration=60_000, now=now + 100)
+        assert reset1 == now + 60_000
+        # shrink so the bucket is already expired: recreated fresh
+        st, _, rem, reset2 = h.hit("k", hits=1, limit=10, duration=50, now=now + 100)
+        assert (st, rem, reset2) == (Status.UNDER_LIMIT, 9, now + 150)
+
+    def test_reset_remaining(self):
+        h = Harness()
+        now = 1_000_000
+        h.hit("k", hits=10, limit=10, duration=60_000, now=now)
+        st, *_ = h.hit("k", hits=1, limit=10, duration=60_000, now=now + 1)
+        assert st == Status.OVER_LIMIT
+        st, _, rem, reset = h.hit(
+            "k", hits=1, limit=10, duration=60_000,
+            behavior=Behavior.RESET_REMAINING, now=now + 2)
+        assert (st, rem, reset) == (Status.UNDER_LIMIT, 10, 0)
+        # bucket was deleted; next request recreates
+        st, _, rem, _ = h.hit("k", hits=4, limit=10, duration=60_000, now=now + 3)
+        assert (st, rem) == (Status.UNDER_LIMIT, 6)
+
+    def test_algorithm_switch_resets(self):
+        h = Harness()
+        now = 1_000_000
+        h.hit("k", hits=5, limit=10, duration=60_000, now=now)
+        st, _, rem, _ = h.hit(
+            "k", hits=1, limit=10, duration=60_000,
+            algorithm=Algorithm.LEAKY_BUCKET, now=now + 1)
+        assert (st, rem) == (Status.UNDER_LIMIT, 9)
+
+    def test_expired_bucket_recreated(self):
+        h = Harness()
+        h.hit("k", hits=10, limit=10, duration=1000, now=1_000)
+        st, _, rem, _ = h.hit("k", hits=1, limit=10, duration=1000, now=10_000)
+        assert (st, rem) == (Status.UNDER_LIMIT, 9)
+
+
+class TestLeakyBucket:
+    def test_drain(self):
+        h = Harness()
+        now = 1_000_000
+        # limit 10 per 10s -> 1 token leaks back per second
+        for i in range(10):
+            st, _, rem, _ = h.hit("k", hits=1, limit=10, duration=10_000,
+                                  algorithm=Algorithm.LEAKY_BUCKET, now=now)
+            assert st == Status.UNDER_LIMIT
+            assert rem == 9 - i
+        st, *_ = h.hit("k", hits=1, limit=10, duration=10_000,
+                       algorithm=Algorithm.LEAKY_BUCKET, now=now)
+        assert st == Status.OVER_LIMIT
+        # one rate period later exactly one token has leaked back
+        st, _, rem, reset = h.hit("k", hits=1, limit=10, duration=10_000,
+                                  algorithm=Algorithm.LEAKY_BUCKET, now=now + 1000)
+        assert (st, rem, reset) == (Status.UNDER_LIMIT, 0, now + 2000)
+
+    def test_full_refill_after_duration(self):
+        h = Harness()
+        now = 1_000_000
+        for _ in range(10):
+            h.hit("k", hits=1, limit=10, duration=10_000,
+                  algorithm=Algorithm.LEAKY_BUCKET, now=now)
+        st, _, rem, _ = h.hit("k", hits=1, limit=10, duration=10_000,
+                              algorithm=Algorithm.LEAKY_BUCKET, now=now + 10_000)
+        assert (st, rem) == (Status.UNDER_LIMIT, 9)
+
+    def test_reset_remaining_refills(self):
+        h = Harness()
+        now = 1_000_000
+        for _ in range(10):
+            h.hit("k", hits=1, limit=10, duration=10_000,
+                  algorithm=Algorithm.LEAKY_BUCKET, now=now)
+        st, _, rem, _ = h.hit("k", hits=1, limit=10, duration=10_000,
+                              algorithm=Algorithm.LEAKY_BUCKET,
+                              behavior=Behavior.RESET_REMAINING, now=now + 1)
+        # refilled to limit then the hit deducts (algorithms.go:205-207)
+        assert (st, rem) == (Status.UNDER_LIMIT, 9)
+
+    def test_over_request_no_deduct(self):
+        h = Harness()
+        now = 1_000_000
+        h.hit("k", hits=2, limit=10, duration=10_000,
+              algorithm=Algorithm.LEAKY_BUCKET, now=now)
+        st, _, rem, _ = h.hit("k", hits=100, limit=10, duration=10_000,
+                              algorithm=Algorithm.LEAKY_BUCKET, now=now + 1)
+        assert (st, rem) == (Status.OVER_LIMIT, 8)
+
+    def test_first_request_over_limit_empties(self):
+        h = Harness()
+        st, _, rem, _ = h.hit("k", hits=100, limit=10, duration=10_000,
+                              algorithm=Algorithm.LEAKY_BUCKET, now=1_000)
+        # stored empty, unlike token bucket (algorithms.go:319-323)
+        assert (st, rem) == (Status.OVER_LIMIT, 0)
+
+    def test_peek(self):
+        h = Harness()
+        now = 1_000_000
+        h.hit("k", hits=3, limit=10, duration=10_000,
+              algorithm=Algorithm.LEAKY_BUCKET, now=now)
+        st, _, rem, _ = h.hit("k", hits=0, limit=10, duration=10_000,
+                              algorithm=Algorithm.LEAKY_BUCKET, now=now)
+        assert (st, rem) == (Status.UNDER_LIMIT, 7)
+
+
+class TestKernelMatchesOracle:
+    """Randomized equivalence: the batched kernel vs the sequential oracle."""
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_fuzz(self, seed):
+        import datetime as dt
+
+        from gubernator_tpu.utils.gregorian import (
+            gregorian_duration,
+            gregorian_expiration,
+        )
+
+        rng = random.Random(seed)
+        keys = [f"k{i}" for i in range(10)]
+        cap = 32
+        state = make_table(cap)
+        directory = {}
+        oracle_table = {}
+        now = 1_700_000_000_000
+
+        for step in range(120):
+            now += rng.randint(0, 3000)
+            chosen = rng.sample(keys, rng.randint(1, 6))
+            cols = {k: [] for k in (
+                "slot hits limit duration algorithm behavior greg_expire "
+                "greg_interval fresh".split())}
+            params = []
+            for key in chosen:
+                fresh = key not in directory
+                if fresh:
+                    directory[key] = len(directory)
+                behavior = 0
+                if rng.random() < 0.1:
+                    behavior |= Behavior.RESET_REMAINING
+                duration = rng.choice([1000, 10_000, 60_000])
+                ge = gi = 0
+                if rng.random() < 0.25:
+                    # gregorian: duration is a calendar code; feed the kernel
+                    # the host-precomputed expiry/interval like the engine does
+                    behavior |= Behavior.DURATION_IS_GREGORIAN
+                    duration = rng.choice([0, 1, 2])  # minutes/hours/days
+                    local = dt.datetime.fromtimestamp(now / 1000.0)
+                    ge = gregorian_expiration(local, duration)
+                    gi = gregorian_duration(local, duration)
+                p = dict(
+                    hits=rng.choice([0, 1, 1, 2, 5, 50]),
+                    limit=rng.choice([1, 2, 10, 100]),
+                    duration=duration,
+                    algorithm=rng.choice([Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]),
+                    behavior=behavior,
+                    greg_expire=ge,
+                    greg_interval=gi,
+                )
+                params.append((key, p))
+                cols["slot"].append(directory[key])
+                cols["fresh"].append(fresh)
+                for f in ("hits", "limit", "duration", "algorithm", "behavior",
+                          "greg_expire", "greg_interval"):
+                    cols[f].append(p[f])
+            reqs = padded_batch(cols)
+            state, resp = _DECIDE(state, reqs, now)
+            for i, (key, p) in enumerate(params):
+                want = oracle_decide(oracle_table, key, now=now, **p)
+                got = (int(resp.status[i]), int(resp.limit[i]),
+                       int(resp.remaining[i]), int(resp.reset_time[i]))
+                assert got == (want.status, want.limit, want.remaining,
+                               want.reset_time), f"step {step} key {key} {p}"
+
+        # final state equivalence for live oracle rows
+        for key, slot_idx in directory.items():
+            row = oracle_table.get(key)
+            if row is None or row.algo == -1:
+                continue
+            assert int(state.algo[slot_idx]) == row.algo, key
+            assert int(state.remaining[slot_idx]) == row.remaining, key
+            assert int(state.limit[slot_idx]) == row.limit, key
+            assert int(state.expire_at[slot_idx]) == row.expire_at, key
+
+
+class TestBatchMechanics:
+    def test_padding_lanes_are_inert(self):
+        state = make_table(8)
+        reqs = padded_batch(dict(
+            slot=[0, -1, -1], hits=[1, 99, 99], limit=[10, 99, 99],
+            duration=[1000, 9, 9], algorithm=[0, 0, 0], behavior=[0, 0, 0],
+            greg_expire=[0, 0, 0], greg_interval=[0, 0, 0],
+            fresh=[True, False, False]))
+        state, resp = _DECIDE(state, reqs, 1_000)
+        assert int(resp.status[1]) == 0 and int(resp.remaining[1]) == 0
+        assert int(state.algo[1]) == -1  # untouched
+        assert int(state.remaining[0]) == 9
+
+    def test_distinct_slots_parallel(self):
+        state = make_table(64)
+        n = 50
+        reqs = padded_batch(dict(
+            slot=list(range(n)), hits=[3] * n, limit=[10] * n,
+            duration=[1000] * n, algorithm=[0] * n, behavior=[0] * n,
+            greg_expire=[0] * n, greg_interval=[0] * n, fresh=[True] * n))
+        state, resp = _DECIDE(state, reqs, 1_000)
+        assert np.all(np.asarray(resp.remaining[:n]) == 7)
+        assert np.all(np.asarray(state.remaining[:n]) == 7)

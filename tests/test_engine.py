@@ -1,0 +1,238 @@
+"""Engine tests: duplicate-key rounds, directory recycling, Store/Loader SPI.
+
+Mirrors the reference's persistence tests (reference: store_test.go:30-245)
+and the mutex-serialized same-key semantics (reference: gubernator.go:328).
+"""
+
+import random
+
+import pytest
+
+from gubernator_tpu.models import Engine, KeyDirectory
+from gubernator_tpu.ops.oracle import oracle_decide
+from gubernator_tpu.store import BucketSnapshot, MockLoader, MockStore
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq, Status
+
+# Far-future epoch: snapshot()/close() compare expiry against the real
+# clock, so simulated "now" must sort after wall time.
+NOW = 2_000_000_000_000
+
+
+def req(key="k", name="test", hits=1, limit=10, duration=60_000, algorithm=0, behavior=0):
+    return RateLimitReq(name=name, unique_key=key, hits=hits, limit=limit,
+                        duration=duration, algorithm=algorithm, behavior=behavior)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    # module-scoped: one compile, tests use distinct key names
+    return Engine(capacity=256, min_width=8, max_width=64)
+
+
+class TestEngineBasics:
+    def test_single(self, engine):
+        rs = engine.get_rate_limits([req(key="b1", hits=1)], now_ms=NOW)
+        assert rs[0].status == Status.UNDER_LIMIT
+        assert rs[0].remaining == 9
+        assert rs[0].reset_time == NOW + 60_000
+
+    def test_validation_errors(self, engine):
+        rs = engine.get_rate_limits(
+            [RateLimitReq(name="", unique_key="x"),
+             RateLimitReq(name="x", unique_key=""),
+             req(key="b2")],
+            now_ms=NOW)
+        assert rs[0].error == "field 'namespace' cannot be empty"
+        assert rs[1].error == "field 'unique_key' cannot be empty"
+        assert rs[2].error == ""
+
+    def test_invalid_gregorian(self, engine):
+        rs = engine.get_rate_limits(
+            [req(key="b3", duration=99, behavior=Behavior.DURATION_IS_GREGORIAN)],
+            now_ms=NOW)
+        assert "gregorian" in rs[0].error
+
+    def test_duplicate_keys_serialize(self, engine):
+        # 5 hits of 3 against limit 10: two succeed, rest rejected at rem=4
+        # without deducting — matches mutex-serialized reference behavior
+        rs = engine.get_rate_limits([req(key="dup", hits=3) for _ in range(5)],
+                                    now_ms=NOW)
+        stats = [r.status for r in rs]
+        rems = [r.remaining for r in rs]
+        assert stats == [0, 0, 0, 1, 1]
+        assert rems == [7, 4, 1, 1, 1]
+
+    def test_duplicate_mixed_order_preserved(self, engine):
+        rs = engine.get_rate_limits(
+            [req(key="dm", hits=8), req(key="dm", hits=4), req(key="dm", hits=2)],
+            now_ms=NOW)
+        assert [r.status for r in rs] == [0, 1, 0]
+        assert [r.remaining for r in rs] == [2, 2, 0]
+
+    def test_large_batch_spans_chunks(self, engine):
+        n = 150  # > max_width=64 -> 3 chunks
+        rs = engine.get_rate_limits([req(key=f"lb{i}") for i in range(n)], now_ms=NOW)
+        assert all(r.status == Status.UNDER_LIMIT and r.remaining == 9 for r in rs)
+
+    def test_gregorian_duration(self, engine):
+        from gubernator_tpu.utils.gregorian import gregorian_expiration
+        import datetime as dt
+        rs = engine.get_rate_limits(
+            [req(key="greg", duration=0, behavior=Behavior.DURATION_IS_GREGORIAN)],
+            now_ms=NOW)
+        want = gregorian_expiration(dt.datetime.fromtimestamp(NOW / 1000.0), 0)
+        assert rs[0].reset_time == want
+        assert rs[0].remaining == 9
+
+
+class TestDirectoryRecycling:
+    def test_eviction_recycles_slots(self):
+        eng = Engine(capacity=8, min_width=8, max_width=8)
+        for i in range(8):
+            eng.get_rate_limits([req(key=f"k{i}")], now_ms=NOW)
+        assert len(eng.directory) == 8
+        # ninth key evicts the LRU (k0); k0 re-added later starts fresh
+        eng.get_rate_limits([req(key="k8")], now_ms=NOW + 1)
+        assert eng.directory.evictions == 1
+        rs = eng.get_rate_limits([req(key="k0", hits=1)], now_ms=NOW + 2)
+        assert rs[0].remaining == 9  # state was lost with the slot
+
+    def test_directory_lru_order(self):
+        d = KeyDirectory(2)
+        s, f = d.lookup(["a", "b"])
+        assert f == [True, True]
+        d.lookup(["a"])  # refresh a
+        d.lookup(["c"])  # evicts b
+        assert "b" not in d and "a" in d and "c" in d
+        assert d.evictions == 1
+
+    def test_duplicate_in_one_lookup_shares_slot(self):
+        d = KeyDirectory(4)
+        s, f = d.lookup(["x", "x", "y"])
+        assert s[0] == s[1] != s[2]
+        assert f == [True, False, True]
+
+    def test_same_call_keys_are_pinned_against_eviction(self):
+        # capacity-many distinct keys in one lookup must get distinct slots
+        # even when eviction kicks in (collision-free scatter invariant)
+        d = KeyDirectory(4)
+        d.lookup(["old1", "old2"])
+        s, f = d.lookup(["a", "b", "c", "d"])
+        assert len(set(s)) == 4
+        assert d.evictions == 2  # old1/old2 recycled, never a/b/c/d
+
+    def test_over_committed_lookup_raises(self):
+        d = KeyDirectory(2)
+        with pytest.raises(RuntimeError):
+            d.lookup(["a", "b", "c"])
+
+    def test_engine_chunk_exceeding_capacity_stays_correct(self):
+        # 16 distinct keys through a capacity-8 engine in ONE call: chunking
+        # clamps rounds to capacity; every response is a valid fresh decision
+        eng = Engine(capacity=8, min_width=8, max_width=64)
+        rs = eng.get_rate_limits(
+            [req(key=f"cc{i}") for i in range(16)], now_ms=NOW)
+        assert all(r.status == Status.UNDER_LIMIT and r.remaining == 9
+                   for r in rs)
+        assert eng.directory.evictions == 8
+
+
+class TestStoreSPI:
+    def test_read_through_and_write_through(self):
+        store = MockStore()
+        eng = Engine(capacity=32, min_width=8, max_width=32, store=store)
+        eng.get_rate_limits([req(key="s1", hits=1)], now_ms=NOW)
+        # miss -> get; decision -> on_change
+        assert store.called["get"] == 1
+        assert store.called["on_change"] == 1
+        snap = store.data["test_s1"]
+        assert snap.remaining == 9 and snap.algo == Algorithm.TOKEN_BUCKET
+        # hit: no second get
+        eng.get_rate_limits([req(key="s1", hits=2)], now_ms=NOW + 1)
+        assert store.called["get"] == 1
+        assert store.data["test_s1"].remaining == 7
+
+    def test_read_through_restores_state(self):
+        store = MockStore()
+        store.data["test_s2"] = BucketSnapshot(
+            key="test_s2", algo=0, limit=10, remaining=3, duration=60_000,
+            stamp=NOW - 1000, expire_at=NOW + 59_000)
+        eng = Engine(capacity=32, min_width=8, max_width=32, store=store)
+        rs = eng.get_rate_limits([req(key="s2", hits=1)], now_ms=NOW)
+        assert rs[0].remaining == 2
+        assert store.called["get"] == 1
+
+    def test_reset_remaining_removes(self):
+        store = MockStore()
+        eng = Engine(capacity=32, min_width=8, max_width=32, store=store)
+        eng.get_rate_limits([req(key="s3", hits=1)], now_ms=NOW)
+        eng.get_rate_limits(
+            [req(key="s3", behavior=Behavior.RESET_REMAINING)], now_ms=NOW + 1)
+        assert store.called["remove"] == 1
+        assert "test_s3" not in store.data
+
+    def test_algorithm_switch_removes_then_recreates(self):
+        store = MockStore()
+        eng = Engine(capacity=32, min_width=8, max_width=32, store=store)
+        eng.get_rate_limits([req(key="s4", hits=1)], now_ms=NOW)
+        rs = eng.get_rate_limits(
+            [req(key="s4", hits=1, algorithm=Algorithm.LEAKY_BUCKET)], now_ms=NOW + 1)
+        assert store.called["remove"] == 1
+        assert rs[0].remaining == 9
+        assert store.data["test_s4"].algo == Algorithm.LEAKY_BUCKET
+
+
+class TestLoaderSPI:
+    def test_load_and_save_roundtrip(self):
+        loader = MockLoader([
+            BucketSnapshot(key="test_l1", algo=0, limit=10, remaining=4,
+                           duration=60_000, stamp=NOW - 1000,
+                           expire_at=NOW + 59_000),
+        ])
+        eng = Engine(capacity=32, min_width=8, max_width=32, loader=loader)
+        assert loader.called["load"] == 1
+        rs = eng.get_rate_limits([req(key="l1", hits=1)], now_ms=NOW)
+        assert rs[0].remaining == 3
+        eng.close()
+        assert loader.called["save"] == 1
+        saved = {s.key: s for s in loader.contents}
+        assert saved["test_l1"].remaining == 3
+
+    def test_save_skips_expired(self):
+        loader = MockLoader()
+        eng = Engine(capacity=32, min_width=8, max_width=32, loader=loader)
+        eng.get_rate_limits([req(key="l2", duration=1)], now_ms=1_000)  # long expired
+        eng.get_rate_limits([req(key="l3", duration=10**12)], now_ms=NOW)
+        eng.close()
+        keys = {s.key for s in loader.contents}
+        assert "test_l3" in keys and "test_l2" not in keys
+
+
+class TestEngineMatchesOracleWithDuplicates:
+    @pytest.mark.parametrize("seed", [0, 1])
+    def test_fuzz_with_duplicates(self, seed):
+        rng = random.Random(seed)
+        eng = Engine(capacity=64, min_width=8, max_width=32)
+        oracle_table = {}
+        now = NOW
+        keys = [f"f{i}" for i in range(6)]
+        for _ in range(40):
+            now += rng.randint(0, 2000)
+            batch = []
+            for _ in range(rng.randint(1, 10)):
+                k = rng.choice(keys)
+                batch.append(req(
+                    key=k,
+                    hits=rng.choice([0, 1, 2, 5]),
+                    limit=rng.choice([3, 10]),
+                    duration=rng.choice([1000, 60_000]),
+                    algorithm=rng.choice([0, 1]),
+                ))
+            got = eng.get_rate_limits(batch, now_ms=now)
+            for r, g in zip(batch, got):
+                want = oracle_decide(
+                    oracle_table, r.hash_key(), hits=r.hits, limit=r.limit,
+                    duration=r.duration, algorithm=r.algorithm,
+                    behavior=r.behavior, now=now)
+                assert (g.status, g.limit, g.remaining, g.reset_time) == (
+                    want.status, want.limit, want.remaining, want.reset_time)

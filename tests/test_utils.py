@@ -1,0 +1,144 @@
+"""Unit tests for gregorian math, interval timer, and the host LRU.
+
+Modeled on the reference's pure unit tests (reference: interval_test.go,
+cache semantics in cache.go:140-165).
+"""
+
+import datetime as dt
+import time
+
+import pytest
+
+from gubernator_tpu.types import Behavior, RateLimitReq, has_behavior, set_behavior
+from gubernator_tpu.utils import (
+    GREGORIAN_DAYS,
+    GREGORIAN_HOURS,
+    GREGORIAN_MINUTES,
+    GREGORIAN_MONTHS,
+    GREGORIAN_WEEKS,
+    GREGORIAN_YEARS,
+    GregorianError,
+    Interval,
+    gregorian_duration,
+    gregorian_expiration,
+)
+from gubernator_tpu.utils.lru import CacheItem, LRUCache
+from gubernator_tpu.utils.interval import millisecond_now
+
+
+def ms(d: dt.datetime) -> int:
+    return int(d.timestamp() * 1000)
+
+
+class TestGregorian:
+    def test_minute_expiration(self):
+        now = dt.datetime(2019, 1, 1, 11, 20, 10)
+        # end of current minute, minus 1ms (reference: interval.go:114-120)
+        want = ms(dt.datetime(2019, 1, 1, 11, 21, 0)) - 1
+        assert gregorian_expiration(now, GREGORIAN_MINUTES) == want
+
+    def test_hour_day_expiration(self):
+        now = dt.datetime(2021, 6, 15, 11, 20, 10)
+        assert gregorian_expiration(now, GREGORIAN_HOURS) == ms(dt.datetime(2021, 6, 15, 12)) - 1
+        assert gregorian_expiration(now, GREGORIAN_DAYS) == ms(dt.datetime(2021, 6, 16)) - 1
+
+    def test_month_boundaries(self):
+        now = dt.datetime(2020, 12, 31, 23, 59, 59)
+        assert gregorian_expiration(now, GREGORIAN_MONTHS) == ms(dt.datetime(2021, 1, 1)) - 1
+        assert gregorian_duration(now, GREGORIAN_MONTHS) == 31 * 86_400_000
+
+    def test_year_and_leap(self):
+        now = dt.datetime(2020, 2, 10)
+        assert gregorian_duration(now, GREGORIAN_YEARS) == 366 * 86_400_000
+        assert gregorian_expiration(now, GREGORIAN_YEARS) == ms(dt.datetime(2021, 1, 1)) - 1
+
+    def test_fixed_durations(self):
+        now = dt.datetime(2021, 6, 15)
+        assert gregorian_duration(now, GREGORIAN_MINUTES) == 60_000
+        assert gregorian_duration(now, GREGORIAN_HOURS) == 3_600_000
+        assert gregorian_duration(now, GREGORIAN_DAYS) == 86_400_000
+        assert gregorian_duration(now, GREGORIAN_WEEKS) == 7 * 86_400_000
+
+    def test_week_expiration(self):
+        # Wednesday -> end of Sunday
+        now = dt.datetime(2021, 6, 16, 5, 0, 0)
+        assert now.weekday() == 2
+        assert gregorian_expiration(now, GREGORIAN_WEEKS) == ms(dt.datetime(2021, 6, 21)) - 1
+
+    def test_invalid_code(self):
+        with pytest.raises(GregorianError):
+            gregorian_expiration(dt.datetime(2021, 1, 1), 42)
+        with pytest.raises(GregorianError):
+            gregorian_duration(dt.datetime(2021, 1, 1), -1)
+
+
+class TestBehaviorFlags:
+    def test_has_set(self):
+        b = 0
+        b = set_behavior(b, Behavior.GLOBAL, True)
+        b = set_behavior(b, Behavior.RESET_REMAINING, True)
+        assert has_behavior(b, Behavior.GLOBAL)
+        assert has_behavior(b, Behavior.RESET_REMAINING)
+        assert not has_behavior(b, Behavior.NO_BATCHING)
+        b = set_behavior(b, Behavior.GLOBAL, False)
+        assert not has_behavior(b, Behavior.GLOBAL)
+
+    def test_hash_key(self):
+        r = RateLimitReq(name="requests_per_sec", unique_key="account:1234")
+        assert r.hash_key() == "requests_per_sec_account:1234"
+
+
+class TestInterval:
+    def test_fires_once_per_arm(self):
+        iv = Interval(0.02)
+        iv.next()
+        assert iv.c.get(timeout=1.0)
+        assert iv.c.empty()  # one-shot: no second tick without re-arming
+        time.sleep(0.05)
+        assert iv.c.empty()
+        iv.next()
+        assert iv.c.get(timeout=1.0)
+        iv.stop()
+
+
+class TestLRUCache:
+    def test_add_get_evict(self):
+        c = LRUCache(max_size=2)
+        c.add(CacheItem(key="a", value=1, expire_at=millisecond_now() + 10_000))
+        c.add(CacheItem(key="b", value=2, expire_at=millisecond_now() + 10_000))
+        assert c.get_item("a").value == 1  # refresh recency of a
+        c.add(CacheItem(key="c", value=3, expire_at=millisecond_now() + 10_000))
+        assert c.get_item("b") is None  # b was LRU
+        assert c.get_item("a").value == 1
+        assert c.get_item("c").value == 3
+        assert c.stat_unexpired_evictions == 1
+
+    def test_expiry_on_read(self):
+        c = LRUCache()
+        c.add(CacheItem(key="x", value=1, expire_at=millisecond_now() - 1))
+        assert c.get_item("x") is None
+        assert c.stat_miss == 1
+        assert len(c) == 0
+
+    def test_invalid_at(self):
+        c = LRUCache()
+        c.add(
+            CacheItem(
+                key="x", value=1, expire_at=millisecond_now() + 10_000,
+                invalid_at=millisecond_now() - 1,
+            )
+        )
+        assert c.get_item("x") is None
+
+    def test_update_expiration(self):
+        c = LRUCache()
+        c.add(CacheItem(key="x", value=1, expire_at=millisecond_now() - 1))
+        assert c.update_expiration("x", millisecond_now() + 10_000)
+        assert c.get_item("x").value == 1
+        assert not c.update_expiration("nope", 1)
+
+    def test_each(self):
+        c = LRUCache()
+        for i in range(5):
+            c.add(CacheItem(key=str(i), value=i, expire_at=millisecond_now() + 10_000))
+        assert sorted(item.value for item in c.each()) == [0, 1, 2, 3, 4]
