@@ -21,7 +21,6 @@ The engine is synchronous and thread-safe via one lock — the service layer
 
 from __future__ import annotations
 
-import datetime as _dt
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -30,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gubernator_tpu.models.keyspace import KeyDirectory
+from gubernator_tpu.models.prep import preprocess
 from gubernator_tpu.ops.decide import (
     I32,
     I64,
@@ -44,13 +44,6 @@ from gubernator_tpu.types import (
     Behavior,
     RateLimitReq,
     RateLimitResp,
-    has_behavior,
-    validate_request,
-)
-from gubernator_tpu.utils.gregorian import (
-    GregorianError,
-    gregorian_duration,
-    gregorian_expiration,
 )
 from gubernator_tpu.utils.interval import millisecond_now
 
@@ -139,38 +132,7 @@ class Engine:
         """Decide a batch. Exact per-key sequential semantics, any batch size."""
         if now_ms is None:
             now_ms = millisecond_now()
-        responses: List[Optional[RateLimitResp]] = [None] * len(requests)
-        work: List[Tuple[int, RateLimitReq, int, int]] = []
-        n_errors = 0
-        for i, r in enumerate(requests):
-            err = validate_request(r)
-            if err:
-                responses[i] = RateLimitResp(error=err)
-                n_errors += 1
-                continue
-            ge = gi = 0
-            if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
-                try:
-                    local_now = _dt.datetime.fromtimestamp(now_ms / 1000.0)
-                    ge = gregorian_expiration(local_now, r.duration)
-                    gi = gregorian_duration(local_now, r.duration)
-                except GregorianError as e:
-                    responses[i] = RateLimitResp(error=str(e))
-                    n_errors += 1
-                    continue
-            work.append((i, r, ge, gi))
-
-        # occurrence-k of each key goes to round k: kernel calls stay
-        # collision-free while duplicate requests observe each other in order
-        rounds: List[List[Tuple[int, RateLimitReq, int, int]]] = []
-        occurrence: Dict[str, int] = {}
-        for item in work:
-            k = item[1].hash_key()
-            j = occurrence.get(k, 0)
-            occurrence[k] = j + 1
-            if len(rounds) <= j:
-                rounds.append([])
-            rounds[j].append(item)
+        responses, rounds, n_errors = preprocess(requests, now_ms)
 
         with self._lock:
             self.stats.requests += len(requests)

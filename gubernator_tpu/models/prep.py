@@ -1,0 +1,75 @@
+"""Shared host-side request preprocessing for the engines.
+
+Validation, gregorian precomputation, and duplicate-key *round* splitting are
+identical for the single-table engine (models/engine.py) and the mesh-sharded
+engine (parallel/sharded.py); both call `preprocess`.
+
+Rounds preserve the reference's same-key sequential semantics: the reference
+serializes every request under one cache mutex (reference: gubernator.go:328),
+so two hits to one key in a window observe each other. A scatter kernel with
+duplicate indices cannot express that, so occurrence k of every key goes to
+round k and rounds run back-to-back; almost all real windows are round-1-only.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from gubernator_tpu.types import (
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    has_behavior,
+    validate_request,
+)
+from gubernator_tpu.utils.gregorian import (
+    GregorianError,
+    gregorian_duration,
+    gregorian_expiration,
+)
+
+# (original batch index, request, greg_expire_ms, greg_interval_ms)
+WorkItem = Tuple[int, RateLimitReq, int, int]
+
+
+def preprocess(
+    requests: Sequence[RateLimitReq], now_ms: int
+) -> Tuple[List[Optional[RateLimitResp]], List[List[WorkItem]], int]:
+    """Validate + precompute calendar fields + split into collision-free rounds.
+
+    Returns (responses, rounds, n_errors): `responses` is the output list with
+    error entries already filled (None elsewhere); each round is a list of
+    WorkItems whose keys are distinct within the round.
+    """
+    responses: List[Optional[RateLimitResp]] = [None] * len(requests)
+    work: List[WorkItem] = []
+    n_errors = 0
+    for i, r in enumerate(requests):
+        err = validate_request(r)
+        if err:
+            responses[i] = RateLimitResp(error=err)
+            n_errors += 1
+            continue
+        ge = gi = 0
+        if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+            try:
+                local_now = _dt.datetime.fromtimestamp(now_ms / 1000.0)
+                ge = gregorian_expiration(local_now, r.duration)
+                gi = gregorian_duration(local_now, r.duration)
+            except GregorianError as e:
+                responses[i] = RateLimitResp(error=str(e))
+                n_errors += 1
+                continue
+        work.append((i, r, ge, gi))
+
+    rounds: List[List[WorkItem]] = []
+    occurrence: Dict[str, int] = {}
+    for item in work:
+        k = item[1].hash_key()
+        j = occurrence.get(k, 0)
+        occurrence[k] = j + 1
+        if len(rounds) <= j:
+            rounds.append([])
+        rounds[j].append(item)
+    return responses, rounds, n_errors

@@ -1,0 +1,19 @@
+from gubernator_tpu.parallel.mesh import (
+    MeshPlan,
+    make_mesh,
+    make_sharded_table,
+    shard_of_key,
+)
+from gubernator_tpu.parallel.global_sync import GlobalMirror, make_global_sync
+from gubernator_tpu.parallel.sharded import ShardedEngine, make_decide_sharded
+
+__all__ = [
+    "MeshPlan",
+    "make_mesh",
+    "make_sharded_table",
+    "shard_of_key",
+    "GlobalMirror",
+    "make_global_sync",
+    "ShardedEngine",
+    "make_decide_sharded",
+]

@@ -1,0 +1,352 @@
+"""Mesh-sharded rate-limit engine: the multi-chip authoritative state tier.
+
+Single-host view of the distributed design (SURVEY.md §2.2): the key table is
+sharded over a ("region", "shard") mesh; every key has exactly one owner chip
+(reference's owner-peer model, architecture.md:13-17) and one batch window
+becomes one `shard_map`ped kernel launch where each chip applies the lanes
+routed to it. The reference's non-owner -> owner gRPC forwarding
+(peer_client.go:215-319) is replaced by host-side lane routing into the
+[R, S, W] batch; its GLOBAL gRPC pipelines are replaced by the psum step in
+parallel/global_sync.py.
+
+Behavior=GLOBAL here (reference: gubernator.go:226-247):
+- requests are answered from the replicated host-side mirror (the owner's
+  last broadcast), with local hit deltas accumulated for the next sync;
+- a key's FIRST touch (mirror miss) goes through the authoritative kernel
+  synchronously and its hits are NOT queued — slightly stricter than the
+  reference, which both queues the hit and processes it as-if-owner
+  (double-counting one window's hits, gubernator.go:227-246).
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from gubernator_tpu.models.keyspace import KeyDirectory
+from gubernator_tpu.models.prep import WorkItem, preprocess
+from gubernator_tpu.ops.decide import I32, I64, ReqBatch, RespBatch, TableState, decide
+from gubernator_tpu.parallel.global_sync import (
+    GlobalConfig,
+    GlobalMirror,
+    make_global_sync,
+)
+from gubernator_tpu.parallel.mesh import (
+    REGION_AXIS,
+    SHARD_AXIS,
+    MeshPlan,
+    make_mesh,
+    make_sharded_table,
+    shard_of_key,
+)
+from gubernator_tpu.types import (
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+    has_behavior,
+)
+from gubernator_tpu.utils.interval import millisecond_now
+
+
+def make_decide_sharded(plan: MeshPlan, donate: bool = False):
+    """Compile the batched decision kernel over the plan's mesh.
+
+    fn(state [R,S,C], reqs [R,S,W], now) -> (state, resp [R,S,W]); each chip
+    applies its own lane slice to its own table shard — no cross-chip traffic
+    at all on the normal (non-GLOBAL) path, mirroring the reference's
+    owner-local mutation.
+    """
+    spec = P(REGION_AXIS, SHARD_AXIS, None)
+
+    def _step(state: TableState, reqs: ReqBatch, now: jax.Array):
+        local_state = TableState(*(c.reshape(c.shape[-1:]) for c in state))
+        local_reqs = ReqBatch(*(c.reshape(c.shape[-1:]) for c in reqs))
+        new_state, resp = decide(local_state, local_reqs, now)
+        return (
+            TableState(*(c.reshape(1, 1, -1) for c in new_state)),
+            RespBatch(*(c.reshape(1, 1, -1) for c in resp)),
+        )
+
+    mapped = jax.shard_map(
+        _step, mesh=plan.mesh, in_specs=(spec, spec, P()), out_specs=(spec, spec)
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+class _GlobalEntry:
+    """Host record for one registered global key."""
+
+    __slots__ = ("gidx", "owner", "req", "greg_expire", "greg_interval", "seen")
+
+    def __init__(self, gidx: int, owner: int):
+        self.gidx = gidx
+        self.owner = owner
+        self.req: Optional[RateLimitReq] = None
+        self.greg_expire = 0
+        self.greg_interval = 0
+        self.seen = False  # at least one broadcast has populated the mirror
+
+
+class ShardedEngine:
+    """Authoritative rate-limit state sharded over a device mesh."""
+
+    def __init__(
+        self,
+        mesh=None,
+        n_shards: Optional[int] = None,
+        n_regions: int = 1,
+        capacity_per_shard: int = 1 << 17,
+        global_capacity: int = 1024,
+        min_width: int = 64,
+        max_width: int = 4096,
+        donate: Optional[bool] = None,
+    ):
+        if mesh is None:
+            mesh = make_mesh(n_shards=n_shards, n_regions=n_regions)
+        self.plan = MeshPlan(mesh=mesh, capacity_per_shard=capacity_per_shard)
+        if donate is None:
+            from gubernator_tpu.utils.platform import donation_supported
+
+            donate = donation_supported()
+        self.state = make_sharded_table(self.plan)
+        self._decide = make_decide_sharded(self.plan, donate=donate)
+        self._sync = make_global_sync(self.plan, donate=donate)
+        self.directories = [
+            KeyDirectory(capacity_per_shard) for _ in range(self.plan.n_owners)
+        ]
+        self.min_width = min_width
+        self.max_width = min(max_width, capacity_per_shard)
+        self._lock = threading.Lock()
+
+        # ---- GLOBAL-behavior host state --------------------------------
+        self.global_capacity = global_capacity
+        self._globals: Dict[str, _GlobalEntry] = {}
+        self._gdelta = np.zeros((global_capacity,), np.int64)  # local hits
+        self._mirror = GlobalMirror(  # host copy of last broadcast
+            status=np.zeros((global_capacity,), np.int32),
+            limit=np.zeros((global_capacity,), np.int64),
+            remaining=np.zeros((global_capacity,), np.int64),
+            reset_time=np.zeros((global_capacity,), np.int64),
+        )
+        self.stats = {
+            "requests": 0,
+            "batches": 0,
+            "rounds": 0,
+            "over_limit": 0,
+            "errors": 0,
+            "global_hits_queued": 0,
+            "global_syncs": 0,
+            "global_mirror_answers": 0,
+        }
+
+    # ------------------------------------------------------------------ API
+
+    def owner_of(self, key: str) -> int:
+        return shard_of_key(key, self.plan.n_owners)
+
+    def get_rate_limits(
+        self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        if now_ms is None:
+            now_ms = millisecond_now()
+        responses, rounds, n_errors = preprocess(requests, now_ms)
+        with self._lock:
+            self.stats["requests"] += len(requests)
+            self.stats["batches"] += 1
+            self.stats["errors"] += n_errors
+            for round_work in rounds:
+                kernel_items = []
+                for item in round_work:
+                    if self._try_answer_global(item, responses):
+                        continue
+                    kernel_items.append(item)
+                if kernel_items:
+                    self.stats["rounds"] += 1
+                    for start in range(0, len(kernel_items), self.max_width):
+                        self._apply_round(
+                            kernel_items[start : start + self.max_width],
+                            now_ms,
+                            responses,
+                        )
+        return responses  # type: ignore[return-value]
+
+    def global_sync(self, now_ms: Optional[int] = None) -> int:
+        """Run one psum sync window (reference: global.go runAsyncHits +
+        runBroadcasts, collapsed). Returns the number of keys broadcast."""
+        if now_ms is None:
+            now_ms = millisecond_now()
+        with self._lock:
+            live = [e for e in self._globals.values() if e.req is not None]
+            if not live:
+                return 0
+            cfg = self._build_global_config(now_ms)
+            delta = self._place_delta()
+            self.state, mirror, _ = self._sync(self.state, delta, cfg, now_ms)
+            self._mirror = GlobalMirror(*(np.asarray(c) for c in mirror))
+            self._gdelta[:] = 0
+            for e in live:
+                e.seen = True
+            self.stats["global_syncs"] += 1
+            return len(live)
+
+    def global_pending_hits(self) -> int:
+        return int(self._gdelta.sum())
+
+    # ------------------------------------------------------------- internals
+
+    def _try_answer_global(self, item: WorkItem, responses) -> bool:
+        """Answer a GLOBAL request from the replicated mirror; queue its hits
+        for the next sync. Returns False if the item must go to the kernel
+        (not GLOBAL, or first touch)."""
+        i, r, ge, gi = item
+        if not has_behavior(r.behavior, Behavior.GLOBAL):
+            return False
+        key = r.hash_key()
+        entry = self._globals.get(key)
+        if entry is None:
+            if len(self._globals) >= self.global_capacity:
+                # registry full: serve authoritatively, skip async pipeline
+                return False
+            entry = _GlobalEntry(len(self._globals), self.owner_of(key))
+            self._globals[key] = entry
+        entry.req = r
+        entry.greg_expire = ge
+        entry.greg_interval = gi
+        if not entry.seen:
+            return False  # first touch: authoritative kernel path
+        self._gdelta[entry.gidx] += r.hits
+        self.stats["global_hits_queued"] += int(r.hits)
+        self.stats["global_mirror_answers"] += 1
+        st = int(self._mirror.status[entry.gidx])
+        if st == Status.OVER_LIMIT:
+            self.stats["over_limit"] += 1
+        responses[i] = RateLimitResp(
+            status=st,
+            limit=int(self._mirror.limit[entry.gidx]),
+            remaining=int(self._mirror.remaining[entry.gidx]),
+            reset_time=int(self._mirror.reset_time[entry.gidx]),
+        )
+        return True
+
+    def _apply_round(self, round_work: List[WorkItem], now_ms, responses) -> None:
+        R, S = self.plan.n_regions, self.plan.n_shards
+        lanes: List[List[WorkItem]] = [[] for _ in range(R * S)]
+        for item in round_work:
+            lanes[self.owner_of(item[1].hash_key())].append(item)
+        width = max(len(l) for l in lanes)
+        w = self.min_width
+        while w < width:
+            w *= 2
+        w = min(w, self.max_width)
+
+        cols = {
+            "slot": np.full((R, S, w), -1, np.int32),
+            "hits": np.zeros((R, S, w), np.int64),
+            "limit": np.zeros((R, S, w), np.int64),
+            "duration": np.zeros((R, S, w), np.int64),
+            "algorithm": np.zeros((R, S, w), np.int32),
+            "behavior": np.zeros((R, S, w), np.int32),
+            "greg_expire": np.zeros((R, S, w), np.int64),
+            "greg_interval": np.zeros((R, S, w), np.int64),
+            "fresh": np.zeros((R, S, w), np.bool_),
+        }
+        placed: List[Tuple[int, int, int, int]] = []  # (resp idx, r, s, lane)
+        for owner, items in enumerate(lanes):
+            if not items:
+                continue
+            r_, s_ = self.plan.owner_coords(owner)
+            keys = [it[1].hash_key() for it in items]
+            slots, fresh = self.directories[owner].lookup(keys)
+            for lane, (item, slot, fr) in enumerate(zip(items, slots, fresh)):
+                i, req, ge, gi = item
+                cols["slot"][r_, s_, lane] = slot
+                cols["hits"][r_, s_, lane] = req.hits
+                cols["limit"][r_, s_, lane] = req.limit
+                cols["duration"][r_, s_, lane] = req.duration
+                cols["algorithm"][r_, s_, lane] = int(req.algorithm)
+                cols["behavior"][r_, s_, lane] = int(req.behavior)
+                cols["greg_expire"][r_, s_, lane] = ge
+                cols["greg_interval"][r_, s_, lane] = gi
+                cols["fresh"][r_, s_, lane] = fr
+                placed.append((i, r_, s_, lane))
+
+        reqs = ReqBatch(**{k: jnp.asarray(v) for k, v in cols.items()})
+        self.state, resp = self._decide(self.state, reqs, now_ms)
+
+        status = np.asarray(resp.status)
+        limit = np.asarray(resp.limit)
+        remaining = np.asarray(resp.remaining)
+        reset = np.asarray(resp.reset_time)
+        for i, r_, s_, lane in placed:
+            st = int(status[r_, s_, lane])
+            if st == Status.OVER_LIMIT:
+                self.stats["over_limit"] += 1
+            responses[i] = RateLimitResp(
+                status=st,
+                limit=int(limit[r_, s_, lane]),
+                remaining=int(remaining[r_, s_, lane]),
+                reset_time=int(reset[r_, s_, lane]),
+            )
+
+    def _build_global_config(self, now_ms: int) -> GlobalConfig:
+        import datetime as _dt
+
+        from gubernator_tpu.utils.gregorian import (
+            gregorian_duration,
+            gregorian_expiration,
+        )
+
+        G = self.global_capacity
+        slot = np.full((G,), -1, np.int32)
+        owner = np.zeros((G,), np.int32)
+        limit = np.zeros((G,), np.int64)
+        duration = np.zeros((G,), np.int64)
+        algorithm = np.zeros((G,), np.int32)
+        behavior = np.zeros((G,), np.int32)
+        greg_expire = np.zeros((G,), np.int64)
+        greg_interval = np.zeros((G,), np.int64)
+        fresh = np.zeros((G,), np.bool_)
+        for key, e in self._globals.items():
+            if e.req is None:
+                continue
+            g = e.gidx
+            slots, fr = self.directories[e.owner].lookup([key])
+            slot[g] = slots[0]
+            owner[g] = e.owner
+            limit[g] = e.req.limit
+            duration[g] = e.req.duration
+            algorithm[g] = int(e.req.algorithm)
+            # the broadcast re-applies with the GLOBAL flag stripped
+            # (reference: global.go:209-214)
+            behavior[g] = int(e.req.behavior) & ~int(Behavior.GLOBAL)
+            fresh[g] = fr[0]
+            if has_behavior(e.req.behavior, Behavior.DURATION_IS_GREGORIAN):
+                local_now = _dt.datetime.fromtimestamp(now_ms / 1000.0)
+                greg_expire[g] = gregorian_expiration(local_now, e.req.duration)
+                greg_interval[g] = gregorian_duration(local_now, e.req.duration)
+        return GlobalConfig(
+            slot=jnp.asarray(slot),
+            owner=jnp.asarray(owner),
+            limit=jnp.asarray(limit),
+            duration=jnp.asarray(duration),
+            algorithm=jnp.asarray(algorithm),
+            behavior=jnp.asarray(behavior),
+            greg_expire=jnp.asarray(greg_expire),
+            greg_interval=jnp.asarray(greg_interval),
+            fresh=jnp.asarray(fresh),
+        )
+
+    def _place_delta(self) -> jax.Array:
+        """This host's deltas enter the mesh on device (0, 0); psum makes
+        placement irrelevant. Multi-host processes each fill their local row."""
+        R, S = self.plan.n_regions, self.plan.n_shards
+        delta = np.zeros((R, S, self.global_capacity), np.int64)
+        delta[0, 0, :] = self._gdelta
+        return jnp.asarray(delta)

@@ -1,0 +1,161 @@
+"""Mesh-sharded engine tests on the 8-device virtual CPU mesh.
+
+Differential strategy: the sharded engine must produce byte-identical
+decisions to the single-table engine for any workload without GLOBAL
+behavior — sharding is a pure layout change. GLOBAL behavior is asserted
+against the reference's eventual-consistency contract
+(reference: global.go, gubernator.go:226-247).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.models.engine import Engine
+from gubernator_tpu.parallel import ShardedEngine, make_mesh, shard_of_key
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq, Status
+
+NOW = 1_700_000_000_000
+
+
+def _req(key, hits=1, limit=10, duration=60_000, algo=Algorithm.TOKEN_BUCKET, behavior=0):
+    return RateLimitReq(
+        name="test", unique_key=key, hits=hits, limit=limit,
+        duration=duration, algorithm=algo, behavior=behavior,
+    )
+
+
+@pytest.fixture(scope="module")
+def eng8():
+    return ShardedEngine(n_shards=8, capacity_per_shard=2048)
+
+
+def test_mesh_shapes():
+    m = make_mesh(n_shards=4, n_regions=2)
+    assert m.devices.shape == (2, 4)
+    m1 = make_mesh()
+    assert m1.devices.shape[0] == 1
+
+
+def test_owner_deterministic(eng8):
+    owners = {shard_of_key(f"test_k{i}", 8) for i in range(200)}
+    # 200 keys over 8 shards must touch every shard
+    assert owners == set(range(8))
+    assert shard_of_key("test_k0", 8) == shard_of_key("test_k0", 8)
+
+
+def test_token_bucket_across_shards(eng8):
+    reqs = [_req(f"tb{i}") for i in range(100)]
+    resps = eng8.get_rate_limits(reqs, now_ms=NOW)
+    assert all(r.status == Status.UNDER_LIMIT and r.remaining == 9 for r in resps)
+    # drain one key to OVER_LIMIT
+    for j in range(9):
+        r = eng8.get_rate_limits([_req("tb0")], now_ms=NOW + j)[0]
+        assert r.remaining == 8 - j
+    over = eng8.get_rate_limits([_req("tb0")], now_ms=NOW + 10)[0]
+    assert over.status == Status.OVER_LIMIT
+
+
+def test_differential_vs_single_engine():
+    """Random mixed workload: sharded == single-table, response for response."""
+    rng = random.Random(7)
+    single = Engine(capacity=4096)
+    sharded = ShardedEngine(n_shards=4, n_regions=2, capacity_per_shard=1024)
+    keys = [f"key{i}" for i in range(40)]
+    for step in range(30):
+        now = NOW + step * 1_000
+        batch = [
+            _req(
+                rng.choice(keys),
+                hits=rng.randint(0, 4),
+                limit=rng.choice([5, 10, 20]),
+                duration=rng.choice([10_000, 60_000]),
+                algo=rng.choice([Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]),
+                behavior=rng.choice([0, int(Behavior.RESET_REMAINING)]),
+            )
+            for _ in range(rng.randint(1, 20))
+        ]
+        a = single.get_rate_limits(batch, now_ms=now)
+        b = sharded.get_rate_limits(batch, now_ms=now)
+        for ra, rb in zip(a, b):
+            assert (ra.status, ra.limit, ra.remaining, ra.reset_time) == (
+                rb.status, rb.limit, rb.remaining, rb.reset_time,
+            ), f"divergence at step {step}"
+
+
+def test_duplicate_keys_in_batch(eng8):
+    """Same-key requests in one batch observe each other (round splitting)."""
+    reqs = [_req("dup", hits=3), _req("dup", hits=3), _req("dup", hits=3)]
+    resps = eng8.get_rate_limits(reqs, now_ms=NOW)
+    assert [r.remaining for r in resps] == [7, 4, 1]
+
+
+def test_validation_errors(eng8):
+    resps = eng8.get_rate_limits(
+        [RateLimitReq(name="", unique_key="x"), RateLimitReq(name="x", unique_key="")],
+        now_ms=NOW,
+    )
+    assert "namespace" in resps[0].error
+    assert "unique_key" in resps[1].error
+
+
+class TestGlobal:
+    def test_first_touch_is_authoritative(self):
+        eng = ShardedEngine(n_shards=8, capacity_per_shard=512)
+        r = eng.get_rate_limits(
+            [_req("g1", hits=5, limit=100, behavior=Behavior.GLOBAL)], now_ms=NOW
+        )[0]
+        assert r.status == Status.UNDER_LIMIT and r.remaining == 95
+        assert eng.global_pending_hits() == 0
+
+    def test_psum_aggregation_and_broadcast(self):
+        eng = ShardedEngine(n_shards=8, capacity_per_shard=512)
+        g = lambda h: _req("hot", hits=h, limit=100, behavior=Behavior.GLOBAL)
+        eng.get_rate_limits([g(5)], now_ms=NOW)  # authoritative: rem 95
+        assert eng.global_sync(now_ms=NOW + 1) == 1
+        # mirror answers are frozen between syncs
+        r1 = eng.get_rate_limits([g(10)], now_ms=NOW + 2)[0]
+        r2 = eng.get_rate_limits([g(10), g(10)], now_ms=NOW + 3)
+        assert r1.remaining == 95
+        assert [x.remaining for x in r2] == [95, 95]
+        assert eng.global_pending_hits() == 30
+        # sync applies the summed delta at the owner and rebroadcasts
+        eng.global_sync(now_ms=NOW + 4)
+        r3 = eng.get_rate_limits([g(0)], now_ms=NOW + 5)[0]
+        assert r3.remaining == 65
+        assert eng.global_pending_hits() == 0
+
+    def test_global_over_limit_converges(self):
+        eng = ShardedEngine(n_shards=4, capacity_per_shard=512)
+        g = lambda h: _req("burst", hits=h, limit=10, behavior=Behavior.GLOBAL)
+        eng.get_rate_limits([g(1)], now_ms=NOW)
+        eng.global_sync(now_ms=NOW + 1)
+        for _ in range(4):  # 20 hits queued against limit 10
+            eng.get_rate_limits([g(5)], now_ms=NOW + 2)
+        eng.global_sync(now_ms=NOW + 3)
+        r = eng.get_rate_limits([g(0)], now_ms=NOW + 4)[0]
+        assert r.status == Status.OVER_LIMIT
+
+    def test_two_regions_share_global_state(self):
+        eng = ShardedEngine(n_shards=4, n_regions=2, capacity_per_shard=512)
+        g = lambda h: _req("xdc", hits=h, limit=50, behavior=Behavior.GLOBAL)
+        eng.get_rate_limits([g(10)], now_ms=NOW)
+        eng.global_sync(now_ms=NOW + 1)
+        eng.get_rate_limits([g(15)], now_ms=NOW + 2)
+        eng.global_sync(now_ms=NOW + 3)
+        r = eng.get_rate_limits([g(0)], now_ms=NOW + 4)[0]
+        assert r.remaining == 25
+
+
+def test_leaky_bucket_drains_across_shards():
+    eng = ShardedEngine(n_shards=8, capacity_per_shard=512)
+    req = _req("leak", hits=10, limit=10, duration=10_000, algo=Algorithm.LEAKY_BUCKET)
+    r = eng.get_rate_limits([req], now_ms=NOW)[0]
+    assert r.remaining == 0
+    # rate = duration/limit = 1000ms per token; after 3s three tokens leaked
+    r2 = eng.get_rate_limits(
+        [_req("leak", hits=0, limit=10, duration=10_000, algo=Algorithm.LEAKY_BUCKET)],
+        now_ms=NOW + 3_000,
+    )[0]
+    assert r2.remaining == 3
