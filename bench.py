@@ -1,0 +1,108 @@
+"""Headline benchmark: rate-limit decisions/sec on one chip at 10M active keys.
+
+Measures the steady-state throughput of the batched decision kernel
+(ops/decide.py) against a 10M-slot key table resident in HBM — the TPU-native
+replacement for the reference's per-request bucket state machines
+(reference: algorithms.go:24-336, production headline >2,000 req/s/node,
+README.md:94-100; see BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+REFERENCE_BASELINE_RPS = 2_000.0  # reference production node (README.md:94-100)
+TABLE_CAPACITY = 10_000_000  # north-star active key count (BASELINE.json)
+BATCH_WIDTH = 4_096  # one aggregated batch window
+N_BATCH_VARIANTS = 8
+TARGET_SECONDS = 3.0
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from gubernator_tpu.ops.decide import ReqBatch, decide, make_table
+    from gubernator_tpu.types import Algorithm
+    from gubernator_tpu.utils.platform import donation_supported
+
+    rng = np.random.RandomState(42)
+    state = make_table(TABLE_CAPACITY)
+
+    def make_batch(seed: int) -> ReqBatch:
+        r = np.random.RandomState(seed)
+        # distinct slots per window (engine guarantees via rounds)
+        slots = r.choice(TABLE_CAPACITY, BATCH_WIDTH, replace=False).astype(np.int32)
+        return ReqBatch(
+            slot=jnp.asarray(slots),
+            hits=jnp.asarray(r.randint(0, 5, BATCH_WIDTH), jnp.int64),
+            limit=jnp.asarray(r.choice([100, 1000, 10000], BATCH_WIDTH), jnp.int64),
+            duration=jnp.asarray(np.full(BATCH_WIDTH, 60_000), jnp.int64),
+            algorithm=jnp.asarray(
+                r.choice(
+                    [int(Algorithm.TOKEN_BUCKET), int(Algorithm.LEAKY_BUCKET)],
+                    BATCH_WIDTH,
+                ),
+                jnp.int32,
+            ),
+            behavior=jnp.zeros(BATCH_WIDTH, jnp.int32),
+            greg_expire=jnp.zeros(BATCH_WIDTH, jnp.int64),
+            greg_interval=jnp.zeros(BATCH_WIDTH, jnp.int64),
+            fresh=jnp.zeros(BATCH_WIDTH, bool),
+        )
+
+    batches = [make_batch(s) for s in range(N_BATCH_VARIANTS)]
+    donate = donation_supported()
+    step = jax.jit(decide, donate_argnums=(0,) if donate else ())
+
+    now = 1_700_000_000_000
+    # Warm-up: compile + populate the touched rows.
+    state, resp = step(state, batches[0], now)
+    jax.block_until_ready(resp)
+
+    # Calibrate iteration count for ~TARGET_SECONDS.
+    t0 = time.perf_counter()
+    state, resp = step(state, batches[1], now + 1)
+    jax.block_until_ready(resp)
+    per_call = max(time.perf_counter() - t0, 1e-5)
+    iters = max(20, min(5000, int(TARGET_SECONDS / per_call)))
+
+    lat = np.zeros(iters)
+    t_start = time.perf_counter()
+    for i in range(iters):
+        t1 = time.perf_counter()
+        state, resp = step(state, batches[i % N_BATCH_VARIANTS], now + 2 + i)
+        jax.block_until_ready(resp)
+        lat[i] = time.perf_counter() - t1
+    elapsed = time.perf_counter() - t_start
+
+    decisions_per_sec = iters * BATCH_WIDTH / elapsed
+    p50 = float(np.percentile(lat, 50) * 1e3)
+    p99 = float(np.percentile(lat, 99) * 1e3)
+
+    print(
+        json.dumps(
+            {
+                "metric": "rate-limit decisions/sec/chip @ 10M active keys",
+                "value": round(decisions_per_sec, 1),
+                "unit": "decisions/s",
+                "vs_baseline": round(decisions_per_sec / REFERENCE_BASELINE_RPS, 2),
+                "batch_width": BATCH_WIDTH,
+                "table_capacity": TABLE_CAPACITY,
+                "window_p50_ms": round(p50, 3),
+                "window_p99_ms": round(p99, 3),
+                "iters": iters,
+                "device": str(jax.devices()[0]),
+                "donated": donate,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

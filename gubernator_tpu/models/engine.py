@@ -22,14 +22,14 @@ The engine is synchronous and thread-safe via one lock — the service layer
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from gubernator_tpu.models.keyspace import KeyDirectory
-from gubernator_tpu.models.prep import preprocess
+from gubernator_tpu.models.prep import bucket_width as _bucket_width, preprocess
 from gubernator_tpu.ops.decide import (
     I32,
     I64,
@@ -39,20 +39,8 @@ from gubernator_tpu.ops.decide import (
     make_table,
 )
 from gubernator_tpu.store import BucketSnapshot, Loader, Store
-from gubernator_tpu.types import (
-    Algorithm,
-    Behavior,
-    RateLimitReq,
-    RateLimitResp,
-)
+from gubernator_tpu.types import RateLimitReq, RateLimitResp
 from gubernator_tpu.utils.interval import millisecond_now
-
-
-def _bucket_width(n: int, lo: int, hi: int) -> int:
-    w = lo
-    while w < n:
-        w *= 2
-    return min(w, hi)
 
 
 def _inject_rows(state: TableState, slot, algo, limit, remaining, duration,

@@ -33,6 +33,15 @@ from gubernator_tpu.utils.gregorian import (
 WorkItem = Tuple[int, RateLimitReq, int, int]
 
 
+def bucket_width(n: int, lo: int, hi: int) -> int:
+    """Round a batch width up to a power-of-two bucket in [lo, hi] so XLA
+    compiles a handful of program shapes and reuses them."""
+    w = lo
+    while w < n:
+        w *= 2
+    return min(w, hi)
+
+
 def preprocess(
     requests: Sequence[RateLimitReq], now_ms: int
 ) -> Tuple[List[Optional[RateLimitResp]], List[List[WorkItem]], int]:

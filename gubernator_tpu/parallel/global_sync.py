@@ -24,14 +24,13 @@ exactly like the reference's non-owner local-cache answer
 
 from __future__ import annotations
 
-from functools import partial
 from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from gubernator_tpu.ops.decide import I32, I64, ReqBatch, TableState, decide
+from gubernator_tpu.ops.decide import I32, ReqBatch, TableState, decide
 from gubernator_tpu.parallel.mesh import MeshPlan, REGION_AXIS, SHARD_AXIS
 
 

@@ -15,13 +15,17 @@ Behavior=GLOBAL here (reference: gubernator.go:226-247):
 - a key's FIRST touch (mirror miss) goes through the authoritative kernel
   synchronously and its hits are NOT queued — slightly stricter than the
   reference, which both queues the hit and processes it as-if-owner
-  (double-counting one window's hits, gubernator.go:227-246).
+  (double-counting one window's hits, gubernator.go:227-246);
+- between syncs the local mirror's `remaining` is optimistically decremented
+  by locally-queued hits — stricter than the reference, which returns the
+  cached broadcast unmodified (gubernator.go:232-240) and so admits
+  unbounded hits per peer per sync window; each broadcast overwrites the
+  optimistic copy with the authoritative psum result.
 """
 
 from __future__ import annotations
 
 import threading
-from functools import partial
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -30,8 +34,8 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from gubernator_tpu.models.keyspace import KeyDirectory
-from gubernator_tpu.models.prep import WorkItem, preprocess
-from gubernator_tpu.ops.decide import I32, I64, ReqBatch, RespBatch, TableState, decide
+from gubernator_tpu.models.prep import WorkItem, bucket_width, preprocess
+from gubernator_tpu.ops.decide import ReqBatch, RespBatch, TableState, decide
 from gubernator_tpu.parallel.global_sync import (
     GlobalConfig,
     GlobalMirror,
@@ -83,14 +87,12 @@ def make_decide_sharded(plan: MeshPlan, donate: bool = False):
 class _GlobalEntry:
     """Host record for one registered global key."""
 
-    __slots__ = ("gidx", "owner", "req", "greg_expire", "greg_interval", "seen")
+    __slots__ = ("gidx", "owner", "req", "seen")
 
     def __init__(self, gidx: int, owner: int):
         self.gidx = gidx
         self.owner = owner
         self.req: Optional[RateLimitReq] = None
-        self.greg_expire = 0
-        self.greg_interval = 0
         self.seen = False  # at least one broadcast has populated the mirror
 
 
@@ -189,7 +191,9 @@ class ShardedEngine:
             cfg = self._build_global_config(now_ms)
             delta = self._place_delta()
             self.state, mirror, _ = self._sync(self.state, delta, cfg, now_ms)
-            self._mirror = GlobalMirror(*(np.asarray(c) for c in mirror))
+            # np.array (not asarray): the host mirror must be writable for
+            # optimistic deduction between syncs
+            self._mirror = GlobalMirror(*(np.array(c) for c in mirror))
             self._gdelta[:] = 0
             for e in live:
                 e.seen = True
@@ -205,7 +209,7 @@ class ShardedEngine:
         """Answer a GLOBAL request from the replicated mirror; queue its hits
         for the next sync. Returns False if the item must go to the kernel
         (not GLOBAL, or first touch)."""
-        i, r, ge, gi = item
+        i, r, _ge, _gi = item
         if not has_behavior(r.behavior, Behavior.GLOBAL):
             return False
         key = r.hash_key()
@@ -217,21 +221,32 @@ class ShardedEngine:
             entry = _GlobalEntry(len(self._globals), self.owner_of(key))
             self._globals[key] = entry
         entry.req = r
-        entry.greg_expire = ge
-        entry.greg_interval = gi
         if not entry.seen:
             return False  # first touch: authoritative kernel path
         self._gdelta[entry.gidx] += r.hits
         self.stats["global_hits_queued"] += int(r.hits)
         self.stats["global_mirror_answers"] += 1
-        st = int(self._mirror.status[entry.gidx])
+        # Optimistic local admission against the last broadcast: deduct hits
+        # we can satisfy, reject the rest without deducting (token-bucket
+        # response semantics, algorithms.go:107-133). Stricter than the
+        # reference's frozen cached answer; authoritative state arrives with
+        # the next broadcast.
+        g = entry.gidx
+        rem = int(self._mirror.remaining[g])
+        st = int(self._mirror.status[g])
+        if r.hits > 0:
+            if rem == 0 or r.hits > rem:
+                st = int(Status.OVER_LIMIT)
+            else:
+                rem -= r.hits
+                self._mirror.remaining[g] = rem
         if st == Status.OVER_LIMIT:
             self.stats["over_limit"] += 1
         responses[i] = RateLimitResp(
             status=st,
-            limit=int(self._mirror.limit[entry.gidx]),
-            remaining=int(self._mirror.remaining[entry.gidx]),
-            reset_time=int(self._mirror.reset_time[entry.gidx]),
+            limit=int(self._mirror.limit[g]),
+            remaining=rem,
+            reset_time=int(self._mirror.reset_time[g]),
         )
         return True
 
@@ -241,10 +256,7 @@ class ShardedEngine:
         for item in round_work:
             lanes[self.owner_of(item[1].hash_key())].append(item)
         width = max(len(l) for l in lanes)
-        w = self.min_width
-        while w < width:
-            w *= 2
-        w = min(w, self.max_width)
+        w = bucket_width(width, self.min_width, self.max_width)
 
         cols = {
             "slot": np.full((R, S, w), -1, np.int32),
@@ -313,24 +325,27 @@ class ShardedEngine:
         greg_expire = np.zeros((G,), np.int64)
         greg_interval = np.zeros((G,), np.int64)
         fresh = np.zeros((G,), np.bool_)
+        by_owner: Dict[int, List[Tuple[str, _GlobalEntry]]] = {}
         for key, e in self._globals.items():
-            if e.req is None:
-                continue
-            g = e.gidx
-            slots, fr = self.directories[e.owner].lookup([key])
-            slot[g] = slots[0]
-            owner[g] = e.owner
-            limit[g] = e.req.limit
-            duration[g] = e.req.duration
-            algorithm[g] = int(e.req.algorithm)
-            # the broadcast re-applies with the GLOBAL flag stripped
-            # (reference: global.go:209-214)
-            behavior[g] = int(e.req.behavior) & ~int(Behavior.GLOBAL)
-            fresh[g] = fr[0]
-            if has_behavior(e.req.behavior, Behavior.DURATION_IS_GREGORIAN):
-                local_now = _dt.datetime.fromtimestamp(now_ms / 1000.0)
-                greg_expire[g] = gregorian_expiration(local_now, e.req.duration)
-                greg_interval[g] = gregorian_duration(local_now, e.req.duration)
+            if e.req is not None:
+                by_owner.setdefault(e.owner, []).append((key, e))
+        local_now = _dt.datetime.fromtimestamp(now_ms / 1000.0)
+        for own, entries in by_owner.items():
+            slots, fr = self.directories[own].lookup([k for k, _ in entries])
+            for (key, e), s_, f_ in zip(entries, slots, fr):
+                g = e.gidx
+                slot[g] = s_
+                owner[g] = own
+                limit[g] = e.req.limit
+                duration[g] = e.req.duration
+                algorithm[g] = int(e.req.algorithm)
+                # the broadcast re-applies with the GLOBAL flag stripped
+                # (reference: global.go:209-214)
+                behavior[g] = int(e.req.behavior) & ~int(Behavior.GLOBAL)
+                fresh[g] = f_
+                if has_behavior(e.req.behavior, Behavior.DURATION_IS_GREGORIAN):
+                    greg_expire[g] = gregorian_expiration(local_now, e.req.duration)
+                    greg_interval[g] = gregorian_duration(local_now, e.req.duration)
         return GlobalConfig(
             slot=jnp.asarray(slot),
             owner=jnp.asarray(owner),

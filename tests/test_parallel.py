@@ -114,17 +114,30 @@ class TestGlobal:
         g = lambda h: _req("hot", hits=h, limit=100, behavior=Behavior.GLOBAL)
         eng.get_rate_limits([g(5)], now_ms=NOW)  # authoritative: rem 95
         assert eng.global_sync(now_ms=NOW + 1) == 1
-        # mirror answers are frozen between syncs
+        # mirror answers deduct optimistically between syncs (stricter than
+        # the reference's frozen cached answer, gubernator.go:232-240)
         r1 = eng.get_rate_limits([g(10)], now_ms=NOW + 2)[0]
         r2 = eng.get_rate_limits([g(10), g(10)], now_ms=NOW + 3)
-        assert r1.remaining == 95
-        assert [x.remaining for x in r2] == [95, 95]
+        assert r1.remaining == 85
+        assert [x.remaining for x in r2] == [75, 65]
         assert eng.global_pending_hits() == 30
         # sync applies the summed delta at the owner and rebroadcasts
         eng.global_sync(now_ms=NOW + 4)
         r3 = eng.get_rate_limits([g(0)], now_ms=NOW + 5)[0]
         assert r3.remaining == 65
         assert eng.global_pending_hits() == 0
+
+    def test_mirror_optimistic_rejection(self):
+        """Local admission is bounded between syncs — hits beyond the last
+        broadcast's remaining are rejected locally."""
+        eng = ShardedEngine(n_shards=4, capacity_per_shard=512)
+        g = lambda h: _req("opt", hits=h, limit=10, behavior=Behavior.GLOBAL)
+        eng.get_rate_limits([g(0)], now_ms=NOW)  # first touch: peek, rem 10
+        eng.global_sync(now_ms=NOW + 1)
+        resps = eng.get_rate_limits([g(4) for _ in range(5)], now_ms=NOW + 2)
+        statuses = [r.status for r in resps]
+        assert statuses[:2] == [Status.UNDER_LIMIT] * 2  # 4 + 4 admitted
+        assert all(s == Status.OVER_LIMIT for s in statuses[2:])
 
     def test_global_over_limit_converges(self):
         eng = ShardedEngine(n_shards=4, capacity_per_shard=512)
