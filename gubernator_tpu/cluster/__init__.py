@@ -1,0 +1,17 @@
+from gubernator_tpu.cluster.pickers import (
+    ConsistentHashPicker,
+    RegionPicker,
+    ReplicatedConsistentHashPicker,
+    crc32_hash,
+    fnv1_32,
+    fnv1a_32,
+)
+
+__all__ = [
+    "ConsistentHashPicker",
+    "ReplicatedConsistentHashPicker",
+    "RegionPicker",
+    "crc32_hash",
+    "fnv1_32",
+    "fnv1a_32",
+]

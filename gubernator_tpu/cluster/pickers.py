@@ -1,0 +1,182 @@
+"""Host-tier peer pickers: which *process* owns a rate-limit key.
+
+Two ownership tiers exist in this framework (SURVEY.md §2.2): within a
+process, keys map to mesh shards by `parallel.mesh.shard_of_key`; across
+processes, these pickers map keys to host peers, exactly mirroring the
+reference's consistent-hash rings so that routing behavior (and its tests)
+carry over:
+
+- ConsistentHashPicker: one ring point per peer, crc32 default, binary
+  search with wraparound (reference: hash.go:31-99).
+- ReplicatedConsistentHashPicker: `replicas` ring points per peer
+  (DefaultReplicas=512), 64-bit fnv1 default, point hash of
+  ``str(i) + address`` (reference: replicated_hash.go:27-116).
+- RegionPicker: one sub-picker per datacenter; GetClients returns one owner
+  per region for MULTI_REGION fan-out (reference: region_picker.go:17-95).
+
+Peers are any object carrying an `info: PeerInfo` attribute.
+"""
+
+from __future__ import annotations
+
+import bisect
+import zlib
+from typing import Any, Callable, Dict, List, Optional
+
+from gubernator_tpu.types import PeerInfo
+from gubernator_tpu.utils.fnv import fnv1_64, fnv1a_64
+
+HashFunc = Callable[[bytes], int]
+
+DEFAULT_REPLICAS = 512  # reference: replicated_hash.go:27
+
+
+def crc32_hash(data: bytes) -> int:
+    """Default 32-bit ring hash (reference: hash.go:43-45)."""
+    return zlib.crc32(data) & 0xFFFFFFFF
+
+
+def fnv1_32(data: bytes) -> int:
+    h = 2166136261
+    for b in data:
+        h = ((h * 16777619) & 0xFFFFFFFF) ^ b
+    return h
+
+
+def fnv1a_32(data: bytes) -> int:
+    h = 2166136261
+    for b in data:
+        h = ((h ^ b) * 16777619) & 0xFFFFFFFF
+    return h
+
+
+class PickerEmptyError(RuntimeError):
+    def __init__(self):
+        super().__init__("unable to pick a peer; pool is empty")
+
+
+class ConsistentHashPicker:
+    """Single-point consistent-hash ring (reference: hash.go:31-99)."""
+
+    def __init__(self, hash_func: Optional[HashFunc] = None):
+        self.hash_func = hash_func or crc32_hash
+        self._ring: List[int] = []  # sorted point hashes
+        self._by_hash: Dict[int, Any] = {}
+
+    def new(self) -> "ConsistentHashPicker":
+        """Empty picker with the same configuration (reference: hash.go:48-53)."""
+        return ConsistentHashPicker(self.hash_func)
+
+    def add(self, peer: Any) -> None:
+        h = self.hash_func(peer.info.address.encode())
+        bisect.insort(self._ring, h)
+        self._by_hash[h] = peer
+
+    def size(self) -> int:
+        return len(self._ring)
+
+    def peers(self) -> List[Any]:
+        return list(self._by_hash.values())
+
+    def get_by_peer_info(self, info: PeerInfo) -> Optional[Any]:
+        return self._by_hash.get(self.hash_func(info.address.encode()))
+
+    def get(self, key: str) -> Any:
+        """Owner of `key`: first ring point >= hash(key), wrapping to the
+        smallest (reference: hash.go:83-99)."""
+        if not self._ring:
+            raise PickerEmptyError()
+        h = self.hash_func(key.encode())
+        idx = bisect.bisect_left(self._ring, h)
+        if idx == len(self._ring):
+            idx = 0
+        return self._by_hash[self._ring[idx]]
+
+
+class ReplicatedConsistentHashPicker:
+    """Virtual-node ring: `replicas` points per peer for smooth key spread
+    (reference: replicated_hash.go:34-116)."""
+
+    def __init__(
+        self,
+        hash_func: Optional[HashFunc] = None,
+        replicas: int = DEFAULT_REPLICAS,
+    ):
+        self.hash_func = hash_func or fnv1_64
+        self.replicas = replicas
+        self._points: List[int] = []  # sorted
+        self._point_peer: List[Any] = []  # parallel to _points
+        self._by_address: Dict[str, Any] = {}
+
+    def new(self) -> "ReplicatedConsistentHashPicker":
+        return ReplicatedConsistentHashPicker(self.hash_func, self.replicas)
+
+    def add(self, peer: Any) -> None:
+        addr = peer.info.address
+        self._by_address[addr] = peer
+        pts = [
+            (self.hash_func((str(i) + addr).encode()), peer)
+            for i in range(self.replicas)
+        ]
+        merged = sorted(
+            list(zip(self._points, self._point_peer)) + pts, key=lambda t: t[0]
+        )
+        self._points = [h for h, _ in merged]
+        self._point_peer = [p for _, p in merged]
+
+    def size(self) -> int:
+        return len(self._by_address)
+
+    def peers(self) -> List[Any]:
+        return list(self._by_address.values())
+
+    def get_by_peer_info(self, info: PeerInfo) -> Optional[Any]:
+        return self._by_address.get(info.address)
+
+    def get(self, key: str) -> Any:
+        if not self._by_address:
+            raise PickerEmptyError()
+        h = self.hash_func(key.encode())
+        idx = bisect.bisect_left(self._points, h)
+        if idx == len(self._points):
+            idx = 0
+        return self._point_peer[idx]
+
+
+class RegionPicker:
+    """Two-level picker for multi-datacenter deployments: one sub-picker per
+    region (reference: region_picker.go:17-95)."""
+
+    def __init__(self, picker: Optional[Any] = None):
+        self._template = picker or ConsistentHashPicker()
+        self._regions: Dict[str, Any] = {}
+
+    def new(self) -> "RegionPicker":
+        return RegionPicker(self._template.new())
+
+    def add(self, peer: Any) -> None:
+        dc = peer.info.datacenter
+        if dc not in self._regions:
+            self._regions[dc] = self._template.new()
+        self._regions[dc].add(peer)
+
+    def pickers(self) -> Dict[str, Any]:
+        return self._regions
+
+    def peers(self) -> List[Any]:
+        return [p for picker in self._regions.values() for p in picker.peers()]
+
+    def size(self) -> int:
+        return sum(p.size() for p in self._regions.values())
+
+    def get_by_peer_info(self, info: PeerInfo) -> Optional[Any]:
+        for picker in self._regions.values():
+            peer = picker.get_by_peer_info(info)
+            if peer is not None:
+                return peer
+        return None
+
+    def get_clients(self, key: str) -> List[Any]:
+        """One owner per region, for MULTI_REGION hit replication
+        (reference: region_picker.go:47-59)."""
+        return [picker.get(key) for picker in self._regions.values()]

@@ -1,0 +1,135 @@
+"""Picker tests, modeled on the reference's suite
+(reference: hash_test.go, replicated_hash_test.go)."""
+
+import random
+from types import SimpleNamespace
+
+import pytest
+
+from gubernator_tpu.cluster.pickers import (
+    ConsistentHashPicker,
+    PickerEmptyError,
+    RegionPicker,
+    ReplicatedConsistentHashPicker,
+    crc32_hash,
+    fnv1_32,
+    fnv1a_32,
+)
+from gubernator_tpu.types import PeerInfo
+from gubernator_tpu.utils.fnv import fnv1_64, fnv1a_64
+
+
+def peer(addr, dc=""):
+    return SimpleNamespace(info=PeerInfo(address=addr, datacenter=dc))
+
+
+HOSTS = ["a.svc.local", "b.svc.local", "c.svc.local"]
+
+
+class TestConsistentHash:
+    @pytest.mark.parametrize("fn", [crc32_hash, fnv1_32, fnv1a_32])
+    def test_deterministic_pinning(self, fn):
+        """Same key always lands on the same peer across instances
+        (reference: hash_test.go:18-37)."""
+        p1 = ConsistentHashPicker(fn)
+        p2 = ConsistentHashPicker(fn)
+        for h in HOSTS:
+            p1.add(peer(h))
+            p2.add(peer(h))
+        for i in range(100):
+            key = f"key_{i}"
+            assert p1.get(key).info.address == p2.get(key).info.address
+
+    def test_empty_pool_raises(self):
+        with pytest.raises(PickerEmptyError):
+            ConsistentHashPicker().get("x")
+
+    def test_size_peers_and_lookup(self):
+        p = ConsistentHashPicker()
+        for h in HOSTS:
+            p.add(peer(h))
+        assert p.size() == 3
+        assert {x.info.address for x in p.peers()} == set(HOSTS)
+        assert p.get_by_peer_info(PeerInfo(address="b.svc.local")) is not None
+        assert p.get_by_peer_info(PeerInfo(address="zz")) is None
+
+    def test_distribution_not_degenerate(self):
+        """10k random IP keys must reach every peer
+        (reference: hash_test.go:64-102)."""
+        p = ConsistentHashPicker()
+        for h in HOSTS:
+            p.add(peer(h))
+        rng = random.Random(1)
+        counts = {h: 0 for h in HOSTS}
+        for _ in range(10_000):
+            ip = ".".join(str(rng.randint(0, 255)) for _ in range(4))
+            counts[p.get(ip).info.address] += 1
+        assert all(c > 0 for c in counts.values())
+
+    def test_new_is_empty_same_config(self):
+        p = ConsistentHashPicker(fnv1a_32)
+        p.add(peer("a"))
+        q = p.new()
+        assert q.size() == 0 and q.hash_func is fnv1a_32
+
+
+class TestReplicatedHash:
+    @pytest.mark.parametrize("fn", [fnv1_64, fnv1a_64])
+    def test_even_spread(self, fn):
+        """512 vnodes keep per-peer share near the mean. The reference's
+        distribution test only logs percentages (replicated_hash_test.go:42-79);
+        we assert a 25% band — loose enough for ring variance, tight enough
+        to catch degenerate point placement."""
+        hosts = [f"host-{i}.local" for i in range(8)]
+        p = ReplicatedConsistentHashPicker(fn)
+        for h in hosts:
+            p.add(peer(h))
+        rng = random.Random(2)
+        counts = {h: 0 for h in hosts}
+        n = 10_000
+        for _ in range(n):
+            ip = ".".join(str(rng.randint(0, 255)) for _ in range(4))
+            counts[p.get(ip).info.address] += 1
+        mean = n / len(hosts)
+        for h, c in counts.items():
+            assert abs(c - mean) / mean < 0.25, f"{h}: {c} vs mean {mean}"
+
+    def test_deterministic_pinning(self):
+        p1 = ReplicatedConsistentHashPicker()
+        p2 = ReplicatedConsistentHashPicker()
+        for h in HOSTS:
+            p1.add(peer(h))
+            p2.add(peer(h))
+        for i in range(100):
+            key = f"test_{i}"
+            assert p1.get(key).info.address == p2.get(key).info.address
+
+    def test_size_counts_peers_not_points(self):
+        p = ReplicatedConsistentHashPicker(replicas=16)
+        p.add(peer("a"))
+        p.add(peer("b"))
+        assert p.size() == 2
+
+    def test_empty_pool_raises(self):
+        with pytest.raises(PickerEmptyError):
+            ReplicatedConsistentHashPicker().get("x")
+
+
+class TestRegionPicker:
+    def test_one_owner_per_region(self):
+        rp = RegionPicker()
+        for dc in ["us-east-1", "us-west-2"]:
+            for i in range(3):
+                rp.add(peer(f"{dc}-{i}", dc=dc))
+        owners = rp.get_clients("some_key")
+        assert len(owners) == 2
+        assert {o.info.datacenter for o in owners} == {"us-east-1", "us-west-2"}
+
+    def test_get_by_peer_info_searches_all_regions(self):
+        rp = RegionPicker()
+        rp.add(peer("x", dc="dc1"))
+        rp.add(peer("y", dc="dc2"))
+        assert rp.get_by_peer_info(PeerInfo(address="y")).info.address == "y"
+        assert rp.get_by_peer_info(PeerInfo(address="zz")) is None
+        assert rp.size() == 2
+        assert set(rp.pickers()) == {"dc1", "dc2"}
