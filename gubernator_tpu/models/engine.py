@@ -21,6 +21,7 @@ The engine is synchronous and thread-safe via one lock — the service layer
 
 from __future__ import annotations
 
+import functools as _functools
 import threading
 from typing import Dict, List, Optional, Sequence
 
@@ -65,6 +66,24 @@ def _gather_rows(state: TableState, slot):
             state.status[g])
 
 
+# Jitted callables are shared process-wide (keyed by donate flag) so N
+# engines in one process — the in-process cluster harness boots several —
+# compile each batch width once, not once per engine.
+@_functools.lru_cache(maxsize=None)
+def _jit_decide(donate: bool):
+    return jax.jit(decide, donate_argnums=(0,) if donate else ())
+
+
+@_functools.lru_cache(maxsize=None)
+def _jit_inject(donate: bool):
+    return jax.jit(_inject_rows, donate_argnums=(0,) if donate else ())
+
+
+@_functools.lru_cache(maxsize=None)
+def _jit_gather():
+    return jax.jit(_gather_rows)
+
+
 class EngineStats:
     def __init__(self):
         self.requests = 0
@@ -105,14 +124,46 @@ class Engine:
             from gubernator_tpu.utils.platform import donation_supported
 
             donate = donation_supported()
-        donate_args = (0,) if donate else ()
-        self._decide = jax.jit(decide, donate_argnums=donate_args)
-        self._inject = jax.jit(_inject_rows, donate_argnums=donate_args)
-        self._gather = jax.jit(_gather_rows)
+        self._decide = _jit_decide(donate)
+        self._inject = _jit_inject(donate)
+        self._gather = _jit_gather()
         if loader is not None:
             self.load_snapshot(loader.load())
 
     # ------------------------------------------------------------------ API
+
+    def warmup(self) -> None:
+        """Compile the decision kernel for every width bucket up front.
+
+        XLA compiles one program per batch width; without this the first
+        request at each width pays seconds of compile latency — fatal inside
+        the 500 µs-windowed peer-forwarding path. Daemons call this before
+        serving (no reference analogue; compilation is a TPU concern)."""
+        # enumerate exactly the widths bucket_width can produce, including
+        # the capped terminal width when max_width isn't min_width * 2^k
+        widths = []
+        w = self.min_width
+        while w < self.max_width:
+            widths.append(w)
+            w *= 2
+        widths.append(self.max_width)
+        resp = None
+        with self._lock:
+            for width in widths:
+                reqs = ReqBatch(
+                    slot=jnp.full((width,), -1, I32),
+                    hits=jnp.zeros((width,), I64),
+                    limit=jnp.zeros((width,), I64),
+                    duration=jnp.zeros((width,), I64),
+                    algorithm=jnp.zeros((width,), I32),
+                    behavior=jnp.zeros((width,), I32),
+                    greg_expire=jnp.zeros((width,), I64),
+                    greg_interval=jnp.zeros((width,), I64),
+                    fresh=jnp.zeros((width,), jnp.bool_),
+                )
+                self.state, resp = self._decide(self.state, reqs, 0)
+            if resp is not None:
+                jax.block_until_ready(resp)
 
     def get_rate_limits(
         self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None

@@ -1,0 +1,12 @@
+from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
+from gubernator_tpu.service.instance import ApiError, Instance
+from gubernator_tpu.service.peer_client import PeerClient, PeerNotReadyError
+
+__all__ = [
+    "ApiError",
+    "BehaviorConfig",
+    "Instance",
+    "InstanceConfig",
+    "PeerClient",
+    "PeerNotReadyError",
+]

@@ -1,0 +1,52 @@
+"""Service-level configuration (reference: config.go:28-106).
+
+Defaults mirror the reference's SetDefaults exactly — the 500 µs batch
+window and 1000-item batch cap are the published performance envelope
+(reference: README.md:113-115).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from gubernator_tpu.types import MAX_BATCH_SIZE
+
+
+@dataclasses.dataclass
+class BehaviorConfig:
+    """Tuning for the async batching pipelines (reference: config.go:62-84)."""
+
+    # peer forwarding micro-batch (reference: config.go:87-90)
+    batch_timeout_s: float = 0.5  # wait for a batched peer response
+    batch_wait_s: float = 0.0005  # window before sending a batch
+    batch_limit: int = MAX_BATCH_SIZE
+
+    # GLOBAL sync pipelines (reference: config.go:92-94)
+    global_timeout_s: float = 0.5
+    global_sync_wait_s: float = 0.0005
+    global_batch_limit: int = MAX_BATCH_SIZE
+
+    # multi-region replication (reference: config.go:96-98)
+    multi_region_timeout_s: float = 0.5
+    multi_region_sync_wait_s: float = 1.0
+    multi_region_batch_limit: int = MAX_BATCH_SIZE
+
+
+@dataclasses.dataclass
+class InstanceConfig:
+    """Wiring for one Instance (reference: config.go:28-60)."""
+
+    behaviors: BehaviorConfig = dataclasses.field(default_factory=BehaviorConfig)
+    data_center: str = ""
+    # backend: models.engine.Engine | parallel.sharded.ShardedEngine;
+    # built by the Instance if omitted
+    backend: Optional[object] = None
+    local_picker: Optional[object] = None  # cluster.pickers.*
+    region_picker: Optional[object] = None
+
+    def validate(self) -> None:
+        if self.behaviors.batch_limit > MAX_BATCH_SIZE:
+            raise ValueError(
+                f"behaviors.batch_limit cannot exceed '{MAX_BATCH_SIZE}'"
+            )

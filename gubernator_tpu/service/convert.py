@@ -1,0 +1,84 @@
+"""Conversions between wire protobuf messages and the internal dataclasses.
+
+The engines speak gubernator_tpu.types dataclasses (plain host data, cheap to
+build in batch loops); the serving edge speaks the protobuf contract
+(proto/gubernator.proto). This module is the only place both meet.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from gubernator_tpu.service.pb import gubernator_pb2 as pb
+from gubernator_tpu.service.pb import peers_pb2 as peers_pb
+from gubernator_tpu.types import HealthCheckResp, RateLimitReq, RateLimitResp
+
+
+def req_from_pb(m: "pb.RateLimitReq") -> RateLimitReq:
+    return RateLimitReq(
+        name=m.name,
+        unique_key=m.unique_key,
+        hits=m.hits,
+        limit=m.limit,
+        duration=m.duration,
+        algorithm=int(m.algorithm),
+        behavior=int(m.behavior),
+    )
+
+
+def req_to_pb(r: RateLimitReq) -> "pb.RateLimitReq":
+    return pb.RateLimitReq(
+        name=r.name,
+        unique_key=r.unique_key,
+        hits=r.hits,
+        limit=r.limit,
+        duration=r.duration,
+        algorithm=int(r.algorithm),
+        behavior=int(r.behavior),
+    )
+
+
+def resp_from_pb(m: "pb.RateLimitResp") -> RateLimitResp:
+    return RateLimitResp(
+        status=int(m.status),
+        limit=m.limit,
+        remaining=m.remaining,
+        reset_time=m.reset_time,
+        error=m.error,
+        metadata=dict(m.metadata),
+    )
+
+
+def resp_to_pb(r: RateLimitResp) -> "pb.RateLimitResp":
+    m = pb.RateLimitResp(
+        status=int(r.status),
+        limit=r.limit,
+        remaining=r.remaining,
+        reset_time=r.reset_time,
+        error=r.error,
+    )
+    for k, v in (r.metadata or {}).items():
+        m.metadata[k] = v
+    return m
+
+
+def resps_to_pb_list(rs: Iterable[RateLimitResp]) -> List["pb.RateLimitResp"]:
+    return [resp_to_pb(r) for r in rs]
+
+
+def health_to_pb(h: HealthCheckResp) -> "pb.HealthCheckResp":
+    return pb.HealthCheckResp(
+        status=h.status, message=h.message, peer_count=h.peer_count
+    )
+
+
+__all__ = [
+    "pb",
+    "peers_pb",
+    "req_from_pb",
+    "req_to_pb",
+    "resp_from_pb",
+    "resp_to_pb",
+    "resps_to_pb_list",
+    "health_to_pb",
+]

@@ -1,0 +1,201 @@
+"""Host-tier GLOBAL pipelines: async hit forwarding + owner broadcast.
+
+This is the cross-host half of Behavior=GLOBAL (reference: global.go:28-239).
+Within one host's device mesh the same flows are a single psum step
+(parallel/global_sync.py); between hosts they ride the PeersV1 RPC surface:
+
+- hit pipeline (non-owner side): requests answered from the local cache queue
+  their hits here; hits aggregate per key and flush to each key's owner host
+  at `global_batch_limit` (1000) keys or `global_sync_wait` (500 µs)
+  (reference: global.go:73-156).
+- broadcast pipeline (owner side): every applied GLOBAL request queues an
+  update; on flush the owner re-reads each key's authoritative state
+  (hits=0, GLOBAL flag stripped) and pushes it to every other peer
+  (reference: global.go:159-239).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional
+
+from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.service.convert import resp_to_pb
+from gubernator_tpu.service.pb import peers_pb2 as peers_pb
+from gubernator_tpu.types import Behavior, RateLimitReq, set_behavior
+
+log = logging.getLogger("gubernator_tpu.global")
+
+
+class _Pipeline:
+    """Aggregate-by-key queue flushed at a cap or `wait_s` after the first
+    enqueue into an empty queue (the Interval semantics of the reference's
+    batching loops, interval.go:26-69 / global.go:73-112)."""
+
+    def __init__(self, name: str, wait_s: float, limit: int, flush_fn):
+        self._name = name
+        self._wait_s = wait_s
+        self._limit = limit
+        self._flush_fn = flush_fn
+        self._pending: Dict[str, RateLimitReq] = {}
+        self._deadline: Optional[float] = None
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._run, name=f"global-{name}", daemon=True
+        )
+        self._thread.start()
+
+    def queue(self, req: RateLimitReq, aggregate_hits: bool) -> None:
+        with self._lock:
+            if aggregate_hits:
+                prev = self._pending.get(req.hash_key())
+                if prev is not None:
+                    # same aggregation the reference applies before
+                    # forwarding (global.go:81-88)
+                    req = RateLimitReq(**{**req.__dict__, "hits": req.hits + prev.hits})
+            self._pending[req.hash_key()] = req
+            n = len(self._pending)
+            if n == 1:
+                self._deadline = time.monotonic() + self._wait_s
+        if n == 1 or n >= self._limit:
+            self._wake.set()
+
+    def _drain(self) -> Dict[str, RateLimitReq]:
+        with self._lock:
+            out, self._pending = self._pending, {}
+            self._deadline = None
+        return out
+
+    def _run(self) -> None:
+        while not self._closed:
+            with self._lock:
+                n = len(self._pending)
+                deadline = self._deadline
+            if n == 0:
+                self._wake.wait(timeout=0.1)
+                self._wake.clear()
+                continue
+            delay = (deadline or 0) - time.monotonic()
+            if n < self._limit and delay > 0:
+                self._wake.wait(timeout=delay)
+                self._wake.clear()
+                with self._lock:
+                    not_ready = (
+                        len(self._pending) < self._limit
+                        and self._deadline is not None
+                        and time.monotonic() < self._deadline
+                    )
+                if not_ready and not self._closed:
+                    continue
+            batch = self._drain()
+            if batch:
+                try:
+                    self._flush_fn(batch)
+                except Exception:  # noqa: BLE001 — pipeline must survive peers dying
+                    log.exception("%s flush failed", self._name)
+
+    def flush_now(self) -> None:
+        """Synchronous flush for tests and shutdown."""
+        batch = self._drain()
+        if batch:
+            self._flush_fn(batch)
+
+    def close(self) -> None:
+        self._closed = True
+        self._wake.set()
+        self._thread.join(timeout=1.0)
+        self.flush_now()
+
+
+class GlobalManager:
+    """Owns both GLOBAL pipelines for one Instance."""
+
+    def __init__(self, instance, behaviors: BehaviorConfig):
+        self.instance = instance
+        self.conf = behaviors
+        self._hits = _Pipeline(
+            "hits", behaviors.global_sync_wait_s, behaviors.global_batch_limit,
+            self._send_hits,
+        )
+        self._broadcasts = _Pipeline(
+            "broadcast", behaviors.global_sync_wait_s,
+            behaviors.global_batch_limit, self._broadcast,
+        )
+        self.stats = {"hits_sent": 0, "broadcasts_sent": 0, "broadcast_errors": 0}
+
+    def queue_hit(self, req: RateLimitReq) -> None:
+        """Non-owner: forward these hits to the owner on the next window
+        (reference: global.go:63-65)."""
+        self._hits.queue(req, aggregate_hits=True)
+
+    def queue_update(self, req: RateLimitReq) -> None:
+        """Owner: broadcast this key's state on the next window
+        (reference: global.go:67-69)."""
+        self._broadcasts.queue(req, aggregate_hits=False)
+
+    def flush(self) -> None:
+        self._hits.flush_now()
+        self._broadcasts.flush_now()
+
+    def close(self) -> None:
+        self._hits.close()
+        self._broadcasts.close()
+
+    # ------------------------------------------------------------ internals
+
+    def _send_hits(self, batch: Dict[str, RateLimitReq]) -> None:
+        """Group aggregated hits by owner peer and relay them
+        (reference: global.go:116-156)."""
+        by_peer = {}
+        for key, req in batch.items():
+            peer = self.instance.get_peer(key)
+            by_peer.setdefault(id(peer), (peer, []))[1].append(req)
+        for peer, reqs in by_peer.values():
+            if peer.info.is_owner:
+                # our own host owns these keys — apply directly
+                self.instance.apply_owner_batch(reqs)
+            else:
+                try:
+                    peer.get_peer_rate_limits(reqs)
+                except Exception:  # noqa: BLE001
+                    log.exception(
+                        "error sending global hits to '%s'", peer.info.address
+                    )
+                    continue
+            self.stats["hits_sent"] += len(reqs)
+
+    def _broadcast(self, batch: Dict[str, RateLimitReq]) -> None:
+        """Re-read authoritative state and push it to every peer
+        (reference: global.go:194-239)."""
+        updates = []
+        for key, req in batch.items():
+            peek = RateLimitReq(**req.__dict__)
+            peek.hits = 0
+            peek.behavior = set_behavior(peek.behavior, Behavior.GLOBAL, False)
+            resp = self.instance.apply_owner_batch([peek])[0]
+            if resp.error:
+                continue
+            updates.append(
+                peers_pb.UpdatePeerGlobal(
+                    key=key,
+                    status=resp_to_pb(resp),
+                    algorithm=int(req.algorithm),
+                )
+            )
+        if not updates:
+            return
+        for peer in self.instance.local_peers():
+            if peer.info.is_owner:  # ourselves
+                continue
+            try:
+                peer.update_peer_globals(updates)
+                self.stats["broadcasts_sent"] += 1
+            except Exception:  # noqa: BLE001
+                self.stats["broadcast_errors"] += 1
+                log.exception(
+                    "error sending global updates to '%s'", peer.info.address
+                )

@@ -1,0 +1,101 @@
+"""gRPC service registration and client stubs, hand-rolled.
+
+The wire contract matches the reference exactly (method paths
+``/pb.gubernator.V1/...`` and ``/pb.gubernator.PeersV1/...``, reference:
+proto/gubernator.proto:27-45, proto/peers.proto:28-34), so existing
+gubernator clients interoperate. We register handlers through grpc's generic
+handler API instead of protoc-generated stubs (grpc's python codegen plugin
+isn't part of our toolchain; the generated code is a thin wrapper over
+exactly these calls anyway).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from gubernator_tpu.service.pb import gubernator_pb2 as pb
+from gubernator_tpu.service.pb import peers_pb2 as peers_pb
+
+V1_SERVICE = "pb.gubernator.V1"
+PEERS_SERVICE = "pb.gubernator.PeersV1"
+
+
+def _serialize(msg):
+    return msg.SerializeToString()
+
+
+def v1_handler(servicer) -> grpc.GenericRpcHandler:
+    """Bind a servicer with GetRateLimits/HealthCheck methods
+    (signature: fn(request_pb, context) -> response_pb)."""
+    return grpc.method_handlers_generic_handler(
+        V1_SERVICE,
+        {
+            "GetRateLimits": grpc.unary_unary_rpc_method_handler(
+                servicer.GetRateLimits,
+                request_deserializer=pb.GetRateLimitsReq.FromString,
+                response_serializer=_serialize,
+            ),
+            "HealthCheck": grpc.unary_unary_rpc_method_handler(
+                servicer.HealthCheck,
+                request_deserializer=pb.HealthCheckReq.FromString,
+                response_serializer=_serialize,
+            ),
+        },
+    )
+
+
+def peers_handler(servicer) -> grpc.GenericRpcHandler:
+    """Bind a servicer with GetPeerRateLimits/UpdatePeerGlobals methods."""
+    return grpc.method_handlers_generic_handler(
+        PEERS_SERVICE,
+        {
+            "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
+                servicer.GetPeerRateLimits,
+                request_deserializer=peers_pb.GetPeerRateLimitsReq.FromString,
+                response_serializer=_serialize,
+            ),
+            "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
+                servicer.UpdatePeerGlobals,
+                request_deserializer=peers_pb.UpdatePeerGlobalsReq.FromString,
+                response_serializer=_serialize,
+            ),
+        },
+    )
+
+
+class V1Stub:
+    """Client stub for the public service (reference: client.go:38-49)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetRateLimits = channel.unary_unary(
+            f"/{V1_SERVICE}/GetRateLimits",
+            request_serializer=_serialize,
+            response_deserializer=pb.GetRateLimitsResp.FromString,
+        )
+        self.HealthCheck = channel.unary_unary(
+            f"/{V1_SERVICE}/HealthCheck",
+            request_serializer=_serialize,
+            response_deserializer=pb.HealthCheckResp.FromString,
+        )
+
+
+class PeersV1Stub:
+    """Client stub for the peer-only service (reference: peer_client.go:81-125)."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.GetPeerRateLimits = channel.unary_unary(
+            f"/{PEERS_SERVICE}/GetPeerRateLimits",
+            request_serializer=_serialize,
+            response_deserializer=peers_pb.GetPeerRateLimitsResp.FromString,
+        )
+        self.UpdatePeerGlobals = channel.unary_unary(
+            f"/{PEERS_SERVICE}/UpdatePeerGlobals",
+            request_serializer=_serialize,
+            response_deserializer=peers_pb.UpdatePeerGlobalsResp.FromString,
+        )
+
+
+def dial_v1(address: str) -> V1Stub:
+    """Connect to a server, returning a ready V1 stub
+    (reference: client.go:38-49 DialV1Server)."""
+    return V1Stub(grpc.insecure_channel(address))

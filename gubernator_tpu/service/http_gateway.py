@@ -1,0 +1,125 @@
+"""HTTP JSON gateway: the same routes the reference's grpc-gateway serves.
+
+POST /v1/GetRateLimits and GET /v1/HealthCheck accept/return the proto3 JSON
+mapping (camelCase or original field names — reference:
+gubernator.pb.gw.go:33-77), plus GET /metrics for prometheus
+(reference: cmd/gubernator/main.go:127-144). Implemented natively on the
+stdlib threading HTTP server — no gRPC hop in between: the gateway calls the
+Instance directly.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from google.protobuf import json_format
+
+from gubernator_tpu.service.convert import (
+    health_to_pb,
+    req_from_pb,
+    resps_to_pb_list,
+)
+from gubernator_tpu.service.instance import ApiError, Instance
+from gubernator_tpu.service.metrics import CONTENT_TYPE_LATEST, Metrics
+from gubernator_tpu.service.pb import gubernator_pb2 as pb
+
+log = logging.getLogger("gubernator_tpu.gateway")
+
+
+class HttpGateway:
+    """Serves /v1/GetRateLimits, /v1/HealthCheck and /metrics."""
+
+    def __init__(
+        self,
+        instance: Instance,
+        address: str = "127.0.0.1:9080",
+        metrics: Optional[Metrics] = None,
+    ):
+        host, _, port = address.rpartition(":")
+        self.instance = instance
+        self.metrics = metrics
+        gateway = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # route through logging
+                log.debug("%s " + fmt, self.address_string(), *args)
+
+            def _reply(self, code: int, body: bytes, ctype="application/json"):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _reply_json(self, code: int, msg) -> None:
+                self._reply(code, json_format.MessageToJson(msg).encode())
+
+            def _reply_error(self, code: int, message: str) -> None:
+                # grpc-gateway error shape: {"error": ..., "code": ...}
+                self._reply(
+                    code,
+                    ('{"error": "%s", "code": %d}' % (message, code)).encode(),
+                )
+
+            def do_GET(self):
+                if self.path == "/v1/HealthCheck":
+                    self._reply_json(200, health_to_pb(gateway.instance.health_check()))
+                elif self.path == "/metrics":
+                    if gateway.metrics is None:
+                        self._reply_error(404, "metrics disabled")
+                    else:
+                        self._reply(
+                            200,
+                            gateway.metrics.render(gateway.instance),
+                            ctype=CONTENT_TYPE_LATEST,
+                        )
+                else:
+                    self._reply_error(404, "not found")
+
+            def do_POST(self):
+                if self.path != "/v1/GetRateLimits":
+                    self._reply_error(404, "not found")
+                    return
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                try:
+                    msg = json_format.Parse(body, pb.GetRateLimitsReq())
+                except json_format.ParseError as e:
+                    self._reply_error(400, f"invalid request: {e}")
+                    return
+                try:
+                    resps = gateway.instance.get_rate_limits(
+                        [req_from_pb(m) for m in msg.requests]
+                    )
+                except ApiError as e:
+                    self._reply_error(400, e.message)
+                    return
+                self._reply_json(
+                    200, pb.GetRateLimitsResp(responses=resps_to_pb_list(resps))
+                )
+
+        self._server = ThreadingHTTPServer((host or "127.0.0.1", int(port)), Handler)
+        self._server.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"{host}:{port}"
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="http-gateway", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)

@@ -1,0 +1,366 @@
+"""Instance: the core request router.
+
+The reference routes each request of a batch through a 1000-wide goroutine
+fan-out, taking a global cache mutex per request (reference:
+gubernator.go:110-224). Here routing is a partition pass: one walk over the
+batch splits it into (a) locally-owned requests — applied to the TPU backend
+as ONE batched kernel call, (b) per-peer forward lists riding the micro-batch
+windows, (c) GLOBAL cache answers. The goroutine fan-out disappears into the
+vectorized kernel.
+
+Owner semantics, health checking, peer rebuild/drain on membership change,
+and the GLOBAL/multi-region queues mirror the reference Instance
+(gubernator.go:41-468).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+from gubernator_tpu.cluster.pickers import (
+    PickerEmptyError,
+    RegionPicker,
+    ReplicatedConsistentHashPicker,
+)
+from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
+from gubernator_tpu.service.global_manager import GlobalManager
+from gubernator_tpu.service.multiregion import MultiRegionManager
+from gubernator_tpu.service.peer_client import PeerClient, PeerNotReadyError
+from gubernator_tpu.types import (
+    MAX_BATCH_SIZE,
+    Behavior,
+    HealthCheckResp,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+    has_behavior,
+    set_behavior,
+)
+from gubernator_tpu.utils.lru import CacheItem, LRUCache
+
+log = logging.getLogger("gubernator_tpu.instance")
+
+
+class ApiError(Exception):
+    """Whole-call failure surfaced as a gRPC status (OUT_OF_RANGE for batch
+    overflow, reference: gubernator.go:113-116)."""
+
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+class _GlobalStatus:
+    """Mutable non-owner copy of a GLOBAL key's last broadcast, supporting
+    optimistic local deduction between broadcasts (stricter than the
+    reference's frozen cached answer, gubernator.go:232-240)."""
+
+    __slots__ = ("status", "limit", "remaining", "reset_time")
+
+    def __init__(self, status: int, limit: int, remaining: int, reset_time: int):
+        self.status = status
+        self.limit = limit
+        self.remaining = remaining
+        self.reset_time = reset_time
+
+
+class Instance:
+    """One serving process (reference: gubernator.go:41-48)."""
+
+    def __init__(self, conf: Optional[InstanceConfig] = None,
+                 advertise_address: str = ""):
+        conf = conf or InstanceConfig()
+        conf.validate()
+        self.conf = conf
+        self.advertise_address = advertise_address
+        self.data_center = conf.data_center
+
+        if conf.backend is None:
+            from gubernator_tpu.models.engine import Engine
+
+            conf.backend = Engine()
+        self.backend = conf.backend
+        self._backend_lock = threading.Lock()
+
+        self.local_picker = conf.local_picker or ReplicatedConsistentHashPicker()
+        self.region_picker = conf.region_picker or RegionPicker()
+        self._peer_lock = threading.RLock()
+
+        self.global_manager = GlobalManager(self, conf.behaviors)
+        self.multiregion_manager = MultiRegionManager(self, conf.behaviors)
+        # non-owner cache of GLOBAL statuses (reference: gubernator.go:251-264)
+        self._global_cache = LRUCache()
+        self._forward_pool = ThreadPoolExecutor(
+            max_workers=64, thread_name_prefix="forward"
+        )
+        self._closed = False
+
+    # ----------------------------------------------------------- public API
+
+    def get_rate_limits(
+        self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        """Route one client batch (reference: gubernator.go:110-224)."""
+        if len(requests) > MAX_BATCH_SIZE:
+            raise ApiError(
+                "OUT_OF_RANGE",
+                f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
+            )
+        responses: List[Optional[RateLimitResp]] = [None] * len(requests)
+        local: List[int] = []
+        futures = []
+
+        for i, req in enumerate(requests):
+            if not req.unique_key:
+                responses[i] = RateLimitResp(error="field 'unique_key' cannot be empty")
+                continue
+            if not req.name:
+                responses[i] = RateLimitResp(error="field 'namespace' cannot be empty")
+                continue
+            key = req.hash_key()
+            try:
+                peer = self.get_peer(key)
+            except PickerEmptyError:
+                # standalone mode: no peer list yet — we own everything
+                local.append(i)
+                continue
+            except Exception as e:  # noqa: BLE001
+                responses[i] = RateLimitResp(
+                    error=f"while finding peer that owns rate limit '{key}' - '{e}'"
+                )
+                continue
+            if peer.info.is_owner:
+                local.append(i)
+            elif has_behavior(req.behavior, Behavior.GLOBAL):
+                responses[i] = self._get_global_rate_limit(req, peer)
+            else:
+                futures.append(
+                    (i, self._forward_pool.submit(self._forward, req, key))
+                )
+
+        if local:
+            batch = [requests[i] for i in local]
+            out = self.apply_owner_batch(batch, now_ms=now_ms)
+            for i, resp in zip(local, out):
+                responses[i] = resp
+        for i, fut in futures:
+            responses[i] = fut.result()
+        return responses  # type: ignore[return-value]
+
+    def get_peer_rate_limits(
+        self, requests: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        """Owner-side application of a forwarded batch
+        (reference: gubernator.go:267-284) — one kernel call, not a loop."""
+        if len(requests) > MAX_BATCH_SIZE:
+            raise ApiError(
+                "OUT_OF_RANGE",
+                f"'PeerRequest.rate_limits' list too large; max size is "
+                f"'{MAX_BATCH_SIZE}'",
+            )
+        return self.apply_owner_batch(list(requests))
+
+    def update_peer_globals(self, updates) -> None:
+        """Receive an owner's GLOBAL broadcast (reference: gubernator.go:251-264).
+        `updates` are peers_pb.UpdatePeerGlobal messages."""
+        for g in updates:
+            self._global_cache.add(
+                CacheItem(
+                    key=g.key,
+                    value=_GlobalStatus(
+                        status=int(g.status.status),
+                        limit=g.status.limit,
+                        remaining=g.status.remaining,
+                        reset_time=g.status.reset_time,
+                    ),
+                    expire_at=g.status.reset_time,
+                    algorithm=int(g.algorithm),
+                )
+            )
+
+    def health_check(self) -> HealthCheckResp:
+        """Accumulate recent peer errors (reference: gubernator.go:287-325)."""
+        errs: List[str] = []
+        with self._peer_lock:
+            for peer in self.local_picker.peers():
+                errs.extend(peer.get_last_err())
+            for peer in self.region_picker.peers():
+                errs.extend(peer.get_last_err())
+            peer_count = self.local_picker.size() + self.region_picker.size()
+        if errs:
+            return HealthCheckResp(
+                status="unhealthy", message="|".join(errs), peer_count=peer_count
+            )
+        return HealthCheckResp(status="healthy", peer_count=peer_count)
+
+    def set_peers(self, peer_infos: Sequence[PeerInfo]) -> None:
+        """Rebuild pickers on membership change, reusing live PeerClients and
+        draining removed ones (reference: gubernator.go:349-417)."""
+        with self._peer_lock:
+            new_local = self.local_picker.new()
+            new_region = self.region_picker.new()
+            for info in peer_infos:
+                info = PeerInfo(
+                    address=info.address,
+                    datacenter=info.datacenter,
+                    is_owner=info.is_owner
+                    or (bool(self.advertise_address)
+                        and info.address == self.advertise_address),
+                )
+                if info.datacenter and info.datacenter != self.data_center:
+                    peer = self.region_picker.get_by_peer_info(info)
+                    if peer is None:
+                        peer = PeerClient(self.conf.behaviors, info)
+                    new_region.add(peer)
+                    continue
+                peer = self.local_picker.get_by_peer_info(info)
+                if peer is None:
+                    peer = PeerClient(self.conf.behaviors, info)
+                else:
+                    peer.info = info
+                new_local.add(peer)
+
+            old_local, self.local_picker = self.local_picker, new_local
+            old_region, self.region_picker = self.region_picker, new_region
+
+        shutdown = [
+            p for p in old_local.peers()
+            if self.local_picker.get_by_peer_info(p.info) is None
+        ] + [
+            p for p in old_region.peers()
+            if self.region_picker.get_by_peer_info(p.info) is None
+        ]
+        for p in shutdown:
+            try:
+                p.shutdown(timeout_s=self.conf.behaviors.batch_timeout_s)
+            except Exception:  # noqa: BLE001
+                log.exception("while shutting down peer %s", p.info.address)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self.global_manager.close()
+        self.multiregion_manager.close()
+        self._forward_pool.shutdown(wait=False)
+        with self._peer_lock:
+            for p in self.local_picker.peers() + self.region_picker.peers():
+                try:
+                    p.shutdown(timeout_s=0.5)
+                except Exception:  # noqa: BLE001
+                    pass
+        if hasattr(self.backend, "close"):
+            self.backend.close()
+
+    # ------------------------------------------------------------- plumbing
+
+    def get_peer(self, key: str) -> PeerClient:
+        """Owner peer for a key (reference: gubernator.go:420-427)."""
+        with self._peer_lock:
+            return self.local_picker.get(key)
+
+    def local_peers(self) -> List[PeerClient]:
+        with self._peer_lock:
+            return self.local_picker.peers()
+
+    def region_pickers(self) -> Dict[str, object]:
+        with self._peer_lock:
+            return dict(self.region_picker.pickers())
+
+    def apply_owner_batch(
+        self, requests: List[RateLimitReq], now_ms: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        """Apply requests we own to the TPU backend in one batched call,
+        queueing GLOBAL broadcasts / multi-region replication first
+        (reference: gubernator.go:327-347)."""
+        stripped = []
+        for req in requests:
+            if has_behavior(req.behavior, Behavior.GLOBAL):
+                self.global_manager.queue_update(req)
+            if has_behavior(req.behavior, Behavior.MULTI_REGION):
+                self.multiregion_manager.queue_hits(req)
+            if has_behavior(req.behavior, Behavior.GLOBAL):
+                # host tier owns GLOBAL semantics; the backend must treat the
+                # request as a plain owned key (see parallel/sharded.py for
+                # the standalone-mesh GLOBAL path)
+                req = RateLimitReq(**{**req.__dict__})
+                req.behavior = set_behavior(req.behavior, Behavior.GLOBAL, False)
+            stripped.append(req)
+        with self._backend_lock:
+            return self.backend.get_rate_limits(stripped, now_ms=now_ms)
+
+    # ------------------------------------------------------------ internals
+
+    def _forward(self, req: RateLimitReq, key: str) -> RateLimitResp:
+        """Relay to the owning peer, re-picking up to 5 times while peers
+        shut down (reference: gubernator.go:149-157,186-205)."""
+        last_err = ""
+        for _ in range(6):
+            try:
+                peer = self.get_peer(key)
+            except Exception as e:  # noqa: BLE001
+                return RateLimitResp(
+                    error=f"while finding peer that owns rate limit '{key}' - '{e}'"
+                )
+            if peer.info.is_owner:  # membership changed under us
+                return self.apply_owner_batch([req])[0]
+            try:
+                resp = peer.get_peer_rate_limit(req)
+                resp.metadata["owner"] = peer.info.address
+                return resp
+            except PeerNotReadyError as e:
+                last_err = str(e)
+                continue
+            except Exception as e:  # noqa: BLE001
+                return RateLimitResp(
+                    error=f"while fetching rate limit '{key}' from peer - '{e}'"
+                )
+        return RateLimitResp(
+            error=f"GetPeer() keeps returning peers that are not connected for "
+            f"'{key}' - '{last_err}'"
+        )
+
+    def _get_global_rate_limit(
+        self, req: RateLimitReq, owner_peer: PeerClient
+    ) -> RateLimitResp:
+        """Non-owner GLOBAL path: answer from the broadcast cache with
+        optimistic deduction and queue the hits; on a cache miss, relay the
+        first touch to the real owner (deviation: the reference processes a
+        miss locally as-if-owner, double-counting its hits,
+        gubernator.go:226-247)."""
+        with self._global_cache.lock:
+            item = self._global_cache.get_item(req.hash_key())
+            if item is not None:
+                st: _GlobalStatus = item.value
+                status = st.status
+                if req.hits > 0:
+                    if st.remaining == 0 or req.hits > st.remaining:
+                        status = int(Status.OVER_LIMIT)
+                    else:
+                        st.remaining -= req.hits
+                        status = st.status
+                self.global_manager.queue_hit(req)
+                return RateLimitResp(
+                    status=status,
+                    limit=st.limit,
+                    remaining=st.remaining,
+                    reset_time=st.reset_time,
+                    metadata={"owner": owner_peer.info.address},
+                )
+        # first touch: relay synchronously to the owner (its response will
+        # also come back to us via the broadcast pipeline)
+        try:
+            resp = owner_peer.get_peer_rate_limit(req)
+            resp.metadata["owner"] = owner_peer.info.address
+            return resp
+        except Exception:  # noqa: BLE001
+            # owner unreachable: process locally as-if-owner so the limit
+            # still enforces something (reference fallback, gubernator.go:242-246)
+            resp = self.apply_owner_batch([req])[0]
+            resp.metadata["owner"] = owner_peer.info.address
+            return resp

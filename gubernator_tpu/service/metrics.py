@@ -1,0 +1,137 @@
+"""Prometheus metrics exposition.
+
+Metric names mirror the reference so dashboards carry over
+(reference: prometheus.go:51-64 grpc stats; cache.go:87-95 cache collectors;
+global.go:45-51 GLOBAL histograms), plus TPU-specific engine metrics
+(decision throughput, kernel rounds) the reference has no analogue for.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import grpc
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+CONTENT_TYPE_LATEST = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class Metrics:
+    """One registry per daemon (keeps in-process cluster tests isolated)."""
+
+    def __init__(self, registry: Optional[CollectorRegistry] = None):
+        self.registry = registry or CollectorRegistry()
+        # (reference: prometheus.go:51-60)
+        self.grpc_request_counts = Counter(
+            "grpc_request_counts", "GRPC requests by status.",
+            ["status", "method"], registry=self.registry,
+        )
+        self.grpc_request_duration = Histogram(
+            "grpc_request_duration_milliseconds",
+            "GRPC request durations in milliseconds.",
+            ["method"], registry=self.registry,
+            buckets=(0.1, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 500, 1000),
+        )
+        # (reference: cache.go:87-95)
+        self.cache_size = Gauge(
+            "cache_size", "The number of items in the cache.",
+            registry=self.registry,
+        )
+        self.cache_access_count = Counter(
+            "cache_access_count", "Cache access counts.",
+            ["type"], registry=self.registry,
+        )
+        # (reference: global.go:45-51)
+        self.async_durations = Histogram(
+            "async_durations", "The duration of GLOBAL async sends in seconds.",
+            registry=self.registry,
+        )
+        self.broadcast_durations = Histogram(
+            "broadcast_durations",
+            "The duration of GLOBAL broadcasts to peers in seconds.",
+            registry=self.registry,
+        )
+        # TPU-native engine metrics (no reference analogue)
+        self.engine_decisions = Counter(
+            "engine_decisions_total",
+            "Rate-limit decisions applied by the device kernel.",
+            registry=self.registry,
+        )
+        self.engine_kernel_rounds = Counter(
+            "engine_kernel_rounds_total",
+            "Device kernel launches (collision-free rounds).",
+            registry=self.registry,
+        )
+        self.engine_over_limit = Counter(
+            "engine_over_limit_total", "Decisions that returned OVER_LIMIT.",
+            registry=self.registry,
+        )
+
+    def observe_instance(self, instance) -> None:
+        """Refresh gauges from live objects before exposition."""
+        stats = getattr(instance.backend, "stats", None)
+        if stats is not None:
+            d = stats.as_dict() if hasattr(stats, "as_dict") else dict(stats)
+            self._set_counter(self.engine_decisions, d.get("requests", 0))
+            self._set_counter(self.engine_kernel_rounds, d.get("rounds", 0))
+            self._set_counter(self.engine_over_limit, d.get("over_limit", 0))
+        cache = getattr(instance, "_global_cache", None)
+        if cache is not None:
+            self.cache_size.set(len(cache))
+
+    @staticmethod
+    def _set_counter(counter, value: float) -> None:
+        # prometheus counters only go up; engines report monotonic totals
+        current = counter._value.get()  # noqa: SLF001
+        if value > current:
+            counter.inc(value - current)
+
+    def render(self, instance=None) -> bytes:
+        if instance is not None:
+            self.observe_instance(instance)
+        return generate_latest(self.registry)
+
+
+class GRPCStatsInterceptor(grpc.ServerInterceptor):
+    """Per-RPC duration + status counters (reference: prometheus.go:29-138,
+    implemented as an interceptor instead of a stats.Handler)."""
+
+    def __init__(self, metrics: Metrics):
+        self.metrics = metrics
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or handler.unary_unary is None:
+            return handler
+        method = handler_call_details.method.rsplit("/", 1)[-1]
+        inner = handler.unary_unary
+        metrics = self.metrics
+
+        def wrapped(request, context):
+            start = time.perf_counter()
+            try:
+                resp = inner(request, context)
+                metrics.grpc_request_counts.labels(status="ok", method=method).inc()
+                return resp
+            except Exception:
+                metrics.grpc_request_counts.labels(
+                    status="failed", method=method
+                ).inc()
+                raise
+            finally:
+                metrics.grpc_request_duration.labels(method=method).observe(
+                    (time.perf_counter() - start) * 1e3
+                )
+
+        return grpc.unary_unary_rpc_method_handler(
+            wrapped,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )

@@ -1,0 +1,17 @@
+"""Generated protobuf modules (see proto/ and scripts/genproto.sh).
+
+protoc emits flat `import gubernator_pb2` statements; expose this package's
+directory on sys.path so the generated modules can find each other.
+"""
+
+import os as _os
+import sys as _sys
+
+_here = _os.path.dirname(__file__)
+if _here not in _sys.path:
+    _sys.path.insert(0, _here)
+
+import gubernator_pb2  # noqa: E402
+import peers_pb2  # noqa: E402
+
+__all__ = ["gubernator_pb2", "peers_pb2"]

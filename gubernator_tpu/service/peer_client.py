@@ -1,0 +1,180 @@
+"""Peer RPC client with micro-batching.
+
+Mirrors the reference's per-peer request pipeline (reference:
+peer_client.go:47-383): a lazy gRPC connection, a per-peer queue whose
+batches flush at `batch_limit` (1000) items or `batch_wait` (500 µs) after
+the first enqueue — the thundering-herd defense the reference documents
+(architecture.md:19-25) — plus a NO_BATCHING bypass, graceful shutdown that
+drains in-flight requests, and an LRU of recent errors feeding HealthCheck
+(reference: peer_client.go:184-213).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+import grpc
+
+from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.service.convert import req_to_pb, resp_from_pb
+from gubernator_tpu.service.grpc_api import PeersV1Stub
+from gubernator_tpu.service.pb import peers_pb2 as peers_pb
+from gubernator_tpu.types import Behavior, PeerInfo, RateLimitReq, RateLimitResp, has_behavior
+from gubernator_tpu.utils.lru import CacheItem, LRUCache
+
+
+class PeerNotReadyError(RuntimeError):
+    """Raised when the peer is shutting down; the router retries another
+    owner pick (reference: peer_client.go:359-383 IsNotReady)."""
+
+
+class PeerClient:
+    """One remote peer: connection + batching queue + error history."""
+
+    ERR_TTL_MS = 5 * 60 * 1000  # last-error retention (reference: peer_client.go:53)
+
+    def __init__(self, behaviors: BehaviorConfig, info: PeerInfo):
+        self.conf = behaviors
+        self.info = info
+        self._stub: Optional[PeersV1Stub] = None
+        self._channel: Optional[grpc.Channel] = None
+        self._queue: "queue.Queue" = queue.Queue()
+        self._closing = False
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self.last_errs = LRUCache(max_size=100)
+
+    # ------------------------------------------------------------ lifecycle
+
+    def _connect(self) -> PeersV1Stub:
+        """Lazy connect (reference: peer_client.go:81-125)."""
+        with self._lock:
+            if self._stub is None:
+                self._channel = grpc.insecure_channel(self.info.address)
+                self._stub = PeersV1Stub(self._channel)
+                self._thread = threading.Thread(
+                    target=self._run, name=f"peer-batch-{self.info.address}",
+                    daemon=True,
+                )
+                self._thread.start()
+            return self._stub
+
+    def shutdown(self, timeout_s: Optional[float] = None) -> None:
+        """Stop accepting requests and drain the queue
+        (reference: peer_client.go:322-356)."""
+        with self._lock:
+            if self._closing:
+                return
+            self._closing = True
+        self._queue.put(None)  # wake the batch loop
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s or self.conf.batch_timeout_s)
+        if self._channel is not None:
+            self._channel.close()
+
+    # ------------------------------------------------------------------ API
+
+    def get_peer_rate_limit(self, req: RateLimitReq) -> RateLimitResp:
+        """Forward one request to this peer, batching unless NO_BATCHING
+        (reference: peer_client.go:127-140)."""
+        if has_behavior(req.behavior, Behavior.NO_BATCHING):
+            resps = self.get_peer_rate_limits([req])
+            return resps[0]
+        if self._closing:
+            raise PeerNotReadyError(self.info.address)
+        self._connect()
+        fut: "Future[RateLimitResp]" = Future()
+        self._queue.put((req, fut))
+        try:
+            return fut.result(timeout=self.conf.batch_timeout_s)
+        except TimeoutError:
+            self._record_err("batch response timeout")
+            raise
+
+    def get_peer_rate_limits(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+        """One direct unary RPC carrying the whole batch."""
+        stub = self._connect()
+        msg = peers_pb.GetPeerRateLimitsReq(requests=[req_to_pb(r) for r in reqs])
+        try:
+            out = stub.GetPeerRateLimits(msg, timeout=self.conf.batch_timeout_s)
+        except grpc.RpcError as e:
+            self._record_err(str(e.code()))
+            raise
+        return [resp_from_pb(m) for m in out.rate_limits]
+
+    def update_peer_globals(self, updates) -> None:
+        """Push a batch of UpdatePeerGlobal messages (reference:
+        peer_client.go:142-160)."""
+        stub = self._connect()
+        msg = peers_pb.UpdatePeerGlobalsReq(globals=updates)
+        try:
+            stub.UpdatePeerGlobals(msg, timeout=self.conf.global_timeout_s)
+        except grpc.RpcError as e:
+            self._record_err(str(e.code()))
+            raise
+
+    def get_last_err(self) -> List[str]:
+        """Recent errors for HealthCheck (reference: peer_client.go:198-213)."""
+        now = int(time.time() * 1000)
+        return [
+            item.key
+            for item in self.last_errs.each()
+            if item.expire_at == 0 or item.expire_at > now
+        ]
+
+    # ------------------------------------------------------------ internals
+
+    def _record_err(self, err: str) -> None:
+        msg = f"{self.info.address}: {err}"
+        self.last_errs.add(
+            CacheItem(key=msg, expire_at=int(time.time() * 1000) + self.ERR_TTL_MS)
+        )
+
+    def _run(self) -> None:
+        """Batch loop: flush at batch_limit items or batch_wait after the
+        first enqueue (reference: peer_client.go:243-283)."""
+        while True:
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                if self._closing:
+                    return
+                continue
+            if first is None:
+                return
+            batch = [first]
+            deadline = time.monotonic() + self.conf.batch_wait_s
+            while len(batch) < self.conf.batch_limit:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    item = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if item is None:
+                    self._send_batch(batch)
+                    return
+                batch.append(item)
+            self._send_batch(batch)
+
+    def _send_batch(self, batch) -> None:
+        """Send one batch, demuxing responses by index
+        (reference: peer_client.go:287-319)."""
+        try:
+            resps = self.get_peer_rate_limits([req for req, _ in batch])
+            if len(resps) != len(batch):
+                raise RuntimeError(
+                    f"server responded with incorrect rate limit list size: "
+                    f"{len(resps)} != {len(batch)}"
+                )
+            for (_, fut), resp in zip(batch, resps):
+                fut.set_result(resp)
+        except Exception as e:  # noqa: BLE001 — every waiter must wake
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)

@@ -1,0 +1,104 @@
+"""gRPC server assembly: bind an Instance to the V1 + PeersV1 services.
+
+One server carries both services, like the reference's single grpc.Server
+registering V1 and PeersV1 (reference: gubernator.go:68-69,
+cmd/gubernator/main.go:60-66).
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from typing import Optional
+
+import grpc
+
+from gubernator_tpu.service.convert import (
+    health_to_pb,
+    req_from_pb,
+    resps_to_pb_list,
+)
+from gubernator_tpu.service.grpc_api import peers_handler, v1_handler
+from gubernator_tpu.service.instance import ApiError, Instance
+from gubernator_tpu.service.pb import gubernator_pb2 as pb
+from gubernator_tpu.service.pb import peers_pb2 as peers_pb
+
+log = logging.getLogger("gubernator_tpu.server")
+
+# reference caps messages at 1 MB (cmd/gubernator/main.go:60-62)
+MAX_MESSAGE_BYTES = 1024 * 1024
+
+_CODES = {
+    "OUT_OF_RANGE": grpc.StatusCode.OUT_OF_RANGE,
+    "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
+}
+
+
+class V1Servicer:
+    """Public API endpoints (reference: proto/gubernator.proto:27-45)."""
+
+    def __init__(self, instance: Instance):
+        self.instance = instance
+
+    def GetRateLimits(self, request, context):
+        try:
+            resps = self.instance.get_rate_limits(
+                [req_from_pb(m) for m in request.requests]
+            )
+        except ApiError as e:
+            context.abort(_CODES.get(e.code, grpc.StatusCode.UNKNOWN), e.message)
+        return pb.GetRateLimitsResp(responses=resps_to_pb_list(resps))
+
+    def HealthCheck(self, request, context):
+        return health_to_pb(self.instance.health_check())
+
+
+class PeersV1Servicer:
+    """Peer-only endpoints (reference: proto/peers.proto:28-34)."""
+
+    def __init__(self, instance: Instance):
+        self.instance = instance
+
+    def GetPeerRateLimits(self, request, context):
+        try:
+            resps = self.instance.get_peer_rate_limits(
+                [req_from_pb(m) for m in request.requests]
+            )
+        except ApiError as e:
+            context.abort(_CODES.get(e.code, grpc.StatusCode.UNKNOWN), e.message)
+        return peers_pb.GetPeerRateLimitsResp(rate_limits=resps_to_pb_list(resps))
+
+    def UpdatePeerGlobals(self, request, context):
+        self.instance.update_peer_globals(request.globals)
+        return peers_pb.UpdatePeerGlobalsResp()
+
+
+def make_server(
+    instance: Instance,
+    address: str,
+    max_workers: int = 32,
+    stats_handler: Optional[object] = None,
+):
+    """Build (not start) a gRPC server serving both services on `address`.
+
+    Returns (server, bound_port) — port matters when `address` ends in :0
+    (dynamic bind, used by the in-process cluster harness)."""
+    options = [
+        ("grpc.max_receive_message_length", MAX_MESSAGE_BYTES),
+        ("grpc.max_send_message_length", MAX_MESSAGE_BYTES),
+    ]
+    server = grpc.server(
+        ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="grpc"),
+        options=options,
+        **({"interceptors": [stats_handler]} if stats_handler else {}),
+    )
+    server.add_generic_rpc_handlers(
+        (
+            v1_handler(V1Servicer(instance)),
+            peers_handler(PeersV1Servicer(instance)),
+        )
+    )
+    bound = server.add_insecure_port(address)
+    if bound == 0:
+        raise RuntimeError(f"failed to bind gRPC server to {address}")
+    return server, bound

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Regenerate the Python protobuf modules from proto/.
+# (reference equivalent: scripts/proto.sh — but we need only message code;
+# gRPC service registration is hand-written in service/grpc_api.py)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+protoc -I proto --python_out=gubernator_tpu/service/pb \
+    proto/gubernator.proto proto/peers.proto
+echo "generated gubernator_tpu/service/pb/{gubernator,peers}_pb2.py"
