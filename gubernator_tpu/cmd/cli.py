@@ -1,0 +1,98 @@
+"""Load generator CLI: `python -m gubernator_tpu.cmd.cli <address>`.
+
+The reference's gubernator-cli fires 2000 random token-bucket limits with a
+10-way concurrent fan-out forever, printing OVER_LIMIT responses
+(reference: cmd/gubernator-cli/main.go:42-85). Same here, plus a --seconds
+bound and a final throughput line for scripted runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import string
+import sys
+import threading
+import time
+
+from gubernator_tpu.service.grpc_api import dial_v1
+from gubernator_tpu.service.pb import gubernator_pb2 as pb
+
+
+def random_string(prefix: str, n: int = 10) -> str:
+    return prefix + "".join(random.choices(string.ascii_lowercase, k=n))
+
+
+def make_requests(count: int = 2000):
+    """(reference: cmd/gubernator-cli/main.go:49-61)"""
+    out = []
+    for _ in range(count):
+        out.append(
+            pb.RateLimitReq(
+                name=random_string("ID-", 6),
+                unique_key=random_string("ID-", 10),
+                hits=1,
+                limit=random.randint(1, 100),
+                duration=random.randint(1, 10) * 1000,
+            )
+        )
+    return out
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("gubernator-tpu-cli")
+    parser.add_argument("address", help="gRPC address of a gubernator server")
+    parser.add_argument("--concurrency", type=int, default=10)
+    parser.add_argument("--seconds", type=float, default=0,
+                        help="stop after N seconds (0 = forever)")
+    parser.add_argument("--requests", type=int, default=2000)
+    opts = parser.parse_args(argv)
+
+    stub = dial_v1(opts.address)
+    reqs = make_requests(opts.requests)
+    stop_at = time.monotonic() + opts.seconds if opts.seconds else None
+    counts = {"sent": 0, "over_limit": 0, "errors": 0}
+    lock = threading.Lock()
+
+    def worker(shard: int):
+        i = shard
+        while stop_at is None or time.monotonic() < stop_at:
+            req = reqs[i % len(reqs)]
+            i += opts.concurrency
+            try:
+                resp = stub.GetRateLimits(
+                    pb.GetRateLimitsReq(requests=[req]), timeout=5
+                ).responses[0]
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    counts["errors"] += 1
+                print(f"error: {e}", file=sys.stderr)
+                continue
+            with lock:
+                counts["sent"] += 1
+                if resp.status == pb.OVER_LIMIT:
+                    counts["over_limit"] += 1
+                    print(f"over limit: {req.unique_key}")
+
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=worker, args=(i,), daemon=True)
+        for i in range(opts.concurrency)
+    ]
+    for t in threads:
+        t.start()
+    try:
+        for t in threads:
+            t.join()
+    except KeyboardInterrupt:
+        pass
+    elapsed = time.monotonic() - t0
+    print(
+        f"sent={counts['sent']} over_limit={counts['over_limit']} "
+        f"errors={counts['errors']} rps={counts['sent'] / max(elapsed, 1e-9):.0f}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Local test cluster: `python -m gubernator_tpu.cmd.cluster_main`.
+
+Boots an in-process 6-node cluster on fixed loopback ports and prints
+"Ready" — the sentinel the cross-language client test fixtures wait for
+(reference: cmd/gubernator-cluster/main.go:29-55,
+python/tests/test_client.py:25-39).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from gubernator_tpu.cluster.harness import LocalCluster
+
+DEFAULT_PORTS = [9090, 9091, 9092, 9093, 9094, 9095]
+
+
+def main(argv=None) -> int:
+    ports = [int(p) for p in (argv or sys.argv[1:])] or DEFAULT_PORTS
+    cluster = LocalCluster()
+    for port in ports:
+        ci = cluster.start_instance(fixed_port=port)
+        print(f"Listening on {ci.address}", file=sys.stderr)
+    cluster.sync_peers()
+    print("Ready", flush=True)
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        cluster.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,155 @@
+"""The serving daemon: `python -m gubernator_tpu.cmd.daemon`.
+
+Wires everything the reference daemon does (reference:
+cmd/gubernator/main.go:41-160): env config, TPU backend, gRPC server with
+stats interceptor, discovery pool selection, HTTP gateway with /metrics,
+and signal handling — plus the TPU-specific steps the reference has no
+analogue for: backend selection (single-table engine vs mesh-sharded) and
+kernel warmup before serving.
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import sys
+import threading
+
+from gubernator_tpu.cmd.envconf import DaemonConfig, build_picker, config_from_env
+from gubernator_tpu.service.config import InstanceConfig
+from gubernator_tpu.service.http_gateway import HttpGateway
+from gubernator_tpu.service.instance import Instance
+from gubernator_tpu.service.metrics import GRPCStatsInterceptor, Metrics
+from gubernator_tpu.service.server import make_server
+from gubernator_tpu.types import PeerInfo
+
+log = logging.getLogger("gubernator_tpu.daemon")
+
+
+def build_backend(conf: DaemonConfig):
+    """Pick the device backend: mesh-sharded when >1 local device, else the
+    single-table engine. (TPU-specific; no reference analogue.)"""
+    import os
+
+    import jax
+
+    # Honor JAX_PLATFORMS even when a platform plugin (e.g. the tunneled-TPU
+    # axon plugin) would otherwise take priority over the env default.
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    n_dev = len(jax.devices())
+    backend = conf.backend
+    if backend == "auto":
+        backend = "sharded" if n_dev > 1 else "engine"
+    if backend == "sharded":
+        from gubernator_tpu.parallel.sharded import ShardedEngine
+
+        cap = max(conf.cache_size // n_dev, 1024)
+        eng = ShardedEngine(
+            n_shards=n_dev,
+            capacity_per_shard=cap,
+            min_width=conf.min_batch_width,
+            max_width=conf.max_batch_width,
+        )
+        log.info("backend: sharded over %d devices, %d slots/shard", n_dev, cap)
+        return eng
+    from gubernator_tpu.models.engine import Engine
+
+    eng = Engine(
+        capacity=conf.cache_size,
+        min_width=conf.min_batch_width,
+        max_width=conf.max_batch_width,
+    )
+    log.info("backend: single-table engine, %d slots", conf.cache_size)
+    return eng
+
+
+def build_pool(conf: DaemonConfig, instance: Instance):
+    """Discovery selection, k8s > memberlist > etcd > file > static
+    (reference: cmd/gubernator/main.go:87-121)."""
+    from gubernator_tpu.cluster import discovery
+
+    def on_update(peers):
+        instance.set_peers(peers)
+
+    if conf.k8s_selector:
+        return discovery.K8sPool()
+    if conf.gossip_bind or conf.gossip_known_nodes:
+        return discovery.GossipPool(
+            bind_address=conf.gossip_bind or "0.0.0.0:7946",
+            grpc_address=conf.advertise_address or conf.grpc_address,
+            datacenter=conf.data_center,
+            known_nodes=conf.gossip_known_nodes,
+            on_update=on_update,
+        )
+    if conf.etcd_endpoints:
+        return discovery.EtcdPool()
+    if conf.peers_file:
+        return discovery.FilePool(conf.peers_file, on_update)
+    peers = conf.peers or [conf.advertise_address or conf.grpc_address]
+    return discovery.StaticPool(
+        [PeerInfo(address=a, datacenter=conf.data_center) for a in peers],
+        on_update,
+    )
+
+
+def main(argv=None) -> int:
+    conf = config_from_env(argv)
+    logging.basicConfig(
+        level=logging.DEBUG if conf.debug else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+        stream=sys.stderr,
+    )
+
+    backend = build_backend(conf)
+    log.info("warming up decision kernel (compiling width buckets)...")
+    if hasattr(backend, "warmup"):
+        backend.warmup()
+
+    advertise = conf.advertise_address or conf.grpc_address
+    instance = Instance(
+        InstanceConfig(
+            behaviors=conf.behaviors,
+            data_center=conf.data_center,
+            backend=backend,
+            local_picker=build_picker(conf),
+        ),
+        advertise_address=advertise,
+    )
+
+    metrics = Metrics()
+    server, port = make_server(
+        instance,
+        conf.grpc_address,
+        stats_handler=GRPCStatsInterceptor(metrics),
+    )
+    server.start()
+    log.info("gRPC serving on %s (advertised as %s)", conf.grpc_address, advertise)
+
+    gateway = HttpGateway(instance, conf.http_address, metrics=metrics)
+    gateway.start()
+    log.info("HTTP gateway on %s", conf.http_address)
+
+    pool = build_pool(conf, instance)
+
+    stop = threading.Event()
+
+    def on_signal(signum, frame):
+        log.info("caught signal %s; shutting down", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, on_signal)
+    signal.signal(signal.SIGTERM, on_signal)
+    print("Ready", flush=True)  # startup sentinel (reference: cmd/gubernator-cluster/main.go:52)
+    stop.wait()
+
+    pool.close()
+    gateway.close()
+    server.stop(grace=1.0)
+    instance.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

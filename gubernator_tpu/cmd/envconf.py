@@ -1,0 +1,187 @@
+"""GUBER_* environment configuration (reference: cmd/gubernator/config.go).
+
+Same variable names and defaults as the reference daemon, plus TPU-specific
+extras (backend selection, table capacity/widths). A `--config` file of
+KEY=VALUE lines is loaded INTO the environment before reading, exactly like
+the reference (config.go:91-96,306-334).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import List, Optional
+
+from gubernator_tpu.service.config import BehaviorConfig
+
+_DUR_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DUR_UNITS = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 1e-3, "s": 1.0,
+    "m": 60.0, "h": 3600.0,
+}
+
+
+def parse_duration(text: str) -> float:
+    """Go-style duration ('500us', '30s', '1m30s') -> seconds."""
+    text = text.strip()
+    if not text:
+        raise ValueError("empty duration")
+    pos = 0
+    total = 0.0
+    for m in _DUR_RE.finditer(text):
+        if m.start() != pos:
+            raise ValueError(f"invalid duration {text!r}")
+        total += float(m.group(1)) * _DUR_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(text):
+        raise ValueError(f"invalid duration {text!r}")
+    return total
+
+
+def load_env_file(path: str) -> None:
+    """KEY=VALUE lines -> os.environ (reference: config.go:306-334)."""
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ValueError(f"malformed key=value on line '{lineno}'")
+            key, _, value = line.partition("=")
+            os.environ[key.strip()] = value.strip()
+
+
+def _env_str(name: str, default: str = "") -> str:
+    return os.environ.get(name, "") or default
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "")
+    return int(v) if v else default
+
+
+def _env_dur(name: str, default: float) -> float:
+    v = os.environ.get(name, "")
+    return parse_duration(v) if v else default
+
+
+def _env_slice(name: str) -> List[str]:
+    v = os.environ.get(name, "")
+    return [s.strip() for s in v.split(",") if s.strip()] if v else []
+
+
+@dataclasses.dataclass
+class DaemonConfig:
+    """(reference: cmd/gubernator/config.go:33-65)"""
+
+    grpc_address: str = "0.0.0.0:81"
+    http_address: str = "0.0.0.0:80"
+    advertise_address: str = ""
+    cache_size: int = 50_000
+    data_center: str = ""
+    behaviors: BehaviorConfig = dataclasses.field(default_factory=BehaviorConfig)
+
+    # discovery
+    peers: List[str] = dataclasses.field(default_factory=list)  # static
+    peers_file: str = ""
+    gossip_bind: str = ""
+    gossip_known_nodes: List[str] = dataclasses.field(default_factory=list)
+    etcd_endpoints: List[str] = dataclasses.field(default_factory=list)
+    k8s_selector: str = ""
+
+    # picker
+    peer_picker: str = ""  # "" | consistent-hash | replicated-hash
+    peer_picker_hash: str = ""
+    replicated_hash_replicas: int = 512
+
+    # TPU backend (no reference analogue)
+    backend: str = "auto"  # auto | engine | sharded
+    min_batch_width: int = 64
+    max_batch_width: int = 4096
+    debug: bool = False
+
+
+def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
+    """(reference: cmd/gubernator/config.go:67-214 confFromEnv)"""
+    import argparse
+
+    parser = argparse.ArgumentParser("gubernator-tpu")
+    parser.add_argument("--config", default="", help="key=value env file")
+    parser.add_argument("--debug", action="store_true")
+    opts, _ = parser.parse_known_args(args)
+    if opts.config:
+        load_env_file(opts.config)
+
+    b = BehaviorConfig()
+    b.batch_timeout_s = _env_dur("GUBER_BATCH_TIMEOUT", b.batch_timeout_s)
+    b.batch_limit = _env_int("GUBER_BATCH_LIMIT", b.batch_limit)
+    b.batch_wait_s = _env_dur("GUBER_BATCH_WAIT", b.batch_wait_s)
+    b.global_timeout_s = _env_dur("GUBER_GLOBAL_TIMEOUT", b.global_timeout_s)
+    b.global_batch_limit = _env_int("GUBER_GLOBAL_BATCH_LIMIT", b.global_batch_limit)
+    b.global_sync_wait_s = _env_dur("GUBER_GLOBAL_SYNC_WAIT", b.global_sync_wait_s)
+    b.multi_region_timeout_s = _env_dur(
+        "GUBER_MULTI_REGION_TIMEOUT", b.multi_region_timeout_s)
+    b.multi_region_batch_limit = _env_int(
+        "GUBER_MULTI_REGION_BATCH_LIMIT", b.multi_region_batch_limit)
+    b.multi_region_sync_wait_s = _env_dur(
+        "GUBER_MULTI_REGION_SYNC_WAIT", b.multi_region_sync_wait_s)
+
+    conf = DaemonConfig(
+        grpc_address=_env_str("GUBER_GRPC_ADDRESS", "0.0.0.0:81"),
+        http_address=_env_str("GUBER_HTTP_ADDRESS", "0.0.0.0:80"),
+        advertise_address=_env_str("GUBER_ADVERTISE_ADDRESS"),
+        cache_size=_env_int("GUBER_CACHE_SIZE", 50_000),
+        data_center=_env_str("GUBER_DATA_CENTER"),
+        behaviors=b,
+        peers=_env_slice("GUBER_PEERS"),
+        peers_file=_env_str("GUBER_PEERS_FILE"),
+        gossip_bind=_env_str("GUBER_MEMBERLIST_ADVERTISE_ADDRESS"),
+        gossip_known_nodes=_env_slice("GUBER_MEMBERLIST_KNOWN_NODES"),
+        etcd_endpoints=_env_slice("GUBER_ETCD_ENDPOINTS"),
+        k8s_selector=_env_str("GUBER_K8S_ENDPOINTS_SELECTOR"),
+        peer_picker=_env_str("GUBER_PEER_PICKER"),
+        peer_picker_hash=_env_str("GUBER_PEER_PICKER_HASH"),
+        replicated_hash_replicas=_env_int("GUBER_REPLICATED_HASH_REPLICAS", 512),
+        backend=_env_str("GUBER_BACKEND", "auto"),
+        min_batch_width=_env_int("GUBER_MIN_BATCH_WIDTH", 64),
+        max_batch_width=_env_int("GUBER_MAX_BATCH_WIDTH", 4096),
+        debug=opts.debug or bool(os.environ.get("GUBER_DEBUG")),
+    )
+    return conf
+
+
+def build_picker(conf: DaemonConfig):
+    """(reference: cmd/gubernator/config.go:137-169)"""
+    from gubernator_tpu.cluster.pickers import (
+        ConsistentHashPicker,
+        ReplicatedConsistentHashPicker,
+        crc32_hash,
+        fnv1_32,
+        fnv1a_32,
+    )
+    from gubernator_tpu.utils.fnv import fnv1_64, fnv1a_64
+
+    if conf.peer_picker in ("", "replicated-hash"):
+        fns = {"fnv1a": fnv1a_64, "fnv1": fnv1_64, "": None}
+        if conf.peer_picker_hash not in fns:
+            raise ValueError(
+                f"'GUBER_PEER_PICKER_HASH={conf.peer_picker_hash}' is invalid; "
+                f"choices are [fnv1a, fnv1]"
+            )
+        return ReplicatedConsistentHashPicker(
+            fns[conf.peer_picker_hash],
+            replicas=conf.replicated_hash_replicas,
+        )
+    if conf.peer_picker == "consistent-hash":
+        fns = {"crc32": crc32_hash, "fnv1a": fnv1a_32, "fnv1": fnv1_32, "": None}
+        if conf.peer_picker_hash not in fns:
+            raise ValueError(
+                f"'GUBER_PEER_PICKER_HASH={conf.peer_picker_hash}' is invalid; "
+                f"choices are [crc32, fnv1a, fnv1]"
+            )
+        return ConsistentHashPicker(fns[conf.peer_picker_hash])
+    raise ValueError(
+        f"'GUBER_PEER_PICKER={conf.peer_picker}' is invalid; "
+        f"choices are [consistent-hash, replicated-hash]"
+    )

@@ -1,0 +1,181 @@
+"""Discovery pools, env config, and daemon wiring tests."""
+
+import json
+import os
+import socket
+import time
+
+import pytest
+
+from gubernator_tpu.cluster.discovery import FilePool, GossipPool, StaticPool
+from gubernator_tpu.cmd.envconf import (
+    build_picker,
+    config_from_env,
+    load_env_file,
+    parse_duration,
+)
+from gubernator_tpu.types import PeerInfo
+
+
+def free_udp_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class TestParseDuration:
+    def test_go_style_durations(self):
+        assert parse_duration("500ms") == 0.5
+        assert parse_duration("500us") == 0.0005
+        assert parse_duration("30s") == 30.0
+        assert parse_duration("1m30s") == 90.0
+        assert parse_duration("2h") == 7200.0
+
+    def test_invalid(self):
+        for bad in ["", "5", "ms", "5x", "5s5"]:
+            with pytest.raises(ValueError):
+                parse_duration(bad)
+
+
+class TestEnvConfig:
+    def test_defaults(self, monkeypatch):
+        for k in list(os.environ):
+            if k.startswith("GUBER_"):
+                monkeypatch.delenv(k)
+        conf = config_from_env([])
+        assert conf.grpc_address == "0.0.0.0:81"
+        assert conf.http_address == "0.0.0.0:80"
+        assert conf.cache_size == 50_000
+        assert conf.behaviors.batch_wait_s == 0.0005
+        assert conf.behaviors.batch_limit == 1000
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("GUBER_GRPC_ADDRESS", "127.0.0.1:9999")
+        monkeypatch.setenv("GUBER_CACHE_SIZE", "1234")
+        monkeypatch.setenv("GUBER_BATCH_WAIT", "2ms")
+        monkeypatch.setenv("GUBER_DATA_CENTER", "us-east-1")
+        monkeypatch.setenv("GUBER_PEERS", "a:1, b:2")
+        conf = config_from_env([])
+        assert conf.grpc_address == "127.0.0.1:9999"
+        assert conf.cache_size == 1234
+        assert conf.behaviors.batch_wait_s == 0.002
+        assert conf.data_center == "us-east-1"
+        assert conf.peers == ["a:1", "b:2"]
+
+    def test_config_file_loads_env(self, tmp_path, monkeypatch):
+        """(reference: config.go:306-334)"""
+        monkeypatch.delenv("GUBER_CACHE_SIZE", raising=False)
+        f = tmp_path / "test.conf"
+        f.write_text("# comment\nGUBER_CACHE_SIZE=777\n\nGUBER_DATA_CENTER=dc9\n")
+        load_env_file(str(f))
+        assert os.environ["GUBER_CACHE_SIZE"] == "777"
+        conf = config_from_env([])
+        assert conf.cache_size == 777
+
+    def test_malformed_config_file(self, tmp_path):
+        f = tmp_path / "bad.conf"
+        f.write_text("NOEQUALSSIGN\n")
+        with pytest.raises(ValueError, match="line '1'"):
+            load_env_file(str(f))
+
+    def test_picker_selection(self, monkeypatch):
+        from gubernator_tpu.cluster.pickers import (
+            ConsistentHashPicker,
+            ReplicatedConsistentHashPicker,
+        )
+
+        conf = config_from_env([])
+        conf.peer_picker = "consistent-hash"
+        conf.peer_picker_hash = "crc32"
+        assert isinstance(build_picker(conf), ConsistentHashPicker)
+        conf.peer_picker = "replicated-hash"
+        conf.peer_picker_hash = "fnv1a"
+        p = build_picker(conf)
+        assert isinstance(p, ReplicatedConsistentHashPicker)
+        assert p.replicas == 512
+        conf.peer_picker = "bogus"
+        with pytest.raises(ValueError, match="GUBER_PEER_PICKER"):
+            build_picker(conf)
+        conf.peer_picker = "consistent-hash"
+        conf.peer_picker_hash = "bogus"
+        with pytest.raises(ValueError, match="GUBER_PEER_PICKER_HASH"):
+            build_picker(conf)
+
+
+class TestStaticPool:
+    def test_pushes_once(self):
+        got = []
+        StaticPool([PeerInfo(address="a:1")], got.append)
+        assert len(got) == 1 and got[0][0].address == "a:1"
+
+
+class TestFilePool:
+    def test_watches_changes(self, tmp_path):
+        path = tmp_path / "peers.json"
+        path.write_text(json.dumps([{"address": "a:1"}]))
+        updates = []
+        pool = FilePool(str(path), updates.append, poll_s=0.05)
+        try:
+            deadline = time.time() + 2
+            while not updates and time.time() < deadline:
+                time.sleep(0.01)
+            assert updates and updates[-1][0].address == "a:1"
+            time.sleep(0.05)  # ensure mtime moves
+            path.write_text(json.dumps(
+                [{"address": "a:1"}, {"address": "b:2", "datacenter": "dc2"}]
+            ))
+            deadline = time.time() + 2
+            while len(updates) < 2 and time.time() < deadline:
+                time.sleep(0.01)
+            assert len(updates[-1]) == 2
+            assert updates[-1][1].datacenter == "dc2"
+        finally:
+            pool.close()
+
+
+class TestGossipPool:
+    def test_three_nodes_converge_and_expire(self):
+        ports = [free_udp_port() for _ in range(3)]
+        updates = {i: [] for i in range(3)}
+        pools = []
+        try:
+            for i, port in enumerate(ports):
+                pools.append(
+                    GossipPool(
+                        bind_address=f"127.0.0.1:{port}",
+                        grpc_address=f"127.0.0.1:{9000 + i}",
+                        datacenter=f"dc{i % 2}",
+                        known_nodes=[f"127.0.0.1:{ports[0]}"] if i else [],
+                        on_update=updates[i].append,
+                        heartbeat_s=0.1,
+                        timeout_s=1.0,
+                    )
+                )
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if all(
+                    updates[i] and len(updates[i][-1]) == 3 for i in range(3)
+                ):
+                    break
+                time.sleep(0.05)
+            for i in range(3):
+                addrs = {p.address for p in updates[i][-1]}
+                assert addrs == {"127.0.0.1:9000", "127.0.0.1:9001", "127.0.0.1:9002"}, (
+                    f"node {i} saw {addrs}"
+                )
+            # datacenter metadata flows through (enables MULTI_REGION,
+            # reference: memberlist.go:17-34)
+            dcs = {p.address: p.datacenter for p in updates[0][-1]}
+            assert dcs["127.0.0.1:9001"] == "dc1"
+
+            # kill node 2; the others must expire it
+            pools[2].close()
+            deadline = time.time() + 5
+            while time.time() < deadline:
+                if updates[0] and len(updates[0][-1]) == 2:
+                    break
+                time.sleep(0.05)
+            assert len(updates[0][-1]) == 2
+        finally:
+            for p in pools[:2]:
+                p.close()

@@ -1,0 +1,116 @@
+"""End-to-end daemon test: spawn the real daemon process with GUBER_* env,
+drive it over both gRPC and the HTTP gateway (reference equivalent: the
+python client fixture launching cmd/gubernator-cluster,
+python/tests/test_client.py:25-39)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def daemon():
+    grpc_port, http_port = free_port(), free_port()
+    env = dict(os.environ)
+    env.update(
+        GUBER_GRPC_ADDRESS=f"127.0.0.1:{grpc_port}",
+        GUBER_HTTP_ADDRESS=f"127.0.0.1:{http_port}",
+        GUBER_CACHE_SIZE="4096",
+        GUBER_MIN_BATCH_WIDTH="32",
+        GUBER_MAX_BATCH_WIDTH="128",
+        JAX_PLATFORMS="cpu",
+        XLA_FLAGS=env.get("XLA_FLAGS", ""),
+    )
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cmd.daemon"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    # wait for the Ready sentinel (covers jax import + kernel warmup)
+    deadline = time.time() + 120
+    line = ""
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if "Ready" in line:
+            break
+        if proc.poll() is not None:
+            pytest.fail(f"daemon died at startup (rc={proc.returncode})")
+    else:
+        proc.kill()
+        pytest.fail("daemon never printed Ready")
+    yield {"grpc": f"127.0.0.1:{grpc_port}", "http": f"127.0.0.1:{http_port}"}
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_grpc_roundtrip(daemon):
+    from gubernator_tpu.service.grpc_api import dial_v1
+    from gubernator_tpu.service.pb import gubernator_pb2 as pb
+
+    stub = dial_v1(daemon["grpc"])
+    resp = stub.GetRateLimits(
+        pb.GetRateLimitsReq(
+            requests=[
+                pb.RateLimitReq(
+                    name="rps", unique_key="k", hits=1, limit=5, duration=60_000
+                )
+            ]
+        ),
+        timeout=10,
+    ).responses[0]
+    assert resp.error == ""
+    assert resp.remaining == 4
+
+
+def test_http_gateway_roundtrip(daemon):
+    body = json.dumps(
+        {
+            "requests": [
+                {
+                    "name": "rps",
+                    "uniqueKey": "http-k",
+                    "hits": "1",
+                    "limit": "5",
+                    "duration": "60000",
+                }
+            ]
+        }
+    ).encode()
+    resp = urllib.request.urlopen(
+        urllib.request.Request(
+            f"http://{daemon['http']}/v1/GetRateLimits",
+            data=body,
+            headers={"Content-Type": "application/json"},
+        ),
+        timeout=10,
+    )
+    data = json.loads(resp.read())
+    assert data["responses"][0]["remaining"] == "4"
+
+
+def test_http_health_and_metrics(daemon):
+    health = json.loads(
+        urllib.request.urlopen(
+            f"http://{daemon['http']}/v1/HealthCheck", timeout=10
+        ).read()
+    )
+    assert health["status"] == "healthy"
+    metrics = urllib.request.urlopen(
+        f"http://{daemon['http']}/metrics", timeout=10
+    ).read().decode()
+    assert "grpc_request_duration_milliseconds" in metrics
+    assert "engine_decisions_total" in metrics
