@@ -112,7 +112,9 @@ class Engine:
     ):
         self.capacity = capacity
         self.state = make_table(capacity)
-        self.directory = KeyDirectory(capacity)
+        from gubernator_tpu.native import make_key_directory
+
+        self.directory = make_key_directory(capacity)
         self.store = store
         self.loader = loader
         self.min_width = min_width

@@ -1,0 +1,220 @@
+"""Native (C++) host-path components, loaded via ctypes.
+
+Builds `keydir.cpp` into a cached shared library on first use (g++ -O2,
+~2 s, cached beside the source keyed by source mtime). Everything here has a
+pure-Python fallback — `NativeKeyDirectory` mirrors
+models/keyspace.KeyDirectory exactly and the engines accept either.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "keydir.cpp")
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_ERR: Optional[str] = None
+
+
+def _lib_path() -> str:
+    mtime = int(os.stat(_SRC).st_mtime)
+    return os.path.join(_HERE, f"_keydir_{mtime}.so")
+
+
+def _build() -> str:
+    path = _lib_path()
+    if os.path.exists(path):
+        return path
+    tmp = path + ".tmp"
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+        check=True, capture_output=True,
+    )
+    os.replace(tmp, path)  # atomic vs concurrent builders
+    # drop stale builds
+    for name in os.listdir(_HERE):
+        if name.startswith("_keydir_") and name.endswith(".so") and \
+                os.path.join(_HERE, name) != path:
+            try:
+                os.unlink(os.path.join(_HERE, name))
+            except OSError:
+                pass
+    return path
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if needed) and load the native library; raises on failure."""
+    global _LIB, _LIB_ERR
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _LIB_ERR is not None:
+            raise RuntimeError(_LIB_ERR)
+        try:
+            lib = ctypes.CDLL(_build())
+        except Exception as e:  # noqa: BLE001
+            _LIB_ERR = f"native keydir unavailable: {e}"
+            raise RuntimeError(_LIB_ERR) from e
+        c = ctypes
+        lib.keydir_new.restype = c.c_void_p
+        lib.keydir_new.argtypes = [c.c_int64]
+        lib.keydir_free.argtypes = [c.c_void_p]
+        lib.keydir_lookup_batch.restype = c.c_int64
+        lib.keydir_lookup_batch.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_void_p, c.c_int32, c.c_void_p, c.c_void_p,
+        ]
+        lib.keydir_drop.argtypes = [c.c_void_p, c.c_char_p, c.c_int32]
+        lib.keydir_peek.restype = c.c_int32
+        lib.keydir_peek.argtypes = [c.c_void_p, c.c_char_p, c.c_int32]
+        lib.keydir_dump.restype = c.c_int64
+        lib.keydir_dump.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_int64, c.c_void_p, c.c_void_p, c.c_int64,
+        ]
+        lib.keydir_size.restype = c.c_int64
+        lib.keydir_size.argtypes = [c.c_void_p]
+        lib.keydir_evictions.restype = c.c_int64
+        lib.keydir_evictions.argtypes = [c.c_void_p]
+        lib.fnv1a_owner_batch.argtypes = [
+            c.c_char_p, c.c_void_p, c.c_int32, c.c_int32, c.c_void_p,
+        ]
+        _LIB = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        load_library()
+        return True
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _pack_keys(keys: Sequence[str]) -> Tuple[bytes, np.ndarray]:
+    """Concatenate utf-8 keys; offsets[n+1] int64.
+
+    Fast path: one join + one encode; when the result is pure ASCII,
+    character counts equal byte counts so no per-key encode is needed."""
+    n = len(keys)
+    joined = "".join(keys)
+    data = joined.encode("utf-8")
+    offsets = np.zeros(n + 1, np.int64)
+    if len(data) == len(joined):
+        lens = np.fromiter(map(len, keys), np.int64, count=n)
+    else:
+        blobs = [k.encode("utf-8") for k in keys]
+        data = b"".join(blobs)
+        lens = np.fromiter(map(len, blobs), np.int64, count=n)
+    np.cumsum(lens, out=offsets[1:])
+    return data, offsets
+
+
+def owner_batch(keys: Sequence[str], n_owners: int) -> np.ndarray:
+    """fnv1a64(key) % n_owners for a key batch (native fast path of
+    parallel/mesh.py shard_of_key)."""
+    lib = load_library()
+    data, offsets = _pack_keys(keys)
+    out = np.empty(len(keys), np.int32)
+    lib.fnv1a_owner_batch(
+        data, offsets.ctypes.data, len(keys), n_owners, out.ctypes.data
+    )
+    return out
+
+
+class NativeKeyDirectory:
+    """Drop-in replacement for models/keyspace.KeyDirectory backed by the
+    C++ open-addressing LRU table."""
+
+    def __init__(self, capacity: int):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._lib = load_library()
+        self._kd = self._lib.keydir_new(capacity)
+        if not self._kd:
+            raise MemoryError("keydir_new failed")
+
+    def __del__(self):
+        kd = getattr(self, "_kd", None)
+        if kd:
+            self._lib.keydir_free(kd)
+            self._kd = None
+
+    def __len__(self) -> int:
+        return int(self._lib.keydir_size(self._kd))
+
+    def __contains__(self, key: str) -> bool:
+        return self.peek_slot(key) >= 0
+
+    @property
+    def evictions(self) -> int:
+        return int(self._lib.keydir_evictions(self._kd))
+
+    def lookup(self, keys: Sequence[str]) -> Tuple[List[int], List[bool]]:
+        data, offsets = _pack_keys(keys)
+        n = len(keys)
+        slots = np.empty(n, np.int32)
+        fresh = np.empty(n, np.uint8)
+        done = self._lib.keydir_lookup_batch(
+            self._kd, data, offsets.ctypes.data, n,
+            slots.ctypes.data, fresh.ctypes.data,
+        )
+        if done != n:
+            raise RuntimeError(
+                f"key directory over-committed: >{self.capacity} distinct "
+                "keys in one lookup"
+            )
+        return slots.tolist(), fresh.astype(bool).tolist()
+
+    def drop(self, key: str) -> None:
+        b = key.encode("utf-8")
+        self._lib.keydir_drop(self._kd, b, len(b))
+
+    def peek_slot(self, key: str) -> int:
+        b = key.encode("utf-8")
+        return int(self._lib.keydir_peek(self._kd, b, len(b)))
+
+    def items(self) -> List[Tuple[str, int]]:
+        n = len(self)
+        if n == 0:
+            return []
+        buf_cap = 1 << 16
+        while True:
+            key_buf = ctypes.create_string_buffer(buf_cap)
+            offsets = np.empty(n + 1, np.int64)
+            slots = np.empty(n, np.int32)
+            count = self._lib.keydir_dump(
+                self._kd, key_buf, buf_cap, offsets.ctypes.data,
+                slots.ctypes.data, n,
+            )
+            if count >= 0:
+                break
+            buf_cap = max(buf_cap * 2, -count)
+        raw = key_buf.raw
+        out = []
+        for i in range(int(count)):
+            out.append(
+                (raw[offsets[i]:offsets[i + 1]].decode("utf-8"), int(slots[i]))
+            )
+        return out
+
+    def keys(self) -> List[str]:
+        return [k for k, _ in self.items()]
+
+
+def make_key_directory(capacity: int, prefer_native: bool = True):
+    """Factory: native directory when buildable, python fallback otherwise."""
+    if prefer_native and not os.environ.get("GUBER_NO_NATIVE"):
+        try:
+            return NativeKeyDirectory(capacity)
+        except Exception:  # noqa: BLE001
+            pass
+    from gubernator_tpu.models.keyspace import KeyDirectory
+
+    return KeyDirectory(capacity)

@@ -1,0 +1,268 @@
+// Native key directory: string key -> device table slot, with LRU recycling.
+//
+// The host-side hot loop of the framework: every request resolves its key to
+// a table row before the batch ships to the device (the role the reference's
+// LRU cache map plays in Go, reference: cache.go:53-165). The pure-Python
+// KeyDirectory (models/keyspace.py) implements identical semantics; this
+// C++ version exists because at >1M decisions/s the directory lookup is the
+// host bottleneck. Exposed through a C ABI consumed via ctypes
+// (gubernator_tpu/native/__init__.py).
+//
+// Design: open-addressing hash table (linear probing, power-of-two buckets)
+// over an entry arena of exactly `capacity` entries; intrusive doubly-linked
+// LRU list; per-call pin generation so one batch never hands the same slot
+// to two different keys (the kernel requires collision-free scatters).
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr uint64_t FNV_OFFSET = 14695981039346656037ull;
+constexpr uint64_t FNV_PRIME = 1099511628211ull;
+
+inline uint64_t fnv1a(const char* data, int32_t len) {
+    uint64_t h = FNV_OFFSET;
+    for (int32_t i = 0; i < len; ++i) {
+        h = (h ^ static_cast<uint8_t>(data[i])) * FNV_PRIME;
+    }
+    return h;
+}
+
+struct Entry {
+    std::string key;
+    int32_t slot = -1;
+    int32_t lru_prev = -1;  // entry indices, -1 = none
+    int32_t lru_next = -1;
+    uint64_t pin_gen = 0;
+    bool used = false;
+};
+
+class KeyDir {
+  public:
+    explicit KeyDir(int64_t capacity)
+        : capacity_(capacity), entries_(capacity) {
+        nbuckets_ = 16;
+        while (nbuckets_ < static_cast<uint64_t>(capacity) * 2) nbuckets_ <<= 1;
+        buckets_.assign(nbuckets_, -1);
+        free_.reserve(capacity);
+        for (int64_t i = capacity - 1; i >= 0; --i) {
+            free_.push_back(static_cast<int32_t>(i));
+            entries_[i].slot = static_cast<int32_t>(i);
+        }
+    }
+
+    // Assign (or find) slots for a batch of keys. fresh_out[i] = 1 when the
+    // slot was newly assigned and the device row must be treated as vacant.
+    // Returns number resolved (== n unless the batch over-commits capacity).
+    int64_t lookup_batch(const char* data, const int64_t* offsets, int32_t n,
+                         int32_t* slots_out, uint8_t* fresh_out) {
+        ++gen_;
+        for (int32_t i = 0; i < n; ++i) {
+            const char* key = data + offsets[i];
+            const int32_t len = static_cast<int32_t>(offsets[i + 1] - offsets[i]);
+            int32_t e = find(key, len);
+            if (e >= 0) {
+                lru_touch(e);
+                entries_[e].pin_gen = gen_;
+                slots_out[i] = entries_[e].slot;
+                fresh_out[i] = 0;
+                continue;
+            }
+            e = allocate();
+            if (e < 0) {  // over-committed: >capacity distinct keys pinned
+                for (int32_t j = i; j < n; ++j) slots_out[j] = -1;
+                return i;
+            }
+            Entry& ent = entries_[e];
+            ent.key.assign(key, len);
+            ent.used = true;
+            ent.pin_gen = gen_;
+            insert_bucket(e);
+            lru_push_front(e);
+            slots_out[i] = ent.slot;
+            fresh_out[i] = 1;
+        }
+        return n;
+    }
+
+    // Forget a key, returning its slot to the free list.
+    void drop(const char* key, int32_t len) {
+        int32_t e = find(key, len);
+        if (e < 0) return;
+        remove_bucket(e);
+        lru_unlink(e);
+        entries_[e].used = false;
+        entries_[e].key.clear();
+        free_.push_back(e);
+    }
+
+    // Peek a key's slot without recency effects; -1 if absent.
+    int32_t peek(const char* key, int32_t len) const {
+        int32_t e = find(key, len);
+        return e < 0 ? -1 : entries_[e].slot;
+    }
+
+    // Dump all (key, slot) pairs, MRU->LRU. Keys are written back-to-back
+    // into key_buf with offsets (n+1 entries). Returns item count, or
+    // -needed_bytes when key_buf is too small.
+    int64_t dump(char* key_buf, int64_t buf_cap, int64_t* offsets,
+                 int32_t* slots, int64_t max_items) const {
+        int64_t nbytes = 0, count = 0;
+        for (int32_t e = lru_head_; e >= 0; e = entries_[e].lru_next) {
+            nbytes += static_cast<int64_t>(entries_[e].key.size());
+            ++count;
+        }
+        if (nbytes > buf_cap || count > max_items) return -nbytes;
+        int64_t off = 0, i = 0;
+        for (int32_t e = lru_head_; e >= 0; e = entries_[e].lru_next, ++i) {
+            const std::string& k = entries_[e].key;
+            std::memcpy(key_buf + off, k.data(), k.size());
+            offsets[i] = off;
+            off += static_cast<int64_t>(k.size());
+            slots[i] = entries_[e].slot;
+        }
+        offsets[i] = off;
+        return count;
+    }
+
+    int64_t size() const { return capacity_ - static_cast<int64_t>(free_.size()); }
+    int64_t evictions() const { return evictions_; }
+
+  private:
+    int32_t find(const char* key, int32_t len) const {
+        uint64_t mask = nbuckets_ - 1;
+        uint64_t b = fnv1a(key, len) & mask;
+        while (buckets_[b] != -1) {
+            int32_t e = buckets_[b];
+            if (e != TOMBSTONE && entries_[e].key.size() == static_cast<size_t>(len)
+                && std::memcmp(entries_[e].key.data(), key, len) == 0) {
+                return e;
+            }
+            b = (b + 1) & mask;
+        }
+        return -1;
+    }
+
+    void insert_bucket(int32_t e) {
+        uint64_t mask = nbuckets_ - 1;
+        uint64_t b = fnv1a(entries_[e].key.data(),
+                           static_cast<int32_t>(entries_[e].key.size())) & mask;
+        while (buckets_[b] != -1 && buckets_[b] != TOMBSTONE) b = (b + 1) & mask;
+        buckets_[b] = e;
+    }
+
+    void remove_bucket(int32_t e) {
+        uint64_t mask = nbuckets_ - 1;
+        uint64_t b = fnv1a(entries_[e].key.data(),
+                           static_cast<int32_t>(entries_[e].key.size())) & mask;
+        while (buckets_[b] != -1) {
+            if (buckets_[b] == e) {
+                buckets_[b] = TOMBSTONE;
+                return;
+            }
+            b = (b + 1) & mask;
+        }
+    }
+
+    int32_t allocate() {
+        if (!free_.empty()) {
+            int32_t e = free_.back();
+            free_.pop_back();
+            return e;
+        }
+        // evict LRU, skipping entries pinned by the current batch
+        for (int32_t e = lru_tail_; e >= 0; e = entries_[e].lru_prev) {
+            if (entries_[e].pin_gen == gen_) continue;
+            remove_bucket(e);
+            lru_unlink(e);
+            entries_[e].key.clear();
+            entries_[e].used = false;
+            ++evictions_;
+            return e;
+        }
+        return -1;
+    }
+
+    // ---- intrusive LRU list: head = most recent ----
+    void lru_push_front(int32_t e) {
+        entries_[e].lru_prev = -1;
+        entries_[e].lru_next = lru_head_;
+        if (lru_head_ >= 0) entries_[lru_head_].lru_prev = e;
+        lru_head_ = e;
+        if (lru_tail_ < 0) lru_tail_ = e;
+    }
+
+    void lru_unlink(int32_t e) {
+        Entry& ent = entries_[e];
+        if (ent.lru_prev >= 0) entries_[ent.lru_prev].lru_next = ent.lru_next;
+        else lru_head_ = ent.lru_next;
+        if (ent.lru_next >= 0) entries_[ent.lru_next].lru_prev = ent.lru_prev;
+        else lru_tail_ = ent.lru_prev;
+        ent.lru_prev = ent.lru_next = -1;
+    }
+
+    void lru_touch(int32_t e) {
+        if (lru_head_ == e) return;
+        lru_unlink(e);
+        lru_push_front(e);
+    }
+
+    static constexpr int32_t TOMBSTONE = -2;
+    int64_t capacity_;
+    uint64_t nbuckets_;
+    std::vector<Entry> entries_;
+    std::vector<int32_t> buckets_;
+    std::vector<int32_t> free_;
+    int32_t lru_head_ = -1;
+    int32_t lru_tail_ = -1;
+    uint64_t gen_ = 0;
+    int64_t evictions_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* keydir_new(int64_t capacity) { return new KeyDir(capacity); }
+void keydir_free(void* kd) { delete static_cast<KeyDir*>(kd); }
+
+int64_t keydir_lookup_batch(void* kd, const char* data, const int64_t* offsets,
+                            int32_t n, int32_t* slots_out, uint8_t* fresh_out) {
+    return static_cast<KeyDir*>(kd)->lookup_batch(data, offsets, n, slots_out,
+                                                  fresh_out);
+}
+
+void keydir_drop(void* kd, const char* key, int32_t len) {
+    static_cast<KeyDir*>(kd)->drop(key, len);
+}
+
+int32_t keydir_peek(void* kd, const char* key, int32_t len) {
+    return static_cast<KeyDir*>(kd)->peek(key, len);
+}
+
+int64_t keydir_dump(void* kd, char* key_buf, int64_t buf_cap, int64_t* offsets,
+                    int32_t* slots, int64_t max_items) {
+    return static_cast<KeyDir*>(kd)->dump(key_buf, buf_cap, offsets, slots,
+                                          max_items);
+}
+
+int64_t keydir_size(void* kd) { return static_cast<KeyDir*>(kd)->size(); }
+int64_t keydir_evictions(void* kd) {
+    return static_cast<KeyDir*>(kd)->evictions();
+}
+
+// Batch fnv1a64 % n_owners for host-side owner routing
+// (parallel/mesh.py shard_of_key; reference: replicated_hash.go:24).
+void fnv1a_owner_batch(const char* data, const int64_t* offsets, int32_t n,
+                       int32_t n_owners, int32_t* owners_out) {
+    for (int32_t i = 0; i < n; ++i) {
+        uint64_t h = fnv1a(data + offsets[i],
+                           static_cast<int32_t>(offsets[i + 1] - offsets[i]));
+        owners_out[i] = static_cast<int32_t>(h % static_cast<uint64_t>(n_owners));
+    }
+}
+
+}  // extern "C"

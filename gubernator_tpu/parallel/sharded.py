@@ -120,8 +120,11 @@ class ShardedEngine:
         self.state = make_sharded_table(self.plan)
         self._decide = make_decide_sharded(self.plan, donate=donate)
         self._sync = make_global_sync(self.plan, donate=donate)
+        from gubernator_tpu.native import make_key_directory
+
         self.directories = [
-            KeyDirectory(capacity_per_shard) for _ in range(self.plan.n_owners)
+            make_key_directory(capacity_per_shard)
+            for _ in range(self.plan.n_owners)
         ]
         self.min_width = min_width
         self.max_width = min(max_width, capacity_per_shard)

@@ -1,0 +1,108 @@
+"""Native C++ key directory: differential tests vs the Python directory and
+a throughput sanity check."""
+
+import random
+
+import pytest
+
+from gubernator_tpu.models.keyspace import KeyDirectory
+from gubernator_tpu.native import (
+    NativeKeyDirectory,
+    available,
+    owner_batch,
+)
+from gubernator_tpu.parallel.mesh import shard_of_key
+
+pytestmark = pytest.mark.skipif(
+    not available(), reason="native library unavailable (g++ missing?)"
+)
+
+
+def test_basic_lookup_and_fresh():
+    d = NativeKeyDirectory(16)
+    slots, fresh = d.lookup(["a", "b", "a"])
+    assert fresh == [True, True, False]
+    assert slots[0] == slots[2] != slots[1]
+    assert len(d) == 2
+    assert "a" in d and "zz" not in d
+
+
+def test_lru_eviction_and_pinning():
+    d = NativeKeyDirectory(4)
+    d.lookup(["a", "b", "c", "d"])
+    d.lookup(["a"])  # refresh a
+    d.lookup(["e"])  # must evict b (LRU)
+    assert "b" not in d
+    assert "a" in d
+    assert d.evictions == 1
+    # one call pinning all capacity: every key gets a distinct slot
+    slots, _ = d.lookup(["w", "x", "y", "z"])
+    assert len(set(slots)) == 4
+    # over-commit raises, like the python directory
+    with pytest.raises(RuntimeError, match="over-committed"):
+        d.lookup(["p", "q", "r", "s", "t"])
+
+
+def test_drop_returns_slot():
+    d = NativeKeyDirectory(2)
+    (s1, _), _ = [d.lookup(["a"]), None][0], None
+    d.drop("a")
+    assert "a" not in d
+    assert len(d) == 0
+    slots, fresh = d.lookup(["b", "c"])
+    assert sorted(slots) == [0, 1] or len(set(slots)) == 2
+
+
+def test_items_roundtrip():
+    d = NativeKeyDirectory(8)
+    d.lookup([f"key{i}" for i in range(5)])
+    items = dict(d.items())
+    assert set(items) == {f"key{i}" for i in range(5)}
+    assert len(set(items.values())) == 5
+
+
+def test_differential_vs_python():
+    """Random ops: same visible behavior as models/keyspace.KeyDirectory."""
+    rng = random.Random(11)
+    native = NativeKeyDirectory(32)
+    pure = KeyDirectory(32)
+    keys = [f"k{i}" for i in range(64)]
+    for step in range(300):
+        op = rng.random()
+        if op < 0.8:
+            batch = [rng.choice(keys) for _ in range(rng.randint(1, 8))]
+            ns, nf = native.lookup(batch)
+            ps, pf = pure.lookup(batch)
+            assert nf == pf, f"fresh diverged at step {step}: {batch}"
+            # slot numbers may differ (allocation order); membership must match
+        else:
+            k = rng.choice(keys)
+            native.drop(k)
+            pure.drop(k)
+        assert len(native) == len(pure), f"size diverged at step {step}"
+        assert native.evictions == pure.evictions, f"evictions diverged at {step}"
+
+
+def test_owner_batch_matches_python():
+    keys = [f"test_key:{i}" for i in range(500)]
+    owners = owner_batch(keys, 8)
+    for k, o in zip(keys, owners):
+        assert shard_of_key(k, 8) == int(o)
+
+
+def test_native_is_faster_than_python():
+    import time
+
+    n = 20_000
+    keys = [f"bench:{i % 5000}" for i in range(n)]
+    native = NativeKeyDirectory(8192)
+    pure = KeyDirectory(8192)
+
+    t0 = time.perf_counter()
+    native.lookup(keys)
+    t_native = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    pure.lookup(keys)
+    t_pure = time.perf_counter() - t0
+    # native should win clearly; allow slack for CI noise
+    assert t_native < t_pure, f"native {t_native:.4f}s vs python {t_pure:.4f}s"
