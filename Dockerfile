@@ -1,0 +1,23 @@
+# gubernator_tpu serving image (CPU/JAX base; swap the base image for a TPU
+# runtime image on TPU VMs). Role parity: reference Dockerfile builds a
+# static Go binary into a scratch image; here the daemon is Python+JAX with
+# a C++ native module compiled at build time.
+FROM python:3.11-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+
+WORKDIR /app
+COPY setup.py README.md ./
+COPY proto ./proto
+COPY gubernator_tpu ./gubernator_tpu
+RUN pip install --no-cache-dir "jax[cpu]" grpcio protobuf prometheus_client numpy \
+    && pip install --no-cache-dir -e . \
+    && python -c "from gubernator_tpu.native import available; assert available()"
+
+# reference ports: 81 gRPC, 80 HTTP (Dockerfile:24-27); gossip on 7946
+EXPOSE 81 80 7946/udp
+ENV GUBER_GRPC_ADDRESS=0.0.0.0:81 \
+    GUBER_HTTP_ADDRESS=0.0.0.0:80
+
+ENTRYPOINT ["python", "-m", "gubernator_tpu.cmd.daemon"]

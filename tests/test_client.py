@@ -1,0 +1,60 @@
+"""Client library tests against a live in-process cluster
+(reference: python/tests/test_client.py:25-60)."""
+
+import pytest
+
+from gubernator_tpu.client import HttpClient, V1Client, random_peer, random_string
+from gubernator_tpu.cluster.harness import LocalCluster
+from gubernator_tpu.service.http_gateway import HttpGateway
+from gubernator_tpu.types import PeerInfo, RateLimitReq, Status
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = LocalCluster().start(2)
+    gw = HttpGateway(c.instances[0].instance, "127.0.0.1:0")
+    gw.start()
+    yield c, gw
+    gw.close()
+    c.stop()
+
+
+def test_grpc_client_dataclass_and_dict(cluster):
+    c, _ = cluster
+    client = V1Client(c.instances[0].address)
+    r1 = client.get_rate_limits(
+        [RateLimitReq(name="cl", unique_key="a", hits=1, limit=10, duration=60_000)]
+    )[0]
+    assert (r1.status, r1.remaining) == (Status.UNDER_LIMIT, 9)
+    r2 = client.get_rate_limits(
+        [{"name": "cl", "unique_key": "a", "hits": 1, "limit": 10,
+          "duration": 60_000}]
+    )[0]
+    assert r2.remaining == 8
+
+    hc = client.health_check()
+    assert hc.status == "healthy" and hc.peer_count == 2
+
+
+def test_http_client(cluster):
+    c, gw = cluster
+    client = HttpClient(gw.address)
+    r = client.get_rate_limits(
+        [RateLimitReq(name="hcl", unique_key="b", hits=1, limit=3, duration=60_000)]
+    )[0]
+    assert (r.status, r.remaining) == (Status.UNDER_LIMIT, 2)
+    client.get_rate_limits(
+        [RateLimitReq(name="hcl", unique_key="b", hits=2, limit=3, duration=60_000)]
+    )
+    r = client.get_rate_limits(
+        [RateLimitReq(name="hcl", unique_key="b", hits=1, limit=3, duration=60_000)]
+    )[0]
+    assert r.status == Status.OVER_LIMIT
+    assert client.health_check().status == "healthy"
+
+
+def test_helpers():
+    peers = [PeerInfo(address=f"h{i}") for i in range(5)]
+    assert random_peer(peers) in peers
+    s = random_string("ID-", 8)
+    assert s.startswith("ID-") and len(s) == 11
