@@ -18,6 +18,7 @@ import grpc
 
 from gubernator_tpu.models.engine import Engine
 from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
+from gubernator_tpu.service.grpc_api import close_channels
 from gubernator_tpu.service.instance import Instance
 from gubernator_tpu.service.server import make_server
 from gubernator_tpu.types import PeerInfo
@@ -25,12 +26,17 @@ from gubernator_tpu.types import PeerInfo
 
 def test_behaviors() -> BehaviorConfig:
     """Batch fast, sync at 50 ms (reference: cluster/cluster.go:57-66)."""
+    # Wait windows are tuned down so async tests settle fast; RPC *timeouts*
+    # stay generous — a first-touch XLA compile or CPU contention from N
+    # in-process servers can exceed 500 ms, and a timed-out forward records a
+    # peer error with a 5-minute TTL that poisons HealthCheck for the rest of
+    # the cluster's life.
     return BehaviorConfig(
-        batch_timeout_s=0.5,
+        batch_timeout_s=5.0,
         batch_wait_s=0.01,
-        global_timeout_s=0.5,
+        global_timeout_s=5.0,
         global_sync_wait_s=0.05,
-        multi_region_timeout_s=0.5,
+        multi_region_timeout_s=5.0,
         multi_region_sync_wait_s=0.05,
     )
 
@@ -45,6 +51,9 @@ class ClusterInstance:
     def stop(self) -> None:
         self.server.stop(grace=0.2)
         self.instance.close()
+        # drop any cached client channel so a restart on the same port isn't
+        # hit through a channel stuck in reconnect backoff
+        close_channels(self.address)
 
 
 class LocalCluster:

@@ -11,6 +11,9 @@ exactly these calls anyway).
 
 from __future__ import annotations
 
+import threading
+from collections import OrderedDict
+
 import grpc
 
 from gubernator_tpu.service.pb import gubernator_pb2 as pb
@@ -95,7 +98,39 @@ class PeersV1Stub:
         )
 
 
+_channel_lock = threading.Lock()
+_channels: "OrderedDict[str, grpc.Channel]" = OrderedDict()
+_CHANNEL_CACHE_MAX = 64
+
+
 def dial_v1(address: str) -> V1Stub:
     """Connect to a server, returning a ready V1 stub
-    (reference: client.go:38-49 DialV1Server)."""
-    return V1Stub(grpc.insecure_channel(address))
+    (reference: client.go:38-49 DialV1Server).
+
+    Channels are cached per address (gRPC channels own background threads
+    and sockets, and callers — tests, CLIs — dial per request), LRU-bounded
+    so address churn can't exhaust fds."""
+    with _channel_lock:
+        ch = _channels.get(address)
+        if ch is None:
+            ch = grpc.insecure_channel(address)
+            _channels[address] = ch
+            while len(_channels) > _CHANNEL_CACHE_MAX:
+                _, old = _channels.popitem(last=False)
+                old.close()
+        else:
+            _channels.move_to_end(address)
+    return V1Stub(ch)
+
+
+def close_channels(address: str = "") -> None:
+    """Close cached client channels — all of them, or one address's.
+    Call when an address is being rebound (e.g. a restarted fixed-port
+    server) so the fresh server isn't hit through a channel stuck in
+    reconnect backoff."""
+    with _channel_lock:
+        targets = [address] if address else list(_channels)
+        for addr in targets:
+            ch = _channels.pop(addr, None)
+            if ch is not None:
+                ch.close()

@@ -19,3 +19,12 @@ if not os.environ.get("GUBER_TEST_TPU"):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+# Persistent compilation cache: without it the first suite run pays every
+# XLA compile cold, which can push loopback RPCs past their deadlines and
+# poison HealthCheck via the 5-minute peer-error TTL.
+import jax as _jax  # noqa: E402
+
+_cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+_jax.config.update("jax_compilation_cache_dir", _cache_dir)
+_jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
