@@ -14,9 +14,11 @@ This build ships:
   {grpc_address, datacenter} metadata, the role memberlist plays in the
   reference (memberlist.go:193-226); the only pool that feeds DataCenter
   and thus enables MULTI_REGION (reference: memberlist.go:17-34).
-- EtcdPool / K8sPool: same contract over the optional `etcd3` /
-  `kubernetes` client packages; raise a clear error when the extra isn't
-  installed (this image ships neither).
+- EtcdPool (cluster/etcd.py): real etcd v3 lease/watch registration over a
+  wire-level gRPC client — no etcd3 package needed; pairs with the
+  embeddable etcdlite server (cluster/etcdlite.py).
+- K8sPool (cluster/k8s.py): real Endpoints-API informer over stdlib
+  HTTP(S) — no kubernetes package needed.
 """
 
 from __future__ import annotations
@@ -260,44 +262,11 @@ class GossipPool(Pool):
         self._sock.close()
 
 
-class EtcdPool(Pool):
-    """Register under a key prefix with a leased heartbeat; watch the prefix
-    (reference: etcd.go:49-329). Requires the optional `etcd3` package."""
-
-    def __init__(self, *args, **kwargs):
-        try:
-            import etcd3  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "EtcdPool requires the 'etcd3' package, which is not "
-                "installed in this environment; use GossipPool, FilePool or "
-                "StaticPool instead"
-            ) from e
-        raise NotImplementedError(
-            "etcd3 client not available in this build environment"
-        )
-
-    def close(self) -> None:
-        pass
+# Real etcd v3 pool (wire-level client, no etcd3 package needed) lives in
+# cluster/etcd.py; re-exported here so all pools share one import point.
+from gubernator_tpu.cluster.etcd import EtcdPool  # noqa: E402,F401
 
 
-class K8sPool(Pool):
-    """Watch the Endpoints API with a label selector
-    (reference: kubernetes.go:36-162). Requires the optional `kubernetes`
-    package."""
-
-    def __init__(self, *args, **kwargs):
-        try:
-            import kubernetes  # noqa: F401
-        except ImportError as e:
-            raise ImportError(
-                "K8sPool requires the 'kubernetes' package, which is not "
-                "installed in this environment; use GossipPool, FilePool or "
-                "StaticPool instead"
-            ) from e
-        raise NotImplementedError(
-            "kubernetes client not available in this build environment"
-        )
-
-    def close(self) -> None:
-        pass
+# Real Endpoints-API pool (stdlib HTTP informer, no kubernetes package
+# needed) lives in cluster/k8s.py.
+from gubernator_tpu.cluster.k8s import K8sPool  # noqa: E402,F401

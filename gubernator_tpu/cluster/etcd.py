@@ -1,0 +1,397 @@
+"""etcd v3 discovery pool — a real implementation, no client library.
+
+Speaks the etcd v3 gRPC API directly through generated stubs from a minimal
+wire-compatible proto subset (proto/etcd.proto); works against a real etcd
+server or the in-process fake in tests.
+
+Behavior mirrors the reference pool (reference: etcd.go:49-329):
+
+- register: grant a 30 s lease, put `base_key + address -> address` bound to
+  the lease, and hold a keep-alive stream open (etcd.go:224-253);
+- if the keep-alive stream is lost, re-register after a back-off
+  (etcd.go:256-282);
+- watch the prefix from the revision of the initial listing; PUT adds the
+  peer, DELETE removes it (by prev_kv value), each event fires `on_update`
+  (etcd.go:163-222);
+- a failed watch is restarted after re-listing peers (etcd.go:198-219);
+- close: delete our key and revoke the lease (etcd.go:283-301).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Iterator, List, Optional, Sequence
+
+import grpc
+
+from gubernator_tpu.service.pb import etcd_pb2 as epb
+from gubernator_tpu.types import PeerInfo
+
+log = logging.getLogger("gubernator_tpu.etcd")
+
+UpdateFunc = Callable[[List[PeerInfo]], None]
+
+ETCD_TIMEOUT_S = 10.0  # (reference: etcd.go:50)
+BACKOFF_S = 5.0  # (reference: etcd.go:51)
+LEASE_TTL_S = 30  # (reference: etcd.go:52)
+DEFAULT_BASE_KEY = "/gubernator/peers/"  # (reference: etcd.go:53)
+
+
+def prefix_range_end(prefix: bytes) -> bytes:
+    """End of the range covering all keys with `prefix` (etcd clientv3
+    GetPrefixRangeEnd semantics): last byte +1, carrying over 0xff."""
+    b = bytearray(prefix)
+    for i in reversed(range(len(b))):
+        if b[i] < 0xFF:
+            b[i] += 1
+            return bytes(b[: i + 1])
+    return b"\x00"  # all-0xff prefix: range to the end of keyspace
+
+
+def _serialize(msg) -> bytes:
+    return msg.SerializeToString()
+
+
+class EtcdClient:
+    """Thin generic-stub client for the KV/Lease/Watch services."""
+
+    def __init__(self, channel: grpc.Channel):
+        self.channel = channel
+        self.range = channel.unary_unary(
+            "/etcdserverpb.KV/Range",
+            request_serializer=_serialize,
+            response_deserializer=epb.RangeResponse.FromString,
+        )
+        self.put = channel.unary_unary(
+            "/etcdserverpb.KV/Put",
+            request_serializer=_serialize,
+            response_deserializer=epb.PutResponse.FromString,
+        )
+        self.delete_range = channel.unary_unary(
+            "/etcdserverpb.KV/DeleteRange",
+            request_serializer=_serialize,
+            response_deserializer=epb.DeleteRangeResponse.FromString,
+        )
+        self.lease_grant = channel.unary_unary(
+            "/etcdserverpb.Lease/LeaseGrant",
+            request_serializer=_serialize,
+            response_deserializer=epb.LeaseGrantResponse.FromString,
+        )
+        self.lease_revoke = channel.unary_unary(
+            "/etcdserverpb.Lease/LeaseRevoke",
+            request_serializer=_serialize,
+            response_deserializer=epb.LeaseRevokeResponse.FromString,
+        )
+        self.lease_keep_alive = channel.stream_stream(
+            "/etcdserverpb.Lease/LeaseKeepAlive",
+            request_serializer=_serialize,
+            response_deserializer=epb.LeaseKeepAliveResponse.FromString,
+        )
+        self.watch = channel.stream_stream(
+            "/etcdserverpb.Watch/Watch",
+            request_serializer=_serialize,
+            response_deserializer=epb.WatchResponse.FromString,
+        )
+
+
+class _StreamFeed:
+    """Blocking request iterator for a bidi stream, closable from outside."""
+
+    _CLOSE = object()
+
+    def __init__(self):
+        import queue
+
+        self._q: "queue.Queue" = queue.Queue()
+
+    def send(self, msg) -> None:
+        self._q.put(msg)
+
+    def close(self) -> None:
+        self._q.put(self._CLOSE)
+
+    def __iter__(self) -> Iterator:
+        while True:
+            item = self._q.get()
+            if item is self._CLOSE:
+                return
+            yield item
+
+
+class EtcdPool:
+    """Register self + watch peers in etcd (reference: etcd.go EtcdPool)."""
+
+    def __init__(
+        self,
+        endpoints: Sequence[str],
+        advertise_address: str,
+        on_update: UpdateFunc,
+        base_key: str = DEFAULT_BASE_KEY,
+        lease_ttl_s: int = LEASE_TTL_S,
+        backoff_s: float = BACKOFF_S,
+        timeout_s: float = ETCD_TIMEOUT_S,
+        channel: Optional[grpc.Channel] = None,
+        credentials: Optional[grpc.ChannelCredentials] = None,
+    ):
+        if not advertise_address:
+            raise ValueError(
+                "advertise address is required (GUBER_ADVERTISE_ADDRESS)"
+            )
+        if channel is None and not endpoints:
+            raise ValueError("GUBER_ETCD_ENDPOINTS is required")
+        self._endpoints = list(endpoints)
+        self._endpoint_idx = 0
+        self._credentials = credentials
+        if channel is None:
+            channel = self._dial(self._endpoints[0])
+        self._own_channel = channel
+        self.client = EtcdClient(channel)
+        self.advertise_address = advertise_address
+        self.base_key = base_key
+        self.instance_key = (base_key + advertise_address).encode()
+        self.on_update = on_update
+        self.lease_ttl_s = lease_ttl_s
+        self.backoff_s = backoff_s
+        self.timeout_s = timeout_s
+
+        self._peers: Dict[str, None] = {}
+        self._peers_lock = threading.Lock()
+        self._conn_lock = threading.Lock()
+        self._closed = threading.Event()
+        self._lease_id = 0
+        self._ka_feed: Optional[_StreamFeed] = None
+        self._ka_call = None
+        self._watch_feed: Optional[_StreamFeed] = None
+        self._watch_call = None
+
+        # initial registration + listing are synchronous and fail loudly,
+        # like the reference's NewEtcdPool (etcd.go:96-110) — after trying
+        # every configured endpoint once
+        for attempt in range(max(len(self._endpoints), 1)):
+            try:
+                self._register()
+                break
+            except grpc.RpcError:
+                if attempt + 1 >= max(len(self._endpoints), 1):
+                    raise
+                self._rotate_endpoint()
+        revision = self._collect_peers()
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, args=(revision,), name="etcd-watch",
+            daemon=True,
+        )
+        self._ka_thread = threading.Thread(
+            target=self._keepalive_loop, name="etcd-keepalive", daemon=True
+        )
+        self._watch_thread.start()
+        self._ka_thread.start()
+
+    def _dial(self, target: str) -> grpc.Channel:
+        return (
+            grpc.secure_channel(target, self._credentials)
+            if self._credentials is not None
+            else grpc.insecure_channel(target)
+        )
+
+    def _rotate_endpoint(self) -> None:
+        """Fail over to the next configured endpoint (clientv3 balances
+        across all endpoints; we fail over sequentially). Closing the old
+        channel fails the other loop's in-flight stream, which then recovers
+        through its own restart path on the fresh channel."""
+        if len(self._endpoints) < 2:
+            return
+        with self._conn_lock:
+            self._endpoint_idx = (self._endpoint_idx + 1) % len(self._endpoints)
+            target = self._endpoints[self._endpoint_idx]
+            log.info("failing over to etcd endpoint %s", target)
+            old = self._own_channel
+            self._own_channel = self._dial(target)
+            self.client = EtcdClient(self._own_channel)
+            try:
+                old.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    # ------------------------------------------------------------- register
+
+    def _register(self) -> None:
+        """Grant lease, put our key, open the keep-alive stream
+        (reference: etcd.go:229-253)."""
+        grant = self.client.lease_grant(
+            epb.LeaseGrantRequest(TTL=self.lease_ttl_s), timeout=self.timeout_s
+        )
+        self._lease_id = grant.ID
+        self.client.put(
+            epb.PutRequest(
+                key=self.instance_key,
+                value=self.advertise_address.encode(),
+                lease=grant.ID,
+            ),
+            timeout=self.timeout_s,
+        )
+        feed = _StreamFeed()
+        call = self.client.lease_keep_alive(iter(feed))
+        feed.send(epb.LeaseKeepAliveRequest(ID=grant.ID))
+        self._ka_feed = feed
+        self._ka_call = call
+        log.info("registered peer '%s' with etcd", self.advertise_address)
+
+    def _keepalive_loop(self) -> None:
+        """Send a keep-alive every ttl/3; re-register if the stream dies
+        (reference: etcd.go:256-282)."""
+        interval = max(self.lease_ttl_s / 3.0, 0.05)
+        while not self._closed.is_set():
+            call, feed = self._ka_call, self._ka_feed
+            try:
+                for resp in call:
+                    if self._closed.is_set():
+                        return
+                    if resp.TTL <= 0:
+                        raise RuntimeError("lease expired")
+                    if self._closed.wait(interval):
+                        return
+                    feed.send(epb.LeaseKeepAliveRequest(ID=self._lease_id))
+                # server closed the stream
+                raise RuntimeError("keep alive stream closed")
+            except BaseException as e:  # noqa: BLE001 — includes RpcError
+                if self._closed.is_set():
+                    return
+                log.warning(
+                    "keep alive lost (%s), attempting to re-register peer", e
+                )
+                while not self._closed.is_set():
+                    try:
+                        self._register()
+                        break
+                    except BaseException as re:  # noqa: BLE001
+                        log.error("while attempting to re-register peer: %s", re)
+                        if self._closed.wait(self.backoff_s):
+                            return
+                        self._rotate_endpoint()
+
+    # ---------------------------------------------------------------- watch
+
+    def _collect_peers(self) -> int:
+        """List the prefix, replacing our peer set; returns the store
+        revision for the subsequent watch (reference: etcd.go:145-161)."""
+        resp = self.client.range(
+            epb.RangeRequest(
+                key=self.base_key.encode(),
+                range_end=prefix_range_end(self.base_key.encode()),
+            ),
+            timeout=self.timeout_s,
+        )
+        with self._peers_lock:
+            self._peers = {kv.value.decode(): None for kv in resp.kvs}
+        self._call_on_update()
+        return resp.header.revision
+
+    def _open_watch(self, revision: int):
+        feed = _StreamFeed()
+        call = self.client.watch(iter(feed))
+        feed.send(
+            epb.WatchRequest(
+                create_request=epb.WatchCreateRequest(
+                    key=self.base_key.encode(),
+                    range_end=prefix_range_end(self.base_key.encode()),
+                    start_revision=revision + 1,
+                    prev_kv=True,
+                )
+            )
+        )
+        self._watch_feed = feed
+        self._watch_call = call
+        log.info(
+            "watching for peer changes '%s' at revision %d",
+            self.base_key, revision,
+        )
+        return call
+
+    def _watch_loop(self, revision: int) -> None:
+        """Apply watch events; restart the watch (after re-listing) on any
+        error (reference: etcd.go:163-222)."""
+        call = self._open_watch(revision)
+        while not self._closed.is_set():
+            try:
+                for resp in call:
+                    if resp.canceled:
+                        if self._closed.is_set():
+                            log.info("graceful watch shutdown")
+                            return
+                        # server-side cancel (e.g. requested revision was
+                        # compacted away): re-list and re-watch — the
+                        # reference wrongly treats every cancel as graceful
+                        # shutdown and freezes membership (etcd.go:171-174)
+                        raise RuntimeError(
+                            f"watch canceled by server "
+                            f"(compact_revision={resp.compact_revision}, "
+                            f"reason={resp.cancel_reason!r})"
+                        )
+                    changed = False
+                    with self._peers_lock:
+                        for ev in resp.events:
+                            if ev.type == epb.Event.PUT and ev.kv.value:
+                                self._peers[ev.kv.value.decode()] = None
+                                changed = True
+                            elif ev.type == epb.Event.DELETE and ev.prev_kv.value:
+                                self._peers.pop(ev.prev_kv.value.decode(), None)
+                                changed = True
+                    if changed:
+                        self._call_on_update()
+                # stream ended without cancel
+                raise RuntimeError("watch stream closed")
+            except BaseException as e:  # noqa: BLE001
+                if self._closed.is_set():
+                    return
+                log.error("watch error: %s; restarting watch", e)
+                while not self._closed.is_set():
+                    try:
+                        revision = self._collect_peers()
+                        call = self._open_watch(revision)
+                        break
+                    except BaseException as re:  # noqa: BLE001
+                        log.error("while attempting to restart watch: %s", re)
+                        if self._closed.wait(self.backoff_s):
+                            return
+                        self._rotate_endpoint()
+
+    def _call_on_update(self) -> None:
+        """(reference: etcd.go:321-329)"""
+        peers = [PeerInfo(address=a) for a in sorted(self._peers)]
+        try:
+            self.on_update(peers)
+        except Exception:  # noqa: BLE001
+            log.exception("peer update callback failed")
+
+    # ---------------------------------------------------------------- close
+
+    def close(self) -> None:
+        """Deregister: delete our key, revoke the lease
+        (reference: etcd.go:283-301)."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        for call in (self._watch_call, self._ka_call):
+            if call is not None:
+                try:
+                    call.cancel()
+                except Exception:  # noqa: BLE001
+                    pass
+        for feed in (self._watch_feed, self._ka_feed):
+            if feed is not None:
+                feed.close()
+        try:
+            self.client.delete_range(
+                epb.DeleteRangeRequest(key=self.instance_key),
+                timeout=self.timeout_s,
+            )
+            if self._lease_id:
+                self.client.lease_revoke(
+                    epb.LeaseRevokeRequest(ID=self._lease_id),
+                    timeout=self.timeout_s,
+                )
+        except grpc.RpcError as e:
+            log.warning("during etcd deregister: %s", e)
+        self._watch_thread.join(timeout=2.0)
+        self._ka_thread.join(timeout=2.0)

@@ -1,0 +1,380 @@
+"""etcdlite: an embeddable server speaking the etcd v3 API subset we use.
+
+Implements KV Range/Put/DeleteRange, Lease Grant/Revoke/KeepAlive, and
+prefix Watch with prev_kv — the exact surface EtcdPool (cluster/etcd.py)
+consumes — over the real etcd wire protocol (proto/etcd.proto). Two roles:
+
+- test double: discovery tests run the full register/watch/lease-expiry
+  lifecycle in-process with no external etcd (the reference never tests its
+  etcd pool at all; reference: etcd.go has no _test.go);
+- embedded membership server: a cluster without an etcd deployment can point
+  every node's EtcdPool at one etcdlite (e.g. `gubernator-cluster --etcd`),
+  accepting that it is a single-node, in-memory store — the same accepted
+  tradeoff as the rate-limit state itself (reference: architecture.md:5-11).
+
+Leases expire for real: a lapsed keep-alive deletes the lease's keys and
+notifies watchers, so peer death is observable end to end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+from concurrent import futures
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import grpc
+
+from gubernator_tpu.service.pb import etcd_pb2 as epb
+
+log = logging.getLogger("gubernator_tpu.etcdlite")
+
+
+@dataclasses.dataclass
+class _KV:
+    value: bytes
+    lease: int
+    create_revision: int
+    mod_revision: int
+    version: int
+
+
+@dataclasses.dataclass
+class _Lease:
+    id: int
+    ttl_s: float
+    expires_at: float  # monotonic
+
+
+class _Watcher:
+    def __init__(self, watch_id: int, key: bytes, range_end: bytes):
+        self.watch_id = watch_id
+        self.key = key
+        self.range_end = range_end
+        self.events: "queue.Queue[Optional[List[epb.Event]]]" = queue.Queue()
+
+    def matches(self, key: bytes) -> bool:
+        if self.range_end:
+            return self.key <= key < self.range_end
+        return key == self.key
+
+
+class EtcdLite:
+    """In-memory etcd-subset server. `address` of "127.0.0.1:0" picks a port;
+    the bound address is in `.address` after start()."""
+
+    def __init__(self, address: str = "127.0.0.1:0",
+                 min_lease_ttl_s: float = 0.0):
+        self._kvs: Dict[bytes, _KV] = {}
+        self._leases: Dict[int, _Lease] = {}
+        self._watchers: List[_Watcher] = []
+        # (revision, event) log so watches can replay from start_revision,
+        # like real etcd's mvcc history — trimmed to the newest
+        # `max_history` entries; replays from before the trim point are
+        # answered with canceled+compact_revision, like compacted etcd
+        self._events: List[Tuple[int, epb.Event]] = []
+        self._compacted_rev = 0
+        self.max_history = 4096
+        self._revision = 0
+        self._next_lease = 1000
+        self._next_watch = 1
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self.min_lease_ttl_s = min_lease_ttl_s
+        # test hook: when set, keep-alive streams terminate immediately and
+        # grants/renewals are refused, simulating a dead etcd
+        self.refuse_keepalives = False
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=16),
+            options=[("grpc.so_reuseport", 0)],
+        )
+        self._server.add_generic_rpc_handlers((self._handlers(),))
+        port = self._server.add_insecure_port(address)
+        host = address.rsplit(":", 1)[0]
+        self.address = f"{host}:{port}"
+        self._reaper = threading.Thread(
+            target=self._reap_loop, name="etcdlite-reaper", daemon=True
+        )
+
+    def start(self) -> "EtcdLite":
+        self._server.start()
+        self._reaper.start()
+        return self
+
+    def stop(self) -> None:
+        self._closed.set()
+        with self._lock:
+            for w in self._watchers:
+                w.events.put(None)
+            self._watchers = []
+        self._server.stop(grace=0.2)
+        self._reaper.join(timeout=2.0)
+
+    # -------------------------------------------------------------- handlers
+
+    def _handlers(self):
+        def unary(fn, req_cls):
+            return grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        def stream(fn, req_cls):
+            return grpc.stream_stream_rpc_method_handler(
+                fn,
+                request_deserializer=req_cls.FromString,
+                response_serializer=lambda m: m.SerializeToString(),
+            )
+
+        method_map = {
+            "/etcdserverpb.KV/Range": unary(self._range, epb.RangeRequest),
+            "/etcdserverpb.KV/Put": unary(self._put, epb.PutRequest),
+            "/etcdserverpb.KV/DeleteRange": unary(
+                self._delete_range, epb.DeleteRangeRequest
+            ),
+            "/etcdserverpb.Lease/LeaseGrant": unary(
+                self._lease_grant, epb.LeaseGrantRequest
+            ),
+            "/etcdserverpb.Lease/LeaseRevoke": unary(
+                self._lease_revoke, epb.LeaseRevokeRequest
+            ),
+            "/etcdserverpb.Lease/LeaseKeepAlive": stream(
+                self._lease_keep_alive, epb.LeaseKeepAliveRequest
+            ),
+            "/etcdserverpb.Watch/Watch": stream(self._watch, epb.WatchRequest),
+        }
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                return method_map.get(handler_call_details.method)
+
+        return Handler()
+
+    def _header(self) -> epb.ResponseHeader:
+        return epb.ResponseHeader(revision=self._revision)
+
+    # ------------------------------------------------------------------- KV
+
+    def _in_range(self, key: bytes, start: bytes, end: bytes) -> bool:
+        if end:
+            return start <= key < end
+        return key == start
+
+    def _range(self, req: epb.RangeRequest, ctx) -> epb.RangeResponse:
+        with self._lock:
+            kvs = [
+                epb.KeyValue(
+                    key=k, value=kv.value, lease=kv.lease,
+                    create_revision=kv.create_revision,
+                    mod_revision=kv.mod_revision, version=kv.version,
+                )
+                for k, kv in sorted(self._kvs.items())
+                if self._in_range(k, req.key, req.range_end)
+            ]
+            return epb.RangeResponse(
+                header=self._header(), kvs=kvs, count=len(kvs)
+            )
+
+    def _put(self, req: epb.PutRequest, ctx) -> epb.PutResponse:
+        with self._lock:
+            self._revision += 1
+            old = self._kvs.get(req.key)
+            kv = _KV(
+                value=req.value,
+                lease=req.lease,
+                create_revision=old.create_revision if old else self._revision,
+                mod_revision=self._revision,
+                version=(old.version + 1) if old else 1,
+            )
+            self._kvs[req.key] = kv
+            self._notify(
+                epb.Event(
+                    type=epb.Event.PUT,
+                    kv=epb.KeyValue(
+                        key=req.key, value=req.value, lease=req.lease,
+                        create_revision=kv.create_revision,
+                        mod_revision=kv.mod_revision, version=kv.version,
+                    ),
+                )
+            )
+            return epb.PutResponse(header=self._header())
+
+    def _delete_range(
+        self, req: epb.DeleteRangeRequest, ctx
+    ) -> epb.DeleteRangeResponse:
+        with self._lock:
+            deleted = self._delete_keys_locked(
+                [
+                    k
+                    for k in list(self._kvs)
+                    if self._in_range(k, req.key, req.range_end)
+                ]
+            )
+            return epb.DeleteRangeResponse(
+                header=self._header(), deleted=deleted
+            )
+
+    def _delete_keys_locked(self, keys: List[bytes]) -> int:
+        n = 0
+        for k in keys:
+            kv = self._kvs.pop(k, None)
+            if kv is None:
+                continue
+            n += 1
+            self._revision += 1
+            self._notify(
+                epb.Event(
+                    type=epb.Event.DELETE,
+                    kv=epb.KeyValue(key=k, mod_revision=self._revision),
+                    prev_kv=epb.KeyValue(
+                        key=k, value=kv.value, lease=kv.lease,
+                        create_revision=kv.create_revision,
+                        mod_revision=kv.mod_revision, version=kv.version,
+                    ),
+                )
+            )
+        return n
+
+    # ---------------------------------------------------------------- leases
+
+    def _lease_grant(self, req: epb.LeaseGrantRequest, ctx) -> epb.LeaseGrantResponse:
+        if self.refuse_keepalives:
+            ctx.abort(grpc.StatusCode.UNAVAILABLE, "etcdlite: refusing leases")
+        with self._lock:
+            self._next_lease += 1
+            lease_id = req.ID or self._next_lease
+            ttl = max(float(req.TTL), self.min_lease_ttl_s)
+            self._leases[lease_id] = _Lease(
+                id=lease_id, ttl_s=ttl, expires_at=time.monotonic() + ttl
+            )
+            return epb.LeaseGrantResponse(
+                header=self._header(), ID=lease_id, TTL=int(ttl)
+            )
+
+    def _lease_revoke(self, req: epb.LeaseRevokeRequest, ctx) -> epb.LeaseRevokeResponse:
+        with self._lock:
+            self._leases.pop(req.ID, None)
+            self._delete_keys_locked(
+                [k for k, kv in self._kvs.items() if kv.lease == req.ID]
+            )
+            return epb.LeaseRevokeResponse(header=self._header())
+
+    def _lease_keep_alive(
+        self, request_iterator: Iterator[epb.LeaseKeepAliveRequest], ctx
+    ) -> Iterator[epb.LeaseKeepAliveResponse]:
+        for req in request_iterator:
+            if self.refuse_keepalives or self._closed.is_set():
+                return  # stream closes; client must re-register
+            with self._lock:
+                lease = self._leases.get(req.ID)
+                if lease is None:
+                    yield epb.LeaseKeepAliveResponse(
+                        header=self._header(), ID=req.ID, TTL=0
+                    )
+                    continue
+                lease.expires_at = time.monotonic() + lease.ttl_s
+                yield epb.LeaseKeepAliveResponse(
+                    header=self._header(), ID=req.ID, TTL=int(lease.ttl_s)
+                )
+
+    def _reap_loop(self) -> None:
+        while not self._closed.wait(0.05):
+            now = time.monotonic()
+            with self._lock:
+                dead = [l.id for l in self._leases.values() if l.expires_at < now]
+                for lease_id in dead:
+                    log.info("lease %d expired", lease_id)
+                    del self._leases[lease_id]
+                    self._delete_keys_locked(
+                        [k for k, kv in self._kvs.items() if kv.lease == lease_id]
+                    )
+
+    # ----------------------------------------------------------------- watch
+
+    def _watch(
+        self, request_iterator: Iterator[epb.WatchRequest], ctx
+    ) -> Iterator[epb.WatchResponse]:
+        create = None
+        for req in request_iterator:
+            if req.HasField("create_request"):
+                create = req.create_request
+                break
+            return
+        if create is None:
+            return
+        with self._lock:
+            self._next_watch += 1
+            watcher = _Watcher(self._next_watch, create.key, create.range_end)
+            if 0 < create.start_revision <= self._compacted_rev:
+                yield epb.WatchResponse(
+                    header=self._header(),
+                    watch_id=watcher.watch_id,
+                    created=True,
+                )
+                yield epb.WatchResponse(
+                    header=self._header(),
+                    watch_id=watcher.watch_id,
+                    canceled=True,
+                    compact_revision=self._compacted_rev + 1,
+                    cancel_reason="required revision has been compacted",
+                )
+                return
+            if create.start_revision > 0:
+                replay = [
+                    ev
+                    for rev, ev in self._events
+                    if rev >= create.start_revision and watcher.matches(ev.kv.key)
+                ]
+                if replay:
+                    watcher.events.put(replay)
+            self._watchers.append(watcher)
+        yield epb.WatchResponse(
+            header=self._header(), watch_id=watcher.watch_id, created=True
+        )
+        try:
+            while True:
+                events = watcher.events.get()
+                if events is None:
+                    yield epb.WatchResponse(
+                        header=self._header(),
+                        watch_id=watcher.watch_id,
+                        canceled=True,
+                    )
+                    return
+                yield epb.WatchResponse(
+                    header=self._header(),
+                    watch_id=watcher.watch_id,
+                    events=events,
+                )
+        finally:
+            with self._lock:
+                if watcher in self._watchers:
+                    self._watchers.remove(watcher)
+
+    def _notify(self, event: epb.Event) -> None:
+        """Callers hold self._lock."""
+        self._events.append((self._revision, event))
+        if len(self._events) > self.max_history:
+            drop = len(self._events) - self.max_history
+            self._compacted_rev = self._events[drop - 1][0]
+            del self._events[:drop]
+        for w in self._watchers:
+            if w.matches(event.kv.key):
+                w.events.put([event])
+
+    # ------------------------------------------------------------- test hooks
+
+    def expire_all_leases(self) -> None:
+        """Force every lease to lapse now (fault injection)."""
+        with self._lock:
+            for lease in self._leases.values():
+                lease.expires_at = 0.0
+
+    def keys(self) -> Dict[bytes, bytes]:
+        with self._lock:
+            return {k: kv.value for k, kv in self._kvs.items()}

@@ -1,0 +1,241 @@
+"""Kubernetes discovery pool — a real implementation, no client library.
+
+Watches the core/v1 Endpoints API with a label selector, the same surface
+the reference consumes through client-go's SharedIndexInformer (reference:
+kubernetes.go:36-162), over plain HTTP(S) with the standard library:
+
+- in-cluster config: KUBERNETES_SERVICE_HOST/PORT + the service-account
+  token/CA/namespace files (what client-go's rest.InClusterConfig reads,
+  reference: kubernetes.go:57-66);
+- list + watch with resourceVersion continuation; 410 Gone or any stream
+  error re-lists and re-watches (the informer's behavior);
+- peers = every subset address of every matching Endpoints object, as
+  `ip:pod_port`, with `is_owner` set when the ip equals our pod ip
+  (reference: kubernetes.go:136-158).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import ssl
+import threading
+import urllib.parse
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from gubernator_tpu.types import PeerInfo
+
+log = logging.getLogger("gubernator_tpu.k8s")
+
+UpdateFunc = Callable[[List[PeerInfo]], None]
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class K8sPool:
+    """Peer discovery from the Endpoints API (reference: kubernetes.go)."""
+
+    def __init__(
+        self,
+        on_update: UpdateFunc,
+        selector: str,
+        pod_ip: str,
+        pod_port: str,
+        namespace: Optional[str] = None,
+        api_server: Optional[str] = None,
+        token: Optional[str] = None,
+        ssl_context: Optional[ssl.SSLContext] = None,
+        backoff_s: float = 5.0,
+        request_timeout_s: float = 30.0,
+        watch_timeout_s: float = 240.0,
+    ):
+        if api_server is None:
+            import os
+
+            host = os.environ.get("KUBERNETES_SERVICE_HOST")
+            port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+            if not host:
+                raise RuntimeError(
+                    "not running in-cluster: KUBERNETES_SERVICE_HOST unset "
+                    "and no api_server given (reference: rest.InClusterConfig)"
+                )
+            api_server = f"https://{host}:{port}"
+            if token is None:
+                with open(f"{SERVICE_ACCOUNT_DIR}/token") as f:
+                    token = f.read().strip()
+            if ssl_context is None:
+                ssl_context = ssl.create_default_context(
+                    cafile=f"{SERVICE_ACCOUNT_DIR}/ca.crt"
+                )
+            if namespace is None:
+                with open(f"{SERVICE_ACCOUNT_DIR}/namespace") as f:
+                    namespace = f.read().strip()
+        self.api_server = api_server.rstrip("/")
+        self.token = token
+        self.ssl_context = ssl_context
+        self.namespace = namespace or "default"
+        self.selector = selector
+        self.pod_ip = pod_ip
+        self.pod_port = pod_port
+        self.on_update = on_update
+        self.backoff_s = backoff_s
+        self.request_timeout_s = request_timeout_s
+        self.watch_timeout_s = watch_timeout_s
+
+        # informer store: "namespace/name" -> Endpoints object
+        self._store: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        self._last_pushed: Optional[List[PeerInfo]] = None
+
+        # initial list is synchronous and fails loudly, mirroring
+        # WaitForCacheSync (reference: kubernetes.go:128-131)
+        rv = self._list()
+        self._push()
+        self._thread = threading.Thread(
+            target=self._watch_loop, args=(rv,), name="k8s-watch", daemon=True
+        )
+        self._thread.start()
+
+    # ------------------------------------------------------------------ http
+
+    def _request(self, query: Dict[str, str], stream: bool):
+        qs = urllib.parse.urlencode(query)
+        url = (
+            f"{self.api_server}/api/v1/namespaces/{self.namespace}"
+            f"/endpoints?{qs}"
+        )
+        req = urllib.request.Request(url)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        # streams get a socket timeout too: a black-holed connection must
+        # raise rather than block recv() forever (client-go sets a
+        # server-side timeoutSeconds per watch for the same reason)
+        timeout = (
+            self.watch_timeout_s + 30.0 if stream else self.request_timeout_s
+        )
+        return urllib.request.urlopen(
+            req, timeout=timeout, context=self.ssl_context
+        )
+
+    def _list(self) -> str:
+        """Full re-list; returns the collection resourceVersion."""
+        query = {}
+        if self.selector:
+            query["labelSelector"] = self.selector
+        with self._request(query, stream=False) as resp:
+            body = json.load(resp)
+        with self._lock:
+            self._store = {
+                self._key(item): item for item in body.get("items", [])
+            }
+        return body.get("metadata", {}).get("resourceVersion", "")
+
+    def _watch_loop(self, resource_version: str) -> None:
+        while not self._closed.is_set():
+            try:
+                query = {
+                    "watch": "1",
+                    "allowWatchBookmarks": "true",
+                    # ask the server to end the watch periodically so a
+                    # silent connection can't freeze discovery forever
+                    "timeoutSeconds": str(int(self.watch_timeout_s)),
+                }
+                if self.selector:
+                    query["labelSelector"] = self.selector
+                if resource_version:
+                    query["resourceVersion"] = resource_version
+                with self._request(query, stream=True) as resp:
+                    for line in resp:
+                        if self._closed.is_set():
+                            return
+                        if not line.strip():
+                            continue
+                        event = json.loads(line)
+                        resource_version = self._apply(event, resource_version)
+            except _Expired:
+                log.info("watch expired (410 Gone); re-listing")
+                resource_version = ""
+            except Exception as e:  # noqa: BLE001
+                if self._closed.is_set():
+                    return
+                log.warning("endpoints watch error: %s; re-listing", e)
+                if self._closed.wait(self.backoff_s):
+                    return
+            if self._closed.is_set():
+                return
+            # stream ended or failed: informer semantics — re-list, then
+            # continue watching from the fresh resourceVersion
+            try:
+                resource_version = self._list()
+                self._push()
+            except Exception as e:  # noqa: BLE001
+                log.warning("endpoints re-list failed: %s", e)
+                if self._closed.wait(self.backoff_s):
+                    return
+
+    def _apply(self, event: dict, resource_version: str) -> str:
+        etype = event.get("type")
+        obj = event.get("object", {})
+        rv = obj.get("metadata", {}).get("resourceVersion", resource_version)
+        if etype == "BOOKMARK":
+            return rv
+        if etype == "ERROR":
+            if obj.get("code") == 410:
+                raise _Expired()
+            raise RuntimeError(f"watch error event: {obj}")
+        key = self._key(obj)
+        with self._lock:
+            if etype == "DELETED":
+                self._store.pop(key, None)
+            else:  # ADDED / MODIFIED
+                self._store[key] = obj
+        # the reference pushes on update/delete events
+        # (kubernetes.go:97-124: Add logs only; Update/Delete call updatePeers)
+        self._push()
+        return rv
+
+    # --------------------------------------------------------------- updates
+
+    @staticmethod
+    def _key(obj: dict) -> str:
+        meta = obj.get("metadata", {})
+        return f"{meta.get('namespace', '')}/{meta.get('name', '')}"
+
+    def _peers(self) -> List[PeerInfo]:
+        """(reference: kubernetes.go:136-158 updatePeers)"""
+        peers = []
+        with self._lock:
+            for obj in self._store.values():
+                for subset in obj.get("subsets") or []:
+                    for addr in subset.get("addresses") or []:
+                        ip = addr.get("ip", "")
+                        if not ip:
+                            continue
+                        peers.append(
+                            PeerInfo(
+                                address=f"{ip}:{self.pod_port}",
+                                is_owner=ip == self.pod_ip,
+                            )
+                        )
+        peers.sort(key=lambda p: p.address)
+        return peers
+
+    def _push(self) -> None:
+        peers = self._peers()
+        if peers == self._last_pushed:
+            return
+        self._last_pushed = peers
+        try:
+            self.on_update(list(peers))
+        except Exception:  # noqa: BLE001
+            log.exception("peer update callback failed")
+
+    def close(self) -> None:
+        self._closed.set()
+        self._thread.join(timeout=2.0)
+
+
+class _Expired(Exception):
+    """HTTP 410: the watch resourceVersion was compacted away."""

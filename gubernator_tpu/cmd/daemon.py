@@ -74,7 +74,17 @@ def build_pool(conf: DaemonConfig, instance: Instance):
         instance.set_peers(peers)
 
     if conf.k8s_selector:
-        return discovery.K8sPool()
+        from gubernator_tpu.cluster.k8s import K8sPool
+
+        grpc_port = (conf.advertise_address or conf.grpc_address).rsplit(":", 1)[-1]
+        return K8sPool(
+            on_update=on_update,
+            selector=conf.k8s_selector,
+            # None -> read the in-cluster service-account namespace file
+            namespace=conf.k8s_namespace or None,
+            pod_ip=conf.k8s_pod_ip,
+            pod_port=conf.k8s_pod_port or grpc_port,
+        )
     if conf.gossip_bind or conf.gossip_known_nodes:
         return discovery.GossipPool(
             bind_address=conf.gossip_bind or "0.0.0.0:7946",
@@ -84,7 +94,11 @@ def build_pool(conf: DaemonConfig, instance: Instance):
             on_update=on_update,
         )
     if conf.etcd_endpoints:
-        return discovery.EtcdPool()
+        return discovery.EtcdPool(
+            endpoints=conf.etcd_endpoints,
+            advertise_address=conf.advertise_address or conf.grpc_address,
+            on_update=on_update,
+        )
     if conf.peers_file:
         return discovery.FilePool(conf.peers_file, on_update)
     peers = conf.peers or [conf.advertise_address or conf.grpc_address]

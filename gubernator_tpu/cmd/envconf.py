@@ -89,6 +89,9 @@ class DaemonConfig:
     gossip_known_nodes: List[str] = dataclasses.field(default_factory=list)
     etcd_endpoints: List[str] = dataclasses.field(default_factory=list)
     k8s_selector: str = ""
+    k8s_namespace: str = ""  # empty -> in-cluster service-account namespace
+    k8s_pod_ip: str = ""
+    k8s_pod_port: str = ""
 
     # picker
     peer_picker: str = ""  # "" | consistent-hash | replicated-hash
@@ -140,6 +143,9 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         gossip_known_nodes=_env_slice("GUBER_MEMBERLIST_KNOWN_NODES"),
         etcd_endpoints=_env_slice("GUBER_ETCD_ENDPOINTS"),
         k8s_selector=_env_str("GUBER_K8S_ENDPOINTS_SELECTOR"),
+        k8s_namespace=_env_str("GUBER_K8S_NAMESPACE"),
+        k8s_pod_ip=_env_str("GUBER_K8S_POD_IP"),
+        k8s_pod_port=_env_str("GUBER_K8S_POD_PORT"),
         peer_picker=_env_str("GUBER_PEER_PICKER"),
         peer_picker_hash=_env_str("GUBER_PEER_PICKER_HASH"),
         replicated_hash_replicas=_env_int("GUBER_REPLICATED_HASH_REPLICAS", 512),

@@ -116,8 +116,10 @@ def dial_v1(address: str) -> V1Stub:
             ch = grpc.insecure_channel(address)
             _channels[address] = ch
             while len(_channels) > _CHANNEL_CACHE_MAX:
-                _, old = _channels.popitem(last=False)
-                old.close()
+                # drop the reference but do NOT close: a live V1Stub may
+                # still hold the evicted channel; GC reclaims it once the
+                # last stub is gone
+                _channels.popitem(last=False)
         else:
             _channels.move_to_end(address)
     return V1Stub(ch)

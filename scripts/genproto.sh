@@ -5,5 +5,5 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 protoc -I proto --python_out=gubernator_tpu/service/pb \
-    proto/gubernator.proto proto/peers.proto
-echo "generated gubernator_tpu/service/pb/{gubernator,peers}_pb2.py"
+    proto/gubernator.proto proto/peers.proto proto/etcd.proto
+echo "generated gubernator_tpu/service/pb/{gubernator,peers,etcd}_pb2.py"

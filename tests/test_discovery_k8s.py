@@ -1,0 +1,251 @@
+"""K8sPool against a fake Kubernetes Endpoints API (stdlib HTTP server).
+
+Covers the informer lifecycle the reference delegates to client-go
+(reference: kubernetes.go:79-134): initial list, watch events (ADDED /
+MODIFIED / DELETED), owner marking by pod IP, and re-list after stream
+expiry (410 Gone).
+"""
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from gubernator_tpu.cluster.k8s import K8sPool
+
+
+def endpoints_obj(name, ips, rv="1"):
+    return {
+        "metadata": {"namespace": "default", "name": name, "resourceVersion": rv},
+        "subsets": [{"addresses": [{"ip": ip} for ip in ips]}],
+    }
+
+
+class FakeK8sApi:
+    """Serves /api/v1/namespaces/default/endpoints list + watch."""
+
+    def __init__(self):
+        self.objects = {}
+        self.rv = 1
+        self.lock = threading.Lock()
+        self.watchers = []
+        self.requests = []
+
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                parsed = urlparse(self.path)
+                q = parse_qs(parsed.query)
+                fake.requests.append(self.path)
+                if not parsed.path.endswith("/endpoints"):
+                    self.send_error(404)
+                    return
+                if q.get("watch"):
+                    self._watch(q)
+                else:
+                    self._list()
+
+            def _list(self):
+                with fake.lock:
+                    body = json.dumps(
+                        {
+                            "metadata": {"resourceVersion": str(fake.rv)},
+                            "items": list(fake.objects.values()),
+                        }
+                    ).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _watch(self, q):
+                events: "queue.Queue" = queue.Queue()
+                rv = int(q.get("resourceVersion", ["0"])[0] or 0)
+                with fake.lock:
+                    expired = rv and rv < fake.min_rv
+                    # real k8s replays events after the requested
+                    # resourceVersion; replay current objects newer than rv
+                    replay = [
+                        {"type": "MODIFIED", "object": obj}
+                        for obj in fake.objects.values()
+                        if int(obj["metadata"]["resourceVersion"]) > rv
+                    ] if not expired else []
+                    fake.watchers.append(events)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    if expired:
+                        self._send_chunk(
+                            {"type": "ERROR", "object": {"code": 410}}
+                        )
+                        return
+                    for ev in replay:
+                        self._send_chunk(ev)
+                    while True:
+                        ev = events.get()
+                        if ev is None:
+                            return
+                        self._send_chunk(ev)
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                finally:
+                    with fake.lock:
+                        if events in fake.watchers:
+                            fake.watchers.remove(events)
+
+            def _send_chunk(self, obj):
+                data = json.dumps(obj).encode() + b"\n"
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+
+        self.min_rv = 0
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.url = f"http://127.0.0.1:{self.server.server_port}"
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    def push(self, etype, obj):
+        with self.lock:
+            self.rv += 1
+            obj["metadata"]["resourceVersion"] = str(self.rv)
+            if etype == "DELETED":
+                self.objects.pop(obj["metadata"]["name"], None)
+            else:
+                self.objects[obj["metadata"]["name"]] = obj
+            for w in self.watchers:
+                w.put({"type": etype, "object": obj})
+
+    def drop_watchers(self):
+        with self.lock:
+            for w in self.watchers:
+                w.put(None)
+
+    def stop(self):
+        self.drop_watchers()
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class Updates:
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.history = []
+
+    def __call__(self, peers):
+        with self.lock:
+            self.history.append(peers)
+
+    def latest(self):
+        with self.lock:
+            return self.history[-1] if self.history else None
+
+    def wait_for(self, predicate, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            latest = self.latest()
+            if latest is not None and predicate(latest):
+                return latest
+            time.sleep(0.02)
+        raise AssertionError(f"not reached; latest: {self.latest()}")
+
+
+@pytest.fixture
+def api():
+    f = FakeK8sApi()
+    yield f
+    f.stop()
+
+
+def make_pool(api, updates, **kw):
+    kw.setdefault("selector", "app=gubernator")
+    kw.setdefault("pod_ip", "10.0.0.1")
+    kw.setdefault("pod_port", "81")
+    kw.setdefault("namespace", "default")
+    kw.setdefault("backoff_s", 0.1)
+    return K8sPool(updates, api_server=api.url, token="test-token", **kw)
+
+
+def test_initial_list_and_owner_marking(api):
+    api.push("ADDED", endpoints_obj("gubernator", ["10.0.0.1", "10.0.0.2"]))
+    u = Updates()
+    pool = make_pool(api, u)
+    try:
+        peers = u.wait_for(lambda p: len(p) == 2)
+        assert [p.address for p in peers] == ["10.0.0.1:81", "10.0.0.2:81"]
+        assert [p.is_owner for p in peers] == [True, False]
+        # selector must be passed through to the API
+        assert any("labelSelector=app%3Dgubernator" in r for r in api.requests)
+    finally:
+        pool.close()
+
+
+def test_watch_add_modify_delete(api):
+    api.push("ADDED", endpoints_obj("gubernator", ["10.0.0.1"]))
+    u = Updates()
+    pool = make_pool(api, u)
+    try:
+        u.wait_for(lambda p: len(p) == 1)
+        api.push("MODIFIED", endpoints_obj("gubernator", ["10.0.0.1", "10.0.0.3"]))
+        u.wait_for(
+            lambda p: [x.address for x in p] == ["10.0.0.1:81", "10.0.0.3:81"]
+        )
+        api.push("DELETED", endpoints_obj("gubernator", []))
+        u.wait_for(lambda p: p == [])
+    finally:
+        pool.close()
+
+
+def test_stream_drop_relists(api):
+    api.push("ADDED", endpoints_obj("gubernator", ["10.0.0.1"]))
+    u = Updates()
+    pool = make_pool(api, u)
+    try:
+        u.wait_for(lambda p: len(p) == 1)
+        # membership changes while the watch is down
+        with api.lock:
+            api.rv += 1
+            api.objects["gubernator"] = endpoints_obj(
+                "gubernator", ["10.0.0.1", "10.0.0.4"], rv=str(api.rv)
+            )
+        api.drop_watchers()
+        u.wait_for(
+            lambda p: [x.address for x in p] == ["10.0.0.1:81", "10.0.0.4:81"]
+        )
+    finally:
+        pool.close()
+
+
+def test_410_gone_relists(api):
+    api.push("ADDED", endpoints_obj("gubernator", ["10.0.0.1"]))
+    u = Updates()
+    pool = make_pool(api, u)
+    try:
+        u.wait_for(lambda p: len(p) == 1)
+        with api.lock:
+            api.min_rv = api.rv + 100  # every watch rv is now "too old"
+            api.rv += 1
+            api.objects["gubernator"] = endpoints_obj(
+                "gubernator", ["10.0.0.5"], rv=str(api.rv)
+            )
+        api.drop_watchers()
+        u.wait_for(lambda p: [x.address for x in p] == ["10.0.0.5:81"])
+        with api.lock:
+            api.min_rv = 0
+    finally:
+        pool.close()
