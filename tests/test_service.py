@@ -58,18 +58,23 @@ class TestTokenBucket:
     def test_remote_key_has_owner_metadata(self, cluster):
         """Requests through a non-owner peer carry the owner address
         (reference: gubernator.go:185-205)."""
-        # find a key owned by instance 1 and call via instance 0
-        inst0 = cluster.instances[0].instance
-        key = None
-        for i in range(200):
-            k = f"remote_{i}"
-            peer = inst0.get_peer(f"test_{k}")
-            if not peer.info.is_owner:
-                key = k
-                owner_addr = peer.info.address
+        # find a (caller, key) pair where the caller is not the owner
+        caller_idx, key = None, None
+        for idx, ci in enumerate(cluster.instances):
+            assert ci.instance.local_peers(), "picker lost its peers"
+            for i in range(200):
+                k = f"remote_{i}"
+                peer = ci.instance.get_peer(f"test_{k}")
+                if not peer.info.is_owner:
+                    caller_idx, key, owner_addr = idx, k, peer.info.address
+                    break
+            if key is not None:
                 break
-        assert key is not None
-        r = _call(cluster, [_req(key)], idx=0)[0]
+        assert key is not None, (
+            f"no remote-owned key found; picker sizes: "
+            f"{[ci.instance.local_peers() for ci in cluster.instances]}"
+        )
+        r = _call(cluster, [_req(key)], idx=caller_idx)[0]
         assert r.error == ""
         assert r.metadata["owner"] == owner_addr
         assert r.remaining == 4
