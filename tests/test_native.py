@@ -95,14 +95,18 @@ def test_native_is_faster_than_python():
 
     n = 20_000
     keys = [f"bench:{i % 5000}" for i in range(n)]
-    native = NativeKeyDirectory(8192)
-    pure = KeyDirectory(8192)
 
-    t0 = time.perf_counter()
-    native.lookup(keys)
-    t_native = time.perf_counter() - t0
-    t0 = time.perf_counter()
-    pure.lookup(keys)
-    t_pure = time.perf_counter() - t0
-    # native should win clearly; allow slack for CI noise
+    # best-of-5 on fresh directories; first rep doubles as warmup for
+    # library load and allocator caches, so single-run scheduler noise
+    # can't flip the comparison
+    t_native = t_pure = float("inf")
+    for _ in range(5):
+        native = NativeKeyDirectory(8192)
+        pure = KeyDirectory(8192)
+        t0 = time.perf_counter()
+        native.lookup(keys)
+        t_native = min(t_native, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        pure.lookup(keys)
+        t_pure = min(t_pure, time.perf_counter() - t0)
     assert t_native < t_pure, f"native {t_native:.4f}s vs python {t_pure:.4f}s"

@@ -68,12 +68,14 @@ class TestTokenBucket:
                 if not peer.info.is_owner:
                     caller_idx, key, owner_addr = idx, k, peer.info.address
                     break
-            if key is not None:
-                break
-        assert key is not None, (
-            f"no remote-owned key found; picker sizes: "
-            f"{[ci.instance.local_peers() for ci in cluster.instances]}"
-        )
+            # with a multi-peer ring, owning all 200 probes means the picker
+            # collapsed onto self — a bug, not a flake to skip past
+            assert key is not None, (
+                f"instance {idx} with "
+                f"{len(ci.instance.local_peers())} peers owns all 200 probe "
+                "keys: picker claims ownership of everything"
+            )
+            break
         r = _call(cluster, [_req(key)], idx=caller_idx)[0]
         assert r.error == ""
         assert r.metadata["owner"] == owner_addr
