@@ -1,0 +1,27 @@
+# Parity with the reference's Makefile (Makefile:1-18): `test` runs the
+# whole suite with concurrency hygiene, plus this repo's bench/proto targets.
+
+.PHONY: test test-fast bench bench-suite proto docker clean
+
+# the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
+test:
+	python -m pytest tests/ -q
+
+test-fast:
+	python -m pytest tests/ -q -x -m "not slow"
+
+bench:
+	python bench.py
+
+bench-suite:
+	python scripts/bench_suite.py
+
+proto:
+	bash scripts/genproto.sh
+
+docker:
+	docker build -t gubernator-tpu:latest .
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true
+	rm -f gubernator_tpu/native/_keydir_*.so

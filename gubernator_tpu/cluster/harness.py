@@ -20,6 +20,7 @@ from gubernator_tpu.models.engine import Engine
 from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
 from gubernator_tpu.service.grpc_api import close_channels
 from gubernator_tpu.service.instance import Instance
+from gubernator_tpu.service.metrics import Metrics
 from gubernator_tpu.service.server import make_server
 from gubernator_tpu.types import PeerInfo
 
@@ -47,6 +48,9 @@ class ClusterInstance:
     datacenter: str
     instance: Instance
     server: grpc.Server
+    # per-instance registry so tests can assert histogram samples the way
+    # the reference's GLOBAL test reads Collect() (functional_test.go:311-343)
+    metrics: Optional[Metrics] = None
 
     def stop(self) -> None:
         self.server.stop(grace=0.2)
@@ -79,11 +83,13 @@ class LocalCluster:
         """(reference: cluster/cluster.go:138-165)"""
         backend = Engine(capacity=capacity, min_width=32, max_width=256)
         backend.warmup()  # compile all width buckets before serving
+        metrics = Metrics()
         inst = Instance(
             InstanceConfig(
                 behaviors=test_behaviors(),
                 data_center=datacenter,
                 backend=backend,
+                metrics=metrics,
             ),
             advertise_address="pending",
         )
@@ -91,9 +97,16 @@ class LocalCluster:
         address = f"127.0.0.1:{port}"
         inst.advertise_address = address
         ci = ClusterInstance(
-            address=address, datacenter=datacenter, instance=inst, server=server
+            address=address, datacenter=datacenter, instance=inst,
+            server=server, metrics=metrics,
         )
         server.start()
+        # a restart on a fixed port replaces the stopped entry, so
+        # sync_peers/instance_for_host never see a dead duplicate address
+        for i, old in enumerate(self.instances):
+            if old.address == address:
+                self.instances[i] = ci
+                return ci
         self.instances.append(ci)
         return ci
 

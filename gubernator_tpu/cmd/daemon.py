@@ -122,17 +122,17 @@ def main(argv=None) -> int:
         backend.warmup()
 
     advertise = conf.advertise_address or conf.grpc_address
+    metrics = Metrics()
     instance = Instance(
         InstanceConfig(
             behaviors=conf.behaviors,
             data_center=conf.data_center,
             backend=backend,
             local_picker=build_picker(conf),
+            metrics=metrics,
         ),
         advertise_address=advertise,
     )
-
-    metrics = Metrics()
     server, port = make_server(
         instance,
         conf.grpc_address,

@@ -44,6 +44,9 @@ class InstanceConfig:
     backend: Optional[object] = None
     local_picker: Optional[object] = None  # cluster.pickers.*
     region_picker: Optional[object] = None
+    # service.metrics.Metrics; optional — managers observe their histograms
+    # through it when present (reference: global.go:45-51,155,238)
+    metrics: Optional[object] = None
 
     def validate(self) -> None:
         if self.behaviors.batch_limit > MAX_BATCH_SIZE:

@@ -34,10 +34,23 @@ class _Pipeline:
     enqueue into an empty queue (the Interval semantics of the reference's
     batching loops, interval.go:26-69 / global.go:73-112)."""
 
-    def __init__(self, name: str, wait_s: float, limit: int, flush_fn):
+    def __init__(self, name: str, wait_s: float, limit: int, flush_fn,
+                 observe=None):
         self._name = name
         self._wait_s = wait_s
         self._limit = limit
+        if observe is not None:
+            # time every flush into a histogram, the reference's defer'd
+            # duration observation (global.go:155,238)
+            inner = flush_fn
+
+            def flush_fn(batch, _inner=inner, _observe=observe):
+                start = time.perf_counter()
+                try:
+                    _inner(batch)
+                finally:
+                    _observe(time.perf_counter() - start)
+
         self._flush_fn = flush_fn
         self._pending: Dict[str, RateLimitReq] = {}
         self._deadline: Optional[float] = None
@@ -114,16 +127,19 @@ class _Pipeline:
 class GlobalManager:
     """Owns both GLOBAL pipelines for one Instance."""
 
-    def __init__(self, instance, behaviors: BehaviorConfig):
+    def __init__(self, instance, behaviors: BehaviorConfig, metrics=None):
         self.instance = instance
         self.conf = behaviors
+        self.metrics = metrics
         self._hits = _Pipeline(
             "hits", behaviors.global_sync_wait_s, behaviors.global_batch_limit,
             self._send_hits,
+            observe=metrics.async_durations.observe if metrics else None,
         )
         self._broadcasts = _Pipeline(
             "broadcast", behaviors.global_sync_wait_s,
             behaviors.global_batch_limit, self._broadcast,
+            observe=metrics.broadcast_durations.observe if metrics else None,
         )
         self.stats = {"hits_sent": 0, "broadcasts_sent": 0, "broadcast_errors": 0}
 

@@ -91,7 +91,9 @@ class Instance:
         self.region_picker = conf.region_picker or RegionPicker()
         self._peer_lock = threading.RLock()
 
-        self.global_manager = GlobalManager(self, conf.behaviors)
+        self.global_manager = GlobalManager(
+            self, conf.behaviors, metrics=conf.metrics
+        )
         self.multiregion_manager = MultiRegionManager(self, conf.behaviors)
         # non-owner cache of GLOBAL statuses (reference: gubernator.go:251-264)
         self._global_cache = LRUCache()

@@ -1,0 +1,86 @@
+"""JSON-(un)marshalable log level wrapper.
+
+Parity with the reference's `logging` package (logging/logging.go:25-55),
+which wraps a logrus level so embedding services can carry it in JSON
+config. Here the same contract over Python's stdlib logging: marshals to
+the level *name*, unmarshals from either a name or a numeric level, and
+accepts the reference's logrus names (panic/fatal/error/warning/info/
+debug/trace) as well as Python's.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+# logrus names → stdlib levels (logrus: panic=0..trace=6; stdlib has no
+# panic/trace, so they clamp to the nearest severity)
+_LOGRUS_TO_STD = {
+    "panic": logging.CRITICAL,
+    "fatal": logging.CRITICAL,
+    "error": logging.ERROR,
+    "warning": logging.WARNING,
+    "warn": logging.WARNING,
+    "info": logging.INFO,
+    "debug": logging.DEBUG,
+    "trace": logging.DEBUG,
+}
+_STD_TO_NAME = {
+    logging.CRITICAL: "fatal",
+    logging.ERROR: "error",
+    logging.WARNING: "warning",
+    logging.INFO: "info",
+    logging.DEBUG: "debug",
+}
+
+
+class LogLevelJSON:
+    """A log level that round-trips through JSON as its name
+    (reference: logging/logging.go:25-55)."""
+
+    def __init__(self, level: int = logging.INFO):
+        self.level = int(level)
+
+    def __str__(self) -> str:
+        return _STD_TO_NAME.get(self.level, str(self.level))
+
+    def __repr__(self) -> str:
+        return f"LogLevelJSON({self})"
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, LogLevelJSON):
+            return self.level == other.level
+        if isinstance(other, int):
+            return self.level == other
+        return NotImplemented
+
+    def __hash__(self) -> int:
+        return hash(self.level)
+
+    def marshal_json(self) -> str:
+        # unnamed levels (NOTSET, addLevelName customs) marshal as the bare
+        # number so unmarshal_json can always read marshal_json's output
+        name = _STD_TO_NAME.get(self.level)
+        return json.dumps(name if name is not None else self.level)
+
+    @classmethod
+    def unmarshal_json(cls, data: str) -> "LogLevelJSON":
+        """Accept a quoted level name or a bare number
+        (reference: logging/logging.go:34-50)."""
+        v = json.loads(data)
+        if isinstance(v, (int, float)):
+            return cls(int(v))
+        if isinstance(v, str):
+            return cls(parse_level(v))
+        raise ValueError("invalid log level")
+
+
+def parse_level(name: str) -> int:
+    """Level name → stdlib level; knows both logrus and Python names."""
+    low = name.strip().lower()
+    if low in _LOGRUS_TO_STD:
+        return _LOGRUS_TO_STD[low]
+    std = logging.getLevelName(name.strip().upper())
+    if isinstance(std, int):
+        return std
+    raise ValueError(f"not a valid log level: {name!r}")

@@ -59,23 +59,22 @@ class TestTokenBucket:
         """Requests through a non-owner peer carry the owner address
         (reference: gubernator.go:185-205)."""
         # find a (caller, key) pair where the caller is not the owner
-        caller_idx, key = None, None
-        for idx, ci in enumerate(cluster.instances):
-            assert ci.instance.local_peers(), "picker lost its peers"
-            for i in range(200):
-                k = f"remote_{i}"
-                peer = ci.instance.get_peer(f"test_{k}")
-                if not peer.info.is_owner:
-                    caller_idx, key, owner_addr = idx, k, peer.info.address
-                    break
-            # with a multi-peer ring, owning all 200 probes means the picker
-            # collapsed onto self — a bug, not a flake to skip past
-            assert key is not None, (
-                f"instance {idx} with "
-                f"{len(ci.instance.local_peers())} peers owns all 200 probe "
-                "keys: picker claims ownership of everything"
-            )
-            break
+        caller_idx, key = 0, None
+        ci = cluster.instances[caller_idx]
+        assert ci.instance.local_peers(), "picker lost its peers"
+        for i in range(200):
+            k = f"remote_{i}"
+            peer = ci.instance.get_peer(f"test_{k}")
+            if not peer.info.is_owner:
+                key, owner_addr = k, peer.info.address
+                break
+        # with a multi-peer ring, owning all 200 probes means the picker
+        # collapsed onto self — a bug, not a flake to skip past
+        assert key is not None, (
+            f"instance {caller_idx} with "
+            f"{len(ci.instance.local_peers())} peers owns all 200 probe "
+            "keys: picker claims ownership of everything"
+        )
         r = _call(cluster, [_req(key)], idx=caller_idx)[0]
         assert r.error == ""
         assert r.metadata["owner"] == owner_addr
@@ -150,11 +149,12 @@ class TestGlobalBehavior:
         """(reference: functional_test.go:274-345)"""
         inst0 = cluster.instances[0].instance
         # pick a key NOT owned by instance 0
-        key = None
+        key, owner_addr = None, None
         for i in range(200):
             k = f"glob_{i}"
-            if not inst0.get_peer(f"test_{k}").info.is_owner:
-                key = k
+            peer = inst0.get_peer(f"test_{k}")
+            if not peer.info.is_owner:
+                key, owner_addr = k, peer.info.address
                 break
         assert key is not None
         g = lambda h: _req(key, hits=h, limit=100, behavior=Behavior.GLOBAL)
@@ -176,6 +176,18 @@ class TestGlobalBehavior:
         for idx in range(1, 4):
             r = _call(cluster, [g(0)], idx=idx)[0]
             assert r.remaining == 85, f"instance {idx} diverged"
+        # the async pipelines left histogram samples behind, like the
+        # reference asserts via Collect() (functional_test.go:311-343):
+        # the non-owner forwarded hits, the owner broadcast state
+        count = cluster.instances[0].metrics.registry.get_sample_value(
+            "async_durations_count"
+        )
+        assert count and count >= 1, f"non-owner async samples: {count}"
+        owner_ci = cluster.instance_for_host(owner_addr)
+        count = owner_ci.metrics.registry.get_sample_value(
+            "broadcast_durations_count"
+        )
+        assert count and count >= 1, f"owner broadcast samples: {count}"
 
 
 class TestHealth:
@@ -202,6 +214,8 @@ class TestFaultInjection:
                     key = k
                     break
             assert key is not None
+            dead_addr = c.instances[2].address
+            dead_port = int(dead_addr.rsplit(":", 1)[1])
             c.stop_instance_at(2)
             r = _call(c, [_req(key)], idx=0)[0]
             assert r.error != ""  # forwarding failed
@@ -209,5 +223,29 @@ class TestFaultInjection:
                 pb.HealthCheckReq(), timeout=5
             )
             assert hc.status == "unhealthy"
+            # the message carries the accumulated peer errors, like the
+            # reference's "connection refused" assertion
+            # (functional_test.go:540-545)
+            assert hc.message != ""
+
+            # restart the dead instance on its old port and re-wire peers:
+            # the key is servable again (functional_test.go:566-568; health
+            # stays unhealthy until the 5-min error TTL drains, by design —
+            # peer_client.go:53)
+            c.start_instance(fixed_port=dead_port)
+            c.sync_peers()
+            # the caller's channel to the restarted peer leaves reconnect
+            # backoff within ~1s; until then forwards fail fast, as in the
+            # reference (gRPC fail-fast + error in the response body)
+            deadline = time.monotonic() + 15
+            while True:
+                r = _call(c, [_req(key)], idx=0)[0]
+                if r.error == "" or time.monotonic() > deadline:
+                    break
+                time.sleep(0.25)
+            assert r.error == "", r.error
+            # restarted owner came back empty (accepted state loss,
+            # architecture.md:5-11): first successful hit of a fresh bucket
+            assert r.remaining == 4
         finally:
             c.stop()

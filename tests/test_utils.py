@@ -142,3 +142,41 @@ class TestLRUCache:
         for i in range(5):
             c.add(CacheItem(key=str(i), value=i, expire_at=millisecond_now() + 10_000))
         assert sorted(item.value for item in c.each()) == [0, 1, 2, 3, 4]
+
+
+class TestLogLevelJSON:
+    """(reference: logging/logging.go:25-55)"""
+
+    def test_marshal_is_name(self):
+        from gubernator_tpu.utils.logging import LogLevelJSON
+        import logging as std
+
+        assert LogLevelJSON(std.INFO).marshal_json() == '"info"'
+        assert LogLevelJSON(std.ERROR).marshal_json() == '"error"'
+
+    def test_unmarshal_from_string_and_number(self):
+        from gubernator_tpu.utils.logging import LogLevelJSON
+        import logging as std
+
+        assert LogLevelJSON.unmarshal_json('"debug"').level == std.DEBUG
+        assert LogLevelJSON.unmarshal_json('"trace"').level == std.DEBUG
+        assert LogLevelJSON.unmarshal_json('"panic"').level == std.CRITICAL
+        assert LogLevelJSON.unmarshal_json(str(std.WARNING)).level == std.WARNING
+
+    def test_roundtrip(self):
+        from gubernator_tpu.utils.logging import LogLevelJSON
+
+        ll = LogLevelJSON.unmarshal_json('"warning"')
+        assert LogLevelJSON.unmarshal_json(ll.marshal_json()) == ll
+
+    def test_invalid(self):
+        import json
+
+        import pytest
+
+        from gubernator_tpu.utils.logging import LogLevelJSON
+
+        with pytest.raises(ValueError):
+            LogLevelJSON.unmarshal_json('"not-a-level"')
+        with pytest.raises(ValueError):
+            LogLevelJSON.unmarshal_json(json.dumps([1]))
