@@ -1,0 +1,131 @@
+"""Flat-combining batch window in front of the device backend.
+
+The reference serializes concurrent requests under one cache mutex and
+processes them one at a time (gubernator.go:328); each request is cheap Go.
+Here every backend call is a device kernel dispatch, so serializing callers
+would pay one dispatch *per request*. Instead, concurrent callers hand
+their requests to a combiner: while one kernel launch is in flight, all
+arriving requests pool up and the next launch applies them as ONE batch.
+This is the TPU-first inversion of the reference's request micro-batching
+(peer_client.go:243-283): the batch window emerges from dispatch latency
+itself — a lone caller dispatches immediately (one thread hop), a
+thundering herd aggregates into dispatch-sized windows automatically.
+
+Per-key sequential semantics are preserved by the engine's collision-free
+rounds (models/prep.py): duplicate keys across merged callers land in
+separate rounds of the same launch.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from concurrent.futures import Future
+from typing import List, Optional, Sequence
+
+from gubernator_tpu.types import RateLimitReq, RateLimitResp
+
+log = logging.getLogger("gubernator_tpu.combiner")
+
+
+class BackendCombiner:
+    """Merges concurrent get_rate_limits calls into single backend batches."""
+
+    def __init__(self, backend, name: str = "backend-combiner"):
+        self.backend = backend
+        self._cond = threading.Condition()
+        self._pending: List[tuple] = []  # (reqs, now_ms, future)
+        self._closed = False
+        # windows actually merged >1 submission (observability)
+        self.stats = {"submissions": 0, "windows": 0, "merged_windows": 0}
+        self._thread = threading.Thread(target=self._run, name=name, daemon=True)
+        self._thread.start()
+
+    def submit(
+        self, reqs: Sequence[RateLimitReq], now_ms: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        """Block until this submission's responses are ready."""
+        if not reqs:
+            return []
+        fut: "Future[List[RateLimitResp]]" = Future()
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("combiner is closed")
+            self._pending.append((list(reqs), now_ms, fut))
+            self.stats["submissions"] += 1
+            self._cond.notify()
+        return fut.result()
+
+    def close(self, timeout_s: float = 30.0) -> None:
+        """Stop accepting submissions; drain what's queued. Anything the
+        worker never got to (dead worker, drain timeout) fails loudly
+        instead of leaving its caller blocked forever."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify()
+        self._thread.join(timeout=timeout_s)
+        if self._thread.is_alive():
+            log.warning(
+                "combiner drain exceeded %.1fs; a snapshot taken now may "
+                "miss in-flight windows", timeout_s,
+            )
+        with self._cond:
+            orphans, self._pending = self._pending, []
+        for _, _, fut in orphans:
+            if not fut.done():
+                fut.set_exception(
+                    RuntimeError("combiner closed before dispatch")
+                )
+
+    # ------------------------------------------------------------ internals
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._pending and not self._closed:
+                    self._cond.wait()
+                if not self._pending:  # closed and drained
+                    return
+                batch, self._pending = self._pending, []
+            try:
+                self._execute(batch)
+            except BaseException as e:  # noqa: BLE001 — never die silently
+                log.exception("combiner window failed")
+                for _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(
+                            RuntimeError(f"combiner window failed: {e!r}")
+                        )
+
+    def _execute(self, batch: List[tuple]) -> None:
+        # group by explicit timestamp: tests pin now_ms; production passes
+        # None, which the backend resolves to processing time — exactly the
+        # reference's behavior of stamping at processing, not arrival
+        groups: dict = {}
+        for entry in batch:
+            groups.setdefault(entry[1], []).append(entry)
+        for now_ms, entries in groups.items():
+            self.stats["windows"] += 1
+            if len(entries) > 1:
+                self.stats["merged_windows"] += 1
+            flat: List[RateLimitReq] = []
+            spans = []
+            for reqs, _, fut in entries:
+                spans.append((len(flat), len(reqs), fut))
+                flat.extend(reqs)
+            try:
+                resps = self.backend.get_rate_limits(flat, now_ms=now_ms)
+                if resps is None or len(resps) != len(flat):
+                    raise RuntimeError(
+                        f"backend returned "
+                        f"{'no' if resps is None else len(resps)} responses "
+                        f"for {len(flat)} requests"
+                    )
+                for start, n, fut in spans:
+                    fut.set_result(resps[start:start + n])
+            except Exception as e:  # noqa: BLE001 — propagate to every caller
+                for _, _, fut in spans:
+                    if not fut.done():
+                        fut.set_exception(e)

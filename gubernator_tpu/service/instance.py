@@ -25,6 +25,7 @@ from gubernator_tpu.cluster.pickers import (
     RegionPicker,
     ReplicatedConsistentHashPicker,
 )
+from gubernator_tpu.service.combiner import BackendCombiner
 from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
 from gubernator_tpu.service.global_manager import GlobalManager
 from gubernator_tpu.service.multiregion import MultiRegionManager
@@ -85,7 +86,9 @@ class Instance:
 
             conf.backend = Engine()
         self.backend = conf.backend
-        self._backend_lock = threading.Lock()
+        # concurrent callers merge into single kernel launches; while one
+        # launch is in flight the next window pools up (service/combiner.py)
+        self.combiner = BackendCombiner(self.backend)
 
         self.local_picker = conf.local_picker or ReplicatedConsistentHashPicker()
         self.region_picker = conf.region_picker or RegionPicker()
@@ -256,6 +259,7 @@ class Instance:
                     p.shutdown(timeout_s=0.5)
                 except Exception:  # noqa: BLE001
                     pass
+        self.combiner.close()
         if hasattr(self.backend, "close"):
             self.backend.close()
 
@@ -293,8 +297,7 @@ class Instance:
                 req = RateLimitReq(**{**req.__dict__})
                 req.behavior = set_behavior(req.behavior, Behavior.GLOBAL, False)
             stripped.append(req)
-        with self._backend_lock:
-            return self.backend.get_rate_limits(stripped, now_ms=now_ms)
+        return self.combiner.submit(stripped, now_ms=now_ms)
 
     # ------------------------------------------------------------ internals
 

@@ -76,7 +76,7 @@ class PeersV1Servicer:
 def make_server(
     instance: Instance,
     address: str,
-    max_workers: int = 32,
+    max_workers: int = 128,
     stats_handler: Optional[object] = None,
 ):
     """Build (not start) a gRPC server serving both services on `address`.

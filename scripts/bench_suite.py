@@ -1,0 +1,301 @@
+"""Serving-stack benchmark suite — ports of the reference's shipped
+benchmarks plus BASELINE.json's scenario configs, against a real in-process
+loopback cluster (the reference's own rig: benchmark_test.go:28-135 over
+cluster/cluster.go).
+
+Scenarios:
+  get_rate_limit             BenchmarkServer_GetRateLimit (single-req RPC)
+  get_peer_no_batching       BenchmarkServer_GetPeerRateLimitNoBatching
+  health_check               BenchmarkServer_Ping
+  thundering_herd            BenchmarkServer_ThunderingHeard (100-wide fanout)
+  leaky_bucket               LEAKY_BUCKET drain (BASELINE.json configs[1])
+  global_mode                Behavior=GLOBAL aggregation (configs[2])
+  gregorian                  DURATION_IS_GREGORIAN resets (configs[3])
+  multi_region               2-DC cluster, MULTI_REGION hits (configs[4])
+
+Each scenario prints one JSON line {"bench", "ops_per_s", "p50_ms",
+"p99_ms", "n", ...}. The serving tier is host code: by default the suite
+pins JAX to CPU so the numbers measure the gRPC/batching/host path the way
+the reference's Go benchmarks do (the device-kernel headline is bench.py's
+job; on a tunneled TPU every dispatch pays ~270 ms RTT, which would measure
+the tunnel, not the framework). Pass --platform=default to keep the ambient
+device.
+
+Usage: python scripts/bench_suite.py [--seconds 2.0] [--nodes 3]
+       [--only name[,name...]] [--platform cpu|default]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import string
+import sys
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(sorted_ms, q: float) -> float:
+    if not sorted_ms:
+        return 0.0
+    idx = min(len(sorted_ms) - 1, int(q * (len(sorted_ms) - 1) + 0.5))
+    return sorted_ms[idx]
+
+
+def _rand_key(rng, n=10) -> str:
+    # reference: client.go RandomString(10)
+    return "".join(rng.choice(string.ascii_lowercase) for _ in range(n))
+
+
+def run_serial(fn, seconds: float, warmup: int = 50):
+    """b.N-style loop: run fn for `seconds` after warmup; returns stats."""
+    for _ in range(warmup):
+        fn()
+    lat = []
+    t_end = time.perf_counter() + seconds
+    t0 = time.perf_counter()
+    while time.perf_counter() < t_end:
+        s = time.perf_counter()
+        fn()
+        lat.append((time.perf_counter() - s) * 1e3)
+    elapsed = time.perf_counter() - t0
+    lat.sort()
+    return {
+        "ops_per_s": round(len(lat) / elapsed, 1),
+        "p50_ms": round(_percentile(lat, 0.50), 3),
+        "p99_ms": round(_percentile(lat, 0.99), 3),
+        "n": len(lat),
+    }
+
+
+def run_fanout(fn, seconds: float, width: int = 100, warmup: int = 50):
+    """ThunderingHeard rig: `width` concurrent callers
+    (reference: benchmark_test.go:108-135 syncutil.NewFanOut(100))."""
+    for _ in range(warmup):
+        fn()
+    lat = []
+    pool = ThreadPoolExecutor(max_workers=width)
+    t_end = time.perf_counter() + seconds
+
+    def timed():
+        s = time.perf_counter()
+        fn()
+        return (time.perf_counter() - s) * 1e3
+
+    t0 = time.perf_counter()
+    futures = [pool.submit(timed) for _ in range(width)]
+    while True:
+        done, futures = futures, []
+        for f in done:
+            lat.append(f.result())
+            if time.perf_counter() < t_end:
+                futures.append(pool.submit(timed))
+        if not futures:
+            break
+    elapsed = time.perf_counter() - t0
+    pool.shutdown()
+    lat.sort()
+    return {
+        "ops_per_s": round(len(lat) / elapsed, 1),
+        "p50_ms": round(_percentile(lat, 0.50), 3),
+        "p99_ms": round(_percentile(lat, 0.99), 3),
+        "n": len(lat),
+        "fanout": width,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=2.0)
+    ap.add_argument("--nodes", type=int, default=3)
+    ap.add_argument("--only", type=str, default="")
+    ap.add_argument("--platform", choices=["cpu", "default"], default="cpu")
+    args = ap.parse_args(argv)
+
+    if args.platform == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.cluster.harness import LocalCluster
+    from gubernator_tpu.service.peer_client import PeerClient
+    from gubernator_tpu.types import Algorithm, Behavior, PeerInfo, RateLimitReq
+    from gubernator_tpu.utils.gregorian import GREGORIAN_MINUTES
+
+    rng = random.Random(42)
+
+    def req(name, key, **kw):
+        defaults = dict(hits=1, limit=10, duration=5_000)
+        defaults.update(kw)
+        return RateLimitReq(name=name, unique_key=key, **defaults)
+
+    print(
+        f"# bench_suite: {args.nodes}-node loopback cluster, "
+        f"{args.seconds:.1f}s/scenario, platform={args.platform}",
+        file=sys.stderr,
+    )
+    cluster = LocalCluster().start(
+        args.nodes, datacenters=["dc-a"] * (args.nodes - 1) + ["dc-b"]
+    )
+    try:
+        client = V1Client(rng.choice(cluster.instances).address)
+
+        def bench_get_rate_limit():
+            # reference: benchmark_test.go:53-77
+            return run_serial(
+                lambda: client.get_rate_limits(
+                    [req("get_rate_limit_benchmark", _rand_key(rng))]
+                ),
+                args.seconds,
+            )
+
+        def bench_get_peer_no_batching():
+            # reference: benchmark_test.go:28-51 — direct PeerClient unary
+            ci = rng.choice(cluster.instances)
+            peer = PeerClient(
+                cluster.instances[0].instance.conf.behaviors,
+                PeerInfo(address=ci.address, datacenter=ci.datacenter),
+            )
+            try:
+                return run_serial(
+                    lambda: peer.get_peer_rate_limit(
+                        req(
+                            "get_peer_rate_limits_benchmark",
+                            _rand_key(rng),
+                            behavior=Behavior.NO_BATCHING,
+                            duration=5,
+                        )
+                    ),
+                    args.seconds,
+                )
+            finally:
+                peer.shutdown()
+
+        def bench_get_rate_limit_batch():
+            # the design point: clients batch (reference README.md:113-115 —
+            # production traffic rides 500µs windows up to 1000 wide).
+            # ops_per_s here counts CALLS; requests/s = ops_per_s * 100.
+            def call():
+                client.get_rate_limits(
+                    [
+                        req("get_rate_limit_benchmark", _rand_key(rng))
+                        for _ in range(100)
+                    ],
+                    timeout=30,
+                )
+
+            stats = run_serial(call, args.seconds, warmup=10)
+            stats["requests_per_s"] = round(stats["ops_per_s"] * 100, 1)
+            return stats
+
+        def bench_health_check():
+            # reference: benchmark_test.go:80-97
+            return run_serial(lambda: client.health_check(), args.seconds)
+
+        def bench_thundering_herd():
+            # reference: benchmark_test.go:108-135
+            return run_fanout(
+                lambda: client.get_rate_limits(
+                    [req("get_rate_limit_benchmark", _rand_key(rng))]
+                ),
+                args.seconds,
+            )
+
+        def bench_leaky_bucket():
+            return run_serial(
+                lambda: client.get_rate_limits(
+                    [
+                        req(
+                            "leaky_benchmark",
+                            _rand_key(rng),
+                            algorithm=Algorithm.LEAKY_BUCKET,
+                            limit=100,
+                            duration=60_000,
+                        )
+                    ]
+                ),
+                args.seconds,
+            )
+
+        def bench_global_mode():
+            return run_serial(
+                lambda: client.get_rate_limits(
+                    [
+                        req(
+                            "global_benchmark",
+                            _rand_key(rng),
+                            behavior=Behavior.GLOBAL,
+                            limit=1_000_000,
+                        )
+                    ]
+                ),
+                args.seconds,
+            )
+
+        def bench_gregorian():
+            return run_serial(
+                lambda: client.get_rate_limits(
+                    [
+                        req(
+                            "gregorian_benchmark",
+                            _rand_key(rng),
+                            behavior=Behavior.DURATION_IS_GREGORIAN,
+                            duration=GREGORIAN_MINUTES,
+                            limit=1_000_000,
+                        )
+                    ]
+                ),
+                args.seconds,
+            )
+
+        def bench_multi_region():
+            return run_serial(
+                lambda: client.get_rate_limits(
+                    [
+                        req(
+                            "multi_region_benchmark",
+                            _rand_key(rng),
+                            behavior=Behavior.MULTI_REGION,
+                            limit=1_000_000,
+                        )
+                    ]
+                ),
+                args.seconds,
+            )
+
+        scenarios = {
+            "get_rate_limit": bench_get_rate_limit,
+            "get_rate_limit_batch100": bench_get_rate_limit_batch,
+            "get_peer_no_batching": bench_get_peer_no_batching,
+            "health_check": bench_health_check,
+            "thundering_herd": bench_thundering_herd,
+            "leaky_bucket": bench_leaky_bucket,
+            "global_mode": bench_global_mode,
+            "gregorian": bench_gregorian,
+            "multi_region": bench_multi_region,
+        }
+        selected = (
+            [s.strip() for s in args.only.split(",") if s.strip()]
+            if args.only
+            else list(scenarios)
+        )
+        unknown = [s for s in selected if s not in scenarios]
+        if unknown:
+            print(f"unknown scenarios: {unknown}", file=sys.stderr)
+            return 2
+
+        for name in selected:
+            stats = scenarios[name]()
+            print(json.dumps({"bench": name, **stats}), flush=True)
+    finally:
+        cluster.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
