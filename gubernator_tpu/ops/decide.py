@@ -285,6 +285,37 @@ def decide(state: TableState, reqs: ReqBatch, now_ms: jax.Array) -> Tuple[TableS
     return new_state, resp
 
 
+def decide_packed(
+    state: TableState, packed: jax.Array, now_ms: jax.Array
+) -> Tuple[TableState, jax.Array]:
+    """decide() over a single staging buffer.
+
+    `packed` is i64[9, B] — one host→device transfer per window instead of
+    nine column uploads; the response comes back as i64[4, B], one
+    device→host readback instead of four. Off-chip round trips are the
+    serving path's real cost (HBM-adjacent compute is ~µs; each transfer
+    pays dispatch + interconnect latency), so the hot path stages through
+    exactly one buffer each way. The host-side packer is
+    models/engine.py Engine._apply_round — keep its row order in sync.
+    """
+    reqs = ReqBatch(
+        slot=packed[0].astype(I32),
+        hits=packed[1],
+        limit=packed[2],
+        duration=packed[3],
+        algorithm=packed[4].astype(I32),
+        behavior=packed[5].astype(I32),
+        greg_expire=packed[6],
+        greg_interval=packed[7],
+        fresh=packed[8] != 0,
+    )
+    new_state, resp = decide(state, reqs, now_ms)
+    out = jnp.stack(
+        [resp.status.astype(I64), resp.limit, resp.remaining, resp.reset_time]
+    )
+    return new_state, out
+
+
 def make_decide_jit(donate: bool = None):
     """Compiled decide(). Donating the table keeps the 7 HBM columns in place
     across windows instead of allocating a fresh ~56B/key copy per call —
