@@ -37,6 +37,7 @@ from gubernator_tpu.ops.decide import (
     TableState,
     decide_packed,
     make_table,
+    pack_window,
 )
 from gubernator_tpu.store import BucketSnapshot, Loader, Store
 from gubernator_tpu.types import RateLimitReq, RateLimitResp
@@ -247,19 +248,7 @@ class Engine:
         w = _bucket_width(n, self.min_width, self.max_width)
         # one staging buffer up, one back: off-chip round trips are the
         # serving path's dominant cost, so the window crosses exactly twice
-        # (decide_packed row order)
-        packed = np.zeros((9, w), np.int64)
-        packed[0, :n] = slots
-        packed[0, n:] = -1
-        packed[1:8, :n] = np.array(
-            [
-                (r.hits, r.limit, r.duration, int(r.algorithm),
-                 int(r.behavior), ge, gi)
-                for _i, r, ge, gi in round_work
-            ],
-            np.int64,
-        ).T
-        packed[8, :n] = fresh
+        packed = pack_window(round_work, slots, fresh, w)
         self.state, out = self._decide_packed(self.state, packed, now_ms)
 
         out = np.asarray(out)

@@ -295,8 +295,8 @@ def decide_packed(
     device→host readback instead of four. Off-chip round trips are the
     serving path's real cost (HBM-adjacent compute is ~µs; each transfer
     pays dispatch + interconnect latency), so the hot path stages through
-    exactly one buffer each way. The host-side packer is
-    models/engine.py Engine._apply_round — keep its row order in sync.
+    exactly one buffer each way. The host-side packer is pack_window below
+    — the row-order contract lives only in this file.
     """
     reqs = ReqBatch(
         slot=packed[0].astype(I32),
@@ -314,6 +314,33 @@ def decide_packed(
         [resp.status.astype(I64), resp.limit, resp.remaining, resp.reset_time]
     )
     return new_state, out
+
+
+def pack_window(items, slots, fresh, width: int):
+    """Host-side packer for decide_packed: i64[9, width] from one window.
+
+    `items` are prep WorkItems (resp_index, req, greg_expire, greg_interval);
+    lanes beyond len(items) are padding (slot = -1). This is the only
+    place the packed row order is written; decide_packed is the only place
+    it is read.
+    """
+    import numpy as np
+
+    n = len(items)
+    packed = np.zeros((9, width), np.int64)
+    packed[0, :n] = slots
+    packed[0, n:] = -1
+    if n:
+        packed[1:8, :n] = np.array(
+            [
+                (r.hits, r.limit, r.duration, int(r.algorithm),
+                 int(r.behavior), ge, gi)
+                for _i, r, ge, gi in items
+            ],
+            np.int64,
+        ).T
+    packed[8, :n] = fresh
+    return packed
 
 
 def make_decide_jit(donate: bool = None):

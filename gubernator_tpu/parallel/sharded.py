@@ -35,7 +35,7 @@ from jax.sharding import PartitionSpec as P
 
 from gubernator_tpu.models.keyspace import KeyDirectory
 from gubernator_tpu.models.prep import WorkItem, bucket_width, preprocess
-from gubernator_tpu.ops.decide import ReqBatch, RespBatch, TableState, decide
+from gubernator_tpu.ops.decide import TableState, decide_packed, pack_window
 from gubernator_tpu.parallel.global_sync import (
     GlobalConfig,
     GlobalMirror,
@@ -62,24 +62,30 @@ from gubernator_tpu.utils.interval import millisecond_now
 def make_decide_sharded(plan: MeshPlan, donate: bool = False):
     """Compile the batched decision kernel over the plan's mesh.
 
-    fn(state [R,S,C], reqs [R,S,W], now) -> (state, resp [R,S,W]); each chip
-    applies its own lane slice to its own table shard — no cross-chip traffic
-    at all on the normal (non-GLOBAL) path, mirroring the reference's
-    owner-local mutation.
+    fn(state [R,S,C], packed i64[R,S,9,W], now) -> (state, out i64[R,S,4,W]);
+    each chip applies its own lane slice to its own table shard — no
+    cross-chip traffic at all on the normal (non-GLOBAL) path, mirroring the
+    reference's owner-local mutation. Requests ride ONE staging buffer up
+    and one back (see ops/decide.py decide_packed; the host-side packer is
+    ShardedEngine._apply_round — keep row orders in sync).
     """
-    spec = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_state = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_io = P(REGION_AXIS, SHARD_AXIS, None, None)
 
-    def _step(state: TableState, reqs: ReqBatch, now: jax.Array):
+    def _step(state: TableState, packed: jax.Array, now: jax.Array):
         local_state = TableState(*(c.reshape(c.shape[-1:]) for c in state))
-        local_reqs = ReqBatch(*(c.reshape(c.shape[-1:]) for c in reqs))
-        new_state, resp = decide(local_state, local_reqs, now)
+        new_state, out = decide_packed(
+            local_state, packed.reshape(packed.shape[-2:]), now
+        )
         return (
             TableState(*(c.reshape(1, 1, -1) for c in new_state)),
-            RespBatch(*(c.reshape(1, 1, -1) for c in resp)),
+            out.reshape(1, 1, *out.shape),
         )
 
     mapped = jax.shard_map(
-        _step, mesh=plan.mesh, in_specs=(spec, spec, P()), out_specs=(spec, spec)
+        _step, mesh=plan.mesh,
+        in_specs=(spec_state, spec_io, P()),
+        out_specs=(spec_state, spec_io),
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
@@ -261,17 +267,10 @@ class ShardedEngine:
         width = max(len(l) for l in lanes)
         w = bucket_width(width, self.min_width, self.max_width)
 
-        cols = {
-            "slot": np.full((R, S, w), -1, np.int32),
-            "hits": np.zeros((R, S, w), np.int64),
-            "limit": np.zeros((R, S, w), np.int64),
-            "duration": np.zeros((R, S, w), np.int64),
-            "algorithm": np.zeros((R, S, w), np.int32),
-            "behavior": np.zeros((R, S, w), np.int32),
-            "greg_expire": np.zeros((R, S, w), np.int64),
-            "greg_interval": np.zeros((R, S, w), np.int64),
-            "fresh": np.zeros((R, S, w), np.bool_),
-        }
+        # one i64[R,S,9,w] staging buffer up, one i64[R,S,4,w] back
+        # (row order must match make_decide_sharded's unpack)
+        packed = np.zeros((R, S, 9, w), np.int64)
+        packed[:, :, 0, :] = -1  # vacant lanes
         placed: List[Tuple[int, int, int, int]] = []  # (resp idx, r, s, lane)
         for owner, items in enumerate(lanes):
             if not items:
@@ -279,35 +278,22 @@ class ShardedEngine:
             r_, s_ = self.plan.owner_coords(owner)
             keys = [it[1].hash_key() for it in items]
             slots, fresh = self.directories[owner].lookup(keys)
-            for lane, (item, slot, fr) in enumerate(zip(items, slots, fresh)):
-                i, req, ge, gi = item
-                cols["slot"][r_, s_, lane] = slot
-                cols["hits"][r_, s_, lane] = req.hits
-                cols["limit"][r_, s_, lane] = req.limit
-                cols["duration"][r_, s_, lane] = req.duration
-                cols["algorithm"][r_, s_, lane] = int(req.algorithm)
-                cols["behavior"][r_, s_, lane] = int(req.behavior)
-                cols["greg_expire"][r_, s_, lane] = ge
-                cols["greg_interval"][r_, s_, lane] = gi
-                cols["fresh"][r_, s_, lane] = fr
-                placed.append((i, r_, s_, lane))
+            packed[r_, s_] = pack_window(items, slots, fresh, w)
+            for lane, item in enumerate(items):
+                placed.append((item[0], r_, s_, lane))
 
-        reqs = ReqBatch(**{k: jnp.asarray(v) for k, v in cols.items()})
-        self.state, resp = self._decide(self.state, reqs, now_ms)
+        self.state, out = self._decide(self.state, packed, now_ms)
 
-        status = np.asarray(resp.status)
-        limit = np.asarray(resp.limit)
-        remaining = np.asarray(resp.remaining)
-        reset = np.asarray(resp.reset_time)
+        out = np.asarray(out)
         for i, r_, s_, lane in placed:
-            st = int(status[r_, s_, lane])
+            st = int(out[r_, s_, 0, lane])
             if st == Status.OVER_LIMIT:
                 self.stats["over_limit"] += 1
             responses[i] = RateLimitResp(
                 status=st,
-                limit=int(limit[r_, s_, lane]),
-                remaining=int(remaining[r_, s_, lane]),
-                reset_time=int(reset[r_, s_, lane]),
+                limit=int(out[r_, s_, 1, lane]),
+                remaining=int(out[r_, s_, 2, lane]),
+                reset_time=int(out[r_, s_, 3, lane]),
             )
 
     def _build_global_config(self, now_ms: int) -> GlobalConfig:
