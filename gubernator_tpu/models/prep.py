@@ -17,11 +17,11 @@ import datetime as _dt
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from gubernator_tpu.types import (
+    ERR_EMPTY_NAME,
+    ERR_EMPTY_UNIQUE_KEY,
     Behavior,
     RateLimitReq,
     RateLimitResp,
-    has_behavior,
-    validate_request,
 )
 from gubernator_tpu.utils.gregorian import (
     GregorianError,
@@ -31,6 +31,8 @@ from gubernator_tpu.utils.gregorian import (
 
 # (original batch index, request, greg_expire_ms, greg_interval_ms)
 WorkItem = Tuple[int, RateLimitReq, int, int]
+
+_GREG = int(Behavior.DURATION_IS_GREGORIAN)
 
 
 def bucket_width(n: int, lo: int, hi: int) -> int:
@@ -52,33 +54,36 @@ def preprocess(
     WorkItems whose keys are distinct within the round.
     """
     responses: List[Optional[RateLimitResp]] = [None] * len(requests)
-    work: List[WorkItem] = []
+    rounds: List[List[WorkItem]] = []
+    occurrence: Dict[str, int] = {}
+    occ_get = occurrence.get
     n_errors = 0
+    local_now = None  # lazily computed once per batch
     for i, r in enumerate(requests):
-        err = validate_request(r)
-        if err:
-            responses[i] = RateLimitResp(error=err)
+        # validate_request semantics, inlined for the per-window hot loop
+        if not r.unique_key:
+            responses[i] = RateLimitResp(error=ERR_EMPTY_UNIQUE_KEY)
+            n_errors += 1
+            continue
+        if not r.name:
+            responses[i] = RateLimitResp(error=ERR_EMPTY_NAME)
             n_errors += 1
             continue
         ge = gi = 0
-        if has_behavior(r.behavior, Behavior.DURATION_IS_GREGORIAN):
+        if int(r.behavior) & _GREG:
             try:
-                local_now = _dt.datetime.fromtimestamp(now_ms / 1000.0)
+                if local_now is None:
+                    local_now = _dt.datetime.fromtimestamp(now_ms / 1000.0)
                 ge = gregorian_expiration(local_now, r.duration)
                 gi = gregorian_duration(local_now, r.duration)
             except GregorianError as e:
                 responses[i] = RateLimitResp(error=str(e))
                 n_errors += 1
                 continue
-        work.append((i, r, ge, gi))
-
-    rounds: List[List[WorkItem]] = []
-    occurrence: Dict[str, int] = {}
-    for item in work:
-        k = item[1].hash_key()
-        j = occurrence.get(k, 0)
+        k = r.name + "_" + r.unique_key  # hash_key(), inlined
+        j = occ_get(k, 0)
         occurrence[k] = j + 1
         if len(rounds) <= j:
             rounds.append([])
-        rounds[j].append(item)
+        rounds[j].append((i, r, ge, gi))
     return responses, rounds, n_errors

@@ -43,8 +43,12 @@ class Status(enum.IntEnum):
 
 
 def has_behavior(behavior: int, flag: Behavior) -> bool:
-    """True if `flag` is set (reference: gubernator.go:456-461)."""
-    return bool(behavior & flag)
+    """True if `flag` is set (reference: gubernator.go:456-461).
+
+    int() both sides first: `int & IntFlag` dispatches through enum's
+    reflected __rand__, which costs ~µs per call — real money at 4096
+    requests per window."""
+    return (int(behavior) & int(flag)) != 0
 
 
 def set_behavior(behavior: int, flag: Behavior, on: bool) -> int:
@@ -118,16 +122,21 @@ class UpdatePeerGlobal:
 MAX_BATCH_SIZE = 1000
 
 
+ERR_EMPTY_UNIQUE_KEY = "field 'unique_key' cannot be empty"
+ERR_EMPTY_NAME = "field 'namespace' cannot be empty"
+
+
 def validate_request(req: RateLimitReq) -> str:
     """Return an error string for an invalid request, else "".
 
     (reference: gubernator.go:137-147 — empty unique_key / name are
-    per-request errors, not call failures.)
+    per-request errors, not call failures. models/prep.py inlines these
+    checks in its hot loop — shared constants keep the strings in sync.)
     """
     if not req.unique_key:
-        return "field 'unique_key' cannot be empty"
+        return ERR_EMPTY_UNIQUE_KEY
     if not req.name:
-        return "field 'namespace' cannot be empty"
+        return ERR_EMPTY_NAME
     return ""
 
 

@@ -180,3 +180,32 @@ class TestLogLevelJSON:
             LogLevelJSON.unmarshal_json('"not-a-level"')
         with pytest.raises(ValueError):
             LogLevelJSON.unmarshal_json(json.dumps([1]))
+
+
+def test_prep_inlines_match_types_helpers():
+    """models/prep.py inlines hash_key and the validation strings in its hot
+    loop; this pins the inlined forms to the canonical helpers."""
+    from gubernator_tpu.models.prep import preprocess
+    from gubernator_tpu.types import (
+        ERR_EMPTY_NAME,
+        ERR_EMPTY_UNIQUE_KEY,
+        RateLimitReq,
+        hash_key,
+        validate_request,
+    )
+
+    # key grouping: duplicates of (name, unique_key) must share hash_key()
+    reqs = [
+        RateLimitReq(name="n", unique_key="k", hits=1, limit=5, duration=1000),
+        RateLimitReq(name="n", unique_key="k", hits=1, limit=5, duration=1000),
+        RateLimitReq(name="n_k", unique_key="", hits=1, limit=5, duration=1000),
+    ]
+    responses, rounds, n_errors = preprocess(reqs, 1_700_000_000_000)
+    assert len(rounds) == 2  # the two true duplicates split into rounds
+    assert rounds[0][0][1].hash_key() == hash_key("n", "k")
+    # error strings match validate_request verbatim
+    assert responses[2].error == ERR_EMPTY_UNIQUE_KEY
+    assert validate_request(reqs[2]) == ERR_EMPTY_UNIQUE_KEY
+    assert validate_request(
+        RateLimitReq(name="", unique_key="x", hits=1, limit=5, duration=1000)
+    ) == ERR_EMPTY_NAME
