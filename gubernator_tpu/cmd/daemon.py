@@ -45,6 +45,11 @@ def build_backend(conf: DaemonConfig):
     if backend == "sharded":
         from gubernator_tpu.parallel.sharded import ShardedEngine
 
+        if conf.snapshot_path:
+            log.warning(
+                "GUBER_SNAPSHOT_PATH is only supported by the single-table "
+                "engine backend; ignoring"
+            )
         cap = max(conf.cache_size // n_dev, 1024)
         eng = ShardedEngine(
             n_shards=n_dev,
@@ -56,10 +61,16 @@ def build_backend(conf: DaemonConfig):
         return eng
     from gubernator_tpu.models.engine import Engine
 
+    loader = None
+    if conf.snapshot_path:
+        from gubernator_tpu.store import FileLoader
+
+        loader = FileLoader(conf.snapshot_path)
     eng = Engine(
         capacity=conf.cache_size,
         min_width=conf.min_batch_width,
         max_width=conf.max_batch_width,
+        loader=loader,
     )
     log.info("backend: single-table engine, %d slots", conf.cache_size)
     return eng

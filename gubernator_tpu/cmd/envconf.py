@@ -102,6 +102,9 @@ class DaemonConfig:
     backend: str = "auto"  # auto | engine | sharded
     min_batch_width: int = 64
     max_batch_width: int = 4096
+    # durable bucket snapshot: load at boot, save at shutdown (FileLoader;
+    # the reference leaves persistence to the user, README.md:159-175)
+    snapshot_path: str = ""
     debug: bool = False
 
 
@@ -152,6 +155,7 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         backend=_env_str("GUBER_BACKEND", "auto"),
         min_batch_width=_env_int("GUBER_MIN_BATCH_WIDTH", 64),
         max_batch_width=_env_int("GUBER_MAX_BATCH_WIDTH", 4096),
+        snapshot_path=_env_str("GUBER_SNAPSHOT_PATH"),
         debug=opts.debug or bool(os.environ.get("GUBER_DEBUG")),
     )
     return conf

@@ -23,9 +23,14 @@ from __future__ import annotations
 
 import abc
 import dataclasses
+import json
+import logging
+import os
 from typing import Iterable, List, Optional
 
 from gubernator_tpu.types import RateLimitReq
+
+log = logging.getLogger("gubernator_tpu.store")
 
 
 @dataclasses.dataclass
@@ -105,3 +110,54 @@ class MockLoader(Loader):
     def save(self, items: Iterable[BucketSnapshot]) -> None:
         self.called["save"] += 1
         self.contents = list(items)
+
+
+class FileLoader(Loader):
+    """Durable Loader over a JSON-lines snapshot file.
+
+    Goes one step past the reference, which ships only mocks and leaves
+    persistence entirely to the user (store.go:60-130, README.md:159-175):
+    a daemon pointed at GUBER_SNAPSHOT_PATH survives restarts with its
+    buckets intact. Writes are atomic (tmp + rename) so a crash mid-save
+    leaves the previous snapshot in place.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def load(self) -> Iterable[BucketSnapshot]:
+        if not os.path.exists(self.path):
+            return []
+        out: List[BucketSnapshot] = []
+        with open(self.path, "r", encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                # A truncated tail or schema-drifted row must not keep the
+                # daemon from booting; drop the row and keep serving. Fields
+                # are coerced because dataclasses don't validate types and a
+                # wrong-typed value would otherwise blow up later inside
+                # Engine.load_snapshot's jnp.asarray.
+                try:
+                    d = json.loads(line)
+                    out.append(BucketSnapshot(
+                        key=str(d["key"]), algo=int(d["algo"]),
+                        limit=int(d["limit"]), remaining=int(d["remaining"]),
+                        duration=int(d["duration"]), stamp=int(d["stamp"]),
+                        expire_at=int(d["expire_at"]),
+                        status=int(d.get("status", 0))))
+                except (ValueError, TypeError, KeyError) as e:
+                    log.warning("skipping bad snapshot row %s:%d: %r",
+                                self.path, lineno, e)
+        return out
+
+    def save(self, items: Iterable[BucketSnapshot]) -> None:
+        tmp = self.path + ".tmp"
+        os.makedirs(os.path.dirname(os.path.abspath(self.path)), exist_ok=True)
+        with open(tmp, "w", encoding="utf-8") as f:
+            for it in items:
+                f.write(json.dumps(dataclasses.asdict(it)) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)

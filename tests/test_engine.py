@@ -236,3 +236,68 @@ class TestEngineMatchesOracleWithDuplicates:
                     behavior=r.behavior, now=now)
                 assert (g.status, g.limit, g.remaining, g.reset_time) == (
                     want.status, want.limit, want.remaining, want.reset_time)
+
+
+class TestFileLoader:
+    """FileLoader: durable JSON-lines snapshots (past-the-reference; the
+    reference ships only mocks, store.go:60-130)."""
+
+    def test_roundtrip_through_engine_restart(self, tmp_path):
+        from gubernator_tpu.store import FileLoader
+
+        from gubernator_tpu.utils.interval import millisecond_now
+
+        path = str(tmp_path / "snap" / "buckets.jsonl")
+        # snapshot() filters rows expired against the wall clock, so the
+        # pinned timestamps must be near real now
+        now = millisecond_now()
+
+        eng = Engine(capacity=64, min_width=8, max_width=32,
+                     loader=FileLoader(path))
+        rs = eng.get_rate_limits(
+            [RateLimitReq(name="f", unique_key=f"k{i}", hits=2, limit=10,
+                          duration=3_600_000) for i in range(5)],
+            now_ms=now,
+        )
+        assert all(r.remaining == 8 for r in rs)
+        eng.close()  # saves the snapshot
+
+        # a fresh engine resumes the drained state
+        eng2 = Engine(capacity=64, min_width=8, max_width=32,
+                      loader=FileLoader(path))
+        rs = eng2.get_rate_limits(
+            [RateLimitReq(name="f", unique_key=f"k{i}", hits=1, limit=10,
+                          duration=3_600_000) for i in range(5)],
+            now_ms=now + 1000,
+        )
+        assert all(r.remaining == 7 for r in rs), [r.remaining for r in rs]
+
+    def test_missing_file_loads_empty(self, tmp_path):
+        from gubernator_tpu.store import FileLoader
+
+        assert list(FileLoader(str(tmp_path / "nope.jsonl")).load()) == []
+
+    def test_corrupt_rows_are_skipped(self, tmp_path):
+        from gubernator_tpu.store import BucketSnapshot, FileLoader
+
+        path = str(tmp_path / "b.jsonl")
+        fl = FileLoader(path)
+        fl.save([BucketSnapshot(key="a_b", algo=0, limit=5, remaining=3,
+                                duration=1000, stamp=1, expire_at=2)])
+        with open(path, "a", encoding="utf-8") as f:
+            f.write('{"not": "a snapshot"}\n')   # schema drift
+            f.write('{"key": "trunc')            # truncated tail
+        rows = list(fl.load())
+        assert [r.key for r in rows] == ["a_b"]
+
+    def test_atomic_save_leaves_no_tmp(self, tmp_path):
+        from gubernator_tpu.store import BucketSnapshot, FileLoader
+
+        path = str(tmp_path / "b.jsonl")
+        fl = FileLoader(path)
+        fl.save([BucketSnapshot(key="a_b", algo=0, limit=5, remaining=3,
+                                duration=1000, stamp=1, expire_at=2)])
+        import os
+        assert not os.path.exists(path + ".tmp")
+        [snap] = fl.load()
+        assert snap.key == "a_b" and snap.remaining == 3
