@@ -30,12 +30,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from gubernator_tpu.models.keyspace import KeyDirectory
-from gubernator_tpu.models.prep import bucket_width as _bucket_width, preprocess
+from gubernator_tpu.models.prep import (
+    bucket_pow2 as _bucket_pow2,
+    bucket_width as _bucket_width,
+    preprocess,
+)
 from gubernator_tpu.ops.decide import (
     I32,
     I64,
     TableState,
     decide_packed,
+    decide_scan_packed,
     make_table,
     pack_window,
 )
@@ -72,6 +77,11 @@ def _gather_rows(state: TableState, slot):
 @_functools.lru_cache(maxsize=None)
 def _jit_decide_packed(donate: bool):
     return jax.jit(decide_packed, donate_argnums=(0,) if donate else ())
+
+
+@_functools.lru_cache(maxsize=None)
+def _jit_decide_scan(donate: bool):
+    return jax.jit(decide_scan_packed, donate_argnums=(0,) if donate else ())
 
 
 @_functools.lru_cache(maxsize=None)
@@ -127,6 +137,7 @@ class Engine:
 
             donate = donation_supported()
         self._decide_packed = _jit_decide_packed(donate)
+        self._decide_scan = _jit_decide_scan(donate)
         self._inject = _jit_inject(donate)
         self._gather = _jit_gather()
         if loader is not None:
@@ -155,6 +166,14 @@ class Engine:
                 packed = np.zeros((9, width), np.int64)
                 packed[0, :] = -1  # all padding lanes
                 self.state, resp = self._decide_packed(self.state, packed, 0)
+            # every scan-path shape: depths 2..=_MAX_SCAN at min_width (the
+            # fast path dispatches nothing else — see _split_scannable)
+            k = 2
+            while k <= self._MAX_SCAN:
+                stacked = np.zeros((k, 9, self.min_width), np.int64)
+                stacked[:, 0, :] = -1
+                self.state, resp = self._decide_scan(self.state, stacked, 0)
+                k *= 2
             if resp is not None:
                 jax.block_until_ready(resp)
 
@@ -170,11 +189,16 @@ class Engine:
             self.stats.requests += len(requests)
             self.stats.batches += 1
             self.stats.errors += n_errors
+            windows = []
             for round_work in rounds:
                 self.stats.rounds += 1
                 for start in range(0, len(round_work), self.max_width):
-                    self._apply_round(
-                        round_work[start:start + self.max_width], now_ms, responses)
+                    windows.append(round_work[start:start + self.max_width])
+            head, tail = self._split_scannable(windows)
+            for wk in head:
+                self._apply_round(wk, now_ms, responses)
+            if tail:
+                self._apply_windows_scanned(tail, now_ms, responses)
         return responses  # type: ignore[return-value]
 
     # ------------------------------------------------------- persistence SPI
@@ -236,6 +260,78 @@ class Engine:
             self.loader.save(self.snapshot())
 
     # ------------------------------------------------------------- internals
+
+    # Multi-window groups ride one lax.scan dispatch; cap the group so the
+    # staging buffer and the set of compiled scan depths stay small. Scan
+    # groups are always min_width wide, so warmup() can pre-compile every
+    # (depth, width) shape this path can ever dispatch.
+    _MAX_SCAN = 32
+
+    def _split_scannable(self, windows):
+        """Split the window list into a per-round head and a scannable tail.
+
+        The tail is the maximal run of trailing windows no wider than
+        min_width — round sizes only shrink (round k+1's keys are a subset of
+        round k's), so the small windows the scan path exists for (duplicate-
+        key rounds; a hot-key herd is d one-item rounds) always sit at the
+        end. Wide windows keep the per-round path: they are one amortized
+        dispatch already, and admitting them would make the scan width
+        unbounded (unwarmable shapes, oversized padding).
+
+        The Store hooks are per-round host calls (read-through before, write-
+        through after each round, reference: algorithms.go:26-33,64-68), so a
+        store disables the fast path entirely. The capacity guard keeps a
+        group's up-front directory lookups from recycling a slot an earlier
+        window in the group already claimed.
+        """
+        if self.store is not None or len(windows) <= 1:
+            return windows, []
+        split = len(windows)
+        while split > 0 and len(windows[split - 1]) <= self.min_width:
+            split -= 1
+        tail = windows[split:]
+        if len(tail) < 2 or sum(len(w) for w in tail) * 4 > self.capacity:
+            return windows, []
+        return windows[:split], tail
+
+    def _apply_windows_scanned(self, windows, now_ms, responses) -> None:
+        """Retire every scannable window in ⌈N/32⌉ dispatches.
+
+        The worst case this exists for is a hot-key thundering herd: d
+        duplicates of one key = d rounds, which the per-round path pays d
+        full dispatches for (~50-80 µs launch overhead each) while the
+        kernel itself is <1 µs."""
+        width = self.min_width  # _split_scannable guarantees every window fits
+        for g0 in range(0, len(windows), self._MAX_SCAN):
+            group = windows[g0:g0 + self._MAX_SCAN]
+            if len(group) == 1:
+                # a trailing singleton (e.g. 33 windows -> groups [32, 1])
+                # rides the already-warmed single-window program; warmup
+                # compiles scan depths {2..32} only
+                self._apply_round(group[0], now_ms, responses)
+                continue
+            k = _bucket_pow2(len(group))
+            stacked = np.zeros((k, 9, width), np.int64)
+            stacked[:, 0, :] = -1  # pad windows are all padding lanes
+            for gi, wk in enumerate(group):
+                keys = [item[1].hash_key() for item in wk]
+                slots, fresh = self.directory.lookup(keys)
+                pack_window(wk, slots, fresh, width, out=stacked[gi])
+            self.state, out = self._decide_scan(self.state, stacked, now_ms)
+            out = np.asarray(out)
+            for gi, wk in enumerate(group):
+                n = len(wk)
+                status, limit, remaining, reset = (
+                    out[gi, 0, :n], out[gi, 1, :n],
+                    out[gi, 2, :n], out[gi, 3, :n],
+                )
+                for j, (i, _r, _ge, _gi) in enumerate(wk):
+                    st = int(status[j])
+                    if st == 1:
+                        self.stats.over_limit += 1
+                    responses[i] = RateLimitResp(
+                        status=st, limit=int(limit[j]),
+                        remaining=int(remaining[j]), reset_time=int(reset[j]))
 
     def _apply_round(self, round_work, now_ms, responses) -> None:
         n = len(round_work)

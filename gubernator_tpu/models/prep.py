@@ -44,6 +44,14 @@ def bucket_width(n: int, lo: int, hi: int) -> int:
     return min(w, hi)
 
 
+def bucket_pow2(n: int) -> int:
+    """Next power of two ≥ n — bounds the number of compiled scan depths."""
+    k = 1
+    while k < n:
+        k *= 2
+    return k
+
+
 def preprocess(
     requests: Sequence[RateLimitReq], now_ms: int
 ) -> Tuple[List[Optional[RateLimitResp]], List[List[WorkItem]], int]:

@@ -34,8 +34,18 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from gubernator_tpu.models.keyspace import KeyDirectory
-from gubernator_tpu.models.prep import WorkItem, bucket_width, preprocess
-from gubernator_tpu.ops.decide import TableState, decide_packed, pack_window
+from gubernator_tpu.models.prep import (
+    WorkItem,
+    bucket_pow2 as _bucket_pow2,
+    bucket_width,
+    preprocess,
+)
+from gubernator_tpu.ops.decide import (
+    TableState,
+    decide_packed,
+    decide_scan_packed,
+    pack_window,
+)
 from gubernator_tpu.parallel.global_sync import (
     GlobalConfig,
     GlobalMirror,
@@ -90,6 +100,38 @@ def make_decide_sharded(plan: MeshPlan, donate: bool = False):
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
+def make_decide_sharded_scan(plan: MeshPlan, donate: bool = False):
+    """Scan-coalesced variant of make_decide_sharded.
+
+    fn(state [R,S,C], packed i64[R,S,K,9,W], now) -> (state, out
+    i64[R,S,K,4,W]): each chip retires K windows over its own shard in ONE
+    dispatch — `lax.scan` runs *inside* the shard_map, so the K windows cost
+    one launch instead of K (launch overhead dominates; see
+    ops/decide.py decide_scan_packed). Window k+1 observes window k's
+    writes shard-locally, which is exactly the duplicate-key *rounds*
+    ordering the engine needs.
+    """
+    spec_state = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_io = P(REGION_AXIS, SHARD_AXIS, None, None, None)
+
+    def _step(state: TableState, packed_k: jax.Array, now: jax.Array):
+        local_state = TableState(*(c.reshape(c.shape[-1:]) for c in state))
+        new_state, out = decide_scan_packed(
+            local_state, packed_k.reshape(packed_k.shape[-3:]), now
+        )
+        return (
+            TableState(*(c.reshape(1, 1, -1) for c in new_state)),
+            out.reshape(1, 1, *out.shape),
+        )
+
+    mapped = jax.shard_map(
+        _step, mesh=plan.mesh,
+        in_specs=(spec_state, spec_io, P()),
+        out_specs=(spec_state, spec_io),
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
 class _GlobalEntry:
     """Host record for one registered global key."""
 
@@ -125,6 +167,7 @@ class ShardedEngine:
             donate = donation_supported()
         self.state = make_sharded_table(self.plan)
         self._decide = make_decide_sharded(self.plan, donate=donate)
+        self._decide_scan = make_decide_sharded_scan(self.plan, donate=donate)
         self._sync = make_global_sync(self.plan, donate=donate)
         from gubernator_tpu.native import make_key_directory
 
@@ -159,6 +202,32 @@ class ShardedEngine:
 
     # ------------------------------------------------------------------ API
 
+    def warmup(self) -> None:
+        """Compile the mesh kernel for every width bucket and scan shape up
+        front, so no serve-time request pays seconds of XLA compile (see
+        Engine.warmup; daemons call this before reporting ready)."""
+        R, S = self.plan.n_regions, self.plan.n_shards
+        widths = []
+        w = self.min_width
+        while w < self.max_width:
+            widths.append(w)
+            w *= 2
+        widths.append(self.max_width)
+        resp = None
+        with self._lock:
+            for width in widths:
+                packed = np.zeros((R, S, 9, width), np.int64)
+                packed[:, :, 0, :] = -1
+                self.state, resp = self._decide(self.state, packed, 0)
+            k = 2
+            while k <= self._MAX_SCAN:
+                packed = np.zeros((R, S, k, 9, self.min_width), np.int64)
+                packed[:, :, :, 0, :] = -1
+                self.state, resp = self._decide_scan(self.state, packed, 0)
+                k *= 2
+            if resp is not None:
+                jax.block_until_ready(resp)
+
     def owner_of(self, key: str) -> int:
         return shard_of_key(key, self.plan.n_owners)
 
@@ -172,6 +241,7 @@ class ShardedEngine:
             self.stats["requests"] += len(requests)
             self.stats["batches"] += 1
             self.stats["errors"] += n_errors
+            windows: List[List[WorkItem]] = []
             for round_work in rounds:
                 kernel_items = []
                 for item in round_work:
@@ -181,11 +251,13 @@ class ShardedEngine:
                 if kernel_items:
                     self.stats["rounds"] += 1
                     for start in range(0, len(kernel_items), self.max_width):
-                        self._apply_round(
-                            kernel_items[start : start + self.max_width],
-                            now_ms,
-                            responses,
-                        )
+                        windows.append(
+                            kernel_items[start : start + self.max_width])
+            head, tail = self._split_scannable(windows)
+            for wk in head:
+                self._apply_round(wk, now_ms, responses)
+            if tail:
+                self._apply_rounds_scanned(tail, now_ms, responses)
         return responses  # type: ignore[return-value]
 
     def global_sync(self, now_ms: Optional[int] = None) -> int:
@@ -259,33 +331,104 @@ class ShardedEngine:
         )
         return True
 
-    def _apply_round(self, round_work: List[WorkItem], now_ms, responses) -> None:
-        R, S = self.plan.n_regions, self.plan.n_shards
-        lanes: List[List[WorkItem]] = [[] for _ in range(R * S)]
+    # Same fast-path bounds as models/engine.py: scan groups are capped at 32
+    # windows of exactly min_width lanes, so warmup() can pre-compile every
+    # shape this path dispatches, and the capacity guard keeps a group's
+    # up-front directory lookups from recycling a slot an earlier window in
+    # the group already claimed.
+    _MAX_SCAN = 32
+
+    def _split_scannable(self, windows: List[List[WorkItem]]):
+        """Per-round head + scannable tail; see Engine._split_scannable.
+
+        Round sizes only shrink, so the small duplicate-key rounds the scan
+        path exists for always trail the list; wide windows keep the
+        per-round path (already one amortized dispatch)."""
+        if len(windows) <= 1:
+            return windows, []
+        split = len(windows)
+        while split > 0 and len(windows[split - 1]) <= self.min_width:
+            split -= 1
+        tail = windows[split:]
+        if (len(tail) < 2 or
+                sum(len(w) for w in tail) * 4 > self.plan.capacity_per_shard):
+            return windows, []
+        return windows[:split], tail
+
+    def _route_lanes(self, round_work: List[WorkItem]):
+        """Split a window's items by owner chip (host-side lane routing)."""
+        lanes: List[List[WorkItem]] = [[] for _ in range(self.plan.n_owners)]
         for item in round_work:
             lanes[self.owner_of(item[1].hash_key())].append(item)
-        width = max(len(l) for l in lanes)
-        w = bucket_width(width, self.min_width, self.max_width)
+        return lanes
 
-        # one i64[R,S,9,w] staging buffer up, one i64[R,S,4,w] back
-        # (row order must match make_decide_sharded's unpack)
-        packed = np.zeros((R, S, 9, w), np.int64)
-        packed[:, :, 0, :] = -1  # vacant lanes
-        placed: List[Tuple[int, int, int, int]] = []  # (resp idx, r, s, lane)
+    def _pack_lanes(self, lanes, w: int, packed, placed, k: Optional[int]):
+        """Fill one window's [R,S,9,w] slice (packed[..., k, :, :] when k is
+        given) and record (resp idx, r, s, k, lane) demux coordinates."""
         for owner, items in enumerate(lanes):
             if not items:
                 continue
             r_, s_ = self.plan.owner_coords(owner)
             keys = [it[1].hash_key() for it in items]
             slots, fresh = self.directories[owner].lookup(keys)
-            packed[r_, s_] = pack_window(items, slots, fresh, w)
+            dst = packed[r_, s_] if k is None else packed[r_, s_, k]
+            pack_window(items, slots, fresh, w, out=dst)
             for lane, item in enumerate(items):
-                placed.append((item[0], r_, s_, lane))
+                placed.append((item[0], r_, s_, k, lane))
+
+    def _apply_rounds_scanned(self, windows, now_ms, responses) -> None:
+        """Retire every scannable window in ⌈N/32⌉ mesh dispatches.
+
+        The per-round path pays one full shard_map dispatch per duplicate-key
+        round; a hot-key herd of d duplicates costs d launches. Here each
+        chip scans up to 32 windows of its own lanes in one launch."""
+        R, S = self.plan.n_regions, self.plan.n_shards
+        w = self.min_width  # _split_scannable guarantees every window fits
+
+        for g0 in range(0, len(windows), self._MAX_SCAN):
+            group = windows[g0:g0 + self._MAX_SCAN]
+            if len(group) == 1:
+                # trailing singleton rides the warmed single-window program
+                self._apply_round(group[0], now_ms, responses)
+                continue
+            k_pad = _bucket_pow2(len(group))
+            packed = np.zeros((R, S, k_pad, 9, w), np.int64)
+            packed[:, :, :, 0, :] = -1  # vacant lanes (incl. pad windows)
+            placed: List[Tuple[int, int, int, int, int]] = []
+            for k, wk in enumerate(group):
+                self._pack_lanes(self._route_lanes(wk), w, packed, placed, k)
+
+            self.state, out = self._decide_scan(self.state, packed, now_ms)
+
+            out = np.asarray(out)
+            for i, r_, s_, k, lane in placed:
+                st = int(out[r_, s_, k, 0, lane])
+                if st == Status.OVER_LIMIT:
+                    self.stats["over_limit"] += 1
+                responses[i] = RateLimitResp(
+                    status=st,
+                    limit=int(out[r_, s_, k, 1, lane]),
+                    remaining=int(out[r_, s_, k, 2, lane]),
+                    reset_time=int(out[r_, s_, k, 3, lane]),
+                )
+
+    def _apply_round(self, round_work: List[WorkItem], now_ms, responses) -> None:
+        R, S = self.plan.n_regions, self.plan.n_shards
+        lanes = self._route_lanes(round_work)
+        w = bucket_width(
+            max(len(l) for l in lanes), self.min_width, self.max_width)
+
+        # one i64[R,S,9,w] staging buffer up, one i64[R,S,4,w] back
+        # (row order must match make_decide_sharded's unpack)
+        packed = np.zeros((R, S, 9, w), np.int64)
+        packed[:, :, 0, :] = -1  # vacant lanes
+        placed: List[Tuple[int, int, int, Optional[int], int]] = []
+        self._pack_lanes(lanes, w, packed, placed, None)
 
         self.state, out = self._decide(self.state, packed, now_ms)
 
         out = np.asarray(out)
-        for i, r_, s_, lane in placed:
+        for i, r_, s_, _k, lane in placed:
             st = int(out[r_, s_, 0, lane])
             if st == Status.OVER_LIMIT:
                 self.stats["over_limit"] += 1

@@ -301,3 +301,58 @@ class TestFileLoader:
         assert not os.path.exists(path + ".tmp")
         [snap] = fl.load()
         assert snap.key == "a_b" and snap.remaining == 3
+
+
+class TestScannedRounds:
+    """The multi-round scan fast-path must be indistinguishable from the
+    one-dispatch-per-round path (same mutex-serialized semantics,
+    reference: gubernator.go:328)."""
+
+    def test_hot_key_herd_exact_semantics(self):
+        # 100 duplicates of one key = 100 rounds -> 4 scan groups of <=32
+        eng = Engine(capacity=2048, min_width=8, max_width=64)
+        reqs = [req(key="herd", hits=1, limit=50) for _ in range(100)]
+        rs = eng.get_rate_limits(reqs, now_ms=NOW)
+        assert [r.status for r in rs[:50]] == [Status.UNDER_LIMIT] * 50
+        assert [r.status for r in rs[50:]] == [Status.OVER_LIMIT] * 50
+        assert [r.remaining for r in rs[:50]] == list(range(49, -1, -1))
+        assert all(r.remaining == 0 for r in rs[50:])
+
+    def test_scan_path_matches_per_round_path(self):
+        rnd = random.Random(7)
+        keys = [f"sc{i}" for i in range(12)]
+
+        def batch():
+            return [req(key=rnd.choice(keys), hits=rnd.randint(0, 4),
+                        limit=10, duration=60_000,
+                        algorithm=rnd.choice([0, 1]))
+                    for _ in range(rnd.randint(2, 40))]
+
+        batches = [batch() for _ in range(6)]
+        big = Engine(capacity=2048, min_width=8, max_width=64)   # scans
+        small = Engine(capacity=64, min_width=8, max_width=64)
+        small._split_scannable = lambda windows: (windows, [])   # per-round
+        assert Engine(capacity=64, min_width=8, max_width=64)._split_scannable(
+            [[None] * 20, [None] * 20]) == ([[None] * 20, [None] * 20], [])
+        for k, b in enumerate(batches):
+            got = big.get_rate_limits(b, now_ms=NOW + k * 1000)
+            want = small.get_rate_limits(b, now_ms=NOW + k * 1000)
+            assert got == want
+
+    def test_store_disables_scan(self):
+        store = MockStore()
+        eng = Engine(capacity=2048, min_width=8, max_width=64, store=store)
+        rs = eng.get_rate_limits([req(key="sd", hits=2, limit=10)
+                                  for _ in range(4)], now_ms=NOW)
+        assert [r.remaining for r in rs] == [8, 6, 4, 2]
+        # write-through fired once per round, as the per-round path does
+        assert store.called["on_change"] == 4
+
+    def test_herd_33_singleton_group(self):
+        # 33 windows -> scan groups [32, 1]; the singleton takes the
+        # per-round program (warmup never compiles scan depth 1)
+        eng = Engine(capacity=2048, min_width=8, max_width=64)
+        rs = eng.get_rate_limits(
+            [req(key="h33", hits=1, limit=20) for _ in range(33)], now_ms=NOW)
+        assert [r.status for r in rs] == [0] * 20 + [1] * 13
+        assert rs[32].remaining == 0

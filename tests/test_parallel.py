@@ -172,3 +172,58 @@ def test_leaky_bucket_drains_across_shards():
         now_ms=NOW + 3_000,
     )[0]
     assert r2.remaining == 3
+
+
+class TestScannedRounds:
+    """The multi-round scan fast-path (one shard_map dispatch per <=32
+    windows) must be indistinguishable from the per-round path."""
+
+    def test_hot_key_herd_exact_semantics(self):
+        eng = ShardedEngine(n_shards=8, capacity_per_shard=2048,
+                            min_width=8, max_width=64)
+        reqs = [_req("hot", hits=1, limit=50) for _ in range(100)]
+        rs = eng.get_rate_limits(reqs, now_ms=NOW)
+        assert [r.status for r in rs[:50]] == [Status.UNDER_LIMIT] * 50
+        assert [r.status for r in rs[50:]] == [Status.OVER_LIMIT] * 50
+        assert [r.remaining for r in rs[:50]] == list(range(49, -1, -1))
+
+    def test_scan_path_matches_per_round_path(self):
+        rnd = random.Random(11)
+        keys = [f"ssc{i}" for i in range(10)]
+
+        def batch():
+            return [_req(rnd.choice(keys), hits=rnd.randint(0, 4),
+                         algo=rnd.choice([Algorithm.TOKEN_BUCKET,
+                                          Algorithm.LEAKY_BUCKET]))
+                    for _ in range(rnd.randint(2, 40))]
+
+        big = ShardedEngine(n_shards=4, capacity_per_shard=2048,
+                            min_width=8, max_width=64)      # scans
+        small = ShardedEngine(n_shards=4, capacity_per_shard=256,
+                              min_width=8, max_width=64)
+        small._split_scannable = lambda windows: (windows, [])  # per-round
+        for k in range(5):
+            b = batch()
+            got = big.get_rate_limits(b, now_ms=NOW + k * 1000)
+            want = small.get_rate_limits(b, now_ms=NOW + k * 1000)
+            assert got == want
+
+    def test_scan_matches_single_engine_with_dups(self):
+        # the strongest oracle: sharded scan path vs the single-table engine
+        single = Engine(capacity=4096, min_width=8, max_width=64)
+        sharded = ShardedEngine(n_shards=8, capacity_per_shard=1024,
+                                min_width=8, max_width=64)
+        rnd = random.Random(3)
+        keys = [f"sd{i}" for i in range(6)]
+        for k in range(4):
+            b = [_req(rnd.choice(keys), hits=rnd.randint(0, 3), limit=12)
+                 for _ in range(30)]
+            assert (sharded.get_rate_limits(b, now_ms=NOW + k * 500)
+                    == single.get_rate_limits(b, now_ms=NOW + k * 500))
+
+    def test_herd_33_singleton_group(self):
+        eng = ShardedEngine(n_shards=4, capacity_per_shard=2048,
+                            min_width=8, max_width=64)
+        rs = eng.get_rate_limits(
+            [_req("h33", hits=1, limit=20) for _ in range(33)], now_ms=NOW)
+        assert [r.status for r in rs] == [0] * 20 + [1] * 13
