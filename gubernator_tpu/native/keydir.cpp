@@ -14,6 +14,8 @@
 // to two different keys (the kernel requires collision-free scatters).
 
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <string>
 #include <vector>
@@ -92,8 +94,10 @@ class KeyDir {
     void drop(const char* key, int32_t len) {
         int32_t e = find(key, len);
         if (e < 0) return;
-        remove_bucket(e);
+        // unlink from the LRU before touching buckets: remove_bucket may
+        // trigger a rebuild, which reinserts exactly the LRU-linked entries
         lru_unlink(e);
+        remove_bucket(e);
         entries_[e].used = false;
         entries_[e].key.clear();
         free_.push_back(e);
@@ -132,10 +136,27 @@ class KeyDir {
     int64_t evictions() const { return evictions_; }
 
   private:
+    void diag_abort(const char* where) const {
+        int64_t tomb = 0, occ = 0;
+        for (uint64_t i = 0; i < nbuckets_; ++i) {
+            if (buckets_[i] == TOMBSTONE) ++tomb;
+            else if (buckets_[i] != -1) ++occ;
+        }
+        std::fprintf(stderr,
+                     "keydir %s: probe chain exceeded nbuckets=%llu "
+                     "(occupied=%lld tombstones=%lld size=%lld free=%zu "
+                     "evictions=%lld)\n",
+                     where, (unsigned long long)nbuckets_, (long long)occ,
+                     (long long)tomb, (long long)size(), free_.size(),
+                     (long long)evictions_);
+        std::abort();
+    }
+
     int32_t find(const char* key, int32_t len) const {
         uint64_t mask = nbuckets_ - 1;
         uint64_t b = fnv1a(key, len) & mask;
-        while (buckets_[b] != -1) {
+        for (uint64_t probes = 0; buckets_[b] != -1; ++probes) {
+            if (probes > nbuckets_) diag_abort("find");
             int32_t e = buckets_[b];
             if (e != TOMBSTONE && entries_[e].key.size() == static_cast<size_t>(len)
                 && std::memcmp(entries_[e].key.data(), key, len) == 0) {
@@ -150,20 +171,42 @@ class KeyDir {
         uint64_t mask = nbuckets_ - 1;
         uint64_t b = fnv1a(entries_[e].key.data(),
                            static_cast<int32_t>(entries_[e].key.size())) & mask;
-        while (buckets_[b] != -1 && buckets_[b] != TOMBSTONE) b = (b + 1) & mask;
+        uint64_t probes = 0;
+        while (buckets_[b] != -1 && buckets_[b] != TOMBSTONE) {
+            if (++probes > nbuckets_) diag_abort("insert");
+            b = (b + 1) & mask;
+        }
+        if (buckets_[b] == TOMBSTONE) --tombstones_;
         buckets_[b] = e;
     }
 
+    // Tombstone a bucket. Under sustained LRU churn (every insert evicts)
+    // tombstones accumulate until occupied + tombstones == nbuckets and
+    // find() of an ABSENT key has no empty bucket to stop at — an infinite
+    // probe loop on a full table. Rebuild the bucket array once tombstones
+    // exceed a quarter of it: occupied is <= nbuckets/2 by construction, so
+    // after a rebuild at least a quarter of the buckets are empty and probe
+    // chains stay short. Amortized O(1) per removal.
     void remove_bucket(int32_t e) {
         uint64_t mask = nbuckets_ - 1;
         uint64_t b = fnv1a(entries_[e].key.data(),
                            static_cast<int32_t>(entries_[e].key.size())) & mask;
-        while (buckets_[b] != -1) {
+        for (uint64_t probes = 0; buckets_[b] != -1; ++probes) {
+            if (probes > nbuckets_) diag_abort("remove");
             if (buckets_[b] == e) {
                 buckets_[b] = TOMBSTONE;
+                if (++tombstones_ > nbuckets_ / 4) rebuild_buckets();
                 return;
             }
             b = (b + 1) & mask;
+        }
+    }
+
+    void rebuild_buckets() {
+        buckets_.assign(nbuckets_, -1);
+        tombstones_ = 0;
+        for (int32_t e = lru_head_; e >= 0; e = entries_[e].lru_next) {
+            insert_bucket(e);
         }
     }
 
@@ -176,8 +219,10 @@ class KeyDir {
         // evict LRU, skipping entries pinned by the current batch
         for (int32_t e = lru_tail_; e >= 0; e = entries_[e].lru_prev) {
             if (entries_[e].pin_gen == gen_) continue;
-            remove_bucket(e);
+            // unlink before remove_bucket: a tombstone-triggered rebuild
+            // reinserts exactly the LRU-linked entries
             lru_unlink(e);
+            remove_bucket(e);
             entries_[e].key.clear();
             entries_[e].used = false;
             ++evictions_;
@@ -220,6 +265,7 @@ class KeyDir {
     int32_t lru_tail_ = -1;
     uint64_t gen_ = 0;
     int64_t evictions_ = 0;
+    uint64_t tombstones_ = 0;
 };
 
 }  // namespace
