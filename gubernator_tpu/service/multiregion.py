@@ -67,9 +67,16 @@ class MultiRegionManager:
             try:
                 peer.get_peer_rate_limits(reqs)
                 self.stats["replicated"] += len(reqs)
-            except Exception:  # noqa: BLE001
+            except Exception as e:  # noqa: BLE001
                 self.stats["errors"] += 1
-                log.exception(
-                    "error replicating hits to region peer '%s'",
+                # one line, no traceback: an unreachable region peer is a
+                # normal runtime condition (peer down, cluster draining);
+                # this window's hits to that region are dropped, the next
+                # window carries fresh aggregates. RpcError's str() is
+                # multi-line, so log its status code instead.
+                code = getattr(e, "code", None)
+                log.warning(
+                    "error replicating hits to region peer '%s': %s",
                     peer.info.address,
+                    code().name if callable(code) else e,
                 )

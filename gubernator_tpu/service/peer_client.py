@@ -54,6 +54,15 @@ class PeerClient:
         """Lazy connect (reference: peer_client.go:81-125)."""
         with self._lock:
             if self._stub is None:
+                if self._closing:
+                    # refuse NEW connections once closing — but an existing
+                    # stub keeps serving so shutdown can drain the queue
+                    # (channel closes only after the worker joins). Callers
+                    # racing shutdown get the clean not-ready signal the
+                    # reference returns from its status check
+                    # (reference: peer_client.go:127-133), never a raw
+                    # closed-channel error.
+                    raise PeerNotReadyError(self.info.address)
                 self._channel = grpc.insecure_channel(self.info.address)
                 self._stub = PeersV1Stub(self._channel)
                 self._thread = threading.Thread(
@@ -65,7 +74,12 @@ class PeerClient:
 
     def shutdown(self, timeout_s: Optional[float] = None) -> None:
         """Stop accepting requests and drain the queue
-        (reference: peer_client.go:322-356)."""
+        (reference: peer_client.go:322-356).
+
+        Enqueues are atomic with the closing check (get_peer_rate_limit holds
+        _lock for check+put), so everything in the queue precedes the
+        sentinel and the worker drains it all; the sweep below only fires
+        when the worker died or outlived the join timeout."""
         with self._lock:
             if self._closing:
                 return
@@ -73,6 +87,16 @@ class PeerClient:
         self._queue.put(None)  # wake the batch loop
         if self._thread is not None:
             self._thread.join(timeout=timeout_s or self.conf.batch_timeout_s)
+        while True:  # fail anything the worker never got to, loudly
+            try:
+                item = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is None:
+                continue
+            _, fut = item
+            if not fut.done():
+                fut.set_exception(PeerNotReadyError(self.info.address))
         if self._channel is not None:
             self._channel.close()
 
@@ -84,11 +108,16 @@ class PeerClient:
         if has_behavior(req.behavior, Behavior.NO_BATCHING):
             resps = self.get_peer_rate_limits([req])
             return resps[0]
-        if self._closing:
-            raise PeerNotReadyError(self.info.address)
         self._connect()
         fut: "Future[RateLimitResp]" = Future()
-        self._queue.put((req, fut))
+        # check+enqueue atomically vs shutdown's closing flag: a request in
+        # the queue is then always AHEAD of the shutdown sentinel, so the
+        # worker drains it; a request refused here fails fast instead of
+        # sitting in a queue nobody reads until the batch timeout
+        with self._lock:
+            if self._closing:
+                raise PeerNotReadyError(self.info.address)
+            self._queue.put((req, fut))
         try:
             return fut.result(timeout=self.conf.batch_timeout_s)
         except TimeoutError:
@@ -104,6 +133,10 @@ class PeerClient:
         except grpc.RpcError as e:
             self._record_err(str(e.code()))
             raise
+        except ValueError as e:
+            # grpc raises bare ValueError("Cannot invoke RPC on closed
+            # channel!") when shutdown() closed the channel mid-call
+            raise PeerNotReadyError(self.info.address) from e
         return [resp_from_pb(m) for m in out.rate_limits]
 
     def update_peer_globals(self, updates) -> None:
@@ -116,6 +149,8 @@ class PeerClient:
         except grpc.RpcError as e:
             self._record_err(str(e.code()))
             raise
+        except ValueError as e:
+            raise PeerNotReadyError(self.info.address) from e
 
     def get_last_err(self) -> List[str]:
         """Recent errors for HealthCheck (reference: peer_client.go:198-213)."""
