@@ -158,6 +158,8 @@ def main(argv=None) -> int:
 
     pool = build_pool(conf, instance)
 
+    tracing = start_profiling(conf)
+
     stop = threading.Event()
 
     def on_signal(signum, frame):
@@ -173,7 +175,34 @@ def main(argv=None) -> int:
     gateway.close()
     server.stop(grace=1.0)
     instance.close()
+    if tracing:
+        import jax
+
+        jax.profiler.stop_trace()
+        log.info("XLA trace written to %s", conf.profile_dir)
     return 0
+
+
+def start_profiling(conf: DaemonConfig) -> bool:
+    """Device-level tracing/profiling knobs (no reference analogue — the
+    reference's only latency observability is RPC histograms, SURVEY §5.1).
+
+    GUBER_PROFILE_PORT starts jax's live profiler server (attach TensorBoard
+    or `jax.profiler.trace` remotely); GUBER_PROFILE_DIR captures one XLA
+    trace spanning the daemon's lifetime, written at shutdown. Returns
+    whether a trace capture is active."""
+    if conf.profile_port:
+        import jax
+
+        jax.profiler.start_server(conf.profile_port)
+        log.info("jax profiler server on port %d", conf.profile_port)
+    if conf.profile_dir:
+        import jax
+
+        jax.profiler.start_trace(conf.profile_dir)
+        log.info("capturing XLA trace to %s", conf.profile_dir)
+        return True
+    return False
 
 
 if __name__ == "__main__":

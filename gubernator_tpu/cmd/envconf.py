@@ -105,6 +105,10 @@ class DaemonConfig:
     # durable bucket snapshot: load at boot, save at shutdown (FileLoader;
     # the reference leaves persistence to the user, README.md:159-175)
     snapshot_path: str = ""
+    # device-level tracing (no reference analogue): live profiler server
+    # port, and a dir for a capture spanning the daemon's lifetime
+    profile_port: int = 0
+    profile_dir: str = ""
     debug: bool = False
 
 
@@ -156,6 +160,8 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         min_batch_width=_env_int("GUBER_MIN_BATCH_WIDTH", 64),
         max_batch_width=_env_int("GUBER_MAX_BATCH_WIDTH", 4096),
         snapshot_path=_env_str("GUBER_SNAPSHOT_PATH"),
+        profile_port=_env_int("GUBER_PROFILE_PORT", 0),
+        profile_dir=_env_str("GUBER_PROFILE_DIR"),
         debug=opts.debug or bool(os.environ.get("GUBER_DEBUG")),
     )
     return conf

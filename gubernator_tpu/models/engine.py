@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools as _functools
 import threading
+import time
 from typing import Dict, List, Optional, Sequence
 
 import jax
@@ -95,17 +96,35 @@ def _jit_gather():
 
 
 class EngineStats:
+    """Counters plus a cumulative per-stage wall-clock breakdown.
+
+    The stage clocks (nanoseconds) split a window's host path — validate/
+    round-split (`prep`), key-directory resolution (`lookup`), Store
+    read-through/write-through I/O (`store`), staging-buffer fill (`pack`),
+    kernel dispatch + readback (`device`), response demux (`demux`) — so an
+    operator can see WHERE a slow window went without a profiler attached.
+    Lock-acquisition waits are deliberately excluded (deltas are computed
+    before entering the engine lock). Exposed as
+    engine_stage_seconds_total{stage=...} in /metrics (the reference has no
+    tracing tier at all, SURVEY §5.1)."""
+
+    STAGES = ("prep", "lookup", "store", "pack", "device", "demux")
+
     def __init__(self):
         self.requests = 0
         self.batches = 0
         self.rounds = 0
         self.over_limit = 0
         self.errors = 0
+        self.stage_ns = {s: 0 for s in self.STAGES}
 
     def as_dict(self) -> Dict[str, int]:
-        return dict(requests=self.requests, batches=self.batches,
-                    rounds=self.rounds, over_limit=self.over_limit,
-                    errors=self.errors)
+        d = dict(requests=self.requests, batches=self.batches,
+                 rounds=self.rounds, over_limit=self.over_limit,
+                 errors=self.errors)
+        for s, ns in self.stage_ns.items():
+            d[f"{s}_ns"] = ns
+        return d
 
 
 class Engine:
@@ -183,9 +202,12 @@ class Engine:
         """Decide a batch. Exact per-key sequential semantics, any batch size."""
         if now_ms is None:
             now_ms = millisecond_now()
+        t0 = time.perf_counter_ns()
         responses, rounds, n_errors = preprocess(requests, now_ms)
+        prep_ns = time.perf_counter_ns() - t0  # excludes the lock wait below
 
         with self._lock:
+            self.stats.stage_ns["prep"] += prep_ns
             self.stats.requests += len(requests)
             self.stats.batches += 1
             self.stats.errors += n_errors
@@ -301,6 +323,7 @@ class Engine:
         duplicates of one key = d rounds, which the per-round path pays d
         full dispatches for (~50-80 µs launch overhead each) while the
         kernel itself is <1 µs."""
+        stage = self.stats.stage_ns
         width = self.min_width  # _split_scannable guarantees every window fits
         for g0 in range(0, len(windows), self._MAX_SCAN):
             group = windows[g0:g0 + self._MAX_SCAN]
@@ -314,11 +337,18 @@ class Engine:
             stacked = np.zeros((k, 9, width), np.int64)
             stacked[:, 0, :] = -1  # pad windows are all padding lanes
             for gi, wk in enumerate(group):
+                t = time.perf_counter_ns()
                 keys = [item[1].hash_key() for item in wk]
                 slots, fresh = self.directory.lookup(keys)
+                t2 = time.perf_counter_ns()
+                stage["lookup"] += t2 - t
                 pack_window(wk, slots, fresh, width, out=stacked[gi])
+                stage["pack"] += time.perf_counter_ns() - t2
+            t = time.perf_counter_ns()
             self.state, out = self._decide_scan(self.state, stacked, now_ms)
             out = np.asarray(out)
+            t2 = time.perf_counter_ns()
+            stage["device"] += t2 - t
             for gi, wk in enumerate(group):
                 n = len(wk)
                 status, limit, remaining, reset = (
@@ -332,22 +362,33 @@ class Engine:
                     responses[i] = RateLimitResp(
                         status=st, limit=int(limit[j]),
                         remaining=int(remaining[j]), reset_time=int(reset[j]))
+            stage["demux"] += time.perf_counter_ns() - t2
 
     def _apply_round(self, round_work, now_ms, responses) -> None:
+        stage = self.stats.stage_ns
         n = len(round_work)
+        t = time.perf_counter_ns()
         keys = [item[1].hash_key() for item in round_work]
         slots, fresh = self.directory.lookup(keys)
+        stage["lookup"] += time.perf_counter_ns() - t
 
         if self.store is not None:
+            t = time.perf_counter_ns()
             fresh = self._store_read_through(round_work, keys, slots, fresh, now_ms)
+            stage["store"] += time.perf_counter_ns() - t
 
         w = _bucket_width(n, self.min_width, self.max_width)
         # one staging buffer up, one back: off-chip round trips are the
         # serving path's dominant cost, so the window crosses exactly twice
+        t = time.perf_counter_ns()
         packed = pack_window(round_work, slots, fresh, w)
+        t2 = time.perf_counter_ns()
+        stage["pack"] += t2 - t
         self.state, out = self._decide_packed(self.state, packed, now_ms)
-
         out = np.asarray(out)
+        t3 = time.perf_counter_ns()
+        stage["device"] += t3 - t2
+
         status, limit, remaining, reset = (
             out[0, :n], out[1, :n], out[2, :n], out[3, :n],
         )
@@ -358,9 +399,12 @@ class Engine:
             responses[i] = RateLimitResp(
                 status=st, limit=int(limit[j]), remaining=int(remaining[j]),
                 reset_time=int(reset[j]))
+        stage["demux"] += time.perf_counter_ns() - t3
 
         if self.store is not None:
+            t = time.perf_counter_ns()
             self._store_write_through(round_work, keys, slots, now_ms)
+            stage["store"] += time.perf_counter_ns() - t
 
     def _store_read_through(self, round_work, keys, slots, fresh, now_ms):
         """Consult the store for rows the table can't serve

@@ -26,6 +26,7 @@ Behavior=GLOBAL here (reference: gubernator.go:226-247):
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -199,6 +200,13 @@ class ShardedEngine:
             "global_syncs": 0,
             "global_mirror_answers": 0,
         }
+        # per-stage wall clocks, same contract as models/engine.py
+        # EngineStats (exposed as engine_stage_seconds_total in /metrics);
+        # the store stage stays 0 — ShardedEngine has no Store hook
+        from gubernator_tpu.models.engine import EngineStats
+
+        for s in EngineStats.STAGES:
+            self.stats[f"{s}_ns"] = 0
 
     # ------------------------------------------------------------------ API
 
@@ -236,8 +244,11 @@ class ShardedEngine:
     ) -> List[RateLimitResp]:
         if now_ms is None:
             now_ms = millisecond_now()
+        t0 = time.perf_counter_ns()
         responses, rounds, n_errors = preprocess(requests, now_ms)
+        prep_ns = time.perf_counter_ns() - t0  # excludes the lock wait below
         with self._lock:
+            self.stats["prep_ns"] += prep_ns
             self.stats["requests"] += len(requests)
             self.stats["batches"] += 1
             self.stats["errors"] += n_errors
@@ -369,10 +380,14 @@ class ShardedEngine:
             if not items:
                 continue
             r_, s_ = self.plan.owner_coords(owner)
+            t = time.perf_counter_ns()
             keys = [it[1].hash_key() for it in items]
             slots, fresh = self.directories[owner].lookup(keys)
+            t2 = time.perf_counter_ns()
+            self.stats["lookup_ns"] += t2 - t
             dst = packed[r_, s_] if k is None else packed[r_, s_, k]
             pack_window(items, slots, fresh, w, out=dst)
+            self.stats["pack_ns"] += time.perf_counter_ns() - t2
             for lane, item in enumerate(items):
                 placed.append((item[0], r_, s_, k, lane))
 
@@ -398,9 +413,11 @@ class ShardedEngine:
             for k, wk in enumerate(group):
                 self._pack_lanes(self._route_lanes(wk), w, packed, placed, k)
 
+            t = time.perf_counter_ns()
             self.state, out = self._decide_scan(self.state, packed, now_ms)
-
             out = np.asarray(out)
+            t2 = time.perf_counter_ns()
+            self.stats["device_ns"] += t2 - t
             for i, r_, s_, k, lane in placed:
                 st = int(out[r_, s_, k, 0, lane])
                 if st == Status.OVER_LIMIT:
@@ -411,6 +428,7 @@ class ShardedEngine:
                     remaining=int(out[r_, s_, k, 2, lane]),
                     reset_time=int(out[r_, s_, k, 3, lane]),
                 )
+            self.stats["demux_ns"] += time.perf_counter_ns() - t2
 
     def _apply_round(self, round_work: List[WorkItem], now_ms, responses) -> None:
         R, S = self.plan.n_regions, self.plan.n_shards
@@ -425,9 +443,11 @@ class ShardedEngine:
         placed: List[Tuple[int, int, int, Optional[int], int]] = []
         self._pack_lanes(lanes, w, packed, placed, None)
 
+        t = time.perf_counter_ns()
         self.state, out = self._decide(self.state, packed, now_ms)
-
         out = np.asarray(out)
+        t2 = time.perf_counter_ns()
+        self.stats["device_ns"] += t2 - t
         for i, r_, s_, _k, lane in placed:
             st = int(out[r_, s_, 0, lane])
             if st == Status.OVER_LIMIT:
@@ -438,6 +458,7 @@ class ShardedEngine:
                 remaining=int(out[r_, s_, 2, lane]),
                 reset_time=int(out[r_, s_, 3, lane]),
             )
+        self.stats["demux_ns"] += time.perf_counter_ns() - t2
 
     def _build_global_config(self, now_ms: int) -> GlobalConfig:
         import datetime as _dt

@@ -73,6 +73,12 @@ class Metrics:
             "engine_over_limit_total", "Decisions that returned OVER_LIMIT.",
             registry=self.registry,
         )
+        self.engine_stage_seconds = Counter(
+            "engine_stage_seconds_total",
+            "Cumulative wall-clock per engine pipeline stage "
+            "(prep/lookup/pack/device/demux).",
+            ["stage"], registry=self.registry,
+        )
 
     def observe_instance(self, instance) -> None:
         """Refresh gauges from live objects before exposition."""
@@ -82,6 +88,14 @@ class Metrics:
             self._set_counter(self.engine_decisions, d.get("requests", 0))
             self._set_counter(self.engine_kernel_rounds, d.get("rounds", 0))
             self._set_counter(self.engine_over_limit, d.get("over_limit", 0))
+            from gubernator_tpu.models.engine import EngineStats
+
+            for stage in EngineStats.STAGES:
+                ns = d.get(f"{stage}_ns")
+                if ns is not None:
+                    self._set_counter(
+                        self.engine_stage_seconds.labels(stage=stage),
+                        ns / 1e9)
         cache = getattr(instance, "_global_cache", None)
         if cache is not None:
             self.cache_size.set(len(cache))

@@ -114,3 +114,22 @@ def test_http_health_and_metrics(daemon):
     ).read().decode()
     assert "grpc_request_duration_milliseconds" in metrics
     assert "engine_decisions_total" in metrics
+    # stage clocks exposed once traffic has flowed
+    assert 'engine_stage_seconds_total{stage="device"}' in metrics
+
+
+def test_profile_env_parsing(monkeypatch):
+    from gubernator_tpu.cmd.envconf import config_from_env
+
+    monkeypatch.setenv("GUBER_PROFILE_PORT", "9999")
+    monkeypatch.setenv("GUBER_PROFILE_DIR", "/tmp/xla-trace")
+    conf = config_from_env([])
+    assert conf.profile_port == 9999
+    assert conf.profile_dir == "/tmp/xla-trace"
+
+
+def test_start_profiling_noop_by_default():
+    from gubernator_tpu.cmd.daemon import start_profiling
+    from gubernator_tpu.cmd.envconf import DaemonConfig
+
+    assert start_profiling(DaemonConfig()) is False

@@ -356,3 +356,29 @@ class TestScannedRounds:
             [req(key="h33", hits=1, limit=20) for _ in range(33)], now_ms=NOW)
         assert [r.status for r in rs] == [0] * 20 + [1] * 13
         assert rs[32].remaining == 0
+
+
+class TestStageClocks:
+    """Per-stage wall-clock breakdown (tracing tier; the reference has no
+    latency observability beyond RPC histograms, SURVEY §5.1)."""
+
+    def test_stages_accumulate_on_both_paths(self):
+        eng = Engine(capacity=2048, min_width=8, max_width=64)
+        # per-round path (distinct keys) ...
+        eng.get_rate_limits([req(key=f"t{i}") for i in range(10)], now_ms=NOW)
+        # ... and the scan path (hot-key rounds)
+        eng.get_rate_limits([req(key="hot") for _ in range(8)], now_ms=NOW)
+        d = eng.stats.as_dict()
+        for stage in ("prep", "lookup", "pack", "device", "demux"):
+            assert d[f"{stage}_ns"] > 0, stage
+        # device dominates on any real backend; sanity: all clocks are
+        # bounded by a second for two tiny batches
+        assert sum(d[f"{s}_ns"] for s in
+                   ("prep", "lookup", "pack", "device", "demux")) < 60e9
+        assert d["store_ns"] == 0  # no Store configured
+
+    def test_store_stage_accumulates(self):
+        eng = Engine(capacity=256, min_width=8, max_width=32,
+                     store=MockStore())
+        eng.get_rate_limits([req(key="st1")], now_ms=NOW)
+        assert eng.stats.as_dict()["store_ns"] > 0

@@ -227,3 +227,12 @@ class TestScannedRounds:
         rs = eng.get_rate_limits(
             [_req("h33", hits=1, limit=20) for _ in range(33)], now_ms=NOW)
         assert [r.status for r in rs] == [0] * 20 + [1] * 13
+
+
+def test_stage_clocks_accumulate():
+    eng = ShardedEngine(n_shards=4, capacity_per_shard=1024,
+                        min_width=8, max_width=64)
+    eng.get_rate_limits([_req(f"sc{i}") for i in range(10)], now_ms=NOW)
+    eng.get_rate_limits([_req("hot2") for _ in range(6)], now_ms=NOW)
+    for stage in ("prep", "lookup", "pack", "device", "demux"):
+        assert eng.stats[f"{stage}_ns"] > 0, stage
