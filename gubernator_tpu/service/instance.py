@@ -118,7 +118,7 @@ class Instance:
             )
         responses: List[Optional[RateLimitResp]] = [None] * len(requests)
         local: List[int] = []
-        futures = []
+        remote: Dict[str, tuple] = {}  # owner addr -> (peer, [batch indices])
 
         for i, req in enumerate(requests):
             if not req.unique_key:
@@ -144,17 +144,26 @@ class Instance:
             elif has_behavior(req.behavior, Behavior.GLOBAL):
                 responses[i] = self._get_global_rate_limit(req, peer)
             else:
-                futures.append(
-                    (i, self._forward_pool.submit(self._forward, req, key))
-                )
+                remote.setdefault(peer.info.address, (peer, []))[1].append(i)
+
+        futures = []
+        for peer, idxs in remote.values():
+            if len(idxs) == 1:
+                req = requests[idxs[0]]
+                futures.append((idxs, self._forward_pool.submit(
+                    self._forward_as_list, req, req.hash_key())))
+            else:
+                futures.append((idxs, self._forward_pool.submit(
+                    self._forward_group, peer, [requests[i] for i in idxs])))
 
         if local:
             batch = [requests[i] for i in local]
             out = self.apply_owner_batch(batch, now_ms=now_ms)
             for i, resp in zip(local, out):
                 responses[i] = resp
-        for i, fut in futures:
-            responses[i] = fut.result()
+        for idxs, fut in futures:
+            for i, resp in zip(idxs, fut.result()):
+                responses[i] = resp
         return responses  # type: ignore[return-value]
 
     def get_peer_rate_limits(
@@ -329,6 +338,47 @@ class Instance:
             error=f"GetPeer() keeps returning peers that are not connected for "
             f"'{key}' - '{last_err}'"
         )
+
+    def _forward_as_list(self, req: RateLimitReq, key: str) -> List[RateLimitResp]:
+        return [self._forward(req, key)]
+
+    def _forward_group(
+        self, peer: PeerClient, reqs: List[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        """Forward several same-owner requests as ONE ordered batch.
+
+        Same-batch requests to one owner ride a single GetPeerRateLimits
+        RPC, preserving the client's submission order for duplicate keys.
+        The reference forwards each request independently (goroutine fan-out
+        + per-peer micro-batch, gubernator.go:126-213), so two same-key
+        requests in one client batch can be applied in either order there;
+        grouping restores the single-node rounds semantics across the
+        forwarding hop and costs one RPC per owner instead of one per
+        request. Single-request groups keep the micro-batched per-request
+        path so lone callers still amortize into the 500 µs peer window.
+
+        Failure handling mirrors _forward's: not-ready means the RPC was
+        never sent, so re-forwarding per request (with owner re-picks) is
+        safe and fails fast; any OTHER error may mean the owner already
+        applied the batch, so re-sending would double-count hits — those
+        surface as error responses, exactly like the per-request path."""
+        try:
+            resps = peer.get_peer_rate_limits(reqs)
+        except PeerNotReadyError:
+            return [self._forward(r, r.hash_key()) for r in reqs]
+        except Exception as e:  # noqa: BLE001
+            return [RateLimitResp(
+                error=f"while fetching rate limit '{r.hash_key()}' "
+                      f"from peer - '{e}'")
+                for r in reqs]
+        if len(resps) != len(reqs):
+            return [RateLimitResp(
+                error=f"peer returned {len(resps)} responses for "
+                      f"{len(reqs)} requests")
+                for _ in reqs]
+        for r in resps:
+            r.metadata["owner"] = peer.info.address
+        return resps
 
     def _get_global_rate_limit(
         self, req: RateLimitReq, owner_peer: PeerClient
