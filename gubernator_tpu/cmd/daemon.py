@@ -26,19 +26,28 @@ from gubernator_tpu.types import PeerInfo
 log = logging.getLogger("gubernator_tpu.daemon")
 
 
-def build_backend(conf: DaemonConfig):
-    """Pick the device backend: mesh-sharded when >1 local device, else the
-    single-table engine. (TPU-specific; no reference analogue.)"""
+def _apply_jax_platforms() -> None:
+    """Honor JAX_PLATFORMS even when a platform plugin (e.g. the tunneled-TPU
+    axon plugin) would otherwise take priority over the env default. Must run
+    before anything reads the device list, which freezes the platform."""
     import os
 
     import jax
 
-    # Honor JAX_PLATFORMS even when a platform plugin (e.g. the tunneled-TPU
-    # axon plugin) would otherwise take priority over the env default.
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
-    n_dev = len(jax.devices())
+
+def build_backend(conf: DaemonConfig):
+    """Pick the device backend: mesh-sharded when >1 local device, else the
+    single-table engine. (TPU-specific; no reference analogue.)"""
+    import jax
+
+    _apply_jax_platforms()
+    # size by ADDRESSABLE devices: after a multi-host initialize_from_env,
+    # jax.devices() spans every host but this daemon's engine owns only its
+    # local mesh (cross-host request routing stays at the gRPC tier)
+    n_dev = len(jax.local_devices())
     backend = conf.backend
     if backend == "auto":
         backend = "sharded" if n_dev > 1 else "engine"
@@ -50,9 +59,11 @@ def build_backend(conf: DaemonConfig):
                 "GUBER_SNAPSHOT_PATH is only supported by the single-table "
                 "engine backend; ignoring"
             )
+        from gubernator_tpu.parallel.mesh import make_mesh
+
         cap = max(conf.cache_size // n_dev, 1024)
         eng = ShardedEngine(
-            n_shards=n_dev,
+            mesh=make_mesh(n_shards=n_dev, devices=jax.local_devices()),
             capacity_per_shard=cap,
             min_width=conf.min_batch_width,
             max_width=conf.max_batch_width,
@@ -126,6 +137,14 @@ def main(argv=None) -> int:
         format="%(asctime)s %(name)s %(levelname)s %(message)s",
         stream=sys.stderr,
     )
+
+    _apply_jax_platforms()
+
+    # form the cross-host device process group BEFORE the first backend use;
+    # no-op for single-host deployments
+    from gubernator_tpu.parallel.multihost import initialize_from_env
+
+    initialize_from_env(conf.coordinator_address, conf.num_hosts, conf.host_id)
 
     backend = build_backend(conf)
     log.info("warming up decision kernel (compiling width buckets)...")

@@ -109,6 +109,11 @@ class DaemonConfig:
     # port, and a dir for a capture spanning the daemon's lifetime
     profile_port: int = 0
     profile_dir: str = ""
+    # multi-host device process group (parallel/multihost.py); num_hosts <= 1
+    # means single-host, no group formed
+    coordinator_address: str = ""
+    num_hosts: int = 1
+    host_id: int = 0
     debug: bool = False
 
 
@@ -162,6 +167,9 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         snapshot_path=_env_str("GUBER_SNAPSHOT_PATH"),
         profile_port=_env_int("GUBER_PROFILE_PORT", 0),
         profile_dir=_env_str("GUBER_PROFILE_DIR"),
+        coordinator_address=_env_str("GUBER_COORDINATOR_ADDRESS"),
+        num_hosts=_env_int("GUBER_NUM_HOSTS", 1),
+        host_id=_env_int("GUBER_HOST_ID", 0),
         debug=opts.debug or bool(os.environ.get("GUBER_DEBUG")),
     )
     return conf

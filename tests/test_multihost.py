@@ -1,0 +1,76 @@
+"""Multi-host process-group tests: two REAL processes form a jax.distributed
+group over loopback and run cross-host collectives — the DCN-tier analogue
+of the reference's peer gRPC mesh (reference: peers.proto, global.go). The
+reference's own strategy of N real servers on loopback (cluster/cluster.go)
+applied to the device fabric."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = """
+import json, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+from gubernator_tpu.parallel.multihost import CrossHostHitSync, initialize_from_env
+
+host_id = int(sys.argv[1])
+assert initialize_from_env(sys.argv[2], 2, host_id)
+assert len(jax.devices()) == 4 and len(jax.local_devices()) == 2
+
+import numpy as np
+sync = CrossHostHitSync(global_capacity=4)
+# tick 1: host0 contributes [5,0,1,0], host1 [7,3,0,0]
+mine = np.array([5, 0, 1, 0] if host_id == 0 else [7, 3, 0, 0], np.int64)
+t1 = sync.step(mine)
+# tick 2: only host1 contributes
+t2 = sync.step(np.zeros(4, np.int64) if host_id == 0 else
+               np.array([0, 0, 0, 9], np.int64))
+print("RESULT " + json.dumps({"host": host_id, "t1": t1.tolist(),
+                              "t2": t2.tolist()}), flush=True)
+"""
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_hit_sync(tmp_path):
+    worker = tmp_path / "worker.py"
+    worker.write_text(WORKER)
+    coord = f"127.0.0.1:{free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["PYTHONPATH"] = REPO
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(worker), str(i), coord],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for i in range(2)
+    ]
+    try:
+        outs = [p.communicate(timeout=180)[0] for p in procs]
+    finally:
+        for p in procs:  # a stalled collective must not leak workers
+            if p.poll() is None:
+                p.kill()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, out[-2000:]
+    results = {}
+    for out in outs:
+        line = next(l for l in out.splitlines() if l.startswith("RESULT "))
+        r = json.loads(line[len("RESULT "):])
+        results[r["host"]] = r
+    # both hosts converged on the cluster-total deltas, per tick
+    for h in (0, 1):
+        assert results[h]["t1"] == [12, 3, 1, 0], results
+        assert results[h]["t2"] == [0, 0, 0, 9], results
