@@ -33,11 +33,11 @@ def test_behaviors() -> BehaviorConfig:
     # peer error with a 5-minute TTL that poisons HealthCheck for the rest of
     # the cluster's life.
     return BehaviorConfig(
-        batch_timeout_s=5.0,
+        batch_timeout_s=10.0,
         batch_wait_s=0.01,
-        global_timeout_s=5.0,
+        global_timeout_s=10.0,
         global_sync_wait_s=0.05,
-        multi_region_timeout_s=5.0,
+        multi_region_timeout_s=10.0,
         multi_region_sync_wait_s=0.05,
     )
 
