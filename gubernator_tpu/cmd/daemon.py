@@ -52,14 +52,8 @@ def build_backend(conf: DaemonConfig):
     if backend == "auto":
         backend = "sharded" if n_dev > 1 else "engine"
     if backend == "sharded":
-        from gubernator_tpu.parallel.sharded import ShardedEngine
-
-        if conf.snapshot_path:
-            log.warning(
-                "GUBER_SNAPSHOT_PATH is only supported by the single-table "
-                "engine backend; ignoring"
-            )
         from gubernator_tpu.parallel.mesh import make_mesh
+        from gubernator_tpu.parallel.sharded import ShardedEngine
 
         cap = max(conf.cache_size // n_dev, 1024)
         eng = ShardedEngine(
@@ -67,24 +61,29 @@ def build_backend(conf: DaemonConfig):
             capacity_per_shard=cap,
             min_width=conf.min_batch_width,
             max_width=conf.max_batch_width,
+            loader=_make_loader(conf),
         )
         log.info("backend: sharded over %d devices, %d slots/shard", n_dev, cap)
         return eng
     from gubernator_tpu.models.engine import Engine
 
-    loader = None
-    if conf.snapshot_path:
-        from gubernator_tpu.store import FileLoader
-
-        loader = FileLoader(conf.snapshot_path)
     eng = Engine(
         capacity=conf.cache_size,
         min_width=conf.min_batch_width,
         max_width=conf.max_batch_width,
-        loader=loader,
+        loader=_make_loader(conf),
     )
     log.info("backend: single-table engine, %d slots", conf.cache_size)
     return eng
+
+
+def _make_loader(conf: DaemonConfig):
+    """Durable bucket snapshots via GUBER_SNAPSHOT_PATH (both backends)."""
+    if not conf.snapshot_path:
+        return None
+    from gubernator_tpu.store import FileLoader
+
+    return FileLoader(conf.snapshot_path)
 
 
 def build_pool(conf: DaemonConfig, instance: Instance):

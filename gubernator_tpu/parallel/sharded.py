@@ -158,6 +158,7 @@ class ShardedEngine:
         min_width: int = 64,
         max_width: int = 4096,
         donate: Optional[bool] = None,
+        loader=None,
     ):
         if mesh is None:
             mesh = make_mesh(n_shards=n_shards, n_regions=n_regions)
@@ -179,6 +180,7 @@ class ShardedEngine:
         self.min_width = min_width
         self.max_width = min(max_width, capacity_per_shard)
         self._lock = threading.Lock()
+        self.loader = loader
 
         # ---- GLOBAL-behavior host state --------------------------------
         self.global_capacity = global_capacity
@@ -207,6 +209,9 @@ class ShardedEngine:
 
         for s in EngineStats.STAGES:
             self.stats[f"{s}_ns"] = 0
+
+        if loader is not None:
+            self.load_snapshot(loader.load())
 
     # ------------------------------------------------------------------ API
 
@@ -238,6 +243,81 @@ class ShardedEngine:
 
     def owner_of(self, key: str) -> int:
         return shard_of_key(key, self.plan.n_owners)
+
+    # ------------------------------------------------------- persistence SPI
+
+    def snapshot(self, include_expired: bool = False):
+        """Dump live rows across every shard (single-process meshes; a
+        multi-host group snapshots per host, each daemon owning its local
+        shards). Mirrors Engine.snapshot (reference: gubernator.go:86-105)."""
+        from gubernator_tpu.store import BucketSnapshot
+        from gubernator_tpu.utils.interval import millisecond_now
+
+        out = []
+        now = millisecond_now()
+        with self._lock:
+            cols = [np.asarray(c) for c in self.state]  # each [R, S, C]
+            for owner, directory in enumerate(self.directories):
+                r_, s_ = self.plan.owner_coords(owner)
+                for key, slot in directory.items():
+                    algo = int(cols[0][r_, s_, slot])
+                    expire = int(cols[5][r_, s_, slot])
+                    if algo < 0:
+                        continue
+                    if not include_expired and now > expire:
+                        continue
+                    out.append(BucketSnapshot(
+                        key=key, algo=algo,
+                        limit=int(cols[1][r_, s_, slot]),
+                        remaining=int(cols[2][r_, s_, slot]),
+                        duration=int(cols[3][r_, s_, slot]),
+                        stamp=int(cols[4][r_, s_, slot]),
+                        expire_at=expire,
+                        status=int(cols[6][r_, s_, slot])))
+        return out
+
+    def load_snapshot(self, items) -> int:
+        """Seed table rows from a Loader at boot (boot-time only: columns
+        round-trip through the host). Reference: gubernator.go:75-83."""
+        items = list(items)
+        if not items:
+            return 0
+        with self._lock:
+            cols = [np.array(c) for c in self.state]  # writable host copies
+            n = 0
+            by_owner: Dict[int, list] = {}
+            for it in items:
+                by_owner.setdefault(self.owner_of(it.key), []).append(it)
+            for owner, rows in by_owner.items():
+                r_, s_ = self.plan.owner_coords(owner)
+                # chunked lookups: a snapshot larger than the (possibly
+                # resized-down) shard degrades via LRU eviction instead of
+                # tripping the directory's over-commit guard, mirroring
+                # Engine.load_snapshot
+                for start in range(0, len(rows), self.max_width):
+                    chunk = rows[start:start + self.max_width]
+                    slots, _ = self.directories[owner].lookup(
+                        [it.key for it in chunk])
+                    for it, slot in zip(chunk, slots):
+                        vals = (it.algo, it.limit, it.remaining, it.duration,
+                                it.stamp, it.expire_at, it.status)
+                        for c, v in zip(cols, vals):
+                            c[r_, s_, slot] = v
+                        n += 1
+            sharding = self.plan.state_sharding()
+            self.state = TableState(
+                *(jax.device_put(c, sharding) for c in cols))
+        return n
+
+    def close(self) -> None:
+        """Persist via the Loader, mirroring daemon shutdown
+        (reference: gubernator.go:86-105). Pending GLOBAL hit deltas are
+        flushed through one last sync first so the saved rows reflect every
+        admitted hit, not just the last broadcast."""
+        if self.loader is not None:
+            if self.global_pending_hits():
+                self.global_sync()
+            self.loader.save(self.snapshot())
 
     def get_rate_limits(
         self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None

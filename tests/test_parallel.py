@@ -236,3 +236,93 @@ def test_stage_clocks_accumulate():
     eng.get_rate_limits([_req("hot2") for _ in range(6)], now_ms=NOW)
     for stage in ("prep", "lookup", "pack", "device", "demux"):
         assert eng.stats[f"{stage}_ns"] > 0, stage
+
+
+def test_sharded_snapshot_roundtrip(tmp_path):
+    """Durable snapshots on the mesh backend: drain, save at close, resume
+    in a fresh engine (mirrors Engine's Loader lifecycle)."""
+    from gubernator_tpu.store import FileLoader
+    from gubernator_tpu.utils.interval import millisecond_now
+
+    path = str(tmp_path / "sharded.jsonl")
+    now = millisecond_now()  # snapshot() filters expiry against wall clock
+    eng = ShardedEngine(n_shards=8, capacity_per_shard=256,
+                        min_width=8, max_width=32, loader=FileLoader(path))
+    rs = eng.get_rate_limits(
+        [_req(f"sn{i}", hits=3, limit=10, duration=3_600_000)
+         for i in range(20)], now_ms=now)
+    assert all(r.remaining == 7 for r in rs)
+    eng.close()
+
+    eng2 = ShardedEngine(n_shards=8, capacity_per_shard=256,
+                         min_width=8, max_width=32, loader=FileLoader(path))
+    rs = eng2.get_rate_limits(
+        [_req(f"sn{i}", hits=1, limit=10, duration=3_600_000)
+         for i in range(20)], now_ms=now + 1000)
+    assert all(r.remaining == 6 for r in rs), [r.remaining for r in rs]
+
+
+def test_sharded_snapshot_respects_owner_routing(tmp_path):
+    """A snapshot written by an 8-shard mesh loads into a 4-shard mesh:
+    keys re-route to their new owners with state intact."""
+    from gubernator_tpu.store import FileLoader
+    from gubernator_tpu.utils.interval import millisecond_now
+
+    path = str(tmp_path / "resize.jsonl")
+    now = millisecond_now()
+    big = ShardedEngine(n_shards=8, capacity_per_shard=256,
+                        min_width=8, max_width=32, loader=FileLoader(path))
+    big.get_rate_limits([_req(f"rz{i}", hits=4, limit=10,
+                              duration=3_600_000) for i in range(12)],
+                        now_ms=now)
+    big.close()
+    small = ShardedEngine(n_shards=4, capacity_per_shard=256,
+                          min_width=8, max_width=32, loader=FileLoader(path))
+    rs = small.get_rate_limits(
+        [_req(f"rz{i}", hits=0, limit=10, duration=3_600_000)
+         for i in range(12)], now_ms=now + 500)
+    assert all(r.remaining == 6 for r in rs)
+
+
+def test_oversized_snapshot_degrades_via_eviction(tmp_path):
+    """A snapshot larger than the shard capacity must boot (oldest rows
+    evicted), not crash on the directory over-commit guard."""
+    from gubernator_tpu.store import BucketSnapshot, FileLoader
+    from gubernator_tpu.utils.interval import millisecond_now
+
+    now = millisecond_now()
+    path = str(tmp_path / "big.jsonl")
+    FileLoader(path).save([
+        BucketSnapshot(key=f"test_ov{i}", algo=0, limit=10, remaining=5,
+                       duration=3_600_000, stamp=now, expire_at=now + 3_600_000)
+        for i in range(300)  # >> 4 shards * 32 slots
+    ])
+    eng = ShardedEngine(n_shards=4, capacity_per_shard=32,
+                        min_width=8, max_width=16, loader=FileLoader(path))
+    assert sum(d.evictions for d in eng.directories) > 0
+    r = eng.get_rate_limits([_req("fresh", hits=1, limit=10)], now_ms=now)[0]
+    assert r.remaining == 9
+
+
+def test_close_flushes_pending_global_hits(tmp_path):
+    from gubernator_tpu.store import FileLoader
+    from gubernator_tpu.utils.interval import millisecond_now
+
+    now = millisecond_now()
+    path = str(tmp_path / "gflush.jsonl")
+    eng = ShardedEngine(n_shards=4, capacity_per_shard=256, min_width=8,
+                        max_width=32, loader=FileLoader(path))
+    g = lambda h, t: eng.get_rate_limits(
+        [_req("gk", hits=h, limit=100, duration=3_600_000,
+              behavior=Behavior.GLOBAL)], now_ms=t)[0]
+    g(5, now)                      # first touch: authoritative, rem 95
+    eng.global_sync(now_ms=now + 1)
+    g(10, now + 2)                 # mirror answer: delta queued
+    assert eng.global_pending_hits() == 10
+    eng.close()                    # must flush the 10 queued hits
+    eng2 = ShardedEngine(n_shards=4, capacity_per_shard=256, min_width=8,
+                         max_width=32, loader=FileLoader(path))
+    r = eng2.get_rate_limits(
+        [_req("gk", hits=0, limit=100, duration=3_600_000)],
+        now_ms=now + 1000)[0]
+    assert r.remaining == 85
