@@ -411,3 +411,47 @@ class TestGroupForwardFailure:
             assert all(r.error for r in rs), [r.error for r in rs]
         finally:
             c.stop()
+
+
+class TestConcurrentConservation:
+    """-race-grade invariant (reference runs its suite under go test -race,
+    Makefile:7-8): under concurrent hammering from every node, a key must
+    admit EXACTLY its limit — no lost updates (under-admission beyond
+    rejects) and no mutex-bypass double-admission."""
+
+    def test_exact_admission_under_concurrency(self, cluster):
+        import threading
+
+        keys = [f"cons{i}" for i in range(4)]
+        LIMIT, THREADS, PER = 30, 8, 25  # 200 hits/key vs limit 30
+        admitted = {k: 0 for k in keys}
+        errors = []
+        lock = threading.Lock()
+
+        def worker(t):
+            stub = dial_v1(cluster.instances[t % 4].address)
+            for i in range(PER):
+                for k in keys:
+                    try:
+                        r = stub.GetRateLimits(pb.GetRateLimitsReq(requests=[
+                            _req(k, hits=1, limit=LIMIT, duration=3_600_000)
+                        ]), timeout=15).responses[0]
+                    except Exception as e:  # noqa: BLE001 — surface, don't die
+                        with lock:
+                            errors.append(repr(e))
+                        continue
+                    with lock:
+                        if r.error:
+                            errors.append(r.error)
+                        elif r.status == 0:
+                            admitted[k] += 1
+
+        threads = [threading.Thread(target=worker, args=(t,))
+                   for t in range(THREADS)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in threads), "a worker hung"
+        assert not errors, errors[:3]
+        assert admitted == {k: LIMIT for k in keys}, admitted
