@@ -1,0 +1,119 @@
+"""Pallas ICI ring collectives.
+
+The GLOBAL sync's two information flows (parallel/global_sync.py) are both
+all-reduce-sums of small int64 vectors: per-device hit deltas, and the
+owner-masked response columns whose sum IS the broadcast (non-owners
+contribute zeros). XLA lowers `psum` to its own collective schedule; this
+module provides the same reduction as an explicit Pallas ring — a
+rotate-and-accumulate: each device starts its own value around the ring,
+and on every hop forwards the value it just RECEIVED to its right
+neighbour over ICI RDMA (`pltpu.make_async_remote_copy`) while adding it
+to a local accumulator, so after N-1 hops every device has seen (and
+summed) every other device's original value.
+
+For the ~8 KB payloads GLOBAL sync moves, XLA's psum is already optimal and
+remains the default (DESIGN.md "Why the decide kernel is XLA, not Pallas" —
+same reasoning); the ring exists as the hand-scheduled ICI path for
+payloads/topologies where XLA's choice is wrong, and as the compiled
+building block a future in-kernel hot-key broadcast would extend. It runs
+under Pallas TPU interpret mode on the CPU test mesh (tests/test_ring.py
+holds it bit-equal to psum) and compiles for real ICI on TPU.
+
+Reference contrast: the equivalent data movement in the reference is the
+GLOBAL gRPC fan-in + fan-out (global.go:116-156, 219-236) — O(peers) unary
+RPCs per window instead of one ring pass.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+I32 = jnp.int32
+
+
+def _ring_kernel(n_devices: int, axis_name: str, mesh_axes,
+                 local_ref, out_ref, comm_ref, acc_ref, send_sem, recv_sem):
+    """All-reduce-sum around a 1-D ring over mesh axis `axis_name`.
+
+    comm_ref is a 2-slot VMEM double buffer: slot `step % 2` holds the value
+    being forwarded this hop, the RDMA lands the neighbour's value in slot
+    `(step + 1) % 2`. acc_ref accumulates everything seen. `mesh_axes` is
+    the full axis-name tuple of the enclosing shard_map's mesh — MESH
+    addressing takes one coordinate per axis, and non-ring axes keep the
+    sender's own coordinate."""
+    my_id = jax.lax.axis_index(axis_name).astype(I32)
+    n = jnp.int32(n_devices)
+    acc_ref[...] = local_ref[...]
+    comm_ref[0] = local_ref[...]
+    for step in range(n_devices - 1):
+        dst = jax.lax.rem(my_id + jnp.int32(1), n)
+        if len(mesh_axes) == 1:
+            # LOGICAL scalar addressing — the only form the CPU interpreter
+            # supports (jax dma_start discharge handles 1 named axis only)
+            device_id, id_type = dst, pltpu.DeviceIdType.LOGICAL
+        else:
+            # compiled Mosaic accepts per-axis MESH coordinates
+            device_id = tuple(
+                dst if a == axis_name else jax.lax.axis_index(a).astype(I32)
+                for a in mesh_axes
+            )
+            id_type = pltpu.DeviceIdType.MESH
+        send_slot, recv_slot = step % 2, (step + 1) % 2
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_ref.at[send_slot],
+            dst_ref=comm_ref.at[recv_slot],
+            send_sem=send_sem.at[send_slot],
+            recv_sem=recv_sem.at[recv_slot],
+            device_id=device_id,
+            device_id_type=id_type,
+        )
+        rdma.start()
+        rdma.wait()
+        acc_ref[...] = acc_ref[...] + comm_ref[recv_slot]
+    out_ref[...] = acc_ref[...]
+
+
+def make_ring_all_reduce(n_devices: int, length: int, dtype=jnp.int64,
+                         axis_name: str = "shard",
+                         mesh_axes=None,
+                         interpret: bool = None,
+                         collective_id: int = 0):
+    """fn(x: dtype[length]) -> dtype[length], for use INSIDE a shard_map
+    whose mesh includes axis `axis_name` of n_devices. Sums every device's
+    x around the ring; other mesh axes (`mesh_axes` lists the full axis
+    order, default just the ring axis) stay at the caller's coordinate.
+
+    `interpret` defaults to True off-TPU (the CPU test mesh) and False on
+    TPU, where the kernel compiles to real ICI RDMAs. `collective_id`
+    names the barrier-semaphore group: rings that may execute CONCURRENTLY
+    in one program (no data dependence between them) must use distinct ids
+    or they consume each other's semaphore signals.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    kernel = functools.partial(
+        _ring_kernel, n_devices, axis_name,
+        tuple(mesh_axes) if mesh_axes is not None else (axis_name,))
+
+    def ring(x):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((length,), dtype),
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pl.ANY),
+            scratch_shapes=[
+                pltpu.VMEM((2, length), dtype),
+                pltpu.VMEM((length,), dtype),
+                pltpu.SemaphoreType.DMA((2,)),
+                pltpu.SemaphoreType.DMA((2,)),
+            ],
+            interpret=interpret,
+            compiler_params=pltpu.CompilerParams(collective_id=collective_id),
+        )(x)
+
+    return ring

@@ -159,6 +159,7 @@ class ShardedEngine:
         max_width: int = 4096,
         donate: Optional[bool] = None,
         loader=None,
+        collectives: str = "psum",
     ):
         if mesh is None:
             mesh = make_mesh(n_shards=n_shards, n_regions=n_regions)
@@ -170,7 +171,8 @@ class ShardedEngine:
         self.state = make_sharded_table(self.plan)
         self._decide = make_decide_sharded(self.plan, donate=donate)
         self._decide_scan = make_decide_sharded_scan(self.plan, donate=donate)
-        self._sync = make_global_sync(self.plan, donate=donate)
+        self._sync = make_global_sync(self.plan, donate=donate,
+                                      collectives=collectives)
         from gubernator_tpu.native import make_key_directory
 
         self.directories = [
