@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast bench bench-suite proto docker clean
+.PHONY: test test-fast bench bench-suite soak proto docker clean
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -15,6 +15,10 @@ bench:
 
 bench-suite:
 	python scripts/bench_suite.py
+
+# 30s fault-injection soak: kill/restart chaos under load, invariant-judged
+soak:
+	PYTHONPATH=. python scripts/soak.py
 
 proto:
 	bash scripts/genproto.sh

@@ -102,6 +102,16 @@ _channel_lock = threading.Lock()
 _channels: "OrderedDict[str, grpc.Channel]" = OrderedDict()
 _CHANNEL_CACHE_MAX = 64
 
+# Bounded reconnect backoff: grpc's default exponential backoff can sit in
+# TRANSIENT_FAILURE for many seconds after a peer restarts on the same
+# address; elastic recovery (kill/restart fault injection, rolling deploys)
+# wants reconnects within ~1 s of the listener returning.
+CHANNEL_OPTIONS = [
+    ("grpc.initial_reconnect_backoff_ms", 100),
+    ("grpc.min_reconnect_backoff_ms", 100),
+    ("grpc.max_reconnect_backoff_ms", 1000),
+]
+
 
 def dial_v1(address: str) -> V1Stub:
     """Connect to a server, returning a ready V1 stub
@@ -113,7 +123,7 @@ def dial_v1(address: str) -> V1Stub:
     with _channel_lock:
         ch = _channels.get(address)
         if ch is None:
-            ch = grpc.insecure_channel(address)
+            ch = grpc.insecure_channel(address, options=CHANNEL_OPTIONS)
             _channels[address] = ch
             while len(_channels) > _CHANNEL_CACHE_MAX:
                 # drop the reference but do NOT close: a live V1Stub may

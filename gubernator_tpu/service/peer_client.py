@@ -21,7 +21,7 @@ import grpc
 
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.convert import req_to_pb, resp_from_pb
-from gubernator_tpu.service.grpc_api import PeersV1Stub
+from gubernator_tpu.service.grpc_api import CHANNEL_OPTIONS, PeersV1Stub
 from gubernator_tpu.service.pb import peers_pb2 as peers_pb
 from gubernator_tpu.types import Behavior, PeerInfo, RateLimitReq, RateLimitResp, has_behavior
 from gubernator_tpu.utils.lru import CacheItem, LRUCache
@@ -63,7 +63,11 @@ class PeerClient:
                     # (reference: peer_client.go:127-133), never a raw
                     # closed-channel error.
                     raise PeerNotReadyError(self.info.address)
-                self._channel = grpc.insecure_channel(self.info.address)
+                # bounded reconnect backoff: a peer restarting on the same
+                # address must be forwardable-to within ~1 s, not after
+                # grpc's default multi-second exponential backoff
+                self._channel = grpc.insecure_channel(
+                    self.info.address, options=CHANNEL_OPTIONS)
                 self._stub = PeersV1Stub(self._channel)
                 self._thread = threading.Thread(
                     target=self._run, name=f"peer-batch-{self.info.address}",

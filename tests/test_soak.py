@@ -1,0 +1,36 @@
+"""Fault-injection soak smoke run (the full harness is scripts/soak.py).
+
+Continuous kill/restart chaos under concurrent load, judged on invariants:
+admissions never exceed the limit within a bucket epoch, and traffic goes
+fully clean after the last restart (SURVEY §5.3 elastic-recovery story,
+extending the reference's one-shot TestHealthCheck fault test,
+functional_test.go:507-569)."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_soak_invariants_hold():
+    env = dict(os.environ, PYTHONPATH=REPO)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "soak.py"),
+         "--seconds", "8", "--chaos-period", "2", "--nodes", "3",
+         "--threads", "4"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=240,
+    )
+    result = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("{"):
+            d = json.loads(line)
+            if d.get("phase") == "result":
+                result = d
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    assert result is not None
+    assert result["ok"] is True
+    assert result["admission_violations"] == []
+    assert result["errors_after_chaos"] == 0
+    assert result["total_decisions"] > 100
