@@ -62,8 +62,10 @@ def build_backend(conf: DaemonConfig):
             min_width=conf.min_batch_width,
             max_width=conf.max_batch_width,
             loader=_make_loader(conf),
+            collectives=conf.collectives,
         )
-        log.info("backend: sharded over %d devices, %d slots/shard", n_dev, cap)
+        log.info("backend: sharded over %d devices, %d slots/shard (%s)",
+                 n_dev, cap, conf.collectives)
         return eng
     from gubernator_tpu.models.engine import Engine
 

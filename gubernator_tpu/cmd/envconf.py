@@ -109,6 +109,10 @@ class DaemonConfig:
     # port, and a dir for a capture spanning the daemon's lifetime
     profile_port: int = 0
     profile_dir: str = ""
+    # GLOBAL-sync collective implementation for the sharded backend:
+    # "psum" (XLA, default) or "ring" (Pallas ICI ring — TPU-compiled only,
+    # single-region meshes; see ops/ring.py)
+    collectives: str = "psum"
     # multi-host device process group (parallel/multihost.py); num_hosts <= 1
     # means single-host, no group formed
     coordinator_address: str = ""
@@ -167,11 +171,16 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         snapshot_path=_env_str("GUBER_SNAPSHOT_PATH"),
         profile_port=_env_int("GUBER_PROFILE_PORT", 0),
         profile_dir=_env_str("GUBER_PROFILE_DIR"),
+        collectives=_env_str("GUBER_COLLECTIVES", "psum"),
         coordinator_address=_env_str("GUBER_COORDINATOR_ADDRESS"),
         num_hosts=_env_int("GUBER_NUM_HOSTS", 1),
         host_id=_env_int("GUBER_HOST_ID", 0),
         debug=opts.debug or bool(os.environ.get("GUBER_DEBUG")),
     )
+    if conf.collectives not in ("psum", "ring"):
+        raise ValueError(
+            f"'GUBER_COLLECTIVES={conf.collectives}' is invalid; "
+            "choices are ['psum', 'ring']")
     return conf
 
 

@@ -133,3 +133,22 @@ def test_start_profiling_noop_by_default():
     from gubernator_tpu.cmd.envconf import DaemonConfig
 
     assert start_profiling(DaemonConfig()) is False
+
+
+def test_collectives_env_parsing(monkeypatch):
+    from gubernator_tpu.cmd.envconf import config_from_env
+
+    monkeypatch.setenv("GUBER_COLLECTIVES", "ring")
+    assert config_from_env([]).collectives == "ring"
+    monkeypatch.delenv("GUBER_COLLECTIVES")
+    assert config_from_env([]).collectives == "psum"
+
+
+def test_collectives_env_validation(monkeypatch):
+    import pytest
+
+    from gubernator_tpu.cmd.envconf import config_from_env
+
+    monkeypatch.setenv("GUBER_COLLECTIVES", "rings")
+    with pytest.raises(ValueError, match="GUBER_COLLECTIVES"):
+        config_from_env([])
