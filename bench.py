@@ -28,6 +28,8 @@ import time
 import numpy as np
 
 REFERENCE_BASELINE_RPS = 2_000.0  # reference production node (README.md:94-100)
+METRIC = "rate-limit decisions/sec/chip @ 10M active keys"
+UNIT = "decisions/s"
 TABLE_CAPACITY = 10_000_000  # north-star active key count (BASELINE.json)
 BATCH_WIDTH = 4_096  # one aggregated batch window
 SCAN_K = 32  # windows retired per dispatch (engine _MAX_SCAN)
@@ -35,9 +37,41 @@ N_VARIANTS = 4
 TARGET_SECONDS = 3.0
 
 
+def _init_watchdog(seconds: float = 180.0):
+    """A wedged device tunnel can hang backend init indefinitely; emit a
+    parseable failure line and exit instead of hanging the harness."""
+    import os
+    import threading
+
+    def fire():
+        print(
+            json.dumps(
+                {
+                    "metric": METRIC,
+                    "value": 0,
+                    "unit": UNIT,
+                    "vs_baseline": 0,
+                    "error": f"device backend unreachable: init exceeded "
+                             f"{seconds:.0f}s (wedged tunnel?)",
+                }
+            ),
+            flush=True,
+        )
+        os._exit(3)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main() -> None:
+    watchdog = _init_watchdog()
     import jax
     import jax.numpy as jnp
+
+    jax.devices()  # cheap reachability probe: THIS is what hangs on a
+    watchdog.cancel()  # wedged tunnel; compiles/timing may run long safely
 
     from gubernator_tpu.ops.decide import (
         decide_packed,
@@ -109,9 +143,9 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "rate-limit decisions/sec/chip @ 10M active keys",
+                "metric": METRIC,
                 "value": round(decisions_per_sec, 1),
-                "unit": "decisions/s",
+                "unit": UNIT,
                 "vs_baseline": round(decisions_per_sec / REFERENCE_BASELINE_RPS, 2),
                 "batch_width": BATCH_WIDTH,
                 "scan_k": SCAN_K,
