@@ -7,6 +7,7 @@ against the reference's eventual-consistency contract
 (reference: global.go, gubernator.go:226-247).
 """
 
+import datetime as dt
 import random
 
 import numpy as np
@@ -15,6 +16,7 @@ import pytest
 from gubernator_tpu.models.engine import Engine
 from gubernator_tpu.parallel import ShardedEngine, make_mesh, shard_of_key
 from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq, Status
+from gubernator_tpu.utils.gregorian import gregorian_expiration
 
 NOW = 1_700_000_000_000
 
@@ -326,3 +328,28 @@ def test_close_flushes_pending_global_hits(tmp_path):
         [_req("gk", hits=0, limit=100, duration=3_600_000)],
         now_ms=now + 1000)[0]
     assert r.remaining == 85
+
+
+def test_global_gregorian_combination():
+    """GLOBAL + DURATION_IS_GREGORIAN through the mesh sync: the owner must
+    apply calendar expiry (host-precomputed greg fields ride GlobalConfig)
+    and the broadcast mirror must carry the calendar reset_time."""
+    eng = ShardedEngine(n_shards=4, capacity_per_shard=256,
+                        min_width=8, max_width=32)
+    behavior = int(Behavior.GLOBAL) | int(Behavior.DURATION_IS_GREGORIAN)
+    g = lambda h: _req("gcal", hits=h, limit=100, duration=2,  # 2 = days
+                       behavior=behavior)
+    r = eng.get_rate_limits([g(5)], now_ms=NOW)[0]
+    want_reset = gregorian_expiration(
+        dt.datetime.fromtimestamp(NOW / 1000.0), 2)
+    assert r.remaining == 95
+    assert r.reset_time == want_reset
+    eng.global_sync(now_ms=NOW + 1)
+    # mirror answer after sync carries the same calendar boundary
+    r2 = eng.get_rate_limits([g(10)], now_ms=NOW + 2)[0]
+    assert r2.remaining == 85
+    assert r2.reset_time == want_reset
+    eng.global_sync(now_ms=NOW + 3)
+    r3 = eng.get_rate_limits([g(0)], now_ms=NOW + 4)[0]
+    assert r3.remaining == 85
+    assert r3.reset_time == want_reset

@@ -32,6 +32,19 @@ def _req(key, hits=1, limit=5, duration=60_000, algorithm=0, behavior=0, name="t
     )
 
 
+def _non_owner_key(ci, prefix, name="test"):
+    """First key with `prefix` NOT owned by instance `ci` — with a
+    diagnostic for the picker-collapsed-onto-self regression."""
+    for i in range(200):
+        k = f"{prefix}{i}"
+        peer = ci.instance.get_peer(f"{name}_{k}")
+        if not peer.info.is_owner:
+            return k, peer.info.address
+    raise AssertionError(
+        f"instance with {len(ci.instance.local_peers())} peers owns all 200 "
+        f"'{prefix}*' probe keys: picker claims ownership of everything")
+
+
 def _call(cluster, reqs, idx=0):
     # generous deadline: ambient CPU contention (parallel jobs on the test
     # box) can stall a cross-peer forward well past its usual ~1 ms
@@ -60,24 +73,10 @@ class TestTokenBucket:
     def test_remote_key_has_owner_metadata(self, cluster):
         """Requests through a non-owner peer carry the owner address
         (reference: gubernator.go:185-205)."""
-        # find a (caller, key) pair where the caller is not the owner
-        caller_idx, key = 0, None
-        ci = cluster.instances[caller_idx]
+        ci = cluster.instances[0]
         assert ci.instance.local_peers(), "picker lost its peers"
-        for i in range(200):
-            k = f"remote_{i}"
-            peer = ci.instance.get_peer(f"test_{k}")
-            if not peer.info.is_owner:
-                key, owner_addr = k, peer.info.address
-                break
-        # with a multi-peer ring, owning all 200 probes means the picker
-        # collapsed onto self — a bug, not a flake to skip past
-        assert key is not None, (
-            f"instance {caller_idx} with "
-            f"{len(ci.instance.local_peers())} peers owns all 200 probe "
-            "keys: picker claims ownership of everything"
-        )
-        r = _call(cluster, [_req(key)], idx=caller_idx)[0]
+        key, owner_addr = _non_owner_key(ci, "remote_")
+        r = _call(cluster, [_req(key)], idx=0)[0]
         assert r.error == ""
         assert r.metadata["owner"] == owner_addr
         assert r.remaining == 4
@@ -149,16 +148,7 @@ class TestValidation:
 class TestGlobalBehavior:
     def test_eventual_consistency(self, cluster):
         """(reference: functional_test.go:274-345)"""
-        inst0 = cluster.instances[0].instance
-        # pick a key NOT owned by instance 0
-        key, owner_addr = None, None
-        for i in range(200):
-            k = f"glob_{i}"
-            peer = inst0.get_peer(f"test_{k}")
-            if not peer.info.is_owner:
-                key, owner_addr = k, peer.info.address
-                break
-        assert key is not None
+        key, owner_addr = _non_owner_key(cluster.instances[0], "glob_")
         g = lambda h: _req(key, hits=h, limit=100, behavior=Behavior.GLOBAL)
 
         # first touch through the non-owner: relayed to owner
@@ -455,3 +445,25 @@ class TestConcurrentConservation:
         assert not any(t.is_alive() for t in threads), "a worker hung"
         assert not errors, errors[:3]
         assert admitted == {k: LIMIT for k in keys}, admitted
+
+
+class TestGlobalGregorian:
+    def test_global_gregorian_through_cluster(self, cluster):
+        """GLOBAL + DURATION_IS_GREGORIAN across the host tier: the owner
+        applies calendar expiry and broadcasts it; non-owner mirror answers
+        carry the calendar reset_time."""
+        key, _ = _non_owner_key(cluster.instances[0], "gg")
+        behavior = int(Behavior.GLOBAL) | int(Behavior.DURATION_IS_GREGORIAN)
+        g = lambda h: _req(key, hits=h, limit=100, duration=2,
+                           behavior=behavior)
+        before = time.time() * 1000
+        r = _call(cluster, [g(5)], idx=0)[0]
+        assert r.error == "" and r.remaining == 95
+        # reset is the next local day boundary after the server's stamp
+        # (end of day, within 24h of now)
+        assert before < r.reset_time <= before + 24 * 3600 * 1000 + 1000
+        time.sleep(0.4)  # broadcast window
+        r2 = _call(cluster, [g(10)], idx=0)[0]
+        assert r2.remaining == 85
+        # the broadcast mirror carries the SAME calendar boundary
+        assert r2.reset_time == r.reset_time
