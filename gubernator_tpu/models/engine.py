@@ -44,6 +44,7 @@ from gubernator_tpu.ops.decide import (
     decide_scan_packed,
     make_table,
     pack_window,
+    pad_to_drop,
 )
 from gubernator_tpu.store import BucketSnapshot, Loader, Store
 from gubernator_tpu.types import RateLimitReq, RateLimitResp
@@ -53,6 +54,7 @@ from gubernator_tpu.utils.interval import millisecond_now
 def _inject_rows(state: TableState, slot, algo, limit, remaining, duration,
                  stamp, expire_at, status) -> TableState:
     """Scatter host-provided rows into the table (store read-through/loader)."""
+    slot = pad_to_drop(slot, state.algo.shape[0])
     return TableState(
         algo=state.algo.at[slot].set(algo, mode="drop"),
         limit=state.limit.at[slot].set(limit, mode="drop"),

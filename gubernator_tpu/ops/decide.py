@@ -113,6 +113,15 @@ def _sel(default: jax.Array, *pairs) -> jax.Array:
     return out
 
 
+def pad_to_drop(slot: jax.Array, capacity: int) -> jax.Array:
+    """Remap -1 padding lanes PAST capacity so scatter mode="drop" discards
+    them: drop only drops out-of-range-high indices — negatives wrap
+    NumPy-style, so a raw -1 lane would scatter into the LAST slot and
+    clobber whatever bucket lives there once the table fills. Every scatter
+    of host-routed slots must go through this."""
+    return jnp.where(slot < 0, capacity, slot)
+
+
 def decide(state: TableState, reqs: ReqBatch, now_ms: jax.Array) -> Tuple[TableState, RespBatch]:
     """Apply one collision-free batch of requests to the table.
 
@@ -243,14 +252,15 @@ def decide(state: TableState, reqs: ReqBatch, now_ms: jax.Array) -> Tuple[TableS
         (tok_miss | leak_miss, UNDER),
     )
 
+    sslot = pad_to_drop(slot, state.algo.shape[0])
     new_state = TableState(
-        algo=state.algo.at[slot].set(n_algo, mode="drop"),
-        limit=state.limit.at[slot].set(n_limit, mode="drop"),
-        remaining=state.remaining.at[slot].set(n_rem, mode="drop"),
-        duration=state.duration.at[slot].set(n_dur, mode="drop"),
-        stamp=state.stamp.at[slot].set(n_stamp, mode="drop"),
-        expire_at=state.expire_at.at[slot].set(n_exp, mode="drop"),
-        status=state.status.at[slot].set(n_status, mode="drop"),
+        algo=state.algo.at[sslot].set(n_algo, mode="drop"),
+        limit=state.limit.at[sslot].set(n_limit, mode="drop"),
+        remaining=state.remaining.at[sslot].set(n_rem, mode="drop"),
+        duration=state.duration.at[sslot].set(n_dur, mode="drop"),
+        stamp=state.stamp.at[sslot].set(n_stamp, mode="drop"),
+        expire_at=state.expire_at.at[sslot].set(n_exp, mode="drop"),
+        status=state.status.at[sslot].set(n_status, mode="drop"),
     )
 
     # ---------------- select response --------------------------------------

@@ -133,6 +133,76 @@ def make_decide_sharded_scan(plan: MeshPlan, donate: bool = False):
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
 
+def make_gather_sharded(plan: MeshPlan):
+    """Row gather over the mesh, for the Store hooks and snapshot deltas.
+
+    fn(state [R,S,C], slot i32[R,S,W]) -> rows i64[R,S,7,W]: each chip reads
+    its own slot lanes (lanes with slot -1 return garbage the caller must
+    mask on `algo < 0` / its own bookkeeping). One staging buffer back, like
+    the decide kernels — the host tier's cost is off-chip round trips.
+    Row order is TableState field order; make_inject_sharded mirrors it.
+    """
+    from gubernator_tpu.ops.decide import I64 as _I64
+
+    spec_state = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_slot = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_out = P(REGION_AXIS, SHARD_AXIS, None, None)
+
+    def _step(state: TableState, slot: jax.Array):
+        local = TableState(*(c.reshape(c.shape[-1:]) for c in state))
+        g = jnp.maximum(slot.reshape(slot.shape[-1:]), 0)
+        rows = jnp.stack([
+            local.algo[g].astype(_I64),
+            local.limit[g],
+            local.remaining[g],
+            local.duration[g],
+            local.stamp[g],
+            local.expire_at[g],
+            local.status[g].astype(_I64),
+        ])
+        return rows.reshape(1, 1, *rows.shape)
+
+    mapped = jax.shard_map(
+        _step, mesh=plan.mesh,
+        in_specs=(spec_state, spec_slot), out_specs=spec_out,
+    )
+    return jax.jit(mapped)
+
+
+def make_inject_sharded(plan: MeshPlan, donate: bool = False):
+    """Row scatter over the mesh: the Store read-through's injection path.
+
+    fn(state [R,S,C], slot i32[R,S,W], rows i64[R,S,7,W]) -> state; lanes
+    with slot -1 are dropped. Mirrors models/engine.py _inject_rows for the
+    single-table engine (reference: algorithms.go:26-33 read-through)."""
+    from gubernator_tpu.ops.decide import I32 as _I32, pad_to_drop
+
+    spec_state = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_slot = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_rows = P(REGION_AXIS, SHARD_AXIS, None, None)
+
+    def _step(state: TableState, slot: jax.Array, rows: jax.Array):
+        local = TableState(*(c.reshape(c.shape[-1:]) for c in state))
+        s = pad_to_drop(slot.reshape(slot.shape[-1:]), local.algo.shape[0])
+        r = rows.reshape(rows.shape[-2:])
+        new = TableState(
+            algo=local.algo.at[s].set(r[0].astype(_I32), mode="drop"),
+            limit=local.limit.at[s].set(r[1], mode="drop"),
+            remaining=local.remaining.at[s].set(r[2], mode="drop"),
+            duration=local.duration.at[s].set(r[3], mode="drop"),
+            stamp=local.stamp.at[s].set(r[4], mode="drop"),
+            expire_at=local.expire_at.at[s].set(r[5], mode="drop"),
+            status=local.status.at[s].set(r[6].astype(_I32), mode="drop"),
+        )
+        return TableState(*(c.reshape(1, 1, -1) for c in new))
+
+    mapped = jax.shard_map(
+        _step, mesh=plan.mesh,
+        in_specs=(spec_state, spec_slot, spec_rows), out_specs=spec_state,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
 class _GlobalEntry:
     """Host record for one registered global key."""
 
@@ -159,6 +229,7 @@ class ShardedEngine:
         max_width: int = 4096,
         donate: Optional[bool] = None,
         loader=None,
+        store=None,
         collectives: str = "psum",
     ):
         if mesh is None:
@@ -173,6 +244,10 @@ class ShardedEngine:
         self._decide_scan = make_decide_sharded_scan(self.plan, donate=donate)
         self._sync = make_global_sync(self.plan, donate=donate,
                                       collectives=collectives)
+        self.store = store
+        if store is not None:
+            self._gather = make_gather_sharded(self.plan)
+            self._inject = make_inject_sharded(self.plan, donate=donate)
         from gubernator_tpu.native import make_key_directory
 
         self.directories = [
@@ -205,8 +280,7 @@ class ShardedEngine:
             "global_mirror_answers": 0,
         }
         # per-stage wall clocks, same contract as models/engine.py
-        # EngineStats (exposed as engine_stage_seconds_total in /metrics);
-        # the store stage stays 0 — ShardedEngine has no Store hook
+        # EngineStats (exposed as engine_stage_seconds_total in /metrics)
         from gubernator_tpu.models.engine import EngineStats
 
         for s in EngineStats.STAGES:
@@ -240,6 +314,44 @@ class ShardedEngine:
                 packed[:, :, :, 0, :] = -1
                 self.state, resp = self._decide_scan(self.state, packed, 0)
                 k *= 2
+            if self.store is not None:
+                # the Store path adds two gathers + an inject per window
+                # (_apply_round_store) and a gather per global sync
+                # (_store_write_global, whose width ladder is capped by
+                # global_capacity rather than max_width)
+                gather_widths = set(widths)
+                w = self.min_width
+                while w < self.global_capacity:
+                    gather_widths.add(w)
+                    w *= 2
+                gather_widths.add(
+                    bucket_width(self.global_capacity, self.min_width,
+                                 self.global_capacity))
+                for width in sorted(gather_widths):
+                    slotmat = np.full((R, S, width), -1, np.int32)
+                    resp = self._gather(self.state, slotmat)
+                    if width in widths:
+                        self.state = self._inject(
+                            self.state, slotmat,
+                            np.zeros((R, S, 7, width), np.int64))
+            # the GLOBAL sync kernel is one fixed-shape program; an
+            # explicitly empty config + zero delta exercises it as a
+            # guaranteed no-op — live host state (registered globals,
+            # pending _gdelta) must NOT feed a warmup, or re-warming a
+            # serving engine would apply queued hits here and again at the
+            # next real sync
+            G = self.global_capacity
+            z32 = np.zeros((G,), np.int32)
+            z64 = np.zeros((G,), np.int64)
+            empty_cfg = GlobalConfig(
+                slot=jnp.asarray(np.full((G,), -1, np.int32)),
+                owner=jnp.asarray(z32), limit=jnp.asarray(z64),
+                duration=jnp.asarray(z64), algorithm=jnp.asarray(z32),
+                behavior=jnp.asarray(z32), greg_expire=jnp.asarray(z64),
+                greg_interval=jnp.asarray(z64),
+                fresh=jnp.asarray(np.zeros((G,), np.bool_)))
+            self.state, _, _ = self._sync(
+                self.state, np.zeros((R, S, G), np.int64), empty_cfg, 0)
             if resp is not None:
                 jax.block_until_ready(resp)
 
@@ -314,11 +426,13 @@ class ShardedEngine:
     def close(self) -> None:
         """Persist via the Loader, mirroring daemon shutdown
         (reference: gubernator.go:86-105). Pending GLOBAL hit deltas are
-        flushed through one last sync first so the saved rows reflect every
+        flushed through one last sync first so the persisted rows — the
+        Loader snapshot AND the Store's write-through copies — reflect every
         admitted hit, not just the last broadcast."""
+        if ((self.loader is not None or self.store is not None)
+                and self.global_pending_hits()):
+            self.global_sync()
         if self.loader is not None:
-            if self.global_pending_hits():
-                self.global_sync()
             self.loader.save(self.snapshot())
 
     def get_rate_limits(
@@ -359,19 +473,27 @@ class ShardedEngine:
         if now_ms is None:
             now_ms = millisecond_now()
         with self._lock:
-            live = [e for e in self._globals.values() if e.req is not None]
+            live = [(k, e) for k, e in self._globals.items()
+                    if e.req is not None]
             if not live:
                 return 0
             cfg = self._build_global_config(now_ms)
             delta = self._place_delta()
+            # which keys actually carried hits this window, before zeroing:
+            # the Store write-through below skips unchanged keys (the
+            # reference fires OnChange only per applied hit, global.go:145)
+            touched = {int(g) for g in np.nonzero(self._gdelta)[0]}
             self.state, mirror, _ = self._sync(self.state, delta, cfg, now_ms)
             # np.array (not asarray): the host mirror must be writable for
             # optimistic deduction between syncs
             self._mirror = GlobalMirror(*(np.array(c) for c in mirror))
             self._gdelta[:] = 0
-            for e in live:
+            for _k, e in live:
                 e.seen = True
             self.stats["global_syncs"] += 1
+            if self.store is not None and touched:
+                self._store_write_global(
+                    [(k, e) for k, e in live if e.gidx in touched], cfg)
             return len(live)
 
     def global_pending_hits(self) -> int:
@@ -436,8 +558,10 @@ class ShardedEngine:
 
         Round sizes only shrink, so the small duplicate-key rounds the scan
         path exists for always trail the list; wide windows keep the
-        per-round path (already one amortized dispatch)."""
-        if len(windows) <= 1:
+        per-round path (already one amortized dispatch). The Store hooks are
+        per-round host calls, so a store disables the fast path, exactly as
+        in models/engine.py."""
+        if self.store is not None or len(windows) <= 1:
             return windows, []
         split = len(windows)
         while split > 0 and len(windows[split - 1]) <= self.min_width:
@@ -455,16 +579,23 @@ class ShardedEngine:
             lanes[self.owner_of(item[1].hash_key())].append(item)
         return lanes
 
-    def _pack_lanes(self, lanes, w: int, packed, placed, k: Optional[int]):
+    def _pack_lanes(self, lanes, w: int, packed, placed, k: Optional[int],
+                    pre=None):
         """Fill one window's [R,S,9,w] slice (packed[..., k, :, :] when k is
-        given) and record (resp idx, r, s, k, lane) demux coordinates."""
+        given) and record (resp idx, r, s, k, lane) demux coordinates.
+
+        `pre`, when given, maps owner -> (slots, fresh) already resolved by
+        the caller (the Store path looks keys up before read-through)."""
         for owner, items in enumerate(lanes):
             if not items:
                 continue
             r_, s_ = self.plan.owner_coords(owner)
             t = time.perf_counter_ns()
-            keys = [it[1].hash_key() for it in items]
-            slots, fresh = self.directories[owner].lookup(keys)
+            if pre is None:
+                keys = [it[1].hash_key() for it in items]
+                slots, fresh = self.directories[owner].lookup(keys)
+            else:
+                slots, fresh = pre[owner]
             t2 = time.perf_counter_ns()
             self.stats["lookup_ns"] += t2 - t
             dst = packed[r_, s_] if k is None else packed[r_, s_, k]
@@ -472,6 +603,38 @@ class ShardedEngine:
             self.stats["pack_ns"] += time.perf_counter_ns() - t2
             for lane, item in enumerate(items):
                 placed.append((item[0], r_, s_, k, lane))
+
+    def _demux(self, out, placed, responses) -> None:
+        """Demux one readback buffer into responses.
+
+        `placed` rows are (resp idx, r, s, k, lane); k is None outside the
+        scan path. Response row order is decide_packed's output contract."""
+        for i, r_, s_, k, lane in placed:
+            row = out[r_, s_] if k is None else out[r_, s_, k]
+            st = int(row[0, lane])
+            if st == Status.OVER_LIMIT:
+                self.stats["over_limit"] += 1
+            responses[i] = RateLimitResp(
+                status=st,
+                limit=int(row[1, lane]),
+                remaining=int(row[2, lane]),
+                reset_time=int(row[3, lane]),
+            )
+
+    @staticmethod
+    def _row_snapshot(rows, r_: int, s_: int, j: int, key: str):
+        """One gathered-rows lane ([R,S,7,W] buffer, make_gather_sharded's
+        row order = TableState field order) as a host BucketSnapshot."""
+        from gubernator_tpu.store import BucketSnapshot
+
+        return BucketSnapshot(
+            key=key, algo=int(rows[r_, s_, 0, j]),
+            limit=int(rows[r_, s_, 1, j]),
+            remaining=int(rows[r_, s_, 2, j]),
+            duration=int(rows[r_, s_, 3, j]),
+            stamp=int(rows[r_, s_, 4, j]),
+            expire_at=int(rows[r_, s_, 5, j]),
+            status=int(rows[r_, s_, 6, j]))
 
     def _apply_rounds_scanned(self, windows, now_ms, responses) -> None:
         """Retire every scannable window in ⌈N/32⌉ mesh dispatches.
@@ -500,19 +663,12 @@ class ShardedEngine:
             out = np.asarray(out)
             t2 = time.perf_counter_ns()
             self.stats["device_ns"] += t2 - t
-            for i, r_, s_, k, lane in placed:
-                st = int(out[r_, s_, k, 0, lane])
-                if st == Status.OVER_LIMIT:
-                    self.stats["over_limit"] += 1
-                responses[i] = RateLimitResp(
-                    status=st,
-                    limit=int(out[r_, s_, k, 1, lane]),
-                    remaining=int(out[r_, s_, k, 2, lane]),
-                    reset_time=int(out[r_, s_, k, 3, lane]),
-                )
+            self._demux(out, placed, responses)
             self.stats["demux_ns"] += time.perf_counter_ns() - t2
 
     def _apply_round(self, round_work: List[WorkItem], now_ms, responses) -> None:
+        if self.store is not None:
+            return self._apply_round_store(round_work, now_ms, responses)
         R, S = self.plan.n_regions, self.plan.n_shards
         lanes = self._route_lanes(round_work)
         w = bucket_width(
@@ -530,17 +686,96 @@ class ShardedEngine:
         out = np.asarray(out)
         t2 = time.perf_counter_ns()
         self.stats["device_ns"] += t2 - t
-        for i, r_, s_, _k, lane in placed:
-            st = int(out[r_, s_, 0, lane])
-            if st == Status.OVER_LIMIT:
-                self.stats["over_limit"] += 1
-            responses[i] = RateLimitResp(
-                status=st,
-                limit=int(out[r_, s_, 1, lane]),
-                remaining=int(out[r_, s_, 2, lane]),
-                reset_time=int(out[r_, s_, 3, lane]),
-            )
+        self._demux(out, placed, responses)
         self.stats["demux_ns"] += time.perf_counter_ns() - t2
+
+    def _apply_round_store(self, round_work: List[WorkItem], now_ms,
+                           responses) -> None:
+        """Store-aware round: read-through before the kernel, write-through
+        after, per owner lane. Mirrors models/engine.py
+        _store_read_through/_store_write_through (reference:
+        algorithms.go:26-33,64-68,175-177); the extra cost is two mesh row
+        gathers and at most one row inject per window — all staged through
+        single [R,S,...] buffers like the decide path itself."""
+        R, S = self.plan.n_regions, self.plan.n_shards
+        lanes = self._route_lanes(round_work)
+        w = bucket_width(
+            max(len(l) for l in lanes), self.min_width, self.max_width)
+
+        per_owner = []  # (owner, r, s, items, keys, slots, fresh)
+        slotmat = np.full((R, S, w), -1, np.int32)
+        t = time.perf_counter_ns()
+        for owner, items in enumerate(lanes):
+            if not items:
+                continue
+            r_, s_ = self.plan.owner_coords(owner)
+            keys = [it[1].hash_key() for it in items]
+            slots, fresh = self.directories[owner].lookup(keys)
+            slotmat[r_, s_, :len(slots)] = slots
+            per_owner.append((owner, r_, s_, items, keys, slots, list(fresh)))
+        self.stats["lookup_ns"] += time.perf_counter_ns() - t
+
+        # ---- read-through (reference: algorithms.go:26-33) ---------------
+        t = time.perf_counter_ns()
+        rows = np.asarray(self._gather(self.state, slotmat))  # [R,S,7,w]
+        inj_slot = np.full((R, S, w), -1, np.int32)
+        inj_rows = np.zeros((R, S, 7, w), np.int64)
+        inj_n = [0] * self.plan.n_owners
+        for owner, r_, s_, items, keys, slots, fresh in per_owner:
+            for j, (_i, r, _ge, _gi) in enumerate(items):
+                algo = int(rows[r_, s_, 0, j])
+                live = (not fresh[j] and algo >= 0
+                        and now_ms <= int(rows[r_, s_, 5, j]))
+                if live and algo != int(r.algorithm):
+                    # algorithm switch discards the old bucket everywhere
+                    # (reference: algorithms.go:54-62)
+                    self.store.remove(keys[j])
+                    live = False
+                if live:
+                    continue
+                item = self.store.get(r)
+                if item is None:
+                    continue
+                k = inj_n[owner]
+                inj_n[owner] = k + 1
+                inj_slot[r_, s_, k] = slots[j]
+                inj_rows[r_, s_, :, k] = (
+                    item.algo, item.limit, item.remaining, item.duration,
+                    item.stamp, item.expire_at, item.status)
+                fresh[j] = False  # the injected row is now live
+        if any(inj_n):
+            self.state = self._inject(self.state, inj_slot, inj_rows)
+        self.stats["store_ns"] += time.perf_counter_ns() - t
+
+        # ---- decide ------------------------------------------------------
+        packed = np.zeros((R, S, 9, w), np.int64)
+        packed[:, :, 0, :] = -1
+        placed: List[Tuple[int, int, int, Optional[int], int]] = []
+        pre = {owner: (slots, fresh)
+               for owner, _r, _s, _items, _keys, slots, fresh in per_owner}
+        self._pack_lanes(lanes, w, packed, placed, None, pre=pre)
+        t2 = time.perf_counter_ns()
+        self.state, out = self._decide(self.state, packed, now_ms)
+        out = np.asarray(out)
+        t3 = time.perf_counter_ns()
+        self.stats["device_ns"] += t3 - t2
+        self._demux(out, placed, responses)
+        self.stats["demux_ns"] += time.perf_counter_ns() - t3
+
+        # ---- write-through (reference: algorithms.go:64-68,175-177) ------
+        t = time.perf_counter_ns()
+        rows = np.asarray(self._gather(self.state, slotmat))
+        for owner, r_, s_, items, keys, slots, fresh in per_owner:
+            for j, (_i, r, _ge, _gi) in enumerate(items):
+                if int(rows[r_, s_, 0, j]) < 0:
+                    # token RESET_REMAINING cleared the row
+                    # (reference: algorithms.go:37-39)
+                    self.store.remove(keys[j])
+                    self.directories[owner].drop(keys[j])
+                    continue
+                self.store.on_change(
+                    r, self._row_snapshot(rows, r_, s_, j, keys[j]))
+        self.stats["store_ns"] += time.perf_counter_ns() - t
 
     def _build_global_config(self, now_ms: int) -> GlobalConfig:
         import datetime as _dt
@@ -592,6 +827,40 @@ class ShardedEngine:
             greg_interval=jnp.asarray(greg_interval),
             fresh=jnp.asarray(fresh),
         )
+
+    def _store_write_global(self, live, cfg: GlobalConfig) -> None:
+        """Write-through the rows a GLOBAL sync just rewrote.
+
+        In the reference every hit an owner applies goes through getRateLimit
+        and so fires Store.OnChange (algorithms.go:64-68 via global.go:145);
+        here the sync applies aggregated deltas on device, so the hooks fire
+        once per synced key per window — same persisted state, fewer calls."""
+        R, S = self.plan.n_regions, self.plan.n_shards
+        slot_np = np.asarray(cfg.slot)
+        owner_np = np.asarray(cfg.owner)
+        lanes = [0] * self.plan.n_owners
+        placed = []  # (key, req, r, s, lane)
+        width = bucket_width(
+            max(1, len(live)), self.min_width, self.global_capacity)
+        slotmat = np.full((R, S, width), -1, np.int32)
+        for key, e in live:
+            g = e.gidx
+            if slot_np[g] < 0:
+                continue
+            r_, s_ = self.plan.owner_coords(int(owner_np[g]))
+            k = lanes[int(owner_np[g])]
+            lanes[int(owner_np[g])] = k + 1
+            slotmat[r_, s_, k] = slot_np[g]
+            placed.append((key, e.req, r_, s_, k))
+        if not placed:
+            return
+        t = time.perf_counter_ns()
+        rows = np.asarray(self._gather(self.state, slotmat))
+        for key, req, r_, s_, k in placed:
+            if int(rows[r_, s_, 0, k]) < 0:
+                continue
+            self.store.on_change(req, self._row_snapshot(rows, r_, s_, k, key))
+        self.stats["store_ns"] += time.perf_counter_ns() - t
 
     def _place_delta(self) -> jax.Array:
         """This host's deltas enter the mesh on device (0, 0); psum makes

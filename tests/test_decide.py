@@ -332,6 +332,28 @@ class TestBatchMechanics:
         assert int(state.algo[1]) == -1  # untouched
         assert int(state.remaining[0]) == 9
 
+    def test_padding_never_clobbers_last_slot(self):
+        """-1 lanes must not wrap to slot capacity-1: jnp's mode="drop" only
+        drops out-of-range-high indices, negatives wrap NumPy-style. A full
+        table would otherwise lose its last bucket on every padded window."""
+        state = make_table(8)
+        # occupy the LAST slot with a live bucket
+        occupy = padded_batch(dict(
+            slot=[7], hits=[2], limit=[10], duration=[60_000],
+            algorithm=[0], behavior=[0], greg_expire=[0], greg_interval=[0],
+            fresh=[True]))
+        state, _ = _DECIDE(state, occupy, 1_000)
+        assert int(state.remaining[7]) == 8
+        # padded window touching a different slot; lanes 1-2 are padding
+        win = padded_batch(dict(
+            slot=[0, -1, -1], hits=[1, 0, 0], limit=[10, 0, 0],
+            duration=[60_000, 0, 0], algorithm=[0, 0, 0], behavior=[0, 0, 0],
+            greg_expire=[0, 0, 0], greg_interval=[0, 0, 0],
+            fresh=[True, False, False]))
+        state, _ = _DECIDE(state, win, 1_001)
+        assert int(state.algo[7]) == 0
+        assert int(state.remaining[7]) == 8  # last slot survived
+
     def test_distinct_slots_parallel(self):
         state = make_table(64)
         n = 50
