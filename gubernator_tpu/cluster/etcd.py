@@ -53,6 +53,77 @@ def _serialize(msg) -> bytes:
     return msg.SerializeToString()
 
 
+def build_tls_credentials(
+    ca_file: str = "",
+    cert_file: str = "",
+    key_file: str = "",
+    skip_verify: bool = False,
+    endpoint: str = "",
+):
+    """Channel credentials + options for TLS to etcd, mirroring the
+    reference's GUBER_ETCD_TLS_* assembly (reference: config.go:216-259).
+
+    Returns (grpc.ChannelCredentials, [channel options]). gRPC cannot
+    disable certificate-chain validation, so GUBER_ETCD_TLS_SKIP_VERIFY is
+    implemented as trust-on-first-use: the server's presented certificate
+    is fetched over a raw TLS handshake and pinned as the root CA, with the
+    target name overridden to the certificate's subject CN (deviation noted
+    in PARITY.md — same "don't verify against a configured CA" intent,
+    strictly stronger than the reference's InsecureSkipVerify because the
+    pinned certificate can't be swapped mid-session).
+    """
+    import ssl
+
+    def _read(path):
+        if not path:
+            return None
+        with open(path, "rb") as f:
+            return f.read()
+
+    root = _read(ca_file)
+    options = []
+    if skip_verify and endpoint:
+        pem = ssl.get_server_certificate(host_port(endpoint))
+        root = pem.encode()
+        cn = _cert_common_name(pem)
+        if cn:
+            options.append(("grpc.ssl_target_name_override", cn))
+    creds = grpc.ssl_channel_credentials(
+        root_certificates=root,
+        private_key=_read(key_file),
+        certificate_chain=_read(cert_file),
+    )
+    return creds, options
+
+
+def host_port(endpoint: str, default_port: int = 2379):
+    """Split host:port, defaulting the port like etcd clients do."""
+    if ":" in endpoint:
+        host, _, port = endpoint.rpartition(":")
+        return host, int(port)
+    return endpoint, default_port
+
+
+def _cert_common_name(pem: str) -> Optional[str]:
+    """Subject CN of a PEM certificate, via the stdlib's decoder (no
+    third-party x509 parser in the image); None when undecodable."""
+    import ssl
+    import tempfile
+
+    try:
+        with tempfile.NamedTemporaryFile("w", suffix=".pem") as f:
+            f.write(pem)
+            f.flush()
+            info = ssl._ssl._test_decode_cert(f.name)  # noqa: SLF001
+        for rdn in info.get("subject", ()):
+            for k, v in rdn:
+                if k == "commonName":
+                    return v
+    except Exception:  # noqa: BLE001
+        log.warning("could not decode server certificate CN", exc_info=True)
+    return None
+
+
 class EtcdClient:
     """Thin generic-stub client for the KV/Lease/Watch services."""
 
@@ -87,6 +158,11 @@ class EtcdClient:
             "/etcdserverpb.Lease/LeaseKeepAlive",
             request_serializer=_serialize,
             response_deserializer=epb.LeaseKeepAliveResponse.FromString,
+        )
+        self.authenticate = channel.unary_unary(
+            "/etcdserverpb.Auth/Authenticate",
+            request_serializer=_serialize,
+            response_deserializer=epb.AuthenticateResponse.FromString,
         )
         self.watch = channel.stream_stream(
             "/etcdserverpb.Watch/Watch",
@@ -131,8 +207,13 @@ class EtcdPool:
         lease_ttl_s: int = LEASE_TTL_S,
         backoff_s: float = BACKOFF_S,
         timeout_s: float = ETCD_TIMEOUT_S,
+        dial_timeout_s: Optional[float] = None,
         channel: Optional[grpc.Channel] = None,
         credentials: Optional[grpc.ChannelCredentials] = None,
+        channel_options: Sequence = (),
+        credentials_factory: Optional[Callable] = None,
+        username: str = "",
+        password: str = "",
     ):
         if not advertise_address:
             raise ValueError(
@@ -143,8 +224,40 @@ class EtcdPool:
         self._endpoints = list(endpoints)
         self._endpoint_idx = 0
         self._credentials = credentials
+        self._channel_options = list(channel_options)
+        # per-target credentials (skip-verify pinning must fetch each
+        # endpoint's own certificate, not reuse endpoints[0]'s)
+        self._credentials_factory = credentials_factory
+        # etcd user/password auth (reference: GUBER_ETCD_USER/PASSWORD fed
+        # to clientv3, cmd/gubernator/config.go:122-123): Authenticate
+        # issues a token carried as "token" metadata; a token invalidated
+        # server-side is re-acquired lazily (_meta)
+        self._username = username
+        self._password = password
+        self._auth_token: Optional[str] = None
         if channel is None:
-            channel = self._dial(self._endpoints[0])
+            # GUBER_ETCD_DIAL_TIMEOUT analogue (reference: config.go:121,
+            # clientv3 DialTimeout spans all endpoints): try each endpoint
+            # until one dials (and, when a timeout is set, becomes ready)
+            last_err: Optional[BaseException] = None
+            for _ in range(max(len(self._endpoints), 1)):
+                target = self._endpoints[self._endpoint_idx]
+                try:
+                    channel = self._dial(target)
+                    if dial_timeout_s:
+                        grpc.channel_ready_future(channel).result(
+                            timeout=dial_timeout_s)
+                    break
+                except BaseException as e:  # noqa: BLE001 — incl. TOFU I/O
+                    log.warning("etcd endpoint %s unreachable: %s", target, e)
+                    last_err = e
+                    if channel is not None:
+                        channel.close()
+                        channel = None
+                    self._endpoint_idx = (
+                        (self._endpoint_idx + 1) % len(self._endpoints))
+            if channel is None:
+                raise last_err  # every endpoint failed
         self._own_channel = channel
         self.client = EtcdClient(channel)
         self.advertise_address = advertise_address
@@ -172,7 +285,8 @@ class EtcdPool:
             try:
                 self._register()
                 break
-            except grpc.RpcError:
+            except grpc.RpcError as e:
+                self._maybe_reauth(e)
                 if attempt + 1 >= max(len(self._endpoints), 1):
                     raise
                 self._rotate_endpoint()
@@ -188,11 +302,35 @@ class EtcdPool:
         self._ka_thread.start()
 
     def _dial(self, target: str) -> grpc.Channel:
+        creds, opts = self._credentials, self._channel_options
+        if self._credentials_factory is not None:
+            creds, opts = self._credentials_factory(target)
+        opts = opts or None
         return (
-            grpc.secure_channel(target, self._credentials)
-            if self._credentials is not None
-            else grpc.insecure_channel(target)
+            grpc.secure_channel(target, creds, options=opts)
+            if creds is not None
+            else grpc.insecure_channel(target, options=opts)
         )
+
+    def _meta(self):
+        """Per-call metadata: the auth token, acquired lazily."""
+        if not self._username:
+            return None
+        if self._auth_token is None:
+            resp = self.client.authenticate(
+                epb.AuthenticateRequest(
+                    name=self._username, password=self._password),
+                timeout=self.timeout_s,
+            )
+            self._auth_token = resp.token
+        return (("token", self._auth_token),)
+
+    def _maybe_reauth(self, e: BaseException) -> None:
+        """An UNAUTHENTICATED failure invalidates the cached token so the
+        retry path re-authenticates (etcd rotates tokens on restart)."""
+        if (isinstance(e, grpc.RpcError)
+                and e.code() == grpc.StatusCode.UNAUTHENTICATED):
+            self._auth_token = None
 
     def _rotate_endpoint(self) -> None:
         """Fail over to the next configured endpoint (clientv3 balances
@@ -205,9 +343,18 @@ class EtcdPool:
             self._endpoint_idx = (self._endpoint_idx + 1) % len(self._endpoints)
             target = self._endpoints[self._endpoint_idx]
             log.info("failing over to etcd endpoint %s", target)
+            try:
+                fresh = self._dial(target)
+            except BaseException as e:  # noqa: BLE001 — e.g. TOFU cert fetch
+                # keep the old channel; the caller's retry loop will rotate
+                # again (the index already advanced to the next endpoint)
+                log.warning("could not dial etcd endpoint %s: %s", target, e)
+                return
             old = self._own_channel
-            self._own_channel = self._dial(target)
+            self._own_channel = fresh
             self.client = EtcdClient(self._own_channel)
+            # simple tokens are per-node; re-authenticate against the new one
+            self._auth_token = None
             try:
                 old.close()
             except Exception:  # noqa: BLE001
@@ -219,7 +366,8 @@ class EtcdPool:
         """Grant lease, put our key, open the keep-alive stream
         (reference: etcd.go:229-253)."""
         grant = self.client.lease_grant(
-            epb.LeaseGrantRequest(TTL=self.lease_ttl_s), timeout=self.timeout_s
+            epb.LeaseGrantRequest(TTL=self.lease_ttl_s),
+            timeout=self.timeout_s, metadata=self._meta(),
         )
         self._lease_id = grant.ID
         self.client.put(
@@ -229,9 +377,10 @@ class EtcdPool:
                 lease=grant.ID,
             ),
             timeout=self.timeout_s,
+            metadata=self._meta(),
         )
         feed = _StreamFeed()
-        call = self.client.lease_keep_alive(iter(feed))
+        call = self.client.lease_keep_alive(iter(feed), metadata=self._meta())
         feed.send(epb.LeaseKeepAliveRequest(ID=grant.ID))
         self._ka_feed = feed
         self._ka_call = call
@@ -260,12 +409,14 @@ class EtcdPool:
                 log.warning(
                     "keep alive lost (%s), attempting to re-register peer", e
                 )
+                self._maybe_reauth(e)
                 while not self._closed.is_set():
                     try:
                         self._register()
                         break
                     except BaseException as re:  # noqa: BLE001
                         log.error("while attempting to re-register peer: %s", re)
+                        self._maybe_reauth(re)
                         if self._closed.wait(self.backoff_s):
                             return
                         self._rotate_endpoint()
@@ -281,6 +432,7 @@ class EtcdPool:
                 range_end=prefix_range_end(self.base_key.encode()),
             ),
             timeout=self.timeout_s,
+            metadata=self._meta(),
         )
         with self._peers_lock:
             self._peers = {kv.value.decode(): None for kv in resp.kvs}
@@ -289,7 +441,7 @@ class EtcdPool:
 
     def _open_watch(self, revision: int):
         feed = _StreamFeed()
-        call = self.client.watch(iter(feed))
+        call = self.client.watch(iter(feed), metadata=self._meta())
         feed.send(
             epb.WatchRequest(
                 create_request=epb.WatchCreateRequest(
@@ -345,6 +497,7 @@ class EtcdPool:
                 if self._closed.is_set():
                     return
                 log.error("watch error: %s; restarting watch", e)
+                self._maybe_reauth(e)
                 while not self._closed.is_set():
                     try:
                         revision = self._collect_peers()
@@ -352,6 +505,7 @@ class EtcdPool:
                         break
                     except BaseException as re:  # noqa: BLE001
                         log.error("while attempting to restart watch: %s", re)
+                        self._maybe_reauth(re)
                         if self._closed.wait(self.backoff_s):
                             return
                         self._rotate_endpoint()
@@ -385,11 +539,13 @@ class EtcdPool:
             self.client.delete_range(
                 epb.DeleteRangeRequest(key=self.instance_key),
                 timeout=self.timeout_s,
+                metadata=self._meta(),
             )
             if self._lease_id:
                 self.client.lease_revoke(
                     epb.LeaseRevokeRequest(ID=self._lease_id),
                     timeout=self.timeout_s,
+                    metadata=self._meta(),
                 )
         except grpc.RpcError as e:
             log.warning("during etcd deregister: %s", e)

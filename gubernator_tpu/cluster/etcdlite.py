@@ -67,7 +67,9 @@ class EtcdLite:
     the bound address is in `.address` after start()."""
 
     def __init__(self, address: str = "127.0.0.1:0",
-                 min_lease_ttl_s: float = 0.0):
+                 min_lease_ttl_s: float = 0.0,
+                 users: Optional[Dict[str, str]] = None,
+                 credentials: Optional[grpc.ServerCredentials] = None):
         self._kvs: Dict[bytes, _KV] = {}
         self._leases: Dict[int, _Lease] = {}
         self._watchers: List[_Watcher] = []
@@ -87,13 +89,20 @@ class EtcdLite:
         # test hook: when set, keep-alive streams terminate immediately and
         # grants/renewals are refused, simulating a dead etcd
         self.refuse_keepalives = False
+        # auth mirrors etcd's: Authenticate issues a token, every other RPC
+        # must carry it as "token" metadata (etcd rpc interceptor semantics)
+        self.users = dict(users) if users else {}
+        self._tokens: Dict[str, str] = {}
 
         self._server = grpc.server(
             futures.ThreadPoolExecutor(max_workers=16),
             options=[("grpc.so_reuseport", 0)],
         )
         self._server.add_generic_rpc_handlers((self._handlers(),))
-        port = self._server.add_insecure_port(address)
+        if credentials is not None:
+            port = self._server.add_secure_port(address, credentials)
+        else:
+            port = self._server.add_insecure_port(address)
         host = address.rsplit(":", 1)[0]
         self.address = f"{host}:{port}"
         self._reaper = threading.Thread(
@@ -131,22 +140,35 @@ class EtcdLite:
                 response_serializer=lambda m: m.SerializeToString(),
             )
 
+        def guarded(fn):
+            """Require a valid auth token when users are configured
+            (etcd's per-RPC auth interceptor)."""
+            def inner(req_or_it, ctx):
+                self._check_auth(ctx)
+                return fn(req_or_it, ctx)
+            return inner
+
         method_map = {
-            "/etcdserverpb.KV/Range": unary(self._range, epb.RangeRequest),
-            "/etcdserverpb.KV/Put": unary(self._put, epb.PutRequest),
+            "/etcdserverpb.KV/Range": unary(
+                guarded(self._range), epb.RangeRequest),
+            "/etcdserverpb.KV/Put": unary(
+                guarded(self._put), epb.PutRequest),
             "/etcdserverpb.KV/DeleteRange": unary(
-                self._delete_range, epb.DeleteRangeRequest
+                guarded(self._delete_range), epb.DeleteRangeRequest
             ),
             "/etcdserverpb.Lease/LeaseGrant": unary(
-                self._lease_grant, epb.LeaseGrantRequest
+                guarded(self._lease_grant), epb.LeaseGrantRequest
             ),
             "/etcdserverpb.Lease/LeaseRevoke": unary(
-                self._lease_revoke, epb.LeaseRevokeRequest
+                guarded(self._lease_revoke), epb.LeaseRevokeRequest
             ),
             "/etcdserverpb.Lease/LeaseKeepAlive": stream(
-                self._lease_keep_alive, epb.LeaseKeepAliveRequest
+                guarded(self._lease_keep_alive), epb.LeaseKeepAliveRequest
             ),
-            "/etcdserverpb.Watch/Watch": stream(self._watch, epb.WatchRequest),
+            "/etcdserverpb.Watch/Watch": stream(
+                guarded(self._watch), epb.WatchRequest),
+            "/etcdserverpb.Auth/Authenticate": unary(
+                self._authenticate, epb.AuthenticateRequest),
         }
 
         class Handler(grpc.GenericRpcHandler):
@@ -157,6 +179,30 @@ class EtcdLite:
 
     def _header(self) -> epb.ResponseHeader:
         return epb.ResponseHeader(revision=self._revision)
+
+    # ----------------------------------------------------------------- auth
+
+    def _check_auth(self, ctx) -> None:
+        if not self.users:
+            return
+        md = dict(ctx.invocation_metadata() or ())
+        if md.get("token") not in self._tokens:
+            ctx.abort(grpc.StatusCode.UNAUTHENTICATED,
+                      "etcdserver: invalid auth token")
+
+    def _authenticate(self, req: epb.AuthenticateRequest,
+                      ctx) -> epb.AuthenticateResponse:
+        import uuid
+
+        if not self.users or self.users.get(req.name) != req.password:
+            ctx.abort(
+                grpc.StatusCode.UNAUTHENTICATED,
+                "etcdserver: authentication failed, "
+                "invalid user ID or password")
+        token = uuid.uuid4().hex
+        with self._lock:
+            self._tokens[token] = req.name
+        return epb.AuthenticateResponse(header=self._header(), token=token)
 
     # ------------------------------------------------------------------- KV
 

@@ -109,18 +109,55 @@ def build_pool(conf: DaemonConfig, instance: Instance):
             pod_port=conf.k8s_pod_port or grpc_port,
         )
     if conf.gossip_bind or conf.gossip_known_nodes:
+        bind = conf.gossip_bind or "0.0.0.0"
+        if ":" not in bind:
+            # GUBER_MEMBERLIST_ADVERTISE_PORT completes a bare address
+            # (reference: config.go:126-127)
+            bind = f"{bind}:{conf.gossip_advertise_port}"
         return discovery.GossipPool(
-            bind_address=conf.gossip_bind or "0.0.0.0:7946",
+            bind_address=bind,
             grpc_address=conf.advertise_address or conf.grpc_address,
             datacenter=conf.data_center,
             known_nodes=conf.gossip_known_nodes,
             on_update=on_update,
         )
     if conf.etcd_endpoints:
+        from gubernator_tpu.cluster.etcd import build_tls_credentials
+
+        credentials, channel_options, factory = None, (), None
+        if conf.etcd_tls_enable:
+            if conf.etcd_tls_skip_verify:
+                # per-endpoint: pinning must fetch each endpoint's own cert
+                def factory(target, _conf=conf):
+                    return build_tls_credentials(
+                        ca_file=_conf.etcd_tls_ca,
+                        cert_file=_conf.etcd_tls_cert,
+                        key_file=_conf.etcd_tls_key,
+                        skip_verify=True,
+                        endpoint=target,
+                    )
+            else:
+                credentials, channel_options = build_tls_credentials(
+                    ca_file=conf.etcd_tls_ca,
+                    cert_file=conf.etcd_tls_cert,
+                    key_file=conf.etcd_tls_key,
+                )
+        kwargs = {}
+        if conf.etcd_key_prefix:
+            base = conf.etcd_key_prefix
+            kwargs["base_key"] = base if base.endswith("/") else base + "/"
         return discovery.EtcdPool(
             endpoints=conf.etcd_endpoints,
-            advertise_address=conf.advertise_address or conf.grpc_address,
+            advertise_address=(conf.etcd_advertise_address
+                               or conf.advertise_address or conf.grpc_address),
             on_update=on_update,
+            dial_timeout_s=conf.etcd_dial_timeout_s,
+            credentials=credentials,
+            channel_options=channel_options,
+            credentials_factory=factory,
+            username=conf.etcd_user,
+            password=conf.etcd_password,
+            **kwargs,
         )
     if conf.peers_file:
         return discovery.FilePool(conf.peers_file, on_update)

@@ -71,6 +71,19 @@ def _env_slice(name: str) -> List[str]:
     return [s.strip() for s in v.split(",") if s.strip()] if v else []
 
 
+def _env_bool(name: str) -> bool:
+    """Go strconv.ParseBool semantics for security-relevant flags: 'false'
+    must mean false. (The reference treats ANY non-empty
+    GUBER_ETCD_TLS_SKIP_VERIFY as true, config.go:254 — a silent inversion
+    of an explicit 'false' we don't reproduce.)"""
+    v = os.environ.get(name, "").strip().lower()
+    if v in ("", "0", "f", "false", "n", "no"):
+        return False
+    if v in ("1", "t", "true", "y", "yes"):
+        return True
+    raise ValueError(f"'{name}={v}' is not a boolean")
+
+
 @dataclasses.dataclass
 class DaemonConfig:
     """(reference: cmd/gubernator/config.go:33-65)"""
@@ -86,8 +99,21 @@ class DaemonConfig:
     peers: List[str] = dataclasses.field(default_factory=list)  # static
     peers_file: str = ""
     gossip_bind: str = ""
+    gossip_advertise_port: int = 7946
     gossip_known_nodes: List[str] = dataclasses.field(default_factory=list)
     etcd_endpoints: List[str] = dataclasses.field(default_factory=list)
+    etcd_advertise_address: str = ""  # defaults to advertise_address
+    etcd_key_prefix: str = ""  # "" -> the pool's /gubernator/peers/ default
+    etcd_dial_timeout_s: float = 5.0
+    etcd_user: str = ""
+    etcd_password: str = ""
+    # TLS to etcd (reference: config.go:203-260); enabled when any
+    # GUBER_ETCD_TLS_* variable is set
+    etcd_tls_enable: bool = False
+    etcd_tls_cert: str = ""
+    etcd_tls_key: str = ""
+    etcd_tls_ca: str = ""
+    etcd_tls_skip_verify: bool = False
     k8s_selector: str = ""
     k8s_namespace: str = ""  # empty -> in-cluster service-account namespace
     k8s_pod_ip: str = ""
@@ -156,8 +182,21 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         peers=_env_slice("GUBER_PEERS"),
         peers_file=_env_str("GUBER_PEERS_FILE"),
         gossip_bind=_env_str("GUBER_MEMBERLIST_ADVERTISE_ADDRESS"),
+        gossip_advertise_port=_env_int("GUBER_MEMBERLIST_ADVERTISE_PORT", 7946),
         gossip_known_nodes=_env_slice("GUBER_MEMBERLIST_KNOWN_NODES"),
         etcd_endpoints=_env_slice("GUBER_ETCD_ENDPOINTS"),
+        etcd_advertise_address=_env_str("GUBER_ETCD_ADVERTISE_ADDRESS"),
+        etcd_key_prefix=_env_str("GUBER_ETCD_KEY_PREFIX"),
+        etcd_dial_timeout_s=_env_dur("GUBER_ETCD_DIAL_TIMEOUT", 5.0),
+        etcd_user=_env_str("GUBER_ETCD_USER"),
+        etcd_password=_env_str("GUBER_ETCD_PASSWORD"),
+        etcd_tls_enable=any(
+            k.startswith("GUBER_ETCD_TLS_") and os.environ[k]
+            for k in os.environ),
+        etcd_tls_cert=_env_str("GUBER_ETCD_TLS_CERT"),
+        etcd_tls_key=_env_str("GUBER_ETCD_TLS_KEY"),
+        etcd_tls_ca=_env_str("GUBER_ETCD_TLS_CA"),
+        etcd_tls_skip_verify=_env_bool("GUBER_ETCD_TLS_SKIP_VERIFY"),
         k8s_selector=_env_str("GUBER_K8S_ENDPOINTS_SELECTOR"),
         k8s_namespace=_env_str("GUBER_K8S_NAMESPACE"),
         k8s_pod_ip=_env_str("GUBER_K8S_POD_IP"),

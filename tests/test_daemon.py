@@ -152,3 +152,55 @@ def test_collectives_env_validation(monkeypatch):
     monkeypatch.setenv("GUBER_COLLECTIVES", "rings")
     with pytest.raises(ValueError, match="GUBER_COLLECTIVES"):
         config_from_env([])
+
+
+def test_etcd_env_parsing(monkeypatch):
+    """Full GUBER_ETCD_* surface (reference: config.go:118-123,203-260)."""
+    from gubernator_tpu.cmd.envconf import config_from_env
+
+    monkeypatch.setenv("GUBER_ETCD_ENDPOINTS", "e1:2379,e2:2379")
+    monkeypatch.setenv("GUBER_ETCD_ADVERTISE_ADDRESS", "10.1.1.1:81")
+    monkeypatch.setenv("GUBER_ETCD_KEY_PREFIX", "/my-peers")
+    monkeypatch.setenv("GUBER_ETCD_DIAL_TIMEOUT", "2s")
+    monkeypatch.setenv("GUBER_ETCD_USER", "guber")
+    monkeypatch.setenv("GUBER_ETCD_PASSWORD", "s3cret")
+    conf = config_from_env([])
+    assert conf.etcd_endpoints == ["e1:2379", "e2:2379"]
+    assert conf.etcd_advertise_address == "10.1.1.1:81"
+    assert conf.etcd_key_prefix == "/my-peers"
+    assert conf.etcd_dial_timeout_s == 2.0
+    assert conf.etcd_user == "guber"
+    assert conf.etcd_password == "s3cret"
+    assert not conf.etcd_tls_enable  # no GUBER_ETCD_TLS_* set
+    monkeypatch.setenv("GUBER_ETCD_TLS_CA", "/certs/ca.pem")
+    monkeypatch.setenv("GUBER_ETCD_TLS_SKIP_VERIFY", "true")
+    conf = config_from_env([])
+    assert conf.etcd_tls_enable
+    assert conf.etcd_tls_ca == "/certs/ca.pem"
+    assert conf.etcd_tls_skip_verify
+
+
+def test_memberlist_advertise_port(monkeypatch):
+    from gubernator_tpu.cmd.envconf import config_from_env
+
+    monkeypatch.setenv("GUBER_MEMBERLIST_ADVERTISE_ADDRESS", "10.0.0.5")
+    monkeypatch.setenv("GUBER_MEMBERLIST_ADVERTISE_PORT", "7777")
+    conf = config_from_env([])
+    assert conf.gossip_bind == "10.0.0.5"
+    assert conf.gossip_advertise_port == 7777
+
+
+def test_skip_verify_false_is_false(monkeypatch):
+    """GUBER_ETCD_TLS_SKIP_VERIFY=false must not enable pinning (the
+    reference treats any non-empty value as true, config.go:254 — we parse
+    it properly; PARITY.md #13)."""
+    from gubernator_tpu.cmd.envconf import config_from_env
+
+    monkeypatch.setenv("GUBER_ETCD_TLS_SKIP_VERIFY", "false")
+    conf = config_from_env([])
+    assert conf.etcd_tls_enable  # any GUBER_ETCD_TLS_* enables TLS
+    assert not conf.etcd_tls_skip_verify
+    monkeypatch.setenv("GUBER_ETCD_TLS_SKIP_VERIFY", "maybe")
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        config_from_env([])

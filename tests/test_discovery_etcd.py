@@ -197,3 +197,153 @@ def test_watch_survives_unrelated_keys(server):
         )
     finally:
         p1.close()
+
+
+# ---------------------------------------------------------------- auth + TLS
+
+
+@pytest.fixture
+def auth_server():
+    s = EtcdLite(users={"guber": "s3cret"}).start()
+    yield s
+    s.stop()
+
+
+class TestAuth:
+    def test_authenticated_lifecycle(self, auth_server):
+        u = Updates()
+        p = make_pool(auth_server, "10.0.0.1:81", u,
+                      username="guber", password="s3cret")
+        try:
+            u.wait_for(lambda peers: peers == ["10.0.0.1:81"])
+        finally:
+            p.close()
+        # graceful close must deregister (delete+revoke carry the token too)
+        assert not [k for k in auth_server._kvs]
+
+    def test_bad_password_rejected(self, auth_server):
+        import grpc
+
+        u = Updates()
+        with pytest.raises(grpc.RpcError) as ei:
+            make_pool(auth_server, "10.0.0.1:81", u,
+                      username="guber", password="wrong")
+        assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+    def test_missing_token_rejected(self, auth_server):
+        import grpc
+
+        u = Updates()
+        with pytest.raises(grpc.RpcError) as ei:
+            make_pool(auth_server, "10.0.0.1:81", u)  # no credentials
+        assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+
+    def test_reauth_after_token_invalidation(self, auth_server):
+        """Server-side token rotation (etcd restart) must be healed by the
+        re-register path's lazy re-authentication."""
+        u = Updates()
+        p = make_pool(auth_server, "10.0.0.1:81", u,
+                      username="guber", password="s3cret")
+        try:
+            u.wait_for(lambda peers: peers == ["10.0.0.1:81"])
+            with auth_server._lock:
+                auth_server._tokens.clear()  # invalidate every token
+            auth_server.refuse_keepalives = True  # kill the lease stream
+            time.sleep(1.2)  # lease (1 s) lapses, key is reaped
+            auth_server.refuse_keepalives = False
+            # pool must re-authenticate and re-register
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if any(k for k in auth_server._kvs):
+                    break
+                time.sleep(0.05)
+            assert any(k for k in auth_server._kvs)
+        finally:
+            p.close()
+
+
+def _make_certs(tmp_path, cn):
+    """Self-signed server cert via the openssl CLI (no x509 lib in-image)."""
+    import subprocess
+
+    key, crt = str(tmp_path / "key.pem"), str(tmp_path / "crt.pem")
+    subprocess.run(
+        ["openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+         "-keyout", key, "-out", crt, "-days", "1", "-subj", f"/CN={cn}",
+         "-addext", f"subjectAltName=DNS:{cn},IP:127.0.0.1"],
+        check=True, capture_output=True)
+    return key, crt
+
+
+class TestTLS:
+    def test_tls_lifecycle_with_ca(self, tmp_path):
+        import grpc
+
+        key, crt = _make_certs(tmp_path, "localhost")
+        server_creds = grpc.ssl_server_credentials(
+            [(open(key, "rb").read(), open(crt, "rb").read())])
+        s = EtcdLite(address="localhost:0", credentials=server_creds).start()
+        try:
+            from gubernator_tpu.cluster.etcd import build_tls_credentials
+
+            creds, opts = build_tls_credentials(ca_file=crt)
+            u = Updates()
+            p = EtcdPool(
+                endpoints=[s.address], advertise_address="10.0.0.9:81",
+                on_update=u, lease_ttl_s=1, backoff_s=0.1, timeout_s=2.0,
+                credentials=creds, channel_options=opts)
+            try:
+                u.wait_for(lambda peers: peers == ["10.0.0.9:81"])
+            finally:
+                p.close()
+        finally:
+            s.stop()
+
+    def test_skip_verify_pins_presented_cert(self, tmp_path):
+        """GUBER_ETCD_TLS_SKIP_VERIFY: no CA configured; the server's own
+        cert is fetched and pinned, hostname mismatch overridden by CN."""
+        import grpc
+
+        key, crt = _make_certs(tmp_path, "not-the-real-hostname")
+        server_creds = grpc.ssl_server_credentials(
+            [(open(key, "rb").read(), open(crt, "rb").read())])
+        s = EtcdLite(address="127.0.0.1:0", credentials=server_creds).start()
+        try:
+            from gubernator_tpu.cluster.etcd import build_tls_credentials
+
+            creds, opts = build_tls_credentials(
+                skip_verify=True, endpoint=s.address)
+            assert ("grpc.ssl_target_name_override",
+                    "not-the-real-hostname") in opts
+            u = Updates()
+            p = EtcdPool(
+                endpoints=[s.address], advertise_address="10.0.0.8:81",
+                on_update=u, lease_ttl_s=1, backoff_s=0.1, timeout_s=2.0,
+                credentials=creds, channel_options=opts)
+            try:
+                u.wait_for(lambda peers: peers == ["10.0.0.8:81"])
+            finally:
+                p.close()
+        finally:
+            s.stop()
+
+
+def test_dial_timeout_fails_over_endpoints(server):
+    """A dead first endpoint must not crash startup: the dial loop tries
+    every endpoint (reference: clientv3 DialTimeout spans all endpoints)."""
+    u = Updates()
+    p = EtcdPool(
+        endpoints=["127.0.0.1:1", server.address],  # port 1: refused
+        advertise_address="10.0.0.7:81", on_update=u,
+        lease_ttl_s=1, backoff_s=0.1, timeout_s=2.0, dial_timeout_s=1.0)
+    try:
+        u.wait_for(lambda peers: peers == ["10.0.0.7:81"])
+    finally:
+        p.close()
+
+
+def test_host_port_parsing():
+    from gubernator_tpu.cluster.etcd import host_port
+
+    assert host_port("myetcd") == ("myetcd", 2379)
+    assert host_port("myetcd:443") == ("myetcd", 443)
