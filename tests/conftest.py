@@ -28,3 +28,12 @@ import jax as _jax  # noqa: E402
 _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
 _jax.config.update("jax_compilation_cache_dir", _cache_dir)
 _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
+
+def free_port() -> int:
+    """Reserve an ephemeral TCP port (shared test helper)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

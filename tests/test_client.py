@@ -58,3 +58,78 @@ def test_helpers():
     assert random_peer(peers) in peers
     s = random_string("ID-", 8)
     assert s.startswith("ID-") and len(s) == 11
+
+
+class TestClusterBinary:
+    """The gubernator-cluster binary (reference:
+    cmd/gubernator-cluster/main.go; python/tests/test_client.py boots it as
+    its fixture)."""
+
+    def test_etcd_discovered_cluster(self):
+        """--etcd mode: membership flows through a real EtcdPool register/
+        watch lifecycle against the embedded etcdlite; cross-node requests
+        must route exactly as with injected peers."""
+        from gubernator_tpu.cmd.cluster_main import build_cluster, shutdown
+
+        cluster, pools, etcd = build_cluster([0, 0, 0], use_etcd=True,
+                                             log=lambda m: None)
+        try:
+            assert len(pools) == 3 and etcd is not None
+            for ci in cluster.instances:
+                assert ci.instance.health_check().peer_count == 3
+            # one key, asked of every node: same counter (owner-routed)
+            remaining = []
+            for ci in cluster.instances:
+                r = V1Client(ci.address).get_rate_limits(
+                    [RateLimitReq(name="etcd_t", unique_key="k",
+                                  hits=1, limit=10, duration=60_000)])[0]
+                remaining.append(r.remaining)
+            assert remaining == [9, 8, 7]
+        finally:
+            shutdown(cluster, pools, etcd)
+
+    def test_ready_sentinel_subprocess(self):
+        """`python -m ...cluster_main <port>` prints Ready and serves — the
+        contract the reference's cross-language fixture depends on."""
+        import os
+        import subprocess
+        import sys
+        import threading
+
+        from conftest import free_port
+
+        port = free_port()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(
+            os.environ, JAX_PLATFORMS="cpu",
+            # reuse the suite's persistent compile cache — a cold subprocess
+            # otherwise recompiles every width bucket (~2 min)
+            JAX_COMPILATION_CACHE_DIR=os.path.join(repo, "tests", ".jax_cache"),
+            JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.5",
+        )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "gubernator_tpu.cmd.cluster_main",
+             str(port)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            text=True, env=env, cwd=repo)
+        try:
+            # a wedged warmup must fail the test, not hang the whole suite
+            got: list = []
+            reader = threading.Thread(
+                target=lambda: got.append(proc.stdout.readline()),
+                daemon=True)
+            reader.start()
+            reader.join(timeout=240)
+            assert got and got[0].strip() == "Ready", got
+            r = V1Client(f"127.0.0.1:{port}").get_rate_limits(
+                [RateLimitReq(name="bin_t", unique_key="k", hits=1,
+                              limit=5, duration=60_000)],
+                timeout=30)[0]  # first RPC may pay residual cold compiles
+            assert r.remaining == 4
+        finally:
+            proc.terminate()
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()

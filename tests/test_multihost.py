@@ -6,9 +6,10 @@ applied to the device fabric."""
 
 import json
 import os
-import socket
 import subprocess
 import sys
+
+from conftest import free_port
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -16,6 +17,7 @@ WORKER = """
 import json, sys
 import jax
 jax.config.update("jax_platforms", "cpu")
+
 from gubernator_tpu.parallel.multihost import CrossHostHitSync, initialize_from_env
 
 host_id = int(sys.argv[1])
@@ -33,12 +35,6 @@ t2 = sync.step(np.zeros(4, np.int64) if host_id == 0 else
 print("RESULT " + json.dumps({"host": host_id, "t1": t1.tolist(),
                               "t2": t2.tolist()}), flush=True)
 """
-
-
-def free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def test_two_process_hit_sync(tmp_path):
