@@ -16,6 +16,8 @@ Within one host's device mesh the same flows are a single psum step
 
 from __future__ import annotations
 
+import dataclasses
+
 import logging
 import threading
 import time
@@ -69,7 +71,7 @@ class _Pipeline:
                 if prev is not None:
                     # same aggregation the reference applies before
                     # forwarding (global.go:81-88)
-                    req = RateLimitReq(**{**req.__dict__, "hits": req.hits + prev.hits})
+                    req = dataclasses.replace(req, hits=req.hits + prev.hits)
             self._pending[req.hash_key()] = req
             n = len(self._pending)
             if n == 1:
@@ -189,9 +191,9 @@ class GlobalManager:
         (reference: global.go:194-239)."""
         updates = []
         for key, req in batch.items():
-            peek = RateLimitReq(**req.__dict__)
-            peek.hits = 0
-            peek.behavior = set_behavior(peek.behavior, Behavior.GLOBAL, False)
+            peek = dataclasses.replace(
+                req, hits=0,
+                behavior=set_behavior(req.behavior, Behavior.GLOBAL, False))
             resp = self.instance.apply_owner_batch([peek])[0]
             if resp.error:
                 continue

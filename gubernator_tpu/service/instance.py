@@ -15,6 +15,8 @@ and the GLOBAL/multi-region queues mirror the reference Instance
 
 from __future__ import annotations
 
+import dataclasses
+
 import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -303,8 +305,9 @@ class Instance:
                 # host tier owns GLOBAL semantics; the backend must treat the
                 # request as a plain owned key (see parallel/sharded.py for
                 # the standalone-mesh GLOBAL path)
-                req = RateLimitReq(**{**req.__dict__})
-                req.behavior = set_behavior(req.behavior, Behavior.GLOBAL, False)
+                req = dataclasses.replace(
+                    req,
+                    behavior=set_behavior(req.behavior, Behavior.GLOBAL, False))
             stripped.append(req)
         return self.combiner.submit(stripped, now_ms=now_ms)
 

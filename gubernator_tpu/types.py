@@ -62,7 +62,7 @@ def hash_key(name: str, unique_key: str) -> str:
     return name + "_" + unique_key
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class RateLimitReq:
     """One rate-limit request (reference: proto/gubernator.proto:134-159)."""
 
@@ -79,7 +79,7 @@ class RateLimitReq:
         return hash_key(self.name, self.unique_key)
 
 
-@dataclasses.dataclass
+@dataclasses.dataclass(slots=True)
 class RateLimitResp:
     """One rate-limit decision (reference: proto/gubernator.proto:166-180)."""
 
