@@ -582,7 +582,8 @@ class ShardedEngine:
     def _pack_lanes(self, lanes, w: int, packed, placed, k: Optional[int],
                     pre=None):
         """Fill one window's [R,S,9,w] slice (packed[..., k, :, :] when k is
-        given) and record (resp idx, r, s, k, lane) demux coordinates.
+        given) and record one (r, s, k, [resp indices]) demux group per
+        owner lane-run (lanes 0..n-1 in index order — _demux's contract).
 
         `pre`, when given, maps owner -> (slots, fresh) already resolved by
         the caller (the Store path looks keys up before read-through)."""
@@ -601,25 +602,28 @@ class ShardedEngine:
             dst = packed[r_, s_] if k is None else packed[r_, s_, k]
             pack_window(items, slots, fresh, w, out=dst)
             self.stats["pack_ns"] += time.perf_counter_ns() - t2
-            for lane, item in enumerate(items):
-                placed.append((item[0], r_, s_, k, lane))
+            # one demux group per owner lane-run: lanes are 0..n-1 in item
+            # order, so the group carries just the response indices
+            placed.append((r_, s_, k, [item[0] for item in items]))
 
     def _demux(self, out, placed, responses) -> None:
         """Demux one readback buffer into responses.
 
-        `placed` rows are (resp idx, r, s, k, lane); k is None outside the
-        scan path. Response row order is decide_packed's output contract."""
-        for i, r_, s_, k, lane in placed:
+        `placed` rows are (r, s, k, [resp indices]) — one group per owner
+        lane-run, lanes 0..n-1 in index order; k is None outside the scan
+        path. Response row order is decide_packed's output contract. One
+        C-level tolist per group beats four per-element int() casts."""
+        over = int(Status.OVER_LIMIT)
+        for r_, s_, k, idxs in placed:
             row = out[r_, s_] if k is None else out[r_, s_, k]
-            st = int(row[0, lane])
-            if st == Status.OVER_LIMIT:
-                self.stats["over_limit"] += 1
-            responses[i] = RateLimitResp(
-                status=st,
-                limit=int(row[1, lane]),
-                remaining=int(row[2, lane]),
-                reset_time=int(row[3, lane]),
-            )
+            status, limit, remaining, reset = row[:, :len(idxs)].tolist()
+            for j, i in enumerate(idxs):
+                st = status[j]
+                if st == over:
+                    self.stats["over_limit"] += 1
+                responses[i] = RateLimitResp(
+                    status=st, limit=limit[j], remaining=remaining[j],
+                    reset_time=reset[j])
 
     @staticmethod
     def _row_snapshot(rows, r_: int, s_: int, j: int, key: str):
@@ -654,7 +658,7 @@ class ShardedEngine:
             k_pad = _bucket_pow2(len(group))
             packed = np.zeros((R, S, k_pad, 9, w), np.int64)
             packed[:, :, :, 0, :] = -1  # vacant lanes (incl. pad windows)
-            placed: List[Tuple[int, int, int, int, int]] = []
+            placed: List[Tuple[int, int, Optional[int], List[int]]] = []
             for k, wk in enumerate(group):
                 self._pack_lanes(self._route_lanes(wk), w, packed, placed, k)
 
@@ -678,7 +682,7 @@ class ShardedEngine:
         # (row order must match make_decide_sharded's unpack)
         packed = np.zeros((R, S, 9, w), np.int64)
         packed[:, :, 0, :] = -1  # vacant lanes
-        placed: List[Tuple[int, int, int, Optional[int], int]] = []
+        placed: List[Tuple[int, int, Optional[int], List[int]]] = []
         self._pack_lanes(lanes, w, packed, placed, None)
 
         t = time.perf_counter_ns()
@@ -750,7 +754,7 @@ class ShardedEngine:
         # ---- decide ------------------------------------------------------
         packed = np.zeros((R, S, 9, w), np.int64)
         packed[:, :, 0, :] = -1
-        placed: List[Tuple[int, int, int, Optional[int], int]] = []
+        placed: List[Tuple[int, int, Optional[int], List[int]]] = []
         pre = {owner: (slots, fresh)
                for owner, _r, _s, _items, _keys, slots, fresh in per_owner}
         self._pack_lanes(lanes, w, packed, placed, None, pre=pre)
