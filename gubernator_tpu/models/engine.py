@@ -47,8 +47,10 @@ from gubernator_tpu.ops.decide import (
     pad_to_drop,
 )
 from gubernator_tpu.store import BucketSnapshot, Loader, Store
-from gubernator_tpu.types import RateLimitReq, RateLimitResp
+from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp
 from gubernator_tpu.utils.interval import millisecond_now
+
+_GREG_MASK = int(Behavior.DURATION_IS_GREGORIAN)
 
 
 def _inject_rows(state: TableState, slot, algo, limit, remaining, duration,
@@ -143,9 +145,18 @@ class Engine:
     ):
         self.capacity = capacity
         self.state = make_table(capacity)
+        from gubernator_tpu import native
         from gubernator_tpu.native import make_key_directory
 
         self.directory = make_key_directory(capacity)
+        # native one-pass window prep: only over the C++ directory (it calls
+        # the KeyDir handle directly); python-directory engines keep the
+        # python pipeline
+        self._prep_fast = (
+            native.prep_pack_fast
+            if isinstance(self.directory, native.NativeKeyDirectory)
+            else None
+        )
         self.store = store
         self.loader = loader
         self.min_width = min_width
@@ -204,6 +215,19 @@ class Engine:
         """Decide a batch. Exact per-key sequential semantics, any batch size."""
         if now_ms is None:
             now_ms = millisecond_now()
+        if (self._prep_fast is not None and self.store is None
+                and 0 < len(requests) <= self.max_width):
+            fast = self._fast_window(requests, now_ms)
+            if fast is not None:
+                return fast
+        return self._slow_window(requests, now_ms)
+
+    def _slow_window(self, requests, now_ms,
+                     count_batch: bool = True) -> List[RateLimitResp]:
+        """The python pipeline: full validation, gregorian precompute, and
+        duplicate-key round splitting (models/prep.py). `count_batch` is
+        False when called as a fast window's leftover tail — the client
+        batch was already counted there."""
         t0 = time.perf_counter_ns()
         responses, rounds, n_errors = preprocess(requests, now_ms)
         prep_ns = time.perf_counter_ns() - t0  # excludes the lock wait below
@@ -211,7 +235,7 @@ class Engine:
         with self._lock:
             self.stats.stage_ns["prep"] += prep_ns
             self.stats.requests += len(requests)
-            self.stats.batches += 1
+            self.stats.batches += 1 if count_batch else 0
             self.stats.errors += n_errors
             windows = []
             for round_work in rounds:
@@ -223,6 +247,65 @@ class Engine:
                 self._apply_round(wk, now_ms, responses)
             if tail:
                 self._apply_windows_scanned(tail, now_ms, responses)
+        return responses  # type: ignore[return-value]
+
+    def _fast_window(self, requests, now_ms) -> Optional[List[RateLimitResp]]:
+        """Native one-pass window: validate + first-occurrence round split +
+        directory lookup + pack in one C call (native/keydir.cpp
+        keydir_prep_pack_fast). Lanes the C pass can't take — invalid,
+        gregorian, duplicate occurrences — come back as leftover item
+        indices and run through the python pipeline AFTER this round, which
+        preserves exact per-key sequential semantics. (The lock is released
+        between the round and the tail: another caller's window may
+        interleave there, exactly as the reference's per-request mutex
+        allows between two same-batch goroutines, gubernator.go:126-213,328
+        — the python pipeline's whole-batch lock is stricter than both.)
+        Returns None only for windows the native path can't start at all
+        (nothing mutated)."""
+        from gubernator_tpu import native
+
+        w = _bucket_width(len(requests), self.min_width, self.max_width)
+        packed = np.zeros((9, w), np.int64)
+        with self._lock:
+            t0 = time.perf_counter_ns()  # excludes the lock wait
+            n0, lane_item, leftover = self._prep_fast(
+                self.directory, requests, packed, _GREG_MASK)
+            if n0 == native.PREP_OVERCOMMIT:
+                raise RuntimeError(
+                    f"key directory over-committed: >{self.capacity} "
+                    "distinct keys in one lookup")
+            if n0 < 0:
+                return None
+            stage = self.stats.stage_ns
+            t1 = time.perf_counter_ns()
+            stage["prep"] += t1 - t0
+            self.stats.requests += n0
+            self.stats.batches += 1
+            responses: List[Optional[RateLimitResp]] = [None] * len(requests)
+            if n0:
+                self.stats.rounds += 1
+                self.state, out = self._decide_packed(
+                    self.state, packed, now_ms)
+                out = np.asarray(out)
+                t2 = time.perf_counter_ns()
+                stage["device"] += t2 - t1
+                status, limit, remaining, reset = out[:, :n0].tolist()
+                over = 0
+                for j, i in enumerate(lane_item.tolist()):
+                    st = status[j]
+                    if st == 1:
+                        over += 1
+                    responses[i] = RateLimitResp(
+                        status=st, limit=limit[j], remaining=remaining[j],
+                        reset_time=reset[j])
+                self.stats.over_limit += over
+                stage["demux"] += time.perf_counter_ns() - t2
+        if len(leftover):
+            idxs = leftover.tolist()
+            tail = self._slow_window(
+                [requests[i] for i in idxs], now_ms, count_batch=False)
+            for i, resp in zip(idxs, tail):
+                responses[i] = resp
         return responses  # type: ignore[return-value]
 
     # ------------------------------------------------------- persistence SPI

@@ -29,12 +29,18 @@ def _lib_path() -> str:
 
 
 def _build() -> str:
+    import sysconfig
+
     path = _lib_path()
     if os.path.exists(path):
         return path
     tmp = path + ".tmp"
     subprocess.run(
-        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, _SRC],
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+         # Python.h for the prep_pack fast path; symbols resolve from the
+         # host interpreter at load time (no -lpython needed on Linux)
+         f"-I{sysconfig.get_paths()['include']}",
+         "-o", tmp, _SRC],
         check=True, capture_output=True,
     )
     os.replace(tmp, path)  # atomic vs concurrent builders
@@ -86,6 +92,60 @@ def load_library() -> ctypes.CDLL:
         ]
         _LIB = lib
         return lib
+
+
+_PYLIB: Optional[ctypes.PyDLL] = None
+
+
+def load_pydll() -> ctypes.PyDLL:
+    """The same library via PyDLL — calls hold the GIL, as the
+    PyObject-consuming prep_pack fast path requires."""
+    global _PYLIB
+    with _LIB_LOCK:
+        if _PYLIB is not None:
+            return _PYLIB
+    load_library()  # build + validate first (its own locking)
+    with _LIB_LOCK:
+        if _PYLIB is None:
+            c = ctypes
+            lib = ctypes.PyDLL(_lib_path())
+            lib.keydir_prep_pack_fast.restype = c.c_int32
+            lib.keydir_prep_pack_fast.argtypes = [
+                c.c_void_p, c.py_object, c.c_void_p, c.c_int32, c.c_int64,
+                c.c_void_p, c.c_void_p, c.c_void_p,
+            ]
+            _PYLIB = lib
+        return _PYLIB
+
+
+# prep_pack_fast return codes (keydir.cpp)
+PREP_FALLBACK = -1
+PREP_OVERCOMMIT = -2
+
+
+def prep_pack_fast(directory: "NativeKeyDirectory", requests,
+                   packed: np.ndarray, greg_mask: int):
+    """One-pass native window prep: validate + first-occurrence round split
+    + directory lookup + pack in one C call. `packed` must be a zeroed
+    C-contiguous i64[9, width].
+
+    Returns (n0, lane_item, leftover): n0 lanes packed (lane j answers
+    requests[lane_item[j]]), with `leftover` the item indices the python
+    pipeline must run AFTER this round (invalid / gregorian / duplicate
+    occurrences). n0 is PREP_FALLBACK or PREP_OVERCOMMIT on the
+    non-sequence/oversize and over-commit paths."""
+    lib = load_pydll()
+    width = packed.shape[1]
+    lane_item = np.empty(width, np.int32)
+    leftover = np.empty(len(requests), np.int32)
+    n_left = np.zeros(1, np.int32)
+    n0 = lib.keydir_prep_pack_fast(
+        directory._kd, requests, packed.ctypes.data, width, greg_mask,
+        lane_item.ctypes.data, leftover.ctypes.data, n_left.ctypes.data,
+    )
+    if n0 < 0:
+        return n0, None, None
+    return n0, lane_item[:n0], leftover[:int(n_left[0])]
 
 
 def available() -> bool:

@@ -13,11 +13,18 @@
 // LRU list; per-call pin generation so one batch never hands the same slot
 // to two different keys (the kernel requires collision-free scatters).
 
+// Python.h first (it defines feature-test macros); used only by the
+// prep_pack fast path at the bottom — the core KeyDir is plain C++.
+#include <Python.h>
+
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
+#include <string_view>
+#include <unordered_set>
 #include <vector>
 
 namespace {
@@ -309,6 +316,153 @@ void fnv1a_owner_batch(const char* data, const int64_t* offsets, int32_t n,
                            static_cast<int32_t>(offsets[i + 1] - offsets[i]));
         owners_out[i] = static_cast<int32_t>(h % static_cast<uint64_t>(n_owners));
     }
+}
+
+// One-pass native window prep: collapse the python validate -> round-split
+// -> directory lookup -> pack_window pipeline (models/prep.py preprocess +
+// ops/decide.py pack_window) for the FIRST round of a window, reading the
+// RateLimitReq slots directly. Lanes the fast path can't take — invalid
+// requests, gregorian lanes (host calendar math), duplicate-key occurrences
+// past the first, and every later occurrence of a key once one lane of it
+// went to the leftovers (per-key order must hold) — are returned as
+// `leftover` item indices for the python pipeline to run AFTER this round.
+//
+// items: a sequence of RateLimitReq; packed: zeroed i64[9, width] row-major
+// (decide_packed's staging-row contract); greg_mask: the
+// Behavior.DURATION_IS_GREGORIAN bit (passed in so the value can't drift
+// from types.py); lane_item: i32[width] out — original item index per
+// packed lane; leftover: i32[len(items)] out; n_leftover_out: i32[1] out.
+//
+// Returns n0 >= 0 (lanes packed; lane j answers items[lane_item[j]]);
+// PREP_FALLBACK for a non-sequence or len > width (nothing mutated);
+// PREP_OVERCOMMIT when the directory over-commits mid-lookup (the python
+// lookup raises on the same condition).
+//
+// MUST be called with the GIL held (load via ctypes.PyDLL, not CDLL).
+int32_t keydir_prep_pack_fast(void* kd, PyObject* items, int64_t* packed,
+                              int32_t width, int64_t greg_mask,
+                              int32_t* lane_item, int32_t* leftover,
+                              int32_t* n_leftover_out) {
+    static PyObject* s_name = nullptr;
+    static PyObject *s_key, *s_hits, *s_limit, *s_dur, *s_algo, *s_beh;
+    if (s_name == nullptr) {
+        s_name = PyUnicode_InternFromString("name");
+        s_key = PyUnicode_InternFromString("unique_key");
+        s_hits = PyUnicode_InternFromString("hits");
+        s_limit = PyUnicode_InternFromString("limit");
+        s_dur = PyUnicode_InternFromString("duration");
+        s_algo = PyUnicode_InternFromString("algorithm");
+        s_beh = PyUnicode_InternFromString("behavior");
+    }
+    PyObject* seq = PySequence_Fast(items, "prep_pack_fast expects a sequence");
+    if (seq == nullptr) {
+        PyErr_Clear();
+        return -1;
+    }
+    const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (n == 0 || n > width) {
+        Py_DECREF(seq);
+        return -1;
+    }
+
+    std::vector<std::string> keys;      // round-0 keys, lane order
+    std::vector<int32_t> lanes;         // round-0 item index per lane
+    std::vector<int64_t> col(5 * n);    // hits/limit/duration/algo/behavior
+    // Every key with a computable identity enters `seen` on first sight,
+    // accepted or not: once any lane of a key is a leftover, every later
+    // occurrence must follow it there, or the python tail would apply
+    // occurrence k before occurrence k-1 (per-key sequential semantics,
+    // reference: gubernator.go:328's mutex).
+    std::unordered_set<std::string> seen;
+    seen.reserve(n);
+    keys.reserve(n);
+    lanes.reserve(n);
+    int32_t n_left = 0;
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        PyObject* o = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
+        PyObject* attrs[2] = {nullptr, nullptr};
+        PyObject* ints[5] = {nullptr, nullptr, nullptr, nullptr, nullptr};
+        bool ok = true;
+        std::string k;
+        do {
+            attrs[0] = PyObject_GetAttr(o, s_name);
+            attrs[1] = PyObject_GetAttr(o, s_key);
+            if (!attrs[0] || !attrs[1]) { ok = false; break; }
+            Py_ssize_t nm_len, uk_len;
+            const char* nm = PyUnicode_AsUTF8AndSize(attrs[0], &nm_len);
+            const char* uk = PyUnicode_AsUTF8AndSize(attrs[1], &uk_len);
+            if (!nm || !uk || nm_len == 0 || uk_len == 0) {
+                ok = false;  // non-str or empty: python path errors it
+                break;
+            }
+            k.reserve(nm_len + 1 + uk_len);
+            k.append(nm, nm_len);
+            k.push_back('_');  // hash_key() contract (reference: client.go:33)
+            k.append(uk, uk_len);
+            const size_t lane = keys.size();
+            ints[0] = PyObject_GetAttr(o, s_hits);
+            ints[1] = PyObject_GetAttr(o, s_limit);
+            ints[2] = PyObject_GetAttr(o, s_dur);
+            ints[3] = PyObject_GetAttr(o, s_algo);
+            ints[4] = PyObject_GetAttr(o, s_beh);
+            for (int f = 0; f < 5 && ok; ++f) {
+                if (ints[f] == nullptr) { ok = false; break; }
+                const int64_t v = PyLong_AsLongLong(ints[f]);
+                if (v == -1 && PyErr_Occurred()) { ok = false; break; }
+                col[f * n + lane] = v;
+            }
+            if (ok && (col[4 * n + lane] & greg_mask)) {
+                ok = false;  // gregorian lanes need host calendar math
+            }
+        } while (false);
+        for (PyObject* a : attrs) Py_XDECREF(a);
+        for (PyObject* v : ints) Py_XDECREF(v);
+        if (PyErr_Occurred()) PyErr_Clear();
+        const bool first = !k.empty() && seen.insert(k).second;
+        if (ok && first) {
+            keys.push_back(std::move(k));
+            lanes.push_back(static_cast<int32_t>(i));
+        } else {
+            leftover[n_left++] = static_cast<int32_t>(i);
+        }
+    }
+    Py_DECREF(seq);
+
+    const Py_ssize_t n0 = static_cast<Py_ssize_t>(keys.size());
+    *n_leftover_out = n_left;
+    if (n0 == 0) return 0;
+
+    // ---- directory lookup + pack ---------------------------------------
+    std::string arena;
+    std::vector<int64_t> offsets(n0 + 1);
+    size_t total = 0;
+    for (const std::string& k : keys) total += k.size();
+    arena.reserve(total);
+    for (Py_ssize_t i = 0; i < n0; ++i) {
+        offsets[i] = static_cast<int64_t>(arena.size());
+        arena += keys[i];
+    }
+    offsets[n0] = static_cast<int64_t>(arena.size());
+
+    std::vector<int32_t> slots(n0);
+    std::vector<uint8_t> fresh(n0);
+    const int64_t done = static_cast<KeyDir*>(kd)->lookup_batch(
+        arena.data(), offsets.data(), static_cast<int32_t>(n0),
+        slots.data(), fresh.data());
+    if (done != n0) return -2;  // over-commit: python lookup raises here too
+
+    int64_t* const row_slot = packed;
+    for (Py_ssize_t i = 0; i < n0; ++i) row_slot[i] = slots[i];
+    for (int32_t i = static_cast<int32_t>(n0); i < width; ++i) row_slot[i] = -1;
+    for (int f = 0; f < 5; ++f) {
+        std::memcpy(packed + (f + 1) * width, col.data() + f * n,
+                    n0 * sizeof(int64_t));
+    }
+    // rows 6/7 (gregorian) stay zero; row 8 = fresh flags
+    int64_t* const row_fresh = packed + 8 * width;
+    for (Py_ssize_t i = 0; i < n0; ++i) row_fresh[i] = fresh[i];
+    std::memcpy(lane_item, lanes.data(), n0 * sizeof(int32_t));
+    return static_cast<int32_t>(n0);
 }
 
 }  // extern "C"

@@ -353,9 +353,10 @@ def pack_window(items, slots, fresh, width: int, out=None):
     """Host-side packer for decide_packed: i64[9, width] from one window.
 
     `items` are prep WorkItems (resp_index, req, greg_expire, greg_interval);
-    lanes beyond len(items) are padding (slot = -1). This is the only
-    place the packed row order is written; decide_packed is the only place
-    it is read. `out`, when given, must be a zero-filled i64[9, width] view
+    lanes beyond len(items) are padding (slot = -1). decide_packed is the
+    only reader of the packed row order; it has TWO writers — this function
+    and the native fast path (native/keydir.cpp keydir_prep_pack_fast) —
+    which must stay in sync. `out`, when given, must be a zero-filled i64[9, width] view
     (e.g. one window's slice of a scan group's staging buffer) and is
     filled in place instead of allocating.
     """
