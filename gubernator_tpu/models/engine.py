@@ -46,6 +46,7 @@ from gubernator_tpu.ops.decide import (
     pack_window,
     pad_to_drop,
 )
+from gubernator_tpu.native import PREP_OVERCOMMIT
 from gubernator_tpu.store import BucketSnapshot, Loader, Store
 from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp
 from gubernator_tpu.utils.interval import millisecond_now
@@ -262,15 +263,13 @@ class Engine:
         — the python pipeline's whole-batch lock is stricter than both.)
         Returns None only for windows the native path can't start at all
         (nothing mutated)."""
-        from gubernator_tpu import native
-
         w = _bucket_width(len(requests), self.min_width, self.max_width)
         packed = np.zeros((9, w), np.int64)
         with self._lock:
             t0 = time.perf_counter_ns()  # excludes the lock wait
             n0, lane_item, leftover = self._prep_fast(
                 self.directory, requests, packed, _GREG_MASK)
-            if n0 == native.PREP_OVERCOMMIT:
+            if n0 == PREP_OVERCOMMIT:
                 raise RuntimeError(
                     f"key directory over-committed: >{self.capacity} "
                     "distinct keys in one lookup")

@@ -114,6 +114,11 @@ def load_pydll() -> ctypes.PyDLL:
                 c.c_void_p, c.py_object, c.c_void_p, c.c_int32, c.c_int64,
                 c.c_void_p, c.c_void_p, c.c_void_p,
             ]
+            lib.keydir_prep_route_sharded.restype = c.c_int32
+            lib.keydir_prep_route_sharded.argtypes = [
+                c.c_void_p, c.c_int32, c.py_object, c.c_int64,
+                c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            ]
             _PYLIB = lib
         return _PYLIB
 
@@ -146,6 +151,36 @@ def prep_pack_fast(directory: "NativeKeyDirectory", requests,
     if n0 < 0:
         return n0, None, None
     return n0, lane_item[:n0], leftover[:int(n_left[0])]
+
+
+def prep_route_sharded(directories, requests, greg_mask: int):
+    """Sharded one-pass native window prep: validate + first-occurrence
+    split + owner routing (fnv1a % n_owners) + per-owner directory lookup.
+
+    Returns (n0, cols, lane_item, owner_count, leftover): `cols` is
+    i64[9, len(requests)] with the first n0 lanes owner-major in the decide
+    staging row order (rows 6/7 zero); lane j answers
+    requests[lane_item[j]]; owner o owns the owner_count[o]-lane run at
+    offset sum(owner_count[:o]). n0 is PREP_FALLBACK / PREP_OVERCOMMIT on
+    the corresponding paths (cols et al. are None then)."""
+    lib = load_pydll()
+    n = len(requests)
+    n_owners = len(directories)
+    handles = (ctypes.c_void_p * n_owners)(*[d._kd for d in directories])
+    cols = np.zeros((9, n), np.int64)
+    lane_item = np.empty(n, np.int32)
+    owner_count = np.empty(n_owners, np.int32)
+    leftover = np.empty(n, np.int32)
+    n_left = np.zeros(1, np.int32)
+    n0 = lib.keydir_prep_route_sharded(
+        handles, n_owners, requests, greg_mask,
+        cols.ctypes.data, lane_item.ctypes.data, owner_count.ctypes.data,
+        leftover.ctypes.data, n_left.ctypes.data,
+    )
+    if n0 < 0:
+        return n0, None, None, None, None
+    return (n0, cols, lane_item[:n0], owner_count,
+            leftover[:int(n_left[0])])
 
 
 def available() -> bool:

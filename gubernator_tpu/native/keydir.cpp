@@ -17,13 +17,11 @@
 // prep_pack fast path at the bottom — the core KeyDir is plain C++.
 #include <Python.h>
 
-#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <string>
-#include <string_view>
 #include <unordered_set>
 #include <vector>
 
@@ -318,6 +316,71 @@ void fnv1a_owner_batch(const char* data, const int64_t* offsets, int32_t n,
     }
 }
 
+namespace {
+
+// Shared per-item reader for the two prep entry points below: pulls the
+// RateLimitReq slots, builds the name_key (reference: client.go:33), and
+// applies the demotion mask. `ok` false (or an empty key) means the lane
+// belongs in the python-pipeline leftovers. GIL must be held.
+struct ParsedItem {
+    bool ok;
+    std::string key;
+    int64_t vals[5];  // hits, limit, duration, algorithm, behavior
+};
+
+PyObject** prep_attr_names() {
+    static PyObject* names[7] = {nullptr};
+    if (names[0] == nullptr) {
+        names[0] = PyUnicode_InternFromString("name");
+        names[1] = PyUnicode_InternFromString("unique_key");
+        names[2] = PyUnicode_InternFromString("hits");
+        names[3] = PyUnicode_InternFromString("limit");
+        names[4] = PyUnicode_InternFromString("duration");
+        names[5] = PyUnicode_InternFromString("algorithm");
+        names[6] = PyUnicode_InternFromString("behavior");
+    }
+    return names;
+}
+
+ParsedItem parse_item(PyObject* o, int64_t slow_mask) {
+    PyObject** s = prep_attr_names();
+    ParsedItem p;
+    p.ok = true;
+    for (int64_t& v : p.vals) v = 0;
+    PyObject* attrs[2] = {nullptr, nullptr};
+    PyObject* ints[5] = {nullptr, nullptr, nullptr, nullptr, nullptr};
+    do {
+        attrs[0] = PyObject_GetAttr(o, s[0]);
+        attrs[1] = PyObject_GetAttr(o, s[1]);
+        if (!attrs[0] || !attrs[1]) { p.ok = false; break; }
+        Py_ssize_t nm_len, uk_len;
+        const char* nm = PyUnicode_AsUTF8AndSize(attrs[0], &nm_len);
+        const char* uk = PyUnicode_AsUTF8AndSize(attrs[1], &uk_len);
+        if (!nm || !uk || nm_len == 0 || uk_len == 0) {
+            p.ok = false;  // non-str or empty: python path errors it
+            break;
+        }
+        p.key.reserve(nm_len + 1 + uk_len);
+        p.key.append(nm, nm_len);
+        p.key.push_back('_');
+        p.key.append(uk, uk_len);
+        for (int f = 0; f < 5 && p.ok; ++f) {
+            ints[f] = PyObject_GetAttr(o, s[f + 2]);
+            if (ints[f] == nullptr) { p.ok = false; break; }
+            const int64_t v = PyLong_AsLongLong(ints[f]);
+            if (v == -1 && PyErr_Occurred()) { p.ok = false; break; }
+            p.vals[f] = v;
+        }
+        if (p.ok && (p.vals[4] & slow_mask)) p.ok = false;
+    } while (false);
+    for (PyObject* a : attrs) Py_XDECREF(a);
+    for (PyObject* v : ints) Py_XDECREF(v);
+    if (PyErr_Occurred()) PyErr_Clear();
+    return p;
+}
+
+}  // namespace
+
 // One-pass native window prep: collapse the python validate -> round-split
 // -> directory lookup -> pack_window pipeline (models/prep.py preprocess +
 // ops/decide.py pack_window) for the FIRST round of a window, reading the
@@ -343,17 +406,6 @@ int32_t keydir_prep_pack_fast(void* kd, PyObject* items, int64_t* packed,
                               int32_t width, int64_t greg_mask,
                               int32_t* lane_item, int32_t* leftover,
                               int32_t* n_leftover_out) {
-    static PyObject* s_name = nullptr;
-    static PyObject *s_key, *s_hits, *s_limit, *s_dur, *s_algo, *s_beh;
-    if (s_name == nullptr) {
-        s_name = PyUnicode_InternFromString("name");
-        s_key = PyUnicode_InternFromString("unique_key");
-        s_hits = PyUnicode_InternFromString("hits");
-        s_limit = PyUnicode_InternFromString("limit");
-        s_dur = PyUnicode_InternFromString("duration");
-        s_algo = PyUnicode_InternFromString("algorithm");
-        s_beh = PyUnicode_InternFromString("behavior");
-    }
     PyObject* seq = PySequence_Fast(items, "prep_pack_fast expects a sequence");
     if (seq == nullptr) {
         PyErr_Clear();
@@ -379,48 +431,12 @@ int32_t keydir_prep_pack_fast(void* kd, PyObject* items, int64_t* packed,
     lanes.reserve(n);
     int32_t n_left = 0;
     for (Py_ssize_t i = 0; i < n; ++i) {
-        PyObject* o = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
-        PyObject* attrs[2] = {nullptr, nullptr};
-        PyObject* ints[5] = {nullptr, nullptr, nullptr, nullptr, nullptr};
-        bool ok = true;
-        std::string k;
-        do {
-            attrs[0] = PyObject_GetAttr(o, s_name);
-            attrs[1] = PyObject_GetAttr(o, s_key);
-            if (!attrs[0] || !attrs[1]) { ok = false; break; }
-            Py_ssize_t nm_len, uk_len;
-            const char* nm = PyUnicode_AsUTF8AndSize(attrs[0], &nm_len);
-            const char* uk = PyUnicode_AsUTF8AndSize(attrs[1], &uk_len);
-            if (!nm || !uk || nm_len == 0 || uk_len == 0) {
-                ok = false;  // non-str or empty: python path errors it
-                break;
-            }
-            k.reserve(nm_len + 1 + uk_len);
-            k.append(nm, nm_len);
-            k.push_back('_');  // hash_key() contract (reference: client.go:33)
-            k.append(uk, uk_len);
+        ParsedItem p = parse_item(PySequence_Fast_GET_ITEM(seq, i), greg_mask);
+        const bool first = !p.key.empty() && seen.insert(p.key).second;
+        if (p.ok && first) {
             const size_t lane = keys.size();
-            ints[0] = PyObject_GetAttr(o, s_hits);
-            ints[1] = PyObject_GetAttr(o, s_limit);
-            ints[2] = PyObject_GetAttr(o, s_dur);
-            ints[3] = PyObject_GetAttr(o, s_algo);
-            ints[4] = PyObject_GetAttr(o, s_beh);
-            for (int f = 0; f < 5 && ok; ++f) {
-                if (ints[f] == nullptr) { ok = false; break; }
-                const int64_t v = PyLong_AsLongLong(ints[f]);
-                if (v == -1 && PyErr_Occurred()) { ok = false; break; }
-                col[f * n + lane] = v;
-            }
-            if (ok && (col[4 * n + lane] & greg_mask)) {
-                ok = false;  // gregorian lanes need host calendar math
-            }
-        } while (false);
-        for (PyObject* a : attrs) Py_XDECREF(a);
-        for (PyObject* v : ints) Py_XDECREF(v);
-        if (PyErr_Occurred()) PyErr_Clear();
-        const bool first = !k.empty() && seen.insert(k).second;
-        if (ok && first) {
-            keys.push_back(std::move(k));
+            for (int f = 0; f < 5; ++f) col[f * n + lane] = p.vals[f];
+            keys.push_back(std::move(p.key));
             lanes.push_back(static_cast<int32_t>(i));
         } else {
             leftover[n_left++] = static_cast<int32_t>(i);
@@ -463,6 +479,89 @@ int32_t keydir_prep_pack_fast(void* kd, PyObject* items, int64_t* packed,
     for (Py_ssize_t i = 0; i < n0; ++i) row_fresh[i] = fresh[i];
     std::memcpy(lane_item, lanes.data(), n0 * sizeof(int32_t));
     return static_cast<int32_t>(n0);
+}
+
+// Sharded variant of keydir_prep_pack_fast: one pass that ALSO routes each
+// lane to its owner shard (owner = fnv1a64(key) % n_owners, the
+// parallel/mesh.py shard_of_key contract) and looks the key up in that
+// owner's directory. Output lanes are owner-major and contiguous —
+// owner_count[o] lanes per owner, `cols` is i64[9, n] in the decide staging
+// row order (slot/hits/limit/duration/algo/behavior/0/0/fresh) — so the
+// python side turns them into the [R,S,9,w] mesh buffer with one numpy
+// slice copy per owner. Leftover semantics match keydir_prep_pack_fast.
+//
+// kds: n_owners KeyDir handles (one per owner shard). Returns n0 total
+// lanes, PREP_FALLBACK, or PREP_OVERCOMMIT. GIL must be held.
+int32_t keydir_prep_route_sharded(void** kds, int32_t n_owners,
+                                  PyObject* items, int64_t greg_mask,
+                                  int64_t* cols, int32_t* lane_item,
+                                  int32_t* owner_count, int32_t* leftover,
+                                  int32_t* n_leftover_out) {
+    PyObject* seq = PySequence_Fast(items, "prep_route expects a sequence");
+    if (seq == nullptr) {
+        PyErr_Clear();
+        return -1;
+    }
+    const Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    if (n == 0) {
+        Py_DECREF(seq);
+        return -1;
+    }
+
+    struct OwnerLanes {
+        std::string arena;
+        std::vector<int64_t> offsets{0};
+        std::vector<int32_t> item;
+        std::vector<int64_t> col5;  // 5 values per lane
+    };
+    std::vector<OwnerLanes> owners(n_owners);
+    std::unordered_set<std::string> seen;  // same per-key order rule as
+    seen.reserve(n);                       // keydir_prep_pack_fast
+    int32_t n_left = 0;
+    for (Py_ssize_t i = 0; i < n; ++i) {
+        ParsedItem p = parse_item(PySequence_Fast_GET_ITEM(seq, i), greg_mask);
+        const bool first = !p.key.empty() && seen.insert(p.key).second;
+        if (!(p.ok && first)) {
+            leftover[n_left++] = static_cast<int32_t>(i);
+            continue;
+        }
+        const uint64_t h =
+            fnv1a(p.key.data(), static_cast<int32_t>(p.key.size()));
+        OwnerLanes& ol = owners[h % static_cast<uint64_t>(n_owners)];
+        ol.arena += p.key;
+        ol.offsets.push_back(static_cast<int64_t>(ol.arena.size()));
+        ol.item.push_back(static_cast<int32_t>(i));
+        for (int f = 0; f < 5; ++f) ol.col5.push_back(p.vals[f]);
+    }
+    Py_DECREF(seq);
+    *n_leftover_out = n_left;
+
+    // per-owner lookup + owner-major output
+    int64_t pos = 0;
+    for (int32_t o = 0; o < n_owners; ++o) {
+        OwnerLanes& ol = owners[o];
+        const int32_t cnt = static_cast<int32_t>(ol.item.size());
+        owner_count[o] = cnt;
+        if (cnt == 0) continue;
+        std::vector<int32_t> slots(cnt);
+        std::vector<uint8_t> fresh(cnt);
+        const int64_t done = static_cast<KeyDir*>(kds[o])->lookup_batch(
+            ol.arena.data(), ol.offsets.data(), cnt, slots.data(),
+            fresh.data());
+        if (done != cnt) return -2;
+        for (int32_t j = 0; j < cnt; ++j) {
+            const int64_t lane = pos + j;
+            cols[0 * n + lane] = slots[j];
+            for (int f = 0; f < 5; ++f) {
+                cols[(f + 1) * n + lane] = ol.col5[5 * j + f];
+            }
+            // rows 6/7 (gregorian) stay zero
+            cols[8 * n + lane] = fresh[j];
+            lane_item[lane] = ol.item[j];
+        }
+        pos += cnt;
+    }
+    return static_cast<int32_t>(pos);
 }
 
 }  // extern "C"

@@ -69,6 +69,12 @@ from gubernator_tpu.types import (
 )
 from gubernator_tpu.utils.interval import millisecond_now
 
+from gubernator_tpu.native import PREP_OVERCOMMIT
+
+# lanes the sharded native fast path must hand to the python pipeline:
+# gregorian (host calendar math) and GLOBAL (mirror/psum tier)
+_SLOW_MASK = int(Behavior.DURATION_IS_GREGORIAN) | int(Behavior.GLOBAL)
+
 
 def make_decide_sharded(plan: MeshPlan, donate: bool = False):
     """Compile the batched decision kernel over the plan's mesh.
@@ -248,12 +254,20 @@ class ShardedEngine:
         if store is not None:
             self._gather = make_gather_sharded(self.plan)
             self._inject = make_inject_sharded(self.plan, donate=donate)
+        from gubernator_tpu import native
         from gubernator_tpu.native import make_key_directory
 
         self.directories = [
             make_key_directory(capacity_per_shard)
             for _ in range(self.plan.n_owners)
         ]
+        # native one-pass window prep + owner routing (see Engine._fast_window)
+        self._prep_fast = (
+            native.prep_route_sharded
+            if all(isinstance(d, native.NativeKeyDirectory)
+                   for d in self.directories)
+            else None
+        )
         self.min_width = min_width
         self.max_width = min(max_width, capacity_per_shard)
         self._lock = threading.Lock()
@@ -440,13 +454,81 @@ class ShardedEngine:
     ) -> List[RateLimitResp]:
         if now_ms is None:
             now_ms = millisecond_now()
+        if (self._prep_fast is not None and self.store is None
+                and 0 < len(requests) <= self.max_width):
+            fast = self._fast_window(requests, now_ms)
+            if fast is not None:
+                return fast
+        return self._slow_window(requests, now_ms)
+
+    def _fast_window(self, requests, now_ms) -> Optional[List[RateLimitResp]]:
+        """Native one-pass window: validate + first-occurrence split + owner
+        routing + per-owner directory lookup in one C call
+        (native/keydir.cpp keydir_prep_route_sharded). Leftover lanes —
+        invalid, gregorian, GLOBAL, duplicate occurrences — run through the
+        python pipeline AFTER this round (same per-key order contract as
+        Engine._fast_window)."""
+        R, S = self.plan.n_regions, self.plan.n_shards
+        with self._lock:
+            t0 = time.perf_counter_ns()  # excludes the lock wait
+            n0, cols, lane_item, owner_count, leftover = self._prep_fast(
+                self.directories, requests, _SLOW_MASK)
+            if n0 == PREP_OVERCOMMIT:
+                raise RuntimeError(
+                    "key directory over-committed: "
+                    f">{self.plan.capacity_per_shard} distinct keys on one "
+                    "shard in one lookup")
+            if n0 < 0:
+                return None
+            t1 = time.perf_counter_ns()
+            self.stats["prep_ns"] += t1 - t0
+            self.stats["requests"] += n0
+            self.stats["batches"] += 1
+            responses: List[Optional[RateLimitResp]] = [None] * len(requests)
+            if n0:
+                counts = owner_count.tolist()
+                w = bucket_width(max(counts), self.min_width, self.max_width)
+                packed = np.zeros((R, S, 9, w), np.int64)
+                packed[:, :, 0, :] = -1
+                placed = []
+                lanes = lane_item.tolist()
+                pos = 0
+                for o, cnt in enumerate(counts):
+                    if not cnt:
+                        continue
+                    r_, s_ = self.plan.owner_coords(o)
+                    packed[r_, s_, :, :cnt] = cols[:, pos:pos + cnt]
+                    placed.append((r_, s_, None, lanes[pos:pos + cnt]))
+                    pos += cnt
+                t2 = time.perf_counter_ns()
+                self.stats["pack_ns"] += t2 - t1
+                self.stats["rounds"] += 1
+                self.state, out = self._decide(self.state, packed, now_ms)
+                out = np.asarray(out)
+                t3 = time.perf_counter_ns()
+                self.stats["device_ns"] += t3 - t2
+                self._demux(out, placed, responses)
+                self.stats["demux_ns"] += time.perf_counter_ns() - t3
+        if len(leftover):
+            idxs = leftover.tolist()
+            tail = self._slow_window(
+                [requests[i] for i in idxs], now_ms, count_batch=False)
+            for i, resp in zip(idxs, tail):
+                responses[i] = resp
+        return responses  # type: ignore[return-value]
+
+    def _slow_window(self, requests, now_ms,
+                     count_batch: bool = True) -> List[RateLimitResp]:
+        """The python pipeline (full validation, gregorian, GLOBAL mirror,
+        duplicate rounds). `count_batch` is False for a fast window's
+        leftover tail — the client batch was already counted there."""
         t0 = time.perf_counter_ns()
         responses, rounds, n_errors = preprocess(requests, now_ms)
         prep_ns = time.perf_counter_ns() - t0  # excludes the lock wait below
         with self._lock:
             self.stats["prep_ns"] += prep_ns
             self.stats["requests"] += len(requests)
-            self.stats["batches"] += 1
+            self.stats["batches"] += 1 if count_batch else 0
             self.stats["errors"] += n_errors
             windows: List[List[WorkItem]] = []
             for round_work in rounds:

@@ -536,3 +536,72 @@ def test_rewarm_does_not_apply_pending_global_hits():
         [_req("rw", hits=0, limit=100, duration=3_600_000)],
         now_ms=NOW + 4)[0]
     assert r.remaining == 85       # applied once, not twice
+
+
+class TestShardedNativeFastWindow:
+    """The sharded native one-pass prep (keydir_prep_route_sharded) must be
+    response-identical to the python pipeline, with identical owner routing
+    (C fnv1a must agree with shard_of_key) and GLOBAL/gregorian lanes
+    correctly demoted to the python tail."""
+
+    def _engines(self):
+        import gubernator_tpu.native as native
+
+        fast = ShardedEngine(n_shards=4, capacity_per_shard=128,
+                             min_width=8, max_width=64)
+        if fast._prep_fast is None:
+            pytest.skip("native prep unavailable")
+        slow = ShardedEngine(n_shards=4, capacity_per_shard=128,
+                             min_width=8, max_width=64)
+        slow._prep_fast = None
+        return fast, slow
+
+    def test_differential_mixed_lanes(self):
+        fast, slow = self._engines()
+        rng = random.Random(23)
+        now = NOW
+        for step in range(25):
+            now += rng.randint(0, 2000)
+            batch = []
+            for _ in range(rng.randint(1, 20)):
+                kind = rng.random()
+                if kind < 0.06:
+                    batch.append(RateLimitReq(name="test", unique_key=""))
+                elif kind < 0.16:
+                    batch.append(_req(
+                        f"g{rng.randint(0, 2)}", hits=rng.randint(0, 2),
+                        duration=rng.choice([0, 1]),
+                        behavior=Behavior.DURATION_IS_GREGORIAN))
+                else:
+                    batch.append(_req(
+                        f"k{rng.randint(0, 15)}", hits=rng.randint(0, 3),
+                        limit=rng.choice([5, 10]),
+                        algo=rng.choice(
+                            [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET])))
+            a = fast.get_rate_limits(batch, now_ms=now)
+            b = slow.get_rate_limits(batch, now_ms=now)
+            assert a == b, f"divergence at step {step}"
+
+    def test_global_lanes_take_mirror_path(self):
+        """GLOBAL lanes must be demoted to the python tail, where the
+        mirror/psum tier owns them — identical to a slow-path engine."""
+        fast, slow = self._engines()
+        g = lambda h: _req("gf", hits=h, limit=100, behavior=Behavior.GLOBAL)
+        for eng in (fast, slow):
+            eng.get_rate_limits([g(5), _req("plain")], now_ms=NOW)
+            eng.global_sync(now_ms=NOW + 1)
+            r = eng.get_rate_limits([g(10)], now_ms=NOW + 2)[0]
+            assert r.remaining == 85
+        assert fast.stats["global_mirror_answers"] == \
+            slow.stats["global_mirror_answers"]
+
+    def test_owner_routing_matches_python(self):
+        """C fnv1a owner routing must agree with shard_of_key: every key
+        lands in the directory python would pick."""
+        fast, _ = self._engines()
+        keys = [f"rt{i}" for i in range(60)]
+        fast.get_rate_limits([_req(k) for k in keys], now_ms=NOW)
+        from gubernator_tpu.parallel import shard_of_key
+        for k in keys:
+            owner = shard_of_key(f"test_{k}", fast.plan.n_owners)
+            assert fast.directories[owner].peek_slot(f"test_{k}") >= 0, k
