@@ -8,6 +8,8 @@ Scenarios:
   get_peer_no_batching       BenchmarkServer_GetPeerRateLimitNoBatching
   health_check               BenchmarkServer_Ping
   thundering_herd            BenchmarkServer_ThunderingHeard (100-wide fanout)
+  thundering_herd_mp         same herd from 4 client PROCESSES (server capacity,
+                             not the bench process's GIL)
   leaky_bucket               LEAKY_BUCKET drain (BASELINE.json configs[1])
   global_mode                Behavior=GLOBAL aggregation (configs[2])
   gregorian                  DURATION_IS_GREGORIAN resets (configs[3])
@@ -108,6 +110,105 @@ def run_fanout(fn, seconds: float, width: int = 100, warmup: int = 50):
     }
 
 
+def _herd_worker(address: str, seconds: float, threads: int, seed: int, out_q):
+    """One client PROCESS of the multiprocess herd (spawned): `threads`
+    concurrent single-request callers against `address` for `seconds`.
+    Runs in its own interpreter so the parent's GIL stops capping the
+    offered load — the in-process thread herd (run_fanout) measures the
+    client as much as the server."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # never touch a device
+    import time as _time
+    from concurrent.futures import ThreadPoolExecutor as _Pool
+
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.types import RateLimitReq
+
+    try:
+        client = V1Client(address)
+
+        def loop(tid: int):
+            rng = random.Random(seed * 1000 + tid)
+            lat = []
+            mk = lambda: RateLimitReq(
+                name="get_rate_limit_benchmark", unique_key=_rand_key(rng),
+                hits=1, limit=10, duration=5_000)
+            client.get_rate_limits([mk()], timeout=30)  # connect + warm
+            t_end = _time.perf_counter() + seconds
+            while _time.perf_counter() < t_end:
+                s = _time.perf_counter()
+                client.get_rate_limits([mk()], timeout=30)
+                lat.append((_time.perf_counter() - s) * 1e3)
+            return lat
+
+        out = []
+        t0 = _time.perf_counter()
+        with _Pool(max_workers=threads) as pool:
+            for chunk in pool.map(loop, range(threads)):
+                out.extend(chunk)
+        out_q.put((out, _time.perf_counter() - t0))
+    except Exception as e:  # noqa: BLE001 — a dead worker must not wedge
+        out_q.put(("error", repr(e)))  # the parent (cf. bench.py watchdog)
+
+
+def run_herd_mp(address: str, seconds: float, procs: int = 4,
+                threads: int = 25):
+    """ThunderingHeard with the client herd spread over `procs` real
+    processes (procs*threads concurrent callers) so the measurement is
+    server capacity, not the benchmarking process's GIL."""
+    import multiprocessing as mp
+
+    import queue as _queue
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    workers = [
+        ctx.Process(target=_herd_worker,
+                    args=(address, seconds, threads, p, q), daemon=True)
+        for p in range(procs)
+    ]
+    for w in workers:
+        w.start()
+    lat, spans, failures = [], [], []
+    pending = len(workers)
+    deadline = time.monotonic() + seconds + 90
+    while pending and time.monotonic() < deadline:
+        try:
+            item = q.get(timeout=1.0)
+        except _queue.Empty:
+            # a worker that died without reporting must not wedge the suite
+            if not any(w.is_alive() for w in workers):
+                break
+            continue
+        pending -= 1
+        if isinstance(item, tuple) and item and item[0] == "error":
+            failures.append(item[1])
+        else:
+            chunk, span = item
+            lat.extend(chunk)
+            spans.append(span)
+    for w in workers:
+        w.join(timeout=10)
+        if w.is_alive():
+            w.terminate()
+    lat.sort()
+    # completions over the measured window, same methodology as
+    # run_serial/run_fanout (dividing by nominal `seconds` would count
+    # requests still in flight at the cutoff)
+    elapsed = max(spans) if spans else seconds
+    out = {
+        "ops_per_s": round(len(lat) / elapsed, 1),
+        "p50_ms": round(_percentile(lat, 0.50), 3),
+        "p99_ms": round(_percentile(lat, 0.99), 3),
+        "n": len(lat),
+        "fanout": procs * threads,
+        "client_procs": procs,
+    }
+    if failures or pending:
+        out["worker_failures"] = len(failures) + pending
+        out["first_failure"] = failures[0] if failures else "no report"
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=2.0)
@@ -206,6 +307,11 @@ def main(argv=None) -> int:
                 args.seconds,
             )
 
+        def bench_thundering_herd_mp():
+            # same herd, client spread over real processes: server capacity
+            return run_herd_mp(
+                rng.choice(cluster.instances).address, args.seconds)
+
         def bench_leaky_bucket():
             return run_serial(
                 lambda: client.get_rate_limits(
@@ -274,6 +380,7 @@ def main(argv=None) -> int:
             "get_peer_no_batching": bench_get_peer_no_batching,
             "health_check": bench_health_check,
             "thundering_herd": bench_thundering_herd,
+            "thundering_herd_mp": bench_thundering_herd_mp,
             "leaky_bucket": bench_leaky_bucket,
             "global_mode": bench_global_mode,
             "gregorian": bench_gregorian,
