@@ -65,7 +65,7 @@ def main(argv=None) -> int:
     chaos_done = threading.Event()
     settled = threading.Event()  # 2s after the last restart: reconnect grace
     lock = threading.Lock()
-    # admissions per key since its last observed epoch reset
+    # admissions per (key, reset_time) epoch — see the SAFETY note below
     admitted = collections.Counter()
     violations = []
     errors_during_chaos = 0
@@ -107,16 +107,20 @@ def main(argv=None) -> int:
                     else:
                         errors_during_chaos += 1
                 elif r.status == 0:
-                    admitted[key] += 1
-                    # SAFETY: within one epoch, admissions <= limit. An
-                    # epoch reset (node restart lost the bucket) shows up as
-                    # remaining jumping back up; detect via remaining ==
-                    # limit - 1 while our counter is already high.
-                    if r.remaining == args.limit - 1 and admitted[key] > 1:
-                        admitted[key] = 1  # epoch reset observed
-                    if admitted[key] > args.limit:
+                    # SAFETY: within one epoch, admissions <= limit. The
+                    # epoch is identified by reset_time — a restarted owner
+                    # recreates the bucket with a fresh CreatedAt, so its
+                    # reset_time moves. Counting per (key, reset_time) is
+                    # immune to response-reordering races that a
+                    # "remaining jumped back up" heuristic trips over:
+                    # admission order and response-processing order differ
+                    # under concurrency.
+                    epoch = (key, r.reset_time)
+                    admitted[epoch] += 1
+                    if admitted[epoch] > args.limit:
                         violations.append(
-                            f"{key}: {admitted[key]} admissions > limit")
+                            f"{key}@{r.reset_time}: "
+                            f"{admitted[epoch]} admissions > limit")
 
     def chaos():
         rng = random.Random(99)
