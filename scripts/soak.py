@@ -58,9 +58,15 @@ def main(argv=None) -> int:
     from gubernator_tpu.cluster.harness import LocalCluster
     from gubernator_tpu.service.grpc_api import dial_v1
     from gubernator_tpu.service.pb import gubernator_pb2 as pb
+    from gubernator_tpu.types import Behavior
 
     cluster = LocalCluster().start(args.nodes)
     keys = [f"soak_{i}" for i in range(args.keys)]
+    # ~25% of traffic drives Behavior=GLOBAL keys — the reference's own
+    # fault test targets GLOBAL (functional_test.go:507-569); judged by
+    # post-chaos convergence, not per-epoch admission (eventual consistency
+    # admits bounded overshoot by design, PARITY.md #3)
+    gkeys = [f"gsoak_{i}" for i in range(max(2, args.keys // 4))]
     stop = threading.Event()
     chaos_done = threading.Event()
     settled = threading.Event()  # 2s after the last restart: reconnect grace
@@ -78,12 +84,15 @@ def main(argv=None) -> int:
         rng = random.Random(wid)
         while not stop.is_set():
             addr = cluster.instances[rng.randrange(args.nodes)].address
-            key = rng.choice(keys)
+            is_global = rng.random() < 0.25
+            key = rng.choice(gkeys if is_global else keys)
+            behavior = int(Behavior.GLOBAL) if is_global else 0
             try:
                 stub = dial_v1(addr)
                 r = stub.GetRateLimits(pb.GetRateLimitsReq(requests=[
                     pb.RateLimitReq(name="soak", unique_key=key, hits=1,
-                                    limit=args.limit, duration=3_600_000)
+                                    limit=args.limit, duration=3_600_000,
+                                    behavior=behavior)
                 ]), timeout=10,
                     # settle-phase liveness is judged on the serving stack,
                     # not on grpc client reconnect races
@@ -106,7 +115,7 @@ def main(argv=None) -> int:
                             error_samples.append(r.error[:120])
                     else:
                         errors_during_chaos += 1
-                elif r.status == 0:
+                elif r.status == 0 and not is_global:
                     # SAFETY: within one epoch, admissions <= limit. The
                     # epoch is identified by reset_time — a restarted owner
                     # recreates the bucket with a fresh CreatedAt, so its
@@ -159,9 +168,46 @@ def main(argv=None) -> int:
     stop.set()
     for t in workers:
         t.join(timeout=30)
+
+    # CONVERGENCE: with traffic quiesced, every node's view of every GLOBAL
+    # key — owner authoritative or non-owner mirror — must agree. Broadcasts
+    # are request-triggered, so a key idle through the settle phase can hold
+    # a legitimately stale mirror: the first probe pass touches every
+    # (key, node) pair (a hits=0 GLOBAL request queues through the async
+    # pipelines and the owner rebroadcasts), then a few 50 ms test sync
+    # windows elapse, then the judged pass runs. Any error — application or
+    # RPC, uniform or not — fails the check; ignoring them could false-pass
+    # a cluster-wide GLOBAL breakage as "converged".
+    def probe(key):
+        views = {}
+        for ci in cluster.instances:
+            try:
+                r = dial_v1(ci.address).GetRateLimits(
+                    pb.GetRateLimitsReq(requests=[
+                        pb.RateLimitReq(name="soak", unique_key=key, hits=0,
+                                        limit=args.limit,
+                                        duration=3_600_000,
+                                        behavior=int(Behavior.GLOBAL))
+                    ]), timeout=10, wait_for_ready=True).responses[0]
+                views[ci.address] = (f"err:{r.error[:80]}" if r.error
+                                     else r.remaining)
+            except grpc.RpcError as e:
+                views[ci.address] = f"rpc:{e.code()}"
+        return views
+
+    global_divergence = []
+    for key in gkeys:
+        probe(key)  # refresh pass: trigger owner rebroadcast to every peer
+    time.sleep(1.0)
+    for key in gkeys:
+        views = probe(key)
+        errs = [v for v in views.values() if isinstance(v, str)]
+        if errs or len(set(views.values())) > 1:
+            global_divergence.append({key: views})
     cluster.stop()
 
-    ok = not violations and errors_after_chaos == 0
+    ok = (not violations and errors_after_chaos == 0
+          and not global_divergence)
     print(json.dumps({
         "phase": "result",
         "ok": ok,
@@ -170,6 +216,7 @@ def main(argv=None) -> int:
         "errors_during_chaos": errors_during_chaos,
         "errors_after_chaos": errors_after_chaos,
         "error_samples": error_samples,
+        "global_divergence": global_divergence[:3],
     }), flush=True)
     return 0 if ok else 1
 
