@@ -10,6 +10,7 @@ Instance directly.
 
 from __future__ import annotations
 
+import json
 import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -60,10 +61,12 @@ class HttpGateway:
                 self._reply(code, json_format.MessageToJson(msg).encode())
 
             def _reply_error(self, code: int, message: str) -> None:
-                # grpc-gateway error shape: {"error": ..., "code": ...}
+                # grpc-gateway error shape: {"error": ..., "code": ...};
+                # messages may contain quotes (json_format.ParseError
+                # embeds the offending token), so build real JSON
                 self._reply(
                     code,
-                    ('{"error": "%s", "code": %d}' % (message, code)).encode(),
+                    json.dumps({"error": message, "code": code}).encode(),
                 )
 
             def do_GET(self):

@@ -1,6 +1,10 @@
 """Client library tests against a live in-process cluster
 (reference: python/tests/test_client.py:25-60)."""
 
+import json
+import urllib.error
+import urllib.request
+
 import pytest
 
 from gubernator_tpu.client import HttpClient, V1Client, random_peer, random_string
@@ -133,3 +137,51 @@ class TestClusterBinary:
             except subprocess.TimeoutExpired:
                 proc.kill()
                 proc.wait()
+
+
+class TestGatewayEdges:
+    """HTTP gateway error surfaces (reference: gubernator.pb.gw.go's
+    grpc-gateway error contract)."""
+
+    def _url(self, cluster, path):
+        _, gw = cluster
+        return f"http://{gw.address}{path}"
+
+    def test_unknown_route_404_json(self, cluster):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(self._url(cluster, "/nope"), timeout=10)
+        assert ei.value.code == 404
+        body = json.load(ei.value)
+        assert body["code"] == 404 and body["error"]
+
+    def test_malformed_json_is_400_with_parseable_body(self, cluster):
+        # the ParseError message embeds quoted tokens; the reply must
+        # still be valid JSON
+        req = urllib.request.Request(
+            self._url(cluster, "/v1/GetRateLimits"),
+            data=b'{"requests": [{"name": "x", "bogus_field"',
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=10)
+        assert ei.value.code == 400
+        body = json.load(ei.value)  # must not raise
+        assert body["code"] == 400 and "invalid request" in body["error"]
+
+    def test_oversized_batch_rejected(self, cluster):
+        reqs = [{"name": "big", "uniqueKey": f"k{i}", "hits": "1",
+                 "limit": "5", "duration": "60000"} for i in range(1001)]
+        req = urllib.request.Request(
+            self._url(cluster, "/v1/GetRateLimits"),
+            data=json.dumps({"requests": reqs}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=30)
+        assert ei.value.code == 400
+        body = json.load(ei.value)
+        assert "max size" in body["error"]
+
+    def test_health_check_get(self, cluster):
+        body = json.load(urllib.request.urlopen(
+            self._url(cluster, "/v1/HealthCheck"), timeout=10))
+        assert body["status"] == "healthy"
+        assert int(body["peerCount"]) == 2
