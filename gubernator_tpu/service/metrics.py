@@ -79,6 +79,22 @@ class Metrics:
             "(prep/lookup/pack/device/demux).",
             ["stage"], registry=self.registry,
         )
+        # sharded-backend GLOBAL pipeline (parallel/sharded.py stats)
+        self.engine_global_syncs = Counter(
+            "engine_global_syncs_total",
+            "GLOBAL psum sync windows run by the mesh backend.",
+            registry=self.registry,
+        )
+        self.engine_global_mirror_answers = Counter(
+            "engine_global_mirror_answers_total",
+            "GLOBAL requests answered from the replicated mirror.",
+            registry=self.registry,
+        )
+        self.engine_global_hits_queued = Counter(
+            "engine_global_hits_queued_total",
+            "GLOBAL hits queued for the next mesh sync window.",
+            registry=self.registry,
+        )
 
     def observe_instance(self, instance) -> None:
         """Refresh gauges from live objects before exposition."""
@@ -96,6 +112,14 @@ class Metrics:
                     self._set_counter(
                         self.engine_stage_seconds.labels(stage=stage),
                         ns / 1e9)
+            self._set_counter(
+                self.engine_global_syncs, d.get("global_syncs", 0))
+            self._set_counter(
+                self.engine_global_mirror_answers,
+                d.get("global_mirror_answers", 0))
+            self._set_counter(
+                self.engine_global_hits_queued,
+                d.get("global_hits_queued", 0))
         cache = getattr(instance, "_global_cache", None)
         if cache is not None:
             self.cache_size.set(len(cache))

@@ -37,3 +37,65 @@ def free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def spawn_daemon(env_overrides, ready_timeout=240.0):
+    """Spawn the real daemon subprocess and wait for its Ready sentinel.
+
+    The sentinel is read on a side thread so a silently wedged daemon
+    (alive, printing nothing) fails at the deadline instead of hanging the
+    suite on a blocking readline. Returns the Popen; callers own teardown
+    (terminate + wait, kill on TimeoutExpired).
+    """
+    import os
+    import subprocess
+    import sys
+    import threading
+    import time
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(repo, "tests", ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    env.update(env_overrides)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cmd.daemon"],
+        env=env, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True,
+    )
+    ready = threading.Event()
+
+    def wait_ready():
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                return
+            if "Ready" in line:
+                ready.set()
+                return
+
+    t = threading.Thread(target=wait_ready, daemon=True)
+    t.start()
+    deadline = time.time() + ready_timeout
+    while time.time() < deadline:
+        if ready.is_set():
+            return proc
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"daemon died at startup (rc={proc.returncode})")
+        time.sleep(0.1)
+    proc.kill()
+    proc.wait()
+    raise RuntimeError(f"daemon never printed Ready in {ready_timeout:.0f}s")
+
+
+def stop_daemon(proc):
+    import subprocess
+
+    proc.terminate()
+    try:
+        proc.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
