@@ -234,3 +234,38 @@ def test_sharded_backend_daemon():
         assert "engine_global_syncs_total" in text
     finally:
         stop_daemon(proc)
+
+
+def test_load_generator_cli():
+    """The gubernator-cli load generator (reference:
+    cmd/gubernator-cli/main.go:42-85) drives a live cluster in-process: a
+    bounded run must push traffic, observe OVER_LIMIT on drained limits,
+    and report a throughput line."""
+    import io
+    from contextlib import redirect_stdout
+
+    from gubernator_tpu.cluster.harness import LocalCluster
+    from gubernator_tpu.cmd import cli
+
+    import random as _random
+
+    c = LocalCluster().start(1)
+    try:
+        # deterministic workload: seed guarantees low-limit keys exist, so
+        # OVER_LIMIT is reachable regardless of machine speed
+        _random.seed(7)
+        out = io.StringIO()
+        with redirect_stdout(out):
+            rc = cli.main([c.instances[0].address, "--seconds", "2",
+                           "--concurrency", "4", "--requests", "20"])
+        assert rc == 0
+        summary = out.getvalue().strip().splitlines()[-1]
+        assert summary.startswith("sent=")
+        fields = dict(f.split("=") for f in summary.split())
+        assert int(fields["sent"]) > 20
+        assert int(fields["errors"]) == 0
+        # 20 keys hammered for 2s, lowest limit small under seed 7: some
+        # must go over
+        assert int(fields["over_limit"]) > 0
+    finally:
+        c.stop()
