@@ -408,3 +408,47 @@ class TestScanPacked:
         for col_seq, col_scan in zip(seq_state2, scan_state):
             np.testing.assert_array_equal(np.asarray(col_seq),
                                           np.asarray(col_scan))
+
+
+class TestDocumentedReferenceBugFixes:
+    """Pin the deliberate deviations from reference quirks (PARITY.md
+    #2a-2c): each is a place where kernel AND oracle intentionally differ
+    from algorithms.go, so the differential suite alone can't prove the
+    behavior — these tests do."""
+
+    def test_leaky_deduction_extends_expiry_sanely(self):
+        """PARITY #2a: after a leaky deduction, expire_at = now + duration —
+        not the reference's now*duration (algorithms.go:287)."""
+        h = Harness(capacity=8)
+        now = 1_000_000
+        h.hit("k", hits=1, limit=10, duration=60_000,
+              algorithm=Algorithm.LEAKY_BUCKET, now=now)
+        h.hit("k", hits=1, limit=10, duration=60_000,
+              algorithm=Algorithm.LEAKY_BUCKET, now=now + 5)
+        exp = int(h.state.expire_at[h.dir["k"]])
+        assert exp == (now + 5) + 60_000  # not (now+5)*60_000
+
+    def test_leaky_create_reset_time_is_now_plus_rate(self):
+        """PARITY #2b: create-path ResetTime = now + rate, matching the
+        existing-bucket path — not the bare rate (algorithms.go:316)."""
+        h = Harness(capacity=8)
+        now = 1_000_000
+        _, _, _, reset = h.hit("k", hits=1, limit=10, duration=60_000,
+                               algorithm=Algorithm.LEAKY_BUCKET, now=now)
+        assert reset == now + 60_000 // 10
+
+    def test_token_duration_flip_flop_takes_effect(self):
+        """PARITY #2c: changing a token bucket's duration back to its
+        original value must take effect (the reference silently ignores it
+        because it never persists the changed duration)."""
+        h = Harness(capacity=8)
+        now = 1_000_000
+        h.hit("k", hits=1, limit=10, duration=60_000, now=now)
+        _, _, _, r2 = h.hit("k", hits=1, limit=10, duration=30_000,
+                            now=now + 1)
+        assert r2 == now + 30_000  # CreatedAt + new duration
+        _, _, _, r3 = h.hit("k", hits=1, limit=10, duration=60_000,
+                            now=now + 2)
+        # back to 60s: we persist durations, so the change applies again;
+        # the reference would keep the 30s expiry here
+        assert r3 == now + 60_000
