@@ -53,7 +53,10 @@ class ClusterInstance:
     metrics: Optional[Metrics] = None
 
     def stop(self) -> None:
-        self.server.stop(grace=0.2)
+        # wait for full termination: stop() returns before the listening
+        # socket closes, so a fault-injection test could still reach a
+        # "dead" server for a few ms and flake
+        self.server.stop(grace=0.2).wait()
         self.instance.close()
         # drop any cached client channel so a restart on the same port isn't
         # hit through a channel stuck in reconnect backoff

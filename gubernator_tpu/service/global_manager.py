@@ -26,7 +26,7 @@ from typing import Dict, Optional
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.convert import resp_to_pb
 from gubernator_tpu.service.pb import peers_pb2 as peers_pb
-from gubernator_tpu.types import Behavior, RateLimitReq, set_behavior
+from gubernator_tpu.types import Behavior, RateLimitReq, without_behavior
 
 log = logging.getLogger("gubernator_tpu.global")
 
@@ -170,7 +170,12 @@ class GlobalManager:
         (reference: global.go:116-156)."""
         by_peer = {}
         for key, req in batch.items():
-            peer = self.instance.get_peer(key)
+            try:
+                peer = self.instance.get_peer(key)
+            except Exception as e:  # noqa: BLE001 — skip just this key,
+                # keep the rest of the window (reference: global.go:127-131)
+                log.error("while getting peer for hash key '%s': %s", key, e)
+                continue
             by_peer.setdefault(id(peer), (peer, []))[1].append(req)
         for peer, reqs in by_peer.values():
             if peer.info.is_owner:
@@ -192,8 +197,7 @@ class GlobalManager:
         updates = []
         for key, req in batch.items():
             peek = dataclasses.replace(
-                req, hits=0,
-                behavior=set_behavior(req.behavior, Behavior.GLOBAL, False))
+                without_behavior(req, Behavior.GLOBAL), hits=0)
             resp = self.instance.apply_owner_batch([peek])[0]
             if resp.error:
                 continue

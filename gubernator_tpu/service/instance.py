@@ -42,6 +42,7 @@ from gubernator_tpu.types import (
     Status,
     has_behavior,
     set_behavior,
+    without_behavior,
 )
 from gubernator_tpu.utils.lru import CacheItem, LRUCache
 
@@ -305,9 +306,7 @@ class Instance:
                 # host tier owns GLOBAL semantics; the backend must treat the
                 # request as a plain owned key (see parallel/sharded.py for
                 # the standalone-mesh GLOBAL path)
-                req = dataclasses.replace(
-                    req,
-                    behavior=set_behavior(req.behavior, Behavior.GLOBAL, False))
+                req = without_behavior(req, Behavior.GLOBAL)
             stripped.append(req)
         return self.combiner.submit(stripped, now_ms=now_ms)
 
@@ -417,8 +416,19 @@ class Instance:
             resp.metadata["owner"] = owner_peer.info.address
             return resp
         except Exception:  # noqa: BLE001
-            # owner unreachable: process locally as-if-owner so the limit
-            # still enforces something (reference fallback, gubernator.go:242-246)
-            resp = self.apply_owner_batch([req])[0]
+            # Owner unreachable: process locally as-if-owner so the limit
+            # still enforces something (reference fallback,
+            # gubernator.go:242-246). Strip GLOBAL and MULTI_REGION first —
+            # broadcasting and cross-region replication are the owner's
+            # job; queueing them here would push this non-owner's partial
+            # view over every peer's mirror, or replicate hits a second
+            # time when the owner applied the request before the RPC timed
+            # out. (The reference wipes the WHOLE behavior field to
+            # NO_BATCHING, which also nukes DURATION_IS_GREGORIAN and
+            # silently turns a calendar limit into a milliseconds one; we
+            # strip only the owner-pipeline flags.)
+            local = without_behavior(
+                req, Behavior.GLOBAL, Behavior.MULTI_REGION)
+            resp = self.apply_owner_batch([local])[0]
             resp.metadata["owner"] = owner_peer.info.address
             return resp

@@ -56,6 +56,16 @@ def set_behavior(behavior: int, flag: Behavior, on: bool) -> int:
     return (behavior | flag) if on else (behavior & ~flag)
 
 
+def without_behavior(req: "RateLimitReq", *flags: Behavior) -> "RateLimitReq":
+    """A copy of `req` with the given behavior flags cleared — the shared
+    idiom for handing a request down a tier that must not re-trigger
+    owner-side pipelines (GLOBAL broadcast, MULTI_REGION replication)."""
+    b = int(req.behavior)
+    for f in flags:
+        b = set_behavior(b, f, False)
+    return dataclasses.replace(req, behavior=b)
+
+
 def hash_key(name: str, unique_key: str) -> str:
     """The canonical rate-limit key: ``name + "_" + unique_key``
     (reference: client.go:33-35)."""

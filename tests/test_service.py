@@ -467,3 +467,37 @@ class TestGlobalGregorian:
         assert r2.remaining == 85
         # the broadcast mirror carries the SAME calendar boundary
         assert r2.reset_time == r.reset_time
+
+
+class TestGlobalFallbackIsolation:
+    def test_owner_unreachable_fallback_does_not_broadcast(self):
+        """A non-owner processing a GLOBAL first touch locally because the
+        owner is down must NOT queue a broadcast: broadcasting is the
+        owner's job, and pushing this partial local view would overwrite
+        every peer's mirror (the reference wipes the behavior flags for the
+        same reason, gubernator.go:242-246)."""
+        c = LocalCluster().start(3)
+        try:
+            ci = c.instances[0]
+            key, owner_addr = _non_owner_key(ci, "fbk_")
+            owner_idx = next(i for i, x in enumerate(c.instances)
+                             if x.address == owner_addr)
+            c.stop_instance_at(owner_idx)
+            r = _call(c, [_req(key, hits=3, limit=100,
+                               behavior=int(Behavior.GLOBAL)
+                               | int(Behavior.MULTI_REGION))])[0]
+            assert r.error == ""
+            assert r.remaining == 97  # enforced locally
+            gm = ci.instance.global_manager
+            # no legitimate broadcast exists in this test, so the counter
+            # must stay zero even if the background flusher already ran —
+            # and the multi-region pipeline must stay empty too (the owner
+            # may have applied the request before the RPC failed; a second
+            # replication from here would double cross-region counts)
+            gm.flush()
+            ci.instance.multiregion_manager.flush()
+            assert gm.stats["broadcasts_sent"] == 0
+            assert not gm._broadcasts._pending
+            assert ci.instance.multiregion_manager.stats["replicated"] == 0
+        finally:
+            c.stop()
