@@ -13,7 +13,7 @@ import pytest
 from gubernator_tpu.cluster.harness import LocalCluster
 from gubernator_tpu.service.grpc_api import dial_v1
 from gubernator_tpu.service.pb import gubernator_pb2 as pb
-from gubernator_tpu.types import Behavior
+from gubernator_tpu.types import Algorithm, Behavior
 
 import grpc
 
@@ -501,3 +501,41 @@ class TestGlobalFallbackIsolation:
             assert ci.instance.multiregion_manager.stats["replicated"] == 0
         finally:
             c.stop()
+
+
+class TestMissingFields:
+    """Exact port of the reference's field-validation table
+    (functional_test.go:211-272): zero duration and zero limit are VALID
+    requests, not errors."""
+
+    def test_table(self, cluster):
+        cases = [
+            # (req kwargs, expected error, expected status)
+            (dict(key="account:1234", hits=1, limit=10, duration=0),
+             "", 0),
+            (dict(key="account:12345", hits=1, limit=0, duration=10_000),
+             "", 1),  # limit 0: first hit is already over
+        ]
+        for kwargs, want_err, want_status in cases:
+            r = _call(cluster, [_req(name="test_missing_fields", **kwargs)])[0]
+            assert r.error == want_err, kwargs
+            assert r.status == want_status, kwargs
+        # empty name / empty unique_key rows
+        r = _call(cluster, [pb.RateLimitReq(
+            unique_key="account:1234", hits=1, duration=10_000, limit=5)])[0]
+        assert r.error == "field 'namespace' cannot be empty"
+        assert r.status == 0
+        r = _call(cluster, [pb.RateLimitReq(
+            name="test_missing_fields", hits=1, duration=10_000, limit=5)])[0]
+        assert r.error == "field 'unique_key' cannot be empty"
+        assert r.status == 0
+
+    def test_leaky_zero_limit_does_not_crash(self, cluster):
+        """limit=0 on a LEAKY_BUCKET request: the reference computes
+        rate = duration / limit and panics on the zero division
+        (algorithms.go:215,306); our kernel guards the divisor and rejects
+        the hit (PARITY.md #2d)."""
+        r = _call(cluster, [_req("leak0", hits=1, limit=0, duration=10_000,
+                                 algorithm=int(Algorithm.LEAKY_BUCKET))])[0]
+        assert r.error == ""
+        assert r.status == 1 and r.remaining == 0
