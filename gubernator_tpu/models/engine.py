@@ -405,8 +405,8 @@ class Engine:
 
         The worst case this exists for is a hot-key thundering herd: d
         duplicates of one key = d rounds, which the per-round path pays d
-        full dispatches for (~50-80 µs launch overhead each) while the
-        kernel itself is <1 µs."""
+        full dispatches for — launch overhead (plus a network round trip on
+        a tunneled device) per dispatch, while the kernel body is cheap."""
         stage = self.stats.stage_ns
         width = self.min_width  # _split_scannable guarantees every window fits
         for g0 in range(0, len(windows), self._MAX_SCAN):

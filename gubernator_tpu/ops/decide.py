@@ -334,12 +334,12 @@ def decide_scan_packed(
     `packed_k` is i64[K, 9, B]; the result is i64[K, 4, B]. Window k+1
     observes window k's table writes, exactly as K separate decide_packed
     calls would — `lax.scan` compiles the kernel body once and loops on
-    device, so the per-window cost collapses from one full dispatch
-    (~50-80 µs of launch overhead; the kernel itself is <1 µs at B=4096) to
-    the loop-carry overhead (~0.4 µs measured on a v5e chip). The engine
-    uses this to retire all duplicate-key *rounds* of a window — a hot-key
-    thundering herd is the worst case, d duplicates = d rounds — in one
-    launch instead of d.
+    device, so the per-window cost collapses from one full dispatch (launch
+    overhead plus, on a tunneled device, a network round trip — see
+    DESIGN.md "Measurement honesty") to the on-device loop carry. The
+    engine uses this to retire all duplicate-key *rounds* of a window — a
+    hot-key thundering herd is the worst case, d duplicates = d rounds —
+    in one launch instead of d.
     """
 
     def body(st, pk):
