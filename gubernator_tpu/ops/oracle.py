@@ -27,6 +27,13 @@ is an evident typo), leaky reset_time is now+rate on creation too (the
 reference returns a bare duration at algorithms.go:315), and rates are
 clamped to >= 1ms/token to avoid the reference's division-by-zero panic when
 limit > duration.
+
+Validity domain: the oracle computes with python's unbounded ints, while
+the kernel (and the reference's Go int64 arithmetic) wraps at 2^63. The
+two agree for any inputs whose intermediate sums stay within int64 —
+e.g. now + duration, remaining + leak — which is every realistic request
+and everything the differential fuzz generates; feed durations near 2^63
+and the oracle diverges from BOTH wrap-identical implementations.
 """
 
 from __future__ import annotations
