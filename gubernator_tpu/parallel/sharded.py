@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -212,13 +213,14 @@ def make_inject_sharded(plan: MeshPlan, donate: bool = False):
 class _GlobalEntry:
     """Host record for one registered global key."""
 
-    __slots__ = ("gidx", "owner", "req", "seen")
+    __slots__ = ("gidx", "owner", "req", "seen", "last_ms")
 
-    def __init__(self, gidx: int, owner: int):
+    def __init__(self, gidx: int, owner: int, now_ms: int):
         self.gidx = gidx
         self.owner = owner
         self.req: Optional[RateLimitReq] = None
         self.seen = False  # at least one broadcast has populated the mirror
+        self.last_ms = now_ms  # last request touch (LRU / idle eviction)
 
 
 class ShardedEngine:
@@ -237,6 +239,7 @@ class ShardedEngine:
         loader=None,
         store=None,
         collectives: str = "psum",
+        global_idle_ms: int = 60_000,
     ):
         if mesh is None:
             mesh = make_mesh(n_shards=n_shards, n_regions=n_regions)
@@ -274,8 +277,20 @@ class ShardedEngine:
         self.loader = loader
 
         # ---- GLOBAL-behavior host state --------------------------------
+        # The registry is an LRU within global_capacity (the reference routes
+        # GLOBAL keys through its general 50k LRU, cache.go:82-84): gidx
+        # slots are recycled through a free list, idle entries are swept
+        # after each sync, and when the registry is full the
+        # least-recently-touched zero-delta entry is evicted to make room.
+        # Only when every slot still has unsynced hits does a NEW global key
+        # fall back to the authoritative path (counted, never permanent).
         self.global_capacity = global_capacity
-        self._globals: Dict[str, _GlobalEntry] = {}
+        self.global_idle_ms = global_idle_ms
+        # recency-ordered (oldest first): touches move_to_end, so the LRU
+        # victim is the first zero-delta entry in iteration order
+        self._globals: "OrderedDict[str, _GlobalEntry]" = OrderedDict()
+        self._gfree: List[int] = []  # recycled gidx slots
+        self._gnext = 0  # high-water mark of allocated gidx
         self._gdelta = np.zeros((global_capacity,), np.int64)  # local hits
         self._mirror = GlobalMirror(  # host copy of last broadcast
             status=np.zeros((global_capacity,), np.int32),
@@ -292,6 +307,8 @@ class ShardedEngine:
             "global_hits_queued": 0,
             "global_syncs": 0,
             "global_mirror_answers": 0,
+            "global_evictions": 0,
+            "global_registry_fallbacks": 0,
         }
         # per-stage wall clocks, same contract as models/engine.py
         # EngineStats (exposed as engine_stage_seconds_total in /metrics)
@@ -534,7 +551,7 @@ class ShardedEngine:
             for round_work in rounds:
                 kernel_items = []
                 for item in round_work:
-                    if self._try_answer_global(item, responses):
+                    if self._try_answer_global(item, responses, now_ms):
                         continue
                     kernel_items.append(item)
                 if kernel_items:
@@ -576,6 +593,7 @@ class ShardedEngine:
             if self.store is not None and touched:
                 self._store_write_global(
                     [(k, e) for k, e in live if e.gidx in touched], cfg)
+            self._sweep_globals(now_ms)
             return len(live)
 
     def global_pending_hits(self) -> int:
@@ -583,7 +601,8 @@ class ShardedEngine:
 
     # ------------------------------------------------------------- internals
 
-    def _try_answer_global(self, item: WorkItem, responses) -> bool:
+    def _try_answer_global(self, item: WorkItem, responses,
+                           now_ms: int) -> bool:
         """Answer a GLOBAL request from the replicated mirror; queue its hits
         for the next sync. Returns False if the item must go to the kernel
         (not GLOBAL, or first touch)."""
@@ -593,12 +612,18 @@ class ShardedEngine:
         key = r.hash_key()
         entry = self._globals.get(key)
         if entry is None:
-            if len(self._globals) >= self.global_capacity:
-                # registry full: serve authoritatively, skip async pipeline
+            gidx = self._alloc_gidx(now_ms)
+            if gidx < 0:
+                # every slot has unsynced hits: serve this one
+                # authoritatively and try again next touch
+                self.stats["global_registry_fallbacks"] += 1
                 return False
-            entry = _GlobalEntry(len(self._globals), self.owner_of(key))
+            entry = _GlobalEntry(gidx, self.owner_of(key), now_ms)
             self._globals[key] = entry
+        else:
+            self._globals.move_to_end(key)
         entry.req = r
+        entry.last_ms = now_ms
         if not entry.seen:
             return False  # first touch: authoritative kernel path
         self._gdelta[entry.gidx] += r.hits
@@ -627,6 +652,58 @@ class ShardedEngine:
             reset_time=int(self._mirror.reset_time[g]),
         )
         return True
+
+    def _alloc_gidx(self, now_ms: int) -> int:
+        """Claim a registry slot: free list, then high-water growth, then LRU
+        eviction of a zero-delta entry. -1 when every slot holds unsynced
+        hits (caller falls back to the authoritative path for one window)."""
+        if self._gfree:
+            return self._gfree.pop()
+        if self._gnext < self.global_capacity:
+            g = self._gnext
+            self._gnext += 1
+            return g
+        # oldest-first iteration order: the first zero-delta entry IS the
+        # LRU victim (entries with queued hits are skipped — evicting them
+        # would lose hits); O(1) except when the oldest entries all hold
+        # unsynced deltas
+        for key, e in self._globals.items():
+            if self._gdelta[e.gidx]:
+                continue
+            self._evict_global(key, e)
+            return self._gfree.pop()
+        return -1
+
+    def _evict_global(self, key: str, entry: _GlobalEntry) -> None:
+        """Drop one registered global key and recycle its gidx. The bucket
+        row itself stays in the sharded table (its own expiry handles it);
+        a re-registered key restarts on the first-touch authoritative path,
+        exactly like a key evicted from the reference's LRU
+        (cache.go:140-165)."""
+        del self._globals[key]
+        g = entry.gidx
+        self._gdelta[g] = 0  # zero by precondition; keep it invariant
+        self._mirror.status[g] = 0
+        self._mirror.limit[g] = 0
+        self._mirror.remaining[g] = 0
+        self._mirror.reset_time[g] = 0
+        self._gfree.append(g)
+        self.stats["global_evictions"] += 1
+
+    def _sweep_globals(self, now_ms: int) -> None:
+        """Evict idle registered keys (no touch for global_idle_ms). Runs
+        after a sync window, when every delta has just been flushed, so the
+        zero-delta precondition holds for all live entries."""
+        idle = [
+            (k, e) for k, e in self._globals.items()
+            if now_ms - e.last_ms > self.global_idle_ms
+            and not self._gdelta[e.gidx]
+        ]
+        for k, e in idle:
+            self._evict_global(k, e)
+
+    def global_registry_size(self) -> int:
+        return len(self._globals)
 
     # Same fast-path bounds as models/engine.py: scan groups are capped at 32
     # windows of exactly min_width lanes, so warmup() can pre-compile every

@@ -95,6 +95,22 @@ class Metrics:
             "GLOBAL hits queued for the next mesh sync window.",
             registry=self.registry,
         )
+        self.engine_global_evictions = Counter(
+            "engine_global_evictions_total",
+            "GLOBAL registry entries evicted (idle sweep or LRU-on-full).",
+            registry=self.registry,
+        )
+        self.engine_global_registry_fallbacks = Counter(
+            "engine_global_registry_fallbacks_total",
+            "New GLOBAL keys served authoritatively because every registry "
+            "slot still held unsynced hits.",
+            registry=self.registry,
+        )
+        self.engine_global_registry_size = Gauge(
+            "engine_global_registry_size",
+            "Registered GLOBAL keys currently tracked by the mesh backend.",
+            registry=self.registry,
+        )
 
     def observe_instance(self, instance) -> None:
         """Refresh gauges from live objects before exposition."""
@@ -120,6 +136,15 @@ class Metrics:
             self._set_counter(
                 self.engine_global_hits_queued,
                 d.get("global_hits_queued", 0))
+            self._set_counter(
+                self.engine_global_evictions,
+                d.get("global_evictions", 0))
+            self._set_counter(
+                self.engine_global_registry_fallbacks,
+                d.get("global_registry_fallbacks", 0))
+        registry_size = getattr(instance.backend, "global_registry_size", None)
+        if callable(registry_size):
+            self.engine_global_registry_size.set(registry_size())
         cache = getattr(instance, "_global_cache", None)
         if cache is not None:
             self.cache_size.set(len(cache))
