@@ -182,7 +182,8 @@ def main(argv=None) -> int:
     # no-op for single-host deployments
     from gubernator_tpu.parallel.multihost import initialize_from_env
 
-    initialize_from_env(conf.coordinator_address, conf.num_hosts, conf.host_id)
+    multi_host = initialize_from_env(
+        conf.coordinator_address, conf.num_hosts, conf.host_id)
 
     backend = build_backend(conf)
     log.info("warming up decision kernel (compiling width buckets)...")
@@ -201,6 +202,26 @@ def main(argv=None) -> int:
         ),
         advertise_address=advertise,
     )
+    if multi_host:
+        # cross-host GLOBAL aggregation rides the device fabric: one
+        # lockstep collective per tick replaces the per-peer gRPC pipelines
+        # (which stay wired as the fallback transport). Every daemon in the
+        # process group runs the same fixed-cadence loop (SPMD).
+        from gubernator_tpu.parallel.multihost import CollectiveGlobalChannel
+        from gubernator_tpu.service.collective_global import (
+            CollectiveGlobalSync,
+        )
+
+        channel = CollectiveGlobalChannel(conf.cross_host_capacity)
+        collective = CollectiveGlobalSync(
+            instance, channel, interval_s=conf.cross_host_sync_s)
+        instance.attach_collective(collective)
+        collective.start()
+        log.info(
+            "cross-host GLOBAL collective: %d hosts, %d slots, tick %.0f ms",
+            conf.num_hosts, conf.cross_host_capacity,
+            conf.cross_host_sync_s * 1e3)
+
     server, port = make_server(
         instance,
         conf.grpc_address,
@@ -237,6 +258,17 @@ def main(argv=None) -> int:
 
         jax.profiler.stop_trace()
         log.info("XLA trace written to %s", conf.profile_dir)
+    if multi_host:
+        # jax.distributed's interpreter-exit hooks block synchronizing with
+        # the coordinator; when the whole fleet shuts down at once (or the
+        # coordinator died first) that wait can outlive any supervisor's
+        # grace period. Every flush above is done (loader saved, pipelines
+        # drained), so leave hard.
+        log.info("multi-host daemon exiting")
+        sys.stderr.flush()
+        import os
+
+        os._exit(0)
     return 0
 
 

@@ -144,6 +144,11 @@ class DaemonConfig:
     coordinator_address: str = ""
     num_hosts: int = 1
     host_id: int = 0
+    # cross-host collective GLOBAL transport (service/collective_global.py);
+    # active whenever num_hosts > 1. Interval is the lockstep tick cadence —
+    # every host in the process group must use the same value.
+    cross_host_sync_s: float = 0.1
+    cross_host_capacity: int = 1024
     debug: bool = False
 
 
@@ -214,6 +219,8 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         coordinator_address=_env_str("GUBER_COORDINATOR_ADDRESS"),
         num_hosts=_env_int("GUBER_NUM_HOSTS", 1),
         host_id=_env_int("GUBER_HOST_ID", 0),
+        cross_host_sync_s=_env_dur("GUBER_CROSS_HOST_SYNC", 0.1),
+        cross_host_capacity=_env_int("GUBER_CROSS_HOST_CAPACITY", 1024),
         debug=opts.debug or bool(os.environ.get("GUBER_DEBUG")),
     )
     if conf.collectives not in ("psum", "ring"):

@@ -81,6 +81,82 @@ def make_node_mesh(devices=None) -> jax.sharding.Mesh:
     return jax.sharding.Mesh(np.array(devices, dtype=object), (NODE_AXIS,))
 
 
+class CollectiveGlobalChannel:
+    """One lockstep dispatch carrying the whole cross-host GLOBAL exchange.
+
+    Three logical flows share a single collective step (the reference needs
+    two asynchronous gRPC pipelines for the same information movement,
+    global.go:73-156 hit fan-in and global.go:159-239 state fan-out):
+
+    - ``delta``  i64[G]: this host's queued hit deltas → psum = cluster total
+      per slot, delivered to the slot owner.
+    - ``claim``  i64[G]: nonzero key-claim hash per slot this host uses.
+      Slots are assigned deterministically (hash of the key), so two hosts
+      using the same slot for DIFFERENT keys is possible; the claim triple
+      (sum, max, count) lets every host verify agreement — a slot is clean
+      for me iff ``sum == count * max and max == my_claim``. Hosts only
+      contribute deltas/state on slots verified clean on a PREVIOUS tick,
+      so a conflict can never mix two keys' hits.
+    - ``state``  i64[5, G]: rows (valid, status, limit, remaining,
+      reset_time). The owning host contributes its authoritative post-apply
+      state with valid=1; psum hands it to every host. valid != 1 (owner
+      missing, or two hosts claiming ownership during a membership change)
+      means "do not apply this tick".
+
+    Lockstep contract is the same as CrossHostHitSync: every host calls
+    step() in the same sequence, on a fixed cadence.
+    """
+
+    def __init__(self, global_capacity: int, mesh=None):
+        self.global_capacity = global_capacity
+        self.mesh = mesh if mesh is not None else make_node_mesh()
+        self._n_local = len(self.mesh.local_devices)
+        self._row = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(NODE_AXIS, None))
+        self._row3 = jax.sharding.NamedSharding(
+            self.mesh, jax.sharding.PartitionSpec(NODE_AXIS, None, None))
+
+        def _exchange(delta, claim, state):
+            # each block sees ONE device's contribution rows
+            import jax.numpy as jnp
+
+            d = jax.lax.psum(delta[0], NODE_AXIS)
+            c_sum = jax.lax.psum(claim[0], NODE_AXIS)
+            c_max = jax.lax.pmax(claim[0], NODE_AXIS)
+            c_cnt = jax.lax.psum(
+                (claim[0] != 0).astype(jnp.int64), NODE_AXIS)
+            st = jax.lax.psum(state[0], NODE_AXIS)
+            return d, c_sum, c_max, c_cnt, st
+
+        spec_r = jax.sharding.PartitionSpec(NODE_AXIS, None)
+        spec_r3 = jax.sharding.PartitionSpec(NODE_AXIS, None, None)
+        self._step = jax.jit(jax.shard_map(
+            _exchange, mesh=self.mesh,
+            in_specs=(spec_r, spec_r, spec_r3),
+            out_specs=(jax.sharding.PartitionSpec(),) * 5,
+        ))
+        self.steps = 0
+
+    def step(self, delta: np.ndarray, claim: np.ndarray,
+             state: np.ndarray):
+        """One collective tick. Returns host arrays
+        (total_delta[G], claim_sum[G], claim_max[G], claim_cnt[G],
+        state[5, G])."""
+        G = self.global_capacity
+        d = np.zeros((self._n_local, G), np.int64)
+        c = np.zeros((self._n_local, G), np.int64)
+        s = np.zeros((self._n_local, 5, G), np.int64)
+        d[0], c[0], s[0] = delta, claim, state
+        args = (
+            jax.make_array_from_process_local_data(self._row, d),
+            jax.make_array_from_process_local_data(self._row, c),
+            jax.make_array_from_process_local_data(self._row3, s),
+        )
+        out = self._step(*args)
+        self.steps += 1
+        return tuple(np.asarray(o) for o in out)
+
+
 class CrossHostHitSync:
     """Lockstep psum of per-host hit-delta vectors across the process group.
 

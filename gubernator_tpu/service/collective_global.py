@@ -1,0 +1,420 @@
+"""Cross-host GLOBAL sync over the device fabric.
+
+The reference moves GLOBAL aggregate state between machines with two gRPC
+pipelines — non-owners fan hits in to the owner (global.go:73-156) and the
+owner fans authoritative state out to every peer (global.go:159-239), both
+O(peers) unary RPCs per window. When the daemons share a jax.distributed
+process group, this module replaces BOTH transports with one lockstep
+collective per tick (parallel/multihost.py CollectiveGlobalChannel): hosts
+psum their hit deltas and the owner's post-apply state in a single dispatch
+that rides ICI/DCN instead of the RPC stack.
+
+Slot identity without strings on the wire
+-----------------------------------------
+Collectives move numbers, not key strings, so every host must agree which
+vector slot a key occupies. Slots are assigned deterministically
+(fnv1a64(key) % G) and verified by a claims protocol: each host contributes
+a nonzero claim hash for every slot it uses; a slot is clean for me iff
+``claim_sum == claim_cnt * claim_max and claim_max == my_claim``. A new key
+spends its first tick in CLAIMING (claims contributed, no hits), so by the
+time any host contributes deltas on a slot, every host has had the chance
+to detect a collision. Conflicted keys demote permanently to the gRPC
+pipelines (GlobalManager) — correctness never depends on the collective
+tier, it is a transport upgrade.
+
+Lockstep + stall behavior
+-------------------------
+Every host runs the same fixed-cadence tick loop (SPMD: ticks fire whether
+or not there is traffic; the collective blocks until all hosts arrive).
+Defined stall behavior: a tick that exceeds ``stall_timeout_s`` flips
+``health_error()`` (surfaced by Instance.health_check) while the blocked
+step waits; a step that raises (process-group failure) permanently degrades
+to the gRPC pipelines — queued hits are re-routed, none are lost.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from gubernator_tpu.cluster.pickers import PickerEmptyError
+from gubernator_tpu.types import (
+    Behavior,
+    RateLimitReq,
+    without_behavior,
+)
+from gubernator_tpu.utils.fnv import fnv1a_64_str
+
+log = logging.getLogger("gubernator_tpu.collective")
+
+# key phases
+CLAIMING = 0  # claim contributed; deltas/state held back one tick
+ESTABLISHED = 1  # slot verified clean: collective transport active
+FALLBACK = 2  # collision or capacity: gRPC pipelines own this key
+
+
+class _CKey:
+    __slots__ = ("slot", "claim", "req", "phase", "is_owner", "pending",
+                 "last_state", "last_touch_s", "owner_seen", "pending_age")
+
+    def __init__(self, slot: int, claim: int, req: RateLimitReq,
+                 is_owner: bool, now_s: float):
+        self.slot = slot
+        self.claim = claim
+        self.req = req
+        self.phase = CLAIMING
+        self.is_owner = is_owner
+        self.pending = 0  # queued hits awaiting the next tick (non-owner)
+        self.last_state = None  # owner: (status, limit, remaining, reset)
+        self.last_touch_s = now_s  # time.monotonic seconds (idle eviction)
+        # deltas are contributed only once the owner's state has been seen
+        # on the slot — proof an established owner is applying totals; until
+        # then pending hits wait, and age out to the gRPC pipeline
+        self.owner_seen = is_owner
+        self.pending_age = 0  # ticks spent waiting for owner_seen
+
+
+class CollectiveGlobalSync:
+    """Fixed-cadence lockstep GLOBAL sync for one daemon/host."""
+
+    def __init__(
+        self,
+        instance,
+        channel,
+        interval_s: float = 0.1,
+        stall_timeout_s: float = 10.0,
+        idle_s: float = 300.0,
+        owner_wait_ticks: int = 50,
+        slot_fn: Optional[Callable[[str], int]] = None,
+    ):
+        self.instance = instance
+        self.channel = channel
+        self.G = channel.global_capacity
+        self.interval_s = interval_s
+        self.stall_timeout_s = stall_timeout_s
+        self.idle_s = idle_s
+        self.owner_wait_ticks = owner_wait_ticks
+        self._slot_fn = slot_fn or (lambda key: fnv1a_64_str(key) % self.G)
+        self._keys: Dict[str, _CKey] = {}
+        self._by_slot: Dict[int, str] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._tick_started: Optional[float] = None  # wall clock, stall watch
+        self._failed: Optional[str] = None
+        self.stats = {
+            "ticks": 0,
+            "hits_synced": 0,
+            "deltas_applied": 0,
+            "broadcasts_applied": 0,
+            "claims_established": 0,
+            "conflicts": 0,
+            "fallbacks": 0,
+        }
+
+    # ------------------------------------------------------------ public API
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._run, name="collective-global", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            # a step blocked on a dead peer cannot be joined; daemon threads
+            # die with the process (the defined stall behavior)
+            self._thread.join(timeout=self.interval_s + 1.0)
+        # hits accepted since the last tick must not die with the loop:
+        # hand them to the gRPC pipeline, whose own close() flushes
+        # synchronously (Instance.close() closes the GlobalManager after us)
+        self._requeue_all_pending()
+
+    def queue_hit(self, req: RateLimitReq) -> bool:
+        """Absorb a non-owner hit into the next collective tick. False means
+        the caller must use the gRPC pipeline (key conflicted/unknown, or
+        the collective tier has failed)."""
+        if self._failed:
+            return False
+        key = req.hash_key()
+        with self._lock:
+            e = self._keys.get(key)
+            if e is None:
+                e = self._register(key, req, is_owner=False)
+            if e is None or e.phase == FALLBACK:
+                return False
+            e.req = req
+            e.last_touch_s = time.monotonic()
+            if e.phase != ESTABLISHED:
+                return False  # still claiming: one window via gRPC
+            e.pending += req.hits
+        return True
+
+    def queue_update(self, req: RateLimitReq) -> bool:
+        """Owner-side: True when the collective broadcast covers this key
+        (its post-apply state rides every tick), so the gRPC broadcast can
+        be skipped."""
+        if self._failed:
+            return False
+        key = req.hash_key()
+        with self._lock:
+            e = self._keys.get(key)
+            if e is None:
+                e = self._register(key, req, is_owner=True)
+            if e is None or e.phase == FALLBACK:
+                return False
+            e.req = req
+            e.is_owner = True
+            e.owner_seen = True  # we ARE the owner
+            e.last_touch_s = time.monotonic()
+            return e.phase == ESTABLISHED
+
+    def register_remote(self, req: RateLimitReq) -> None:
+        """Non-owner first touch (relayed synchronously to the owner):
+        start claiming the slot so the owner's broadcasts reach this host's
+        cache on the next ticks."""
+        if self._failed:
+            return
+        with self._lock:
+            if req.hash_key() not in self._keys:
+                self._register(req.hash_key(), req, is_owner=False)
+
+    def health_error(self) -> Optional[str]:
+        if self._failed:
+            return f"cross-host GLOBAL sync failed: {self._failed}"
+        started = self._tick_started
+        if started is not None and \
+                time.monotonic() - started > self.stall_timeout_s:
+            return ("cross-host GLOBAL sync stalled "
+                    f">{self.stall_timeout_s}s (peer host not ticking?)")
+        return None
+
+    # ------------------------------------------------------------- internals
+
+    def _register(self, key: str, req: RateLimitReq,
+                  is_owner: bool) -> Optional[_CKey]:
+        slot = self._slot_fn(key)
+        if self._by_slot.get(slot, key) != key:
+            # host-local collision: this key can never use the slot
+            self.stats["fallbacks"] += 1
+            e = _CKey(slot, 0, req, is_owner, time.monotonic())
+            e.phase = FALLBACK
+            self._keys[key] = e
+            return e
+        # 55-bit claims keep the psum exact in int64 up to 256 hosts
+        claim = (fnv1a_64_str(key) & ((1 << 55) - 1)) + 1  # nonzero
+        e = _CKey(slot, claim, req, is_owner, time.monotonic())
+        self._keys[key] = e
+        self._by_slot[slot] = key
+        return e
+
+    def _refresh_ownership(self, key: str, e: _CKey) -> None:
+        """Track membership changes: ownership is re-read from the picker
+        every tick, never trusted from registration time. A promoted host
+        starts applying/broadcasting; a demoted host immediately stops
+        contributing state (else two hosts would psum valid=2 forever and
+        freeze every non-owner's cache) and waits to SEE the new owner's
+        state before contributing deltas again. During the window where the
+        two hosts' peer lists disagree, non-owners skip the transient
+        valid=2 ticks by design."""
+        try:
+            is_owner = self.instance.get_peer(key).info.is_owner
+        except PickerEmptyError:
+            is_owner = True  # standalone: we own everything
+        except Exception:  # noqa: BLE001 — keep the last known role
+            return
+        if is_owner == e.is_owner:
+            return
+        e.is_owner = is_owner
+        e.owner_seen = is_owner
+        e.last_state = None
+
+    def _run(self) -> None:
+        next_tick = time.monotonic()
+        while not self._stop.is_set():
+            next_tick += self.interval_s
+            try:
+                self.tick()
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                self._failed = repr(e)
+                log.exception(
+                    "collective GLOBAL sync failed; degrading to gRPC "
+                    "pipelines")
+                self._requeue_all_pending()
+                return
+            delay = next_tick - time.monotonic()
+            if delay > 0:
+                self._stop.wait(delay)
+            else:
+                next_tick = time.monotonic()  # missed cadence: don't burst
+
+    def tick(self) -> None:
+        """One lockstep exchange. Must run the same number of times on every
+        host (SPMD) — it fires on the cadence regardless of traffic."""
+        delta = np.zeros((self.G,), np.int64)
+        claim = np.zeros((self.G,), np.int64)
+        state = np.zeros((5, self.G), np.int64)
+        in_flight: Dict[str, int] = {}
+        aged_out = []  # reqs whose pending hits waited too long for an owner
+        included = []  # keys whose claims ride THIS exchange: only these may
+        # be judged afterwards — a key registered while the step blocks on
+        # the fabric has no claim in the result and must wait its turn
+        with self._lock:
+            for key, e in self._keys.items():
+                if e.phase == FALLBACK:
+                    continue
+                self._refresh_ownership(key, e)
+                included.append(key)
+                claim[e.slot] = e.claim
+                if e.phase != ESTABLISHED:
+                    continue
+                if e.pending:
+                    if e.owner_seen:
+                        delta[e.slot] = e.pending
+                        in_flight[key] = e.pending
+                        e.pending = 0
+                        e.pending_age = 0
+                    else:
+                        # no proof an owner is applying this slot's totals
+                        # yet: hold the hits, and after owner_wait_ticks
+                        # give up and send them down the gRPC pipeline (the
+                        # owner may be host-locally conflicted forever)
+                        e.pending_age += 1
+                        if e.pending_age > self.owner_wait_ticks:
+                            aged_out.append(
+                                (dataclasses.replace(e.req, hits=e.pending)))
+                            e.pending = 0
+                            e.pending_age = 0
+                if e.is_owner and e.last_state is not None:
+                    state[0, e.slot] = 1
+                    state[1:, e.slot] = e.last_state
+        for req in aged_out:
+            self.instance.global_manager.queue_hit(req)
+
+        self._tick_started = time.monotonic()
+        try:
+            total, c_sum, c_max, c_cnt, st = self.channel.step(
+                delta, claim, state)
+        except BaseException:
+            # the exchange never happened: restore drained hits so the
+            # degradation path (_requeue_all_pending) can re-route them
+            with self._lock:
+                for key, n in in_flight.items():
+                    e = self._keys.get(key)
+                    if e is not None:
+                        e.pending += n
+            raise
+        finally:
+            self._tick_started = None
+
+        owner_batch = []  # (key, entry, req_with_total_delta)
+        apply_cache = []  # (key, entry, status4)
+        with self._lock:
+            for key in included:
+                e = self._keys.get(key)
+                if e is None or e.phase == FALLBACK:
+                    continue
+                s = e.slot
+                clean = (c_max[s] == e.claim
+                         and c_sum[s] == c_cnt[s] * c_max[s])
+                if not clean:
+                    self._demote(key, e, in_flight)
+                    continue
+                if e.phase == CLAIMING:
+                    e.phase = ESTABLISHED
+                    self.stats["claims_established"] += 1
+                    # NO `continue`: establishment can straddle one tick
+                    # across hosts (registration races the drains), so an
+                    # already-established peer may have contributed deltas
+                    # THIS tick — a just-established owner must consume them
+                if e.is_owner:
+                    # apply the cluster total of remote hits and re-read
+                    # authoritative state in ONE batched backend call; the
+                    # response is next tick's broadcast contribution
+                    hits = int(total[s])
+                    self.stats["hits_synced"] += in_flight.pop(key, 0)
+                    if c_cnt[s] > 1:
+                        # non-owner hosts still claim this slot: keep the
+                        # owner entry alive or their deltas would psum into
+                        # a slot nobody applies (idle sweep must only fire
+                        # once every host has let go)
+                        e.last_touch_s = time.monotonic()
+                    # keep MULTI_REGION when carrying real hits so the
+                    # owner's apply replicates them cross-region exactly as
+                    # the gRPC path does (multiregion.go); strip it on pure
+                    # peeks to avoid queueing empty replication entries
+                    base = without_behavior(e.req, Behavior.GLOBAL)
+                    if not hits:
+                        base = without_behavior(base, Behavior.MULTI_REGION)
+                    owner_batch.append(
+                        (key, e, dataclasses.replace(base, hits=hits)))
+                    if hits:
+                        self.stats["deltas_applied"] += hits
+                else:
+                    # delivered to the owner via the psum
+                    self.stats["hits_synced"] += in_flight.pop(key, 0)
+                    if int(st[0, s]) == 1:
+                        e.owner_seen = True
+                        e.pending_age = 0
+                        apply_cache.append(
+                            (key, e,
+                             (int(st[1, s]), int(st[2, s]),
+                              int(st[3, s]), int(st[4, s]))))
+            self._sweep_idle()
+
+        # backend + cache work outside the registry lock
+        if owner_batch:
+            resps = self.instance.apply_owner_batch(
+                [r for _, _, r in owner_batch])
+            with self._lock:
+                for (key, e, _), resp in zip(owner_batch, resps):
+                    if resp.error:
+                        continue
+                    e.last_state = (int(resp.status), resp.limit,
+                                    resp.remaining, resp.reset_time)
+        for key, e, (status, limit, remaining, reset) in apply_cache:
+            self.instance.apply_global_state(
+                key, int(e.req.algorithm), status, limit, remaining, reset)
+            self.stats["broadcasts_applied"] += 1
+        self.stats["ticks"] += 1
+
+    def _demote(self, key: str, e: _CKey, in_flight: Dict[str, int]) -> None:
+        """Cross-host claim conflict: this key permanently leaves the
+        collective tier. Hits contributed this tick were NOT applied by any
+        owner (the owner sees the same conflict), so they re-route through
+        the gRPC pipeline along with anything still pending."""
+        e.phase = FALLBACK
+        self.stats["conflicts"] += 1
+        self.stats["fallbacks"] += 1
+        if self._by_slot.get(e.slot) == key:
+            del self._by_slot[e.slot]
+        lost = in_flight.pop(key, 0) + e.pending
+        e.pending = 0
+        if lost:
+            self.instance.global_manager.queue_hit(
+                dataclasses.replace(e.req, hits=lost))
+
+    def _sweep_idle(self) -> None:
+        """Idle keys release their slots (same role as the sharded backend's
+        registry sweep): eviction is safe once nothing is pending."""
+        now = time.monotonic()
+        for key in [
+            k for k, e in self._keys.items()
+            if now - e.last_touch_s > self.idle_s and not e.pending
+        ]:
+            e = self._keys.pop(key)
+            if self._by_slot.get(e.slot) == key:
+                del self._by_slot[e.slot]
+
+    def _requeue_all_pending(self) -> None:
+        with self._lock:
+            for e in self._keys.values():
+                if e.pending:
+                    self.instance.global_manager.queue_hit(
+                        dataclasses.replace(e.req, hits=e.pending))
+                    e.pending = 0

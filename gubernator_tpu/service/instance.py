@@ -106,7 +106,15 @@ class Instance:
         self._forward_pool = ThreadPoolExecutor(
             max_workers=64, thread_name_prefix="forward"
         )
+        # optional collective (device-fabric) GLOBAL transport; when attached
+        # it absorbs queue_hit/queue_update and the gRPC pipelines remain the
+        # fallback (service/collective_global.py)
+        self.collective_global = None
         self._closed = False
+
+    def attach_collective(self, sync) -> None:
+        """Wire a CollectiveGlobalSync (multi-host daemons only)."""
+        self.collective_global = sync
 
     # ----------------------------------------------------------- public API
 
@@ -142,6 +150,10 @@ class Instance:
                     error=f"while finding peer that owns rate limit '{key}' - '{e}'"
                 )
                 continue
+            if log.isEnabledFor(logging.DEBUG):
+                log.debug("route key=%s -> %s is_owner=%s behavior=%d",
+                          key, peer.info.address, peer.info.is_owner,
+                          req.behavior)
             if peer.info.is_owner:
                 local.append(i)
             elif has_behavior(req.behavior, Behavior.GLOBAL):
@@ -186,23 +198,36 @@ class Instance:
         """Receive an owner's GLOBAL broadcast (reference: gubernator.go:251-264).
         `updates` are peers_pb.UpdatePeerGlobal messages."""
         for g in updates:
-            self._global_cache.add(
-                CacheItem(
-                    key=g.key,
-                    value=_GlobalStatus(
-                        status=int(g.status.status),
-                        limit=g.status.limit,
-                        remaining=g.status.remaining,
-                        reset_time=g.status.reset_time,
-                    ),
-                    expire_at=g.status.reset_time,
-                    algorithm=int(g.algorithm),
-                )
+            self.apply_global_state(
+                g.key, int(g.algorithm), int(g.status.status),
+                g.status.limit, g.status.remaining, g.status.reset_time)
+
+    def apply_global_state(self, key: str, algorithm: int, status: int,
+                           limit: int, remaining: int, reset_time: int) -> None:
+        """Install one key's authoritative GLOBAL state into the local cache
+        — the broadcast receive path, shared by the gRPC transport
+        (update_peer_globals) and the collective transport."""
+        self._global_cache.add(
+            CacheItem(
+                key=key,
+                value=_GlobalStatus(
+                    status=status,
+                    limit=limit,
+                    remaining=remaining,
+                    reset_time=reset_time,
+                ),
+                expire_at=reset_time,
+                algorithm=algorithm,
             )
+        )
 
     def health_check(self) -> HealthCheckResp:
         """Accumulate recent peer errors (reference: gubernator.go:287-325)."""
         errs: List[str] = []
+        if self.collective_global is not None:
+            err = self.collective_global.health_error()
+            if err:
+                errs.append(err)
         with self._peer_lock:
             for peer in self.local_picker.peers():
                 errs.extend(peer.get_last_err())
@@ -244,6 +269,10 @@ class Instance:
 
             old_local, self.local_picker = self.local_picker, new_local
             old_region, self.region_picker = self.region_picker, new_region
+            log.info(
+                "peers updated: %d local, %d region, self=%s",
+                new_local.size(), new_region.size(),
+                self.advertise_address or "?")
 
         shutdown = [
             p for p in old_local.peers()
@@ -262,6 +291,8 @@ class Instance:
         if self._closed:
             return
         self._closed = True
+        if self.collective_global is not None:
+            self.collective_global.close()
         self.global_manager.close()
         self.multiregion_manager.close()
         self._forward_pool.shutdown(wait=False)
@@ -299,7 +330,9 @@ class Instance:
         stripped = []
         for req in requests:
             if has_behavior(req.behavior, Behavior.GLOBAL):
-                self.global_manager.queue_update(req)
+                cg = self.collective_global
+                if cg is None or not cg.queue_update(req):
+                    self.global_manager.queue_update(req)
             if has_behavior(req.behavior, Behavior.MULTI_REGION):
                 self.multiregion_manager.queue_hits(req)
             if has_behavior(req.behavior, Behavior.GLOBAL):
@@ -401,7 +434,9 @@ class Instance:
                     else:
                         st.remaining -= req.hits
                         status = st.status
-                self.global_manager.queue_hit(req)
+                cg = self.collective_global
+                if cg is None or not cg.queue_hit(req):
+                    self.global_manager.queue_hit(req)
                 return RateLimitResp(
                     status=status,
                     limit=st.limit,
@@ -414,6 +449,11 @@ class Instance:
         try:
             resp = owner_peer.get_peer_rate_limit(req)
             resp.metadata["owner"] = owner_peer.info.address
+            if self.collective_global is not None:
+                # start claiming the key's slot so the owner's collective
+                # broadcasts can reach this host's cache (no strings ride
+                # the collective — registration is how key<->slot binds)
+                self.collective_global.register_remote(req)
             return resp
         except Exception:  # noqa: BLE001
             # Owner unreachable: process locally as-if-owner so the limit

@@ -111,6 +111,21 @@ class Metrics:
             "Registered GLOBAL keys currently tracked by the mesh backend.",
             registry=self.registry,
         )
+        # cross-host collective GLOBAL transport (collective_global.py)
+        self.cross_host = {
+            name: Counter(
+                f"cross_host_{name}_total", help_, registry=self.registry)
+            for name, help_ in (
+                ("ticks", "Lockstep collective GLOBAL sync ticks."),
+                ("hits_synced", "GLOBAL hits delivered over the collective."),
+                ("deltas_applied",
+                 "Remote GLOBAL hits applied by this owner host."),
+                ("broadcasts_applied",
+                 "Authoritative GLOBAL states installed from the collective."),
+                ("conflicts", "Slot claim conflicts (keys demoted to gRPC)."),
+                ("fallbacks", "GLOBAL keys using the gRPC pipelines."),
+            )
+        }
 
     def observe_instance(self, instance) -> None:
         """Refresh gauges from live objects before exposition."""
@@ -145,6 +160,10 @@ class Metrics:
         registry_size = getattr(instance.backend, "global_registry_size", None)
         if callable(registry_size):
             self.engine_global_registry_size.set(registry_size())
+        collective = getattr(instance, "collective_global", None)
+        if collective is not None:
+            for name, counter in self.cross_host.items():
+                self._set_counter(counter, collective.stats.get(name, 0))
         cache = getattr(instance, "_global_cache", None)
         if cache is not None:
             self.cache_size.set(len(cache))

@@ -39,13 +39,14 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
-def spawn_daemon(env_overrides, ready_timeout=240.0):
+def spawn_daemon(env_overrides, ready_timeout=240.0, stderr_path=None):
     """Spawn the real daemon subprocess and wait for its Ready sentinel.
 
     The sentinel is read on a side thread so a silently wedged daemon
     (alive, printing nothing) fails at the deadline instead of hanging the
     suite on a blocking readline. Returns the Popen; callers own teardown
-    (terminate + wait, kill on TimeoutExpired).
+    (terminate + wait, kill on TimeoutExpired). `stderr_path` tees the
+    daemon's log stream to a file for post-mortem assertions.
     """
     import os
     import subprocess
@@ -59,11 +60,15 @@ def spawn_daemon(env_overrides, ready_timeout=240.0):
                    os.path.join(repo, "tests", ".jax_cache"))
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
     env.update(env_overrides)
+    stderr = (open(stderr_path, "w") if stderr_path
+              else subprocess.DEVNULL)
     proc = subprocess.Popen(
         [sys.executable, "-m", "gubernator_tpu.cmd.daemon"],
-        env=env, cwd=repo, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        env=env, cwd=repo, stdout=subprocess.PIPE, stderr=stderr,
         text=True,
     )
+    if stderr_path:
+        stderr.close()  # the child holds its own descriptor
     ready = threading.Event()
 
     def wait_ready():

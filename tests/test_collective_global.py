@@ -1,0 +1,482 @@
+"""Collective (device-fabric) cross-host GLOBAL transport tests.
+
+Strategy mirrors the reference's GLOBAL test (functional_test.go:274-345):
+a REAL loopback cluster carries the traffic, and the collective tier is
+driven tick-by-tick in lockstep threads through a FakeFabric — an
+in-process stand-in for CollectiveGlobalChannel that performs the exact
+psum/pmax exchange the device fabric does (the fabric itself is covered by
+tests/test_multihost.py's two-process collective test and the 2-daemon
+end-to-end test there)."""
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.cluster.harness import LocalCluster
+from gubernator_tpu.service.collective_global import (
+    CLAIMING,
+    ESTABLISHED,
+    FALLBACK,
+    CollectiveGlobalSync,
+)
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq, Status
+
+NOW = 1_700_000_000_000
+
+
+class FakeFabric:
+    """K lockstep endpoints exchanging psum/pmax like the device fabric."""
+
+    def __init__(self, k: int, capacity: int):
+        self.k = k
+        self.capacity = capacity
+        self._barrier = threading.Barrier(k, timeout=30)
+        self._contrib = [None] * k
+        self._result = None
+        self.endpoints = [_Endpoint(self, i) for i in range(k)]
+
+    def exchange(self, idx, delta, claim, state):
+        self._contrib[idx] = (delta, claim, state)
+        if self._barrier.wait() == 0:  # leader reduces
+            deltas, claims, states = zip(*self._contrib)
+            claims = np.stack(claims)
+            self._result = (
+                np.sum(deltas, axis=0),
+                claims.sum(axis=0),
+                claims.max(axis=0),
+                (claims != 0).sum(axis=0).astype(np.int64),
+                np.sum(states, axis=0),
+            )
+        self._barrier.wait()
+        return self._result
+
+
+class _Endpoint:
+    def __init__(self, fabric: FakeFabric, idx: int):
+        self._fabric = fabric
+        self._idx = idx
+        self.global_capacity = fabric.capacity
+        self.steps = 0
+
+    def step(self, delta, claim, state):
+        self.steps += 1
+        return self._fabric.exchange(self._idx, delta, claim, state)
+
+
+def lockstep(syncs):
+    """Run one tick on every host concurrently (the fixed-cadence loop's
+    job in production; manual here so tests control the clock)."""
+    errs = []
+
+    def run(s):
+        try:
+            s.tick()
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    ts = [threading.Thread(target=run, args=(s,)) for s in syncs]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=60)
+    assert not errs, errs
+    assert not any(t.is_alive() for t in ts), "lockstep tick deadlocked"
+
+
+def _greq(key, hits, limit=100):
+    return RateLimitReq(
+        name="col", unique_key=key, hits=hits, limit=limit,
+        duration=60_000, algorithm=Algorithm.TOKEN_BUCKET,
+        behavior=Behavior.GLOBAL,
+    )
+
+
+@pytest.fixture()
+def duo():
+    """2-node loopback cluster with collective GLOBAL attached and the gRPC
+    global pipelines frozen (so any convergence is the collective's)."""
+    cluster = LocalCluster().start(2)
+    fabric = FakeFabric(2, 64)
+    syncs = []
+    for i, ci in enumerate(cluster.instances):
+        s = CollectiveGlobalSync(
+            ci.instance, fabric.endpoints[i], interval_s=3600)
+        ci.instance.attach_collective(s)
+        # freeze the gRPC pipelines: traffic must ride the collective
+        ci.instance.global_manager._hits._wait_s = 3600
+        ci.instance.global_manager._broadcasts._wait_s = 3600
+        syncs.append(s)
+    yield cluster, syncs
+    cluster.stop()
+
+
+def _owner_nonowner(cluster):
+    """(owner instance, non-owner instance, key) for a key owned by one of
+    the two nodes."""
+    for i in range(100):
+        key = f"col_k{i}"
+        owner = cluster.owner_of(f"col_{key}")
+        non = next(ci for ci in cluster.instances if ci is not owner)
+        return owner, non, key
+    raise AssertionError("unreachable")
+
+
+class TestCollectiveConvergence:
+    def test_hits_and_broadcast_ride_the_collective(self, duo):
+        cluster, syncs = duo
+        owner, non, key = _owner_nonowner(cluster)
+
+        # first touch at the non-owner relays synchronously to the owner
+        # (request routing, not aggregate flow) and registers both sides
+        r = non.instance.get_rate_limits([_greq(key, hits=5)])[0]
+        assert r.status == Status.UNDER_LIMIT and r.remaining == 95
+        assert r.metadata["owner"] == owner.address
+
+        lockstep(syncs)  # tick 1: claims verified -> ESTABLISHED
+        lockstep(syncs)  # tick 2: owner peeks -> last_state
+        lockstep(syncs)  # tick 3: owner state psum'd -> non-owner cache
+        assert len(non.instance._global_cache) == 1
+
+        # steady state: answered from the local cache, hits queued on the
+        # collective (NOT the gRPC pipeline)
+        r2 = non.instance.get_rate_limits([_greq(key, hits=10)])[0]
+        assert r2.status == Status.UNDER_LIMIT and r2.remaining == 85
+        lockstep(syncs)  # tick 4: delta psum'd, owner applies
+
+        # authoritative convergence at the owner
+        r3 = owner.instance.get_rate_limits([_greq(key, hits=0)])[0]
+        assert r3.remaining == 85
+
+        # and the next broadcast refreshes the non-owner's cache copy
+        lockstep(syncs)
+        item = non.instance._global_cache.get_item(f"col_{key}")
+        assert item.value.remaining == 85
+
+        non_sync = syncs[cluster.instances.index(non)]
+        assert non_sync.stats["hits_synced"] == 10
+        assert non_sync.stats["broadcasts_applied"] >= 1
+        assert non_sync.stats["conflicts"] == 0
+        # the gRPC pipelines moved NOTHING
+        for ci in cluster.instances:
+            gm = ci.instance.global_manager
+            assert gm.stats["hits_sent"] == 0
+            assert gm.stats["broadcasts_sent"] == 0
+
+    def test_multi_host_totals_aggregate(self, duo):
+        """Hits from the non-owner and direct owner traffic both land in the
+        same authoritative bucket."""
+        cluster, syncs = duo
+        owner, non, key = _owner_nonowner(cluster)
+        non.instance.get_rate_limits([_greq(key, hits=1)])
+        for _ in range(3):
+            lockstep(syncs)
+        non.instance.get_rate_limits([_greq(key, hits=4)])
+        # owner-side traffic applies directly (it owns the key)
+        owner.instance.get_rate_limits([_greq(key, hits=7)])
+        lockstep(syncs)
+        r = owner.instance.get_rate_limits([_greq(key, hits=0)])[0]
+        assert r.remaining == 100 - 1 - 4 - 7
+
+
+class TestClaimConflicts:
+    def test_cross_host_collision_demotes_both(self, duo):
+        cluster, syncs = duo
+        for s in syncs:
+            s._slot_fn = lambda key: 0  # force every key onto slot 0
+        owner, non, key = _owner_nonowner(cluster)
+
+        # host A (owner side) registers "keyA"; host B registers "keyB":
+        # same slot, different claims — the protocol must demote BOTH before
+        # any delta is contributed
+        a = syncs[cluster.instances.index(owner)]
+        b = syncs[cluster.instances.index(non)]
+        assert not a.queue_update(_greq("keyA", 1))
+        b.register_remote(_greq("keyB", 1))
+        lockstep(syncs)
+        assert a._keys["col_keyA"].phase == FALLBACK
+        assert b._keys["col_keyB"].phase == FALLBACK
+        assert a.stats["conflicts"] == 1 and b.stats["conflicts"] == 1
+        # demoted keys refuse collective hits -> gRPC fallback
+        assert not b.queue_hit(_greq("keyB", 3))
+
+    def test_late_conflict_requeues_in_flight_hits(self, duo):
+        """A new key colliding with an ESTABLISHED slot must not lose the
+        established key's hits contributed in the conflict tick — they
+        re-route through the gRPC pipeline."""
+        cluster, syncs = duo
+        for s in syncs:
+            s._slot_fn = lambda key: 3
+        owner, non, key = _owner_nonowner(cluster)
+        a = syncs[cluster.instances.index(owner)]
+        b = syncs[cluster.instances.index(non)]
+
+        b.register_remote(_greq("early", 1))
+        lockstep(syncs)  # "early" established on host B, slot 3
+        assert b._keys["col_early"].phase == ESTABLISHED
+        assert b.queue_hit(_greq("early", 6))  # pending on the collective
+
+        # host A now claims the same slot for a different key
+        a.queue_update(_greq("late", 1))
+        lockstep(syncs)  # conflict tick: B contributed 6 hits in-flight
+        assert b._keys["col_early"].phase == FALLBACK
+        assert a._keys["col_late"].phase == FALLBACK
+        # the 6 in-flight hits moved to the gRPC pipeline, not dropped
+        pending = b.instance.global_manager._hits._pending
+        assert pending["col_early"].hits == 6
+
+    def test_host_local_collision_is_immediate_fallback(self, duo):
+        cluster, syncs = duo
+        for s in syncs:
+            s._slot_fn = lambda key: 5
+        b = syncs[1]
+        b.register_remote(_greq("first", 1))
+        b.register_remote(_greq("second", 1))
+        assert b._keys["col_first"].phase == CLAIMING
+        assert b._keys["col_second"].phase == FALLBACK
+        assert b.stats["fallbacks"] == 1
+
+
+class TestOwnerSeenGating:
+    """Deltas must never psum into a slot no owner is applying."""
+
+    @staticmethod
+    def _key_owned_by(cluster, owner, prefix):
+        """A unique_key whose picker owner is `owner` (ownership is
+        re-read from the picker every tick, so the scenario needs a key
+        the NON-owner genuinely does not own)."""
+        for i in range(64):
+            k = f"{i}{prefix}"
+            if cluster.owner_of(f"col_{k}") is owner:
+                return k
+        raise AssertionError("unreachable")
+
+    def test_deltas_wait_for_owner_state(self, duo):
+        cluster, syncs = duo
+        owner, non, _ = _owner_nonowner(cluster)
+        b = syncs[cluster.instances.index(non)]
+        key = self._key_owned_by(cluster, owner, "lonely")
+
+        # non-owner registers and establishes, but the OWNER host has not
+        # registered the key in its collective: no state, no applier
+        b.register_remote(_greq(key, 1))
+        lockstep(syncs)
+        assert b._keys[f"col_{key}"].phase == ESTABLISHED
+        assert b.queue_hit(_greq(key, 5))
+        lockstep(syncs)
+        # held: nobody is applying the slot, contributing would discard
+        assert b._keys[f"col_{key}"].pending == 5
+        assert b.stats["hits_synced"] == 0
+
+        # the owner registers; within a few ticks its state flows and the
+        # held hits are delivered and applied authoritatively
+        owner.instance.get_rate_limits([_greq(key, 2)])
+        for _ in range(4):
+            lockstep(syncs)
+        assert b._keys[f"col_{key}"].pending == 0
+        assert b.stats["hits_synced"] == 5
+        r = owner.instance.get_rate_limits([_greq(key, 0)])[0]
+        assert r.remaining == 100 - 2 - 5
+
+    def test_ownerless_pending_ages_out_to_grpc(self, duo):
+        cluster, syncs = duo
+        owner, non, _ = _owner_nonowner(cluster)
+        b = syncs[cluster.instances.index(non)]
+        key = self._key_owned_by(cluster, owner, "orphan")
+        b.owner_wait_ticks = 2
+        b.register_remote(_greq(key, 1))
+        lockstep(syncs)
+        assert b.queue_hit(_greq(key, 7))
+        for _ in range(4):
+            lockstep(syncs)
+        assert b._keys[f"col_{key}"].pending == 0
+        pending = non.instance.global_manager._hits._pending
+        assert pending[f"col_{key}"].hits == 7  # re-routed, not dropped
+
+    def test_owner_kept_alive_by_remote_claimants(self, duo):
+        """An owner entry must not idle out while other hosts still claim
+        the slot — their deltas would psum into a void."""
+        cluster, syncs = duo
+        owner, non, key = _owner_nonowner(cluster)
+        a = syncs[cluster.instances.index(owner)]
+        b = syncs[cluster.instances.index(non)]
+        non.instance.get_rate_limits([_greq(key, 1)])  # registers both sides
+        lockstep(syncs)
+        a.idle_s = 0.01
+        time.sleep(0.05)
+        lockstep(syncs)  # B still claims -> A's entry refreshed, not swept
+        assert f"col_{key}" in a._keys
+        b._keys.clear()
+        b._by_slot.clear()
+        time.sleep(0.05)
+        lockstep(syncs)  # B let go -> A's entry idles out
+        assert f"col_{key}" not in a._keys
+
+
+def test_multi_region_rides_with_collective_hits(duo):
+    """GLOBAL|MULTI_REGION keys: remote hits applied from the collective
+    must still replicate cross-region, as they do on the gRPC path."""
+    cluster, syncs = duo
+    owner, non, key = _owner_nonowner(cluster)
+    for ci in cluster.instances:
+        ci.instance.multiregion_manager._pipeline._wait_s = 3600
+
+    def mreq(hits):
+        return dataclasses.replace(
+            _greq(key, hits),
+            behavior=Behavior.GLOBAL | Behavior.MULTI_REGION)
+
+    non.instance.get_rate_limits([mreq(1)])
+    for _ in range(3):
+        lockstep(syncs)
+    r = non.instance.get_rate_limits([mreq(4)])[0]
+    assert r.error == ""
+    lockstep(syncs)  # delta delivered; owner applies with MULTI_REGION
+    mr_pending = owner.instance.multiregion_manager._pipeline._pending
+    assert f"col_{key}" in mr_pending
+    assert mr_pending[f"col_{key}"].hits >= 4
+    # pure peek ticks must NOT spam empty replication entries
+    before = dict(mr_pending)
+    lockstep(syncs)
+    after = owner.instance.multiregion_manager._pipeline._pending
+    assert after.get(f"col_{key}") == before.get(f"col_{key}")
+
+
+class _BrokenChannel:
+    global_capacity = 16
+    steps = 0
+
+    def step(self, *a):
+        raise RuntimeError("fabric down")
+
+
+class _StubInstance:
+    """Minimal Instance stand-in: records gRPC-pipeline requeues, owner
+    applies, and cache installs; `is_owner` drives get_peer's answer."""
+
+    def __init__(self, is_owner=False):
+        self.queued = []
+        self.applied = []
+        self.cache = []
+        self.global_manager = self
+        self.is_owner = is_owner
+
+    def queue_hit(self, req):
+        self.queued.append(req)
+
+    def get_peer(self, key):
+        import types as _t
+
+        return _t.SimpleNamespace(info=_t.SimpleNamespace(
+            is_owner=self.is_owner))
+
+    def apply_owner_batch(self, reqs):
+        from gubernator_tpu.types import RateLimitResp
+
+        self.applied.extend(reqs)
+        return [RateLimitResp(status=0, limit=100, remaining=90,
+                              reset_time=1234) for _ in reqs]
+
+    def apply_global_state(self, *args):
+        self.cache.append(args)
+
+
+class TestDegradation:
+    def test_step_failure_degrades_to_grpc(self):
+        inst = _StubInstance()
+        s = CollectiveGlobalSync(inst, _BrokenChannel(), interval_s=0.01)
+        # a queued hit on an established key must survive the failure
+        s._register("k", _greq("k", 1), is_owner=False)
+        s._keys["k"].phase = ESTABLISHED
+        s._keys["k"].pending = 4
+        s.start()
+        deadline = time.time() + 5
+        while s._failed is None and time.time() < deadline:
+            time.sleep(0.01)
+        s._thread.join(timeout=5)  # intake stops first, then the re-route
+        assert s._failed is not None
+        assert "fabric down" in s.health_error()
+        assert not s.queue_hit(_greq("k", 1))  # gRPC owns it now
+        assert inst.queued and inst.queued[0].hits == 4  # re-routed, not lost
+        s.close()
+
+    def test_close_requeues_accepted_hits(self):
+        """Graceful shutdown must not drop hits accepted since the last
+        tick — they re-route to the gRPC pipeline, whose close() flushes
+        synchronously afterwards (Instance.close ordering)."""
+        inst = _StubInstance()
+        s = CollectiveGlobalSync(inst, FakeFabric(1, 16).endpoints[0])
+        s._register("k", _greq("k", 1), is_owner=False)
+        s._keys["k"].phase = ESTABLISHED
+        s._keys["k"].pending = 9
+        s.close()
+        assert inst.queued and inst.queued[0].hits == 9
+
+    def test_stall_watchdog_surfaces_in_health(self, duo):
+        cluster, syncs = duo
+        s = syncs[0]
+        assert s.health_error() is None
+        s._tick_started = time.monotonic() - s.stall_timeout_s - 1
+        err = s.health_error()
+        assert err and "stalled" in err
+        hc = cluster.instances[0].instance.health_check()
+        assert hc.status == "unhealthy" and "stalled" in hc.message
+        s._tick_started = None
+        assert cluster.instances[0].instance.health_check().status == "healthy"
+
+
+class TestOwnershipTransitions:
+    """Membership changes move key ownership: the collective must follow
+    the picker every tick, or a demoted host keeps psum'ing valid=1 state
+    (freezing every non-owner's cache at valid=2) and double-applying
+    deltas."""
+
+    def _solo(self, is_owner):
+        inst = _StubInstance(is_owner=is_owner)
+        fabric = FakeFabric(1, 16)
+        return inst, fabric, CollectiveGlobalSync(
+            inst, fabric.endpoints[0], interval_s=3600)
+
+    def test_demoted_owner_stops_contributing_state(self):
+        inst, fabric, s = self._solo(is_owner=True)
+        assert not s.queue_update(_greq("mov", 1))  # registers; claiming
+        s.tick()  # establish (+ owner apply via fall-through)
+        s.tick()
+        e = s._keys["col_mov"]
+        assert e.is_owner and e.last_state is not None
+        assert fabric._contrib[0][2][0, e.slot] == 1  # state rode the wire
+
+        inst.is_owner = False  # membership moved the key elsewhere
+        s.tick()
+        assert not e.is_owner
+        assert e.last_state is None and not e.owner_seen
+        assert fabric._contrib[0][2][0, e.slot] == 0  # no state contributed
+
+    def test_promoted_host_starts_applying(self):
+        inst, fabric, s = self._solo(is_owner=False)
+        s.register_remote(_greq("mov2", 1))
+        s.tick()  # establish as non-owner
+        assert not s._keys["col_mov2"].is_owner
+        assert not inst.applied
+
+        inst.is_owner = True  # we just became the owner
+        s.tick()
+        e = s._keys["col_mov2"]
+        assert e.is_owner and e.owner_seen
+        assert inst.applied  # owner branch peeks/applies now
+
+
+def test_idle_sweep_releases_slots(duo):
+    cluster, syncs = duo
+    b = syncs[1]
+    b.idle_s = 0.05
+    b.register_remote(_greq("sweepme", 1))
+    lockstep(syncs)
+    assert "col_sweepme" in b._keys
+    time.sleep(0.1)
+    lockstep(syncs)
+    assert "col_sweepme" not in b._keys
+    assert 0 not in b._by_slot or b._by_slot.get(
+        b._slot_fn("col_sweepme")) != "col_sweepme"

@@ -133,3 +133,27 @@ class TestRegionPicker:
         assert rp.get_by_peer_info(PeerInfo(address="zz")) is None
         assert rp.size() == 2
         assert set(rp.pickers()) == {"dc1", "dc2"}
+
+
+def test_fnv1_trailing_suffix_clusters_one_arc():
+    """Document a reference-inherited hashing property (PARITY #15): fnv1
+    (the ring hash, replicated_hash.go:24) mixes a differing byte only
+    through the multiplies that FOLLOW it, so keys that differ near their
+    END cluster within a few low bits — far closer than the ~2^54 average
+    gap between 1024 ring points — and resolve to the same owner. Key
+    families that differ in LEADING bytes spread normally. Anyone load
+    balancing sequential keys ("user:1".."user:N") must put the sequence
+    number early or salt the key."""
+    from gubernator_tpu.cluster.pickers import ReplicatedConsistentHashPicker
+
+    picker = ReplicatedConsistentHashPicker()
+    for h in HOSTS:
+        picker.add(peer(h))
+    # trailing variation: same length, same prefix -> ONE owner arc
+    trailing = {picker.get(f"xhost_conv{i:02d}").info.address
+                for i in range(32)}
+    assert len(trailing) == 1
+    # leading variation: full avalanche -> spread over every peer
+    leading = {picker.get(f"{i:02d}conv_xhost").info.address
+               for i in range(32)}
+    assert len(leading) == len(HOSTS)

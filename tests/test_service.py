@@ -33,16 +33,21 @@ def _req(key, hits=1, limit=5, duration=60_000, algorithm=0, behavior=0, name="t
 
 
 def _non_owner_key(ci, prefix, name="test"):
-    """First key with `prefix` NOT owned by instance `ci` — with a
-    diagnostic for the picker-collapsed-onto-self regression."""
+    """First key with `prefix` NOT owned by instance `ci`.
+
+    The varying digits LEAD the key: the ring hash (fnv1, reference parity)
+    only mixes a byte through the multiplies that follow it, so keys that
+    differ near their end collapse into one ring arc and — for some port
+    layouts — one owner (PARITY #15, tests/test_pickers.py::
+    test_fnv1_trailing_suffix_clusters_one_arc)."""
     for i in range(200):
-        k = f"{prefix}{i}"
+        k = f"{i}{prefix}"
         peer = ci.instance.get_peer(f"{name}_{k}")
         if not peer.info.is_owner:
             return k, peer.info.address
     raise AssertionError(
         f"instance with {len(ci.instance.local_peers())} peers owns all 200 "
-        f"'{prefix}*' probe keys: picker claims ownership of everything")
+        f"'*{prefix}' probe keys: picker claims ownership of everything")
 
 
 def _call(cluster, reqs, idx=0):
