@@ -38,7 +38,9 @@ REFERENCE_BASELINE_RPS = 2_000.0  # reference production node (README.md:94-100)
 METRIC = "rate-limit decisions/sec/chip @ 10M active keys"
 UNIT = "decisions/s"
 TABLE_CAPACITY = 10_000_000  # north-star active key count (BASELINE.json)
-BATCH_WIDTH = 4_096  # one aggregated batch window
+BATCH_WIDTH = 8_192  # one aggregated batch window (the engine's max_width
+# design point; per-dispatch cost is width-flat through the tunnel, so the
+# wider window is free throughput)
 SCAN_K = 128  # windows retired per dispatch; at this depth the host can't
 # outrun the device — per-call wall time stops growing with K, so the
 # deeper scan amortizes launch overhead ~4x vs the engine's serving-path
@@ -84,8 +86,10 @@ def main() -> None:
     watchdog.cancel()  # wedged tunnel; compiles/timing may run long safely
 
     from gubernator_tpu.ops.decide import (
+        compact_window,
         decide_packed,
         decide_scan_packed,
+        decide_scan_packed_compact,
         make_table,
     )
     from gubernator_tpu.utils.platform import donation_supported
@@ -166,6 +170,23 @@ def main() -> None:
         force(resp)
         lat[i] = time.perf_counter() - t1
 
+    # ---- extra: compact (i32) staging variant — the wire format for
+    # ingest-bound links (20 B/decision up instead of 72; see
+    # ops/decide.py "compact") -----------------------------------------------
+    compact_step = jax.jit(decide_scan_packed_compact, **dargs)
+    compact_np = [compact_window(np.asarray(s)) for s in scans]
+    assert all(c is not None for c in compact_np), \
+        "bench windows must stay compact-eligible (no gregorian, values < 2^31)"
+    compacts = [jnp.asarray(c) for c in compact_np]
+    state, resp = compact_step(state, compacts[0], now)
+    force(resp)
+    t0 = time.perf_counter()
+    c_iters = max(3, iters // 2)
+    for i in range(c_iters):
+        state, resp = compact_step(state, compacts[i % N_VARIANTS], now + i)
+    force(resp)
+    compact_rate = c_iters * SCAN_K * BATCH_WIDTH / (time.perf_counter() - t0)
+
     print(
         json.dumps(
             {
@@ -177,6 +198,7 @@ def main() -> None:
                 "scan_k": SCAN_K,
                 "table_capacity": TABLE_CAPACITY,
                 "single_dispatch_decisions_per_sec": round(single_dispatch, 1),
+                "compact_staging_decisions_per_sec": round(compact_rate, 1),
                 "window_p50_ms": round(float(np.percentile(lat, 50) * 1e3), 3),
                 "window_p99_ms": round(float(np.percentile(lat, 99) * 1e3), 3),
                 "latency_samples": lat_iters,  # p99 is ~max at small counts

@@ -127,7 +127,7 @@ class DaemonConfig:
     # TPU backend (no reference analogue)
     backend: str = "auto"  # auto | engine | sharded
     min_batch_width: int = 64
-    max_batch_width: int = 4096
+    max_batch_width: int = 8192
     # durable bucket snapshot: load at boot, save at shutdown (FileLoader;
     # the reference leaves persistence to the user, README.md:159-175)
     snapshot_path: str = ""
@@ -211,7 +211,7 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         replicated_hash_replicas=_env_int("GUBER_REPLICATED_HASH_REPLICAS", 512),
         backend=_env_str("GUBER_BACKEND", "auto"),
         min_batch_width=_env_int("GUBER_MIN_BATCH_WIDTH", 64),
-        max_batch_width=_env_int("GUBER_MAX_BATCH_WIDTH", 4096),
+        max_batch_width=_env_int("GUBER_MAX_BATCH_WIDTH", 8192),
         snapshot_path=_env_str("GUBER_SNAPSHOT_PATH"),
         profile_port=_env_int("GUBER_PROFILE_PORT", 0),
         profile_dir=_env_str("GUBER_PROFILE_DIR"),

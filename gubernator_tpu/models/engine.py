@@ -5,7 +5,7 @@ This is the TPU-native analogue of the reference's core request path
 instead of 1000 goroutines contending on a lock, a request batch becomes one
 device program. The engine owns:
 
-- the device key table (ops/decide.py TableState columns in HBM);
+- the device key table (ops/decide.py row-major i64[C, 8] rows in HBM);
 - the host key directory (models/keyspace.py);
 - duplicate-key *rounds*: the reference's mutex serializes same-key requests
   inside a batch; we split a window so each kernel call touches each slot at
@@ -57,24 +57,21 @@ _GREG_MASK = int(Behavior.DURATION_IS_GREGORIAN)
 def _inject_rows(state: TableState, slot, algo, limit, remaining, duration,
                  stamp, expire_at, status) -> TableState:
     """Scatter host-provided rows into the table (store read-through/loader)."""
-    slot = pad_to_drop(slot, state.algo.shape[0])
-    return TableState(
-        algo=state.algo.at[slot].set(algo, mode="drop"),
-        limit=state.limit.at[slot].set(limit, mode="drop"),
-        remaining=state.remaining.at[slot].set(remaining, mode="drop"),
-        duration=state.duration.at[slot].set(duration, mode="drop"),
-        stamp=state.stamp.at[slot].set(stamp, mode="drop"),
-        expire_at=state.expire_at.at[slot].set(expire_at, mode="drop"),
-        status=state.status.at[slot].set(status, mode="drop"),
+    slot = pad_to_drop(slot, state.shape[-2])
+    rows = jnp.stack(
+        [algo.astype(I64), limit, remaining, duration, stamp, expire_at,
+         status.astype(I64), jnp.zeros_like(limit)],
+        axis=1,
     )
+    return state.at[slot].set(rows, mode="drop")
 
 
 def _gather_rows(state: TableState, slot):
-    """Fetch rows for store write-through / snapshotting."""
+    """Fetch rows for store write-through / snapshotting (7-column tuple,
+    TableState row field order)."""
     g = jnp.maximum(slot, 0)
-    return (state.algo[g], state.limit[g], state.remaining[g],
-            state.duration[g], state.stamp[g], state.expire_at[g],
-            state.status[g])
+    rows = state[g]
+    return tuple(rows[:, i] for i in range(7))
 
 
 # Jitted callables are shared process-wide (keyed by donate flag) so N
@@ -141,7 +138,7 @@ class Engine:
         store: Optional[Store] = None,
         loader: Optional[Loader] = None,
         min_width: int = 64,
-        max_width: int = 4096,
+        max_width: int = 8192,
         donate: Optional[bool] = None,
     ):
         self.capacity = capacity

@@ -86,7 +86,7 @@ def make_global_sync(plan: MeshPlan, donate: bool = False,
             "ring collectives support single-region meshes only (the ring "
             "reduces over the shard axis; psum handles multi-region)")
     S = plan.n_shards
-    state_spec = P(REGION_AXIS, SHARD_AXIS, None)
+    state_spec = P(REGION_AXIS, SHARD_AXIS, None, None)
     delta_spec = P(REGION_AXIS, SHARD_AXIS, None)
     rep = P()
 
@@ -100,7 +100,7 @@ def make_global_sync(plan: MeshPlan, donate: bool = False,
     def _step(
         state: TableState, delta: jax.Array, cfg: GlobalConfig, now: jax.Array
     ) -> Tuple[TableState, GlobalMirror, jax.Array]:
-        local_state = TableState(*(c.reshape(c.shape[-1:]) for c in state))
+        local_state = state.reshape(state.shape[-2:])  # i64[C, 8]
         local_delta = delta.reshape(delta.shape[-1:])  # i64[G]
 
         if collectives == "psum":
@@ -151,7 +151,7 @@ def make_global_sync(plan: MeshPlan, donate: bool = False,
             remaining=summed[2],
             reset_time=summed[3],
         )
-        new_state = TableState(*(c.reshape(1, 1, -1) for c in new_local))
+        new_state = new_local.reshape((1, 1) + new_local.shape)
         return new_state, mirror, jnp.zeros_like(delta)
 
     mapped = jax.shard_map(

@@ -29,7 +29,13 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gubernator_tpu.ops.decide import I32, I64, TableState, _VACANT
+from gubernator_tpu.ops.decide import (
+    I64,
+    ROW_ALGO,
+    TABLE_ROW_FIELDS,
+    TableState,
+    _VACANT,
+)
 from gubernator_tpu.utils.fnv import fnv1a_64_str
 
 REGION_AXIS = "region"
@@ -60,7 +66,7 @@ class MeshPlan:
         return self.n_owners * self.capacity_per_shard
 
     def state_sharding(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P(REGION_AXIS, SHARD_AXIS, None))
+        return NamedSharding(self.mesh, P(REGION_AXIS, SHARD_AXIS, None, None))
 
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
@@ -99,19 +105,14 @@ def shard_of_key(key: str, n_owners: int) -> int:
 
 
 def make_sharded_table(plan: MeshPlan) -> TableState:
-    """Fresh vacant table with columns [R, S, C] sharded over the mesh."""
+    """Fresh vacant row table i64[R, S, C, 8] sharded over the mesh."""
     R, S, C = plan.n_regions, plan.n_shards, plan.capacity_per_shard
 
     @partial(jax.jit, out_shardings=plan.state_sharding())
     def _make() -> TableState:
-        return TableState(
-            algo=jnp.full((R, S, C), _VACANT, I32),
-            limit=jnp.zeros((R, S, C), I64),
-            remaining=jnp.zeros((R, S, C), I64),
-            duration=jnp.zeros((R, S, C), I64),
-            stamp=jnp.zeros((R, S, C), I64),
-            expire_at=jnp.zeros((R, S, C), I64),
-            status=jnp.zeros((R, S, C), I32),
+        return (
+            jnp.zeros((R, S, C, TABLE_ROW_FIELDS), I64)
+            .at[..., ROW_ALGO].set(_VACANT)
         )
 
     return _make()

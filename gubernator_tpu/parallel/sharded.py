@@ -43,6 +43,13 @@ from gubernator_tpu.models.prep import (
     preprocess,
 )
 from gubernator_tpu.ops.decide import (
+    ROW_ALGO,
+    ROW_DURATION,
+    ROW_EXPIRE,
+    ROW_LIMIT,
+    ROW_REMAINING,
+    ROW_STAMP,
+    ROW_STATUS,
     TableState,
     decide_packed,
     decide_scan_packed,
@@ -87,16 +94,16 @@ def make_decide_sharded(plan: MeshPlan, donate: bool = False):
     and one back (see ops/decide.py decide_packed; the host-side packer is
     ShardedEngine._apply_round — keep row orders in sync).
     """
-    spec_state = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_state = P(REGION_AXIS, SHARD_AXIS, None, None)
     spec_io = P(REGION_AXIS, SHARD_AXIS, None, None)
 
     def _step(state: TableState, packed: jax.Array, now: jax.Array):
-        local_state = TableState(*(c.reshape(c.shape[-1:]) for c in state))
+        local_state = state.reshape(state.shape[-2:])
         new_state, out = decide_packed(
             local_state, packed.reshape(packed.shape[-2:]), now
         )
         return (
-            TableState(*(c.reshape(1, 1, -1) for c in new_state)),
+            new_state.reshape((1, 1) + new_state.shape),
             out.reshape(1, 1, *out.shape),
         )
 
@@ -119,16 +126,16 @@ def make_decide_sharded_scan(plan: MeshPlan, donate: bool = False):
     writes shard-locally, which is exactly the duplicate-key *rounds*
     ordering the engine needs.
     """
-    spec_state = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_state = P(REGION_AXIS, SHARD_AXIS, None, None)
     spec_io = P(REGION_AXIS, SHARD_AXIS, None, None, None)
 
     def _step(state: TableState, packed_k: jax.Array, now: jax.Array):
-        local_state = TableState(*(c.reshape(c.shape[-1:]) for c in state))
+        local_state = state.reshape(state.shape[-2:])
         new_state, out = decide_scan_packed(
             local_state, packed_k.reshape(packed_k.shape[-3:]), now
         )
         return (
-            TableState(*(c.reshape(1, 1, -1) for c in new_state)),
+            new_state.reshape((1, 1) + new_state.shape),
             out.reshape(1, 1, *out.shape),
         )
 
@@ -149,24 +156,15 @@ def make_gather_sharded(plan: MeshPlan):
     the decide kernels — the host tier's cost is off-chip round trips.
     Row order is TableState field order; make_inject_sharded mirrors it.
     """
-    from gubernator_tpu.ops.decide import I64 as _I64
-
-    spec_state = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_state = P(REGION_AXIS, SHARD_AXIS, None, None)
     spec_slot = P(REGION_AXIS, SHARD_AXIS, None)
     spec_out = P(REGION_AXIS, SHARD_AXIS, None, None)
 
     def _step(state: TableState, slot: jax.Array):
-        local = TableState(*(c.reshape(c.shape[-1:]) for c in state))
+        local = state.reshape(state.shape[-2:])
         g = jnp.maximum(slot.reshape(slot.shape[-1:]), 0)
-        rows = jnp.stack([
-            local.algo[g].astype(_I64),
-            local.limit[g],
-            local.remaining[g],
-            local.duration[g],
-            local.stamp[g],
-            local.expire_at[g],
-            local.status[g].astype(_I64),
-        ])
+        # row fields 0..6 ARE the output row order (pad field dropped)
+        rows = local[g][:, :7].T
         return rows.reshape(1, 1, *rows.shape)
 
     mapped = jax.shard_map(
@@ -182,26 +180,20 @@ def make_inject_sharded(plan: MeshPlan, donate: bool = False):
     fn(state [R,S,C], slot i32[R,S,W], rows i64[R,S,7,W]) -> state; lanes
     with slot -1 are dropped. Mirrors models/engine.py _inject_rows for the
     single-table engine (reference: algorithms.go:26-33 read-through)."""
-    from gubernator_tpu.ops.decide import I32 as _I32, pad_to_drop
+    from gubernator_tpu.ops.decide import pad_to_drop
 
-    spec_state = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_state = P(REGION_AXIS, SHARD_AXIS, None, None)
     spec_slot = P(REGION_AXIS, SHARD_AXIS, None)
     spec_rows = P(REGION_AXIS, SHARD_AXIS, None, None)
 
     def _step(state: TableState, slot: jax.Array, rows: jax.Array):
-        local = TableState(*(c.reshape(c.shape[-1:]) for c in state))
-        s = pad_to_drop(slot.reshape(slot.shape[-1:]), local.algo.shape[0])
-        r = rows.reshape(rows.shape[-2:])
-        new = TableState(
-            algo=local.algo.at[s].set(r[0].astype(_I32), mode="drop"),
-            limit=local.limit.at[s].set(r[1], mode="drop"),
-            remaining=local.remaining.at[s].set(r[2], mode="drop"),
-            duration=local.duration.at[s].set(r[3], mode="drop"),
-            stamp=local.stamp.at[s].set(r[4], mode="drop"),
-            expire_at=local.expire_at.at[s].set(r[5], mode="drop"),
-            status=local.status.at[s].set(r[6].astype(_I32), mode="drop"),
-        )
-        return TableState(*(c.reshape(1, 1, -1) for c in new))
+        local = state.reshape(state.shape[-2:])
+        s = pad_to_drop(slot.reshape(slot.shape[-1:]), local.shape[0])
+        r = rows.reshape(rows.shape[-2:])  # [7, W], row field order
+        w8 = jnp.concatenate(
+            [r.T, jnp.zeros((r.shape[1], 1), r.dtype)], axis=1)
+        new = local.at[s].set(w8, mode="drop")
+        return new.reshape((1, 1) + new.shape)
 
     mapped = jax.shard_map(
         _step, mesh=plan.mesh,
@@ -234,7 +226,7 @@ class ShardedEngine:
         capacity_per_shard: int = 1 << 17,
         global_capacity: int = 1024,
         min_width: int = 64,
-        max_width: int = 4096,
+        max_width: int = 8192,
         donate: Optional[bool] = None,
         loader=None,
         store=None,
@@ -401,24 +393,25 @@ class ShardedEngine:
         out = []
         now = millisecond_now()
         with self._lock:
-            cols = [np.asarray(c) for c in self.state]  # each [R, S, C]
+            tbl = np.asarray(self.state)  # [R, S, C, 8]
             for owner, directory in enumerate(self.directories):
                 r_, s_ = self.plan.owner_coords(owner)
                 for key, slot in directory.items():
-                    algo = int(cols[0][r_, s_, slot])
-                    expire = int(cols[5][r_, s_, slot])
+                    row = tbl[r_, s_, slot]
+                    algo = int(row[ROW_ALGO])
+                    expire = int(row[ROW_EXPIRE])
                     if algo < 0:
                         continue
                     if not include_expired and now > expire:
                         continue
                     out.append(BucketSnapshot(
                         key=key, algo=algo,
-                        limit=int(cols[1][r_, s_, slot]),
-                        remaining=int(cols[2][r_, s_, slot]),
-                        duration=int(cols[3][r_, s_, slot]),
-                        stamp=int(cols[4][r_, s_, slot]),
+                        limit=int(row[ROW_LIMIT]),
+                        remaining=int(row[ROW_REMAINING]),
+                        duration=int(row[ROW_DURATION]),
+                        stamp=int(row[ROW_STAMP]),
                         expire_at=expire,
-                        status=int(cols[6][r_, s_, slot])))
+                        status=int(row[ROW_STATUS])))
         return out
 
     def load_snapshot(self, items) -> int:
@@ -428,7 +421,7 @@ class ShardedEngine:
         if not items:
             return 0
         with self._lock:
-            cols = [np.array(c) for c in self.state]  # writable host copies
+            tbl = np.array(self.state)  # writable host copy [R, S, C, 8]
             n = 0
             by_owner: Dict[int, list] = {}
             for it in items:
@@ -444,14 +437,11 @@ class ShardedEngine:
                     slots, _ = self.directories[owner].lookup(
                         [it.key for it in chunk])
                     for it, slot in zip(chunk, slots):
-                        vals = (it.algo, it.limit, it.remaining, it.duration,
-                                it.stamp, it.expire_at, it.status)
-                        for c, v in zip(cols, vals):
-                            c[r_, s_, slot] = v
+                        tbl[r_, s_, slot, :7] = (
+                            it.algo, it.limit, it.remaining, it.duration,
+                            it.stamp, it.expire_at, it.status)
                         n += 1
-            sharding = self.plan.state_sharding()
-            self.state = TableState(
-                *(jax.device_put(c, sharding) for c in cols))
+            self.state = jax.device_put(tbl, self.plan.state_sharding())
         return n
 
     def close(self) -> None:

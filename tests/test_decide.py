@@ -313,10 +313,10 @@ class TestKernelMatchesOracle:
             row = oracle_table.get(key)
             if row is None or row.algo == -1:
                 continue
-            assert int(state.algo[slot_idx]) == row.algo, key
-            assert int(state.remaining[slot_idx]) == row.remaining, key
-            assert int(state.limit[slot_idx]) == row.limit, key
-            assert int(state.expire_at[slot_idx]) == row.expire_at, key
+            assert int(state[slot_idx, 0]) == row.algo, key
+            assert int(state[slot_idx, 2]) == row.remaining, key
+            assert int(state[slot_idx, 1]) == row.limit, key
+            assert int(state[slot_idx, 5]) == row.expire_at, key
 
 
 class TestBatchMechanics:
@@ -329,8 +329,8 @@ class TestBatchMechanics:
             fresh=[True, False, False]))
         state, resp = _DECIDE(state, reqs, 1_000)
         assert int(resp.status[1]) == 0 and int(resp.remaining[1]) == 0
-        assert int(state.algo[1]) == -1  # untouched
-        assert int(state.remaining[0]) == 9
+        assert int(state[1, 0]) == -1  # untouched
+        assert int(state[0, 2]) == 9
 
     def test_padding_never_clobbers_last_slot(self):
         """-1 lanes must not wrap to slot capacity-1: jnp's mode="drop" only
@@ -343,7 +343,7 @@ class TestBatchMechanics:
             algorithm=[0], behavior=[0], greg_expire=[0], greg_interval=[0],
             fresh=[True]))
         state, _ = _DECIDE(state, occupy, 1_000)
-        assert int(state.remaining[7]) == 8
+        assert int(state[7, 2]) == 8
         # padded window touching a different slot; lanes 1-2 are padding
         win = padded_batch(dict(
             slot=[0, -1, -1], hits=[1, 0, 0], limit=[10, 0, 0],
@@ -351,8 +351,8 @@ class TestBatchMechanics:
             greg_expire=[0, 0, 0], greg_interval=[0, 0, 0],
             fresh=[True, False, False]))
         state, _ = _DECIDE(state, win, 1_001)
-        assert int(state.algo[7]) == 0
-        assert int(state.remaining[7]) == 8  # last slot survived
+        assert int(state[7, 0]) == 0
+        assert int(state[7, 2]) == 8  # last slot survived
 
     def test_distinct_slots_parallel(self):
         state = make_table(64)
@@ -363,7 +363,7 @@ class TestBatchMechanics:
             greg_expire=[0] * n, greg_interval=[0] * n, fresh=[True] * n))
         state, resp = _DECIDE(state, reqs, 1_000)
         assert np.all(np.asarray(resp.remaining[:n]) == 7)
-        assert np.all(np.asarray(state.remaining[:n]) == 7)
+        assert np.all(np.asarray(state[:n, 2]) == 7)
 
 
 class TestScanPacked:
@@ -425,7 +425,7 @@ class TestDocumentedReferenceBugFixes:
               algorithm=Algorithm.LEAKY_BUCKET, now=now)
         h.hit("k", hits=1, limit=10, duration=60_000,
               algorithm=Algorithm.LEAKY_BUCKET, now=now + 5)
-        exp = int(h.state.expire_at[h.dir["k"]])
+        exp = int(h.state[h.dir["k"], 5])
         assert exp == (now + 5) + 60_000  # not (now+5)*60_000
 
     def test_leaky_create_reset_time_is_now_plus_rate(self):
@@ -452,3 +452,117 @@ class TestDocumentedReferenceBugFixes:
         # back to 60s: we persist durations, so the change applies again;
         # the reference would keep the 30s expiry here
         assert r3 == now + 60_000
+
+
+class TestCompactStaging:
+    """The compact i32 wire format must be bit-identical to the wide i64
+    format on every window it accepts (its whole correctness story), and
+    must refuse windows it cannot represent."""
+
+    @staticmethod
+    def _rand_wide(rng, r, C, B, now, behaviors):
+        p = np.zeros((9, B), np.int64)
+        n = r.randint(1, B)
+        p[0, :n] = rng.choice(C, n, replace=False)
+        p[0, n:] = -1
+        p[1, :n] = rng.randint(0, 6, n)
+        p[2, :n] = rng.choice([1, 5, 100, 10_000, 2**30], n)
+        p[3, :n] = rng.choice([500, 60_000, 2**31 - 1], n)
+        p[4, :n] = rng.randint(0, 2, n)
+        p[5, :n] = rng.choice(behaviors, n)
+        p[8, :n] = rng.randint(0, 2, n)
+        return p
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_differential_vs_wide(self, seed):
+        from gubernator_tpu.ops.decide import (
+            compact_window,
+            decide_packed,
+            decide_packed_compact,
+            widen_compact_out,
+        )
+
+        r = random.Random(seed)
+        rng = np.random.RandomState(seed)
+        C, B, now = 256, 32, 1_700_000_000_000
+        behaviors = [0, int(Behavior.RESET_REMAINING),
+                     int(Behavior.NO_BATCHING)]
+        wide_step = jax.jit(decide_packed)
+        compact_step = jax.jit(decide_packed_compact)
+        st_w, st_c = make_table(C), make_table(C)
+        for i in range(12):
+            wide = self._rand_wide(rng, r, C, B, now + i * 1000, behaviors)
+            compact = compact_window(wide)
+            assert compact is not None and compact.dtype == np.int32
+            st_w, out_w = wide_step(st_w, wide, now + i * 1000)
+            st_c, out_c = compact_step(st_c, compact, now + i * 1000)
+            np.testing.assert_array_equal(
+                np.asarray(out_w),
+                widen_compact_out(out_c, now + i * 1000))
+        np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_c))
+
+    def test_scan_differential_vs_wide(self):
+        from gubernator_tpu.ops.decide import (
+            compact_window,
+            decide_scan_packed,
+            decide_scan_packed_compact,
+            widen_compact_out,
+        )
+
+        r = random.Random(9)
+        rng = np.random.RandomState(9)
+        C, K, B, now = 256, 6, 16, 1_700_000_000_000
+        wide = np.stack([
+            self._rand_wide(rng, r, C, B, now, [0]) for _ in range(K)])
+        compact = compact_window(wide)
+        assert compact is not None and compact.shape == (K, 5, B)
+        st_w, out_w = jax.jit(decide_scan_packed)(make_table(C), wide, now)
+        st_c, out_c = jax.jit(decide_scan_packed_compact)(
+            make_table(C), compact, now)
+        np.testing.assert_array_equal(
+            np.asarray(out_w), widen_compact_out(out_c, now))
+        np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_c))
+
+    def test_rejects_what_it_cannot_represent(self):
+        from gubernator_tpu.ops.decide import compact_window
+
+        base = np.zeros((9, 4), np.int64)
+        base[0] = [0, 1, 2, -1]
+        base[1:4] = 1
+        assert compact_window(base) is not None
+        too_big = base.copy()
+        too_big[2, 1] = 2**31  # limit exceeds i32
+        assert compact_window(too_big) is None
+        negative = base.copy()
+        negative[1, 0] = -1  # negative hits
+        assert compact_window(negative) is None
+        greg = base.copy()
+        greg[5, 2] = int(Behavior.DURATION_IS_GREGORIAN)
+        assert compact_window(greg) is None
+
+    def test_reset_delta_sentinel(self):
+        """RESET_REMAINING answers reset_time=0 absolute; the compact delta
+        encoding must round-trip that exactly."""
+        from gubernator_tpu.ops.decide import (
+            compact_window,
+            decide_packed,
+            decide_packed_compact,
+            widen_compact_out,
+        )
+
+        now = 1_700_000_000_000
+        st_w, st_c = make_table(16), make_table(16)
+        mk = np.zeros((9, 2), np.int64)
+        mk[0] = [3, -1]
+        mk[1, 0], mk[2, 0], mk[3, 0] = 2, 10, 60_000
+        st_w, _ = decide_packed(st_w, mk, now)
+        st_c, _ = decide_packed_compact(st_c, compact_window(mk), now)
+        rr = mk.copy()
+        rr[5, 0] = int(Behavior.RESET_REMAINING)
+        st_w, out_w = decide_packed(st_w, rr, now + 5)
+        st_c, out_c = decide_packed_compact(
+            st_c, compact_window(rr), now + 5)
+        out_w = np.asarray(out_w)
+        assert out_w[3, 0] == 0  # absolute zero from the wide kernel
+        np.testing.assert_array_equal(
+            out_w, widen_compact_out(out_c, now + 5))
