@@ -39,6 +39,9 @@ def test_behaviors() -> BehaviorConfig:
         global_sync_wait_s=0.05,
         multi_region_timeout_s=10.0,
         multi_region_sync_wait_s=0.05,
+        # gRPC ports are dynamic here, so a fixed link offset could collide
+        # with another instance's port; peerlink tests wire it explicitly
+        peer_link_offset=0,
     )
 
 

@@ -234,6 +234,22 @@ def main(argv=None) -> int:
     gateway.start()
     log.info("HTTP gateway on %s", conf.http_address)
 
+    peerlink = None
+    if conf.behaviors.peer_link_offset > 0:
+        # the native peer transport: peers reach it at grpc port + offset
+        # (service/peerlink.py; gRPC remains the compatibility fallback)
+        from gubernator_tpu.service.peerlink import (
+            PeerLinkError,
+            PeerLinkService,
+        )
+
+        link_port = port + conf.behaviors.peer_link_offset
+        try:
+            peerlink = PeerLinkService(instance, port=link_port)
+            log.info("peerlink serving on port %d", peerlink.port)
+        except (PeerLinkError, RuntimeError) as e:
+            log.warning("peerlink disabled: %s (peer calls ride gRPC)", e)
+
     pool = build_pool(conf, instance)
 
     tracing = start_profiling(conf)
@@ -251,6 +267,8 @@ def main(argv=None) -> int:
 
     pool.close()
     gateway.close()
+    if peerlink is not None:
+        peerlink.close()
     server.stop(grace=1.0)
     instance.close()
     if tracing:

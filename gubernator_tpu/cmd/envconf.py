@@ -176,6 +176,7 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         "GUBER_MULTI_REGION_BATCH_LIMIT", b.multi_region_batch_limit)
     b.multi_region_sync_wait_s = _env_dur(
         "GUBER_MULTI_REGION_SYNC_WAIT", b.multi_region_sync_wait_s)
+    b.peer_link_offset = _env_int("GUBER_PEER_LINK_OFFSET", b.peer_link_offset)
 
     conf = DaemonConfig(
         grpc_address=_env_str("GUBER_GRPC_ADDRESS", "0.0.0.0:81"),

@@ -23,6 +23,31 @@ _LIB: Optional[ctypes.CDLL] = None
 _LIB_ERR: Optional[str] = None
 
 
+def _build_lib(src: str, prefix: str, extra_flags: Sequence[str] = ()) -> str:
+    """Compile `src` into a cached .so keyed by source mtime; atomic vs
+    concurrent builders; stale builds dropped. Shared by every native
+    component (keydir, peerlink)."""
+    mtime = int(os.stat(src).st_mtime)
+    path = os.path.join(_HERE, f"{prefix}{mtime}.so")
+    if os.path.exists(path):
+        return path
+    tmp = path + ".tmp"
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+         *extra_flags, "-o", tmp, src],
+        check=True, capture_output=True,
+    )
+    os.replace(tmp, path)  # atomic vs concurrent builders
+    for name in os.listdir(_HERE):
+        if name.startswith(prefix) and name.endswith(".so") and \
+                os.path.join(_HERE, name) != path:
+            try:
+                os.unlink(os.path.join(_HERE, name))
+            except OSError:
+                pass
+    return path
+
+
 def _lib_path() -> str:
     mtime = int(os.stat(_SRC).st_mtime)
     return os.path.join(_HERE, f"_keydir_{mtime}.so")
@@ -31,28 +56,10 @@ def _lib_path() -> str:
 def _build() -> str:
     import sysconfig
 
-    path = _lib_path()
-    if os.path.exists(path):
-        return path
-    tmp = path + ".tmp"
-    subprocess.run(
-        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-         # Python.h for the prep_pack fast path; symbols resolve from the
-         # host interpreter at load time (no -lpython needed on Linux)
-         f"-I{sysconfig.get_paths()['include']}",
-         "-o", tmp, _SRC],
-        check=True, capture_output=True,
-    )
-    os.replace(tmp, path)  # atomic vs concurrent builders
-    # drop stale builds
-    for name in os.listdir(_HERE):
-        if name.startswith("_keydir_") and name.endswith(".so") and \
-                os.path.join(_HERE, name) != path:
-            try:
-                os.unlink(os.path.join(_HERE, name))
-            except OSError:
-                pass
-    return path
+    # Python.h for the prep_pack fast path; symbols resolve from the
+    # host interpreter at load time (no -lpython needed on Linux)
+    return _build_lib(
+        _SRC, "_keydir_", [f"-I{sysconfig.get_paths()['include']}"])
 
 
 def load_library() -> ctypes.CDLL:
@@ -91,6 +98,50 @@ def load_library() -> ctypes.CDLL:
             c.c_char_p, c.c_void_p, c.c_int32, c.c_int32, c.c_void_p,
         ]
         _LIB = lib
+        return lib
+
+
+_PL_SRC = os.path.join(_HERE, "peerlink.cpp")
+_PL_LIB: Optional[ctypes.CDLL] = None
+_PL_ERR: Optional[str] = None
+
+
+def load_peerlink() -> ctypes.CDLL:
+    """Build (if needed) and load the peerlink transport library.
+
+    CDLL on purpose: pls_next_batch blocks in C waiting for frames, and the
+    GIL must be released for the whole wait."""
+    global _PL_LIB, _PL_ERR
+    with _LIB_LOCK:
+        if _PL_LIB is not None:
+            return _PL_LIB
+        if _PL_ERR is not None:
+            raise RuntimeError(_PL_ERR)
+        try:
+            lib = ctypes.CDLL(_build_lib(_PL_SRC, "_peerlink_", ["-pthread"]))
+        except Exception as e:  # noqa: BLE001
+            _PL_ERR = f"native peerlink unavailable: {e}"
+            raise RuntimeError(_PL_ERR) from e
+        c = ctypes
+        lib.pls_start.restype = c.c_void_p
+        lib.pls_start.argtypes = [c.c_int, c.POINTER(c.c_int)]
+        lib.pls_stop.argtypes = [c.c_void_p]
+        lib.pls_free.argtypes = [c.c_void_p]
+        lib.pls_port.restype = c.c_int
+        lib.pls_port.argtypes = [c.c_void_p]
+        lib.pls_next_batch.restype = c.c_int
+        lib.pls_next_batch.argtypes = [
+            c.c_void_p, c.c_longlong, c.c_char_p, c.c_int, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_int,
+        ]
+        lib.pls_send_responses.argtypes = [
+            c.c_void_p, c.c_int, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_char_p,
+        ]
+        _PL_LIB = lib
         return lib
 
 

@@ -1,0 +1,505 @@
+// peerlink: the native serving shim (SURVEY §2.3 native tier).
+//
+// The reference's peer hop is a Go gRPC unary call measured at ~30 µs
+// typical (reference: README.md:104, peer_client.go:127-140). A Python
+// gRPC server pays the GIL + HTTP/2 + protobuf machinery PER RPC (~0.4 ms,
+// ~2.3k unbatched RPC/s); this shim moves everything per-RPC off the GIL:
+//
+//   accept / read / frame parse / micro-batch aggregation  -> C++ (here)
+//   rate-limit decision                                    -> Python,
+//         entered once per BATCH via a blocking, GIL-released puller
+//
+// Wire protocol (internal - both ends are this framework; the public gRPC
+// surface stays wire-compatible with the reference and is served by the
+// Python tier unchanged):
+//
+//   frame   := u32 len | u64 rid | u8 method | u16 count | item*
+//   request := u16 name_len | u16 ukey_len | name | unique_key
+//              | i64 hits | i64 limit | i64 duration
+//              | u32 algorithm | u32 behavior
+//   reply   := i32 status | i64 limit | i64 remaining | i64 reset
+//              | u16 err_len | err
+//
+// name and unique_key ride as separate fields (splitting a concatenated
+// hash_key would mis-attribute embedded underscores and diverge from the
+// gRPC tier's validation). count must be 1..1024; each field <= 1024 B —
+// the CLIENT pre-checks and falls back to gRPC for anything bigger.
+//
+// method 0 = GetRateLimits (public lean surface, router semantics),
+// method 1 = GetPeerRateLimits (owner apply). Responses echo rid/method.
+//
+// Threading: one epoll IO thread owns every socket. Parsed frames land on
+// a mutex+condvar queue; Python worker threads block in pls_next_batch()
+// (ctypes CDLL call -> GIL dropped) and wake with EVERYTHING pending —
+// the same dispatch-latency adaptive batching as service/combiner.py: a
+// lone request wakes a worker immediately (no fixed window), a herd
+// aggregates while the workers are busy. Responses are handed back as
+// arrays; the IO thread serializes and writes them (eventfd-kicked).
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <condition_variable>
+#include <deque>
+#include <fcntl.h>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMaxFrame = 4u << 20;  // 4 MB, > 1000-item batches
+
+struct Item {
+  std::string name_and_key;  // name immediately followed by unique_key
+  uint16_t name_len;
+  int64_t hits, limit, duration;
+  uint32_t algorithm, behavior;
+};
+
+struct Frame {
+  uint64_t conn_token;
+  uint64_t rid;
+  uint8_t method;
+  std::vector<Item> items;
+};
+
+struct PendingReply {
+  uint8_t method = 0;
+  uint16_t expected = 0;
+  uint16_t got = 0;
+  // serialized reply items, by index
+  std::vector<std::string> parts;
+};
+
+struct Conn {
+  int fd = -1;
+  uint64_t token = 0;
+  std::string inbuf;
+  // write side is shared between the IO thread (EPOLLOUT flush) and
+  // responder threads (direct send from pls_send_responses): wmu guards
+  // outbuf + want_write + the fd's send() — two unsynchronized writers
+  // would interleave frame bytes
+  std::mutex wmu;
+  std::string outbuf;
+  bool want_write = false;
+  std::map<uint64_t, PendingReply> pending;  // rid -> reply assembly
+};
+
+struct Server {
+  int listen_fd = -1;
+  int epoll_fd = -1;
+  int wake_fd = -1;  // eventfd: outbox kicks the IO thread
+  std::thread io;
+  bool stopping = false;
+
+  std::mutex mu;  // guards queue + conns map
+  std::condition_variable cv;
+  std::deque<Frame> queue;  // parsed request frames awaiting a puller
+  std::map<uint64_t, std::unique_ptr<Conn>> conns;  // token -> conn
+  uint64_t next_token = 1;
+  int port = 0;
+};
+
+void set_nonblock(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+}
+
+void set_nodelay(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+}
+
+template <typename T>
+bool rd(const char*& p, const char* end, T* out) {
+  if (p + sizeof(T) > end) return false;
+  memcpy(out, p, sizeof(T));
+  p += sizeof(T);
+  return true;
+}
+
+// Parse every complete frame in c->inbuf; enqueue under s->mu.
+// Returns false on protocol violation (caller closes the conn).
+bool drain_inbuf(Server* s, Conn* c) {
+  size_t off = 0;
+  bool enqueued = false;
+  while (true) {
+    if (c->inbuf.size() - off < 4) break;
+    uint32_t len;
+    memcpy(&len, c->inbuf.data() + off, 4);
+    if (len < 11 || len > kMaxFrame) return false;
+    if (c->inbuf.size() - off - 4 < len) break;
+    const char* p = c->inbuf.data() + off + 4;
+    const char* end = p + len;
+    Frame f;
+    f.conn_token = c->token;
+    uint16_t count;
+    if (!rd(p, end, &f.rid)) return false;
+    if (!rd(p, end, &f.method)) return false;
+    if (!rd(p, end, &count)) return false;
+    // bounds keep one frame always deliverable in a single pull
+    // (count <= 1024 < MAX_N, fields <= 1024 B -> ~2 MB = KEY_CAP); a
+    // count of 0 is rejected too — it could never complete a reply
+    if (count == 0 || count > 1024) return false;
+    f.items.reserve(count);
+    for (uint16_t i = 0; i < count; i++) {
+      Item it;
+      uint16_t nlen, klen;
+      if (!rd(p, end, &nlen) || !rd(p, end, &klen)) return false;
+      if (nlen > 1024 || klen > 1024 || p + nlen + klen > end) return false;
+      it.name_and_key.assign(p, (size_t)nlen + klen);
+      it.name_len = nlen;
+      p += (size_t)nlen + klen;
+      if (!rd(p, end, &it.hits) || !rd(p, end, &it.limit) ||
+          !rd(p, end, &it.duration) || !rd(p, end, &it.algorithm) ||
+          !rd(p, end, &it.behavior))
+        return false;
+      f.items.push_back(std::move(it));
+    }
+    if (p != end) return false;
+    off += 4 + len;
+    {
+      std::lock_guard<std::mutex> g(s->mu);
+      PendingReply& pr = c->pending[f.rid];
+      pr.method = f.method;
+      pr.expected = count;
+      pr.got = 0;
+      pr.parts.assign(count, std::string());
+      s->queue.push_back(std::move(f));
+      enqueued = true;
+    }
+  }
+  if (off) c->inbuf.erase(0, off);
+  if (enqueued) s->cv.notify_all();
+  return true;
+}
+
+void close_conn(Server* s, Conn* c) {
+  // extract under s->mu FIRST: pls_send_responses holds s->mu while it
+  // touches the conn (incl. a direct send on its fd), so the fd cannot be
+  // closed-and-reused under a responder's feet
+  std::unique_ptr<Conn> own;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    auto it = s->conns.find(c->token);
+    if (it == s->conns.end()) return;
+    own = std::move(it->second);
+    s->conns.erase(it);
+  }
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_DEL, own->fd, nullptr);
+  close(own->fd);
+}
+
+void arm(Server* s, Conn* c) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c->want_write ? EPOLLOUT : 0);
+  ev.data.u64 = c->token;
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+bool flush_out(Server* s, Conn* c) {
+  std::lock_guard<std::mutex> g(c->wmu);
+  while (!c->outbuf.empty()) {
+    ssize_t n = send(c->fd, c->outbuf.data(), c->outbuf.size(), MSG_NOSIGNAL);
+    if (n > 0) {
+      c->outbuf.erase(0, (size_t)n);
+      continue;
+    }
+    if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      if (!c->want_write) {
+        c->want_write = true;
+        arm(s, c);
+      }
+      return true;
+    }
+    return false;  // peer went away
+  }
+  if (c->want_write) {
+    c->want_write = false;
+    arm(s, c);
+  }
+  return true;
+}
+
+// Responder-thread fast path: write the frame NOW when the socket is
+// drained (saves an eventfd->epoll->IO-thread hop per reply); spill the
+// remainder to outbuf for the IO thread otherwise. Caller holds s->mu.
+// Returns false when the IO thread must be kicked to finish the job.
+bool direct_send(Server* s, Conn* c, const std::string& frame) {
+  std::lock_guard<std::mutex> g(c->wmu);
+  if (c->outbuf.empty()) {
+    size_t off = 0;
+    while (off < frame.size()) {
+      ssize_t n =
+          send(c->fd, frame.data() + off, frame.size() - off, MSG_NOSIGNAL);
+      if (n > 0) {
+        off += (size_t)n;
+        continue;
+      }
+      if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) break;
+      return true;  // dead peer: IO thread will notice on its next event
+    }
+    if (off == frame.size()) return true;
+    c->outbuf.append(frame, off, std::string::npos);
+  } else {
+    c->outbuf += frame;
+  }
+  if (!c->want_write) {
+    c->want_write = true;
+    arm(s, c);
+  }
+  return true;
+}
+
+void io_loop(Server* s) {
+  epoll_event evs[64];
+  while (true) {
+    int n = epoll_wait(s->epoll_fd, evs, 64, 100);
+    {
+      std::lock_guard<std::mutex> g(s->mu);
+      if (s->stopping) return;
+    }
+    for (int i = 0; i < n; i++) {
+      uint64_t token = evs[i].data.u64;
+      if (token == 0) {  // listener
+        while (true) {
+          int fd = accept(s->listen_fd, nullptr, nullptr);
+          if (fd < 0) break;
+          set_nonblock(fd);
+          set_nodelay(fd);
+          auto c = std::make_unique<Conn>();
+          c->fd = fd;
+          {
+            std::lock_guard<std::mutex> g(s->mu);
+            c->token = s->next_token++;
+            epoll_event ev{};
+            ev.events = EPOLLIN;
+            ev.data.u64 = c->token;
+            epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+            s->conns[c->token] = std::move(c);
+          }
+        }
+        continue;
+      }
+      if (token == UINT64_MAX) {  // wake_fd: outbox handled above
+        uint64_t junk;
+        (void)read(s->wake_fd, &junk, 8);
+        continue;
+      }
+      Conn* c = nullptr;
+      {
+        std::lock_guard<std::mutex> g(s->mu);
+        auto it = s->conns.find(token);
+        if (it != s->conns.end()) c = it->second.get();
+      }
+      if (!c) continue;
+      bool dead = false;
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) dead = true;
+      if (!dead && (evs[i].events & EPOLLIN)) {
+        char buf[65536];
+        while (true) {
+          ssize_t r = recv(c->fd, buf, sizeof(buf), 0);
+          if (r > 0) {
+            c->inbuf.append(buf, (size_t)r);
+            if (c->inbuf.size() > 2 * kMaxFrame) {
+              dead = true;
+              break;
+            }
+            continue;
+          }
+          if (r == 0) dead = true;
+          else if (errno != EAGAIN && errno != EWOULDBLOCK) dead = true;
+          break;
+        }
+        if (!dead && !drain_inbuf(s, c)) dead = true;
+      }
+      if (!dead && (evs[i].events & EPOLLOUT)) {
+        if (!flush_out(s, c)) dead = true;
+      }
+      if (dead) close_conn(s, c);
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start a listener on INADDR_ANY:port (port 0 picks one) — peers reach it
+// from other hosts, which the cross-host topology requires. Like the
+// reference's peer gRPC surface it is UNAUTHENTICATED (peers.proto served
+// insecure); deploy it on the peer network only, or set
+// GUBER_PEER_LINK_OFFSET=0 to disable and keep every peer call on gRPC.
+// Returns an opaque handle, or 0 on failure; *bound_port gets the port.
+void* pls_start(int port, int* bound_port) {
+  auto s = std::make_unique<Server>();
+  s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(s->listen_fd, (sockaddr*)&addr, sizeof(addr)) < 0 ||
+      listen(s->listen_fd, 1024) < 0) {
+    close(s->listen_fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(s->listen_fd, (sockaddr*)&addr, &alen);
+  s->port = ntohs(addr.sin_port);
+  if (bound_port) *bound_port = s->port;
+  set_nonblock(s->listen_fd);
+  s->epoll_fd = epoll_create1(0);
+  s->wake_fd = eventfd(0, EFD_NONBLOCK);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = 0;  // listener sentinel
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->listen_fd, &ev);
+  epoll_event wev{};
+  wev.events = EPOLLIN;
+  wev.data.u64 = UINT64_MAX;  // wake sentinel
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, s->wake_fd, &wev);
+  Server* raw = s.release();
+  raw->io = std::thread(io_loop, raw);
+  return raw;
+}
+
+// Stop the IO thread and wake every blocked puller (they return -1).
+// Does NOT free: callers must join their worker threads first, then call
+// pls_free — a puller inside pls_next_batch must never race the delete.
+void pls_stop(void* h) {
+  auto* s = (Server*)h;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->stopping = true;
+  }
+  uint64_t one = 1;
+  (void)write(s->wake_fd, &one, 8);
+  s->cv.notify_all();
+  s->io.join();
+}
+
+void pls_free(void* h) {
+  auto* s = (Server*)h;
+  for (auto& [tok, c] : s->conns) close(c->fd);
+  close(s->listen_fd);
+  close(s->epoll_fd);
+  close(s->wake_fd);
+  delete s;
+}
+
+// Pull everything pending (up to max_n items) into caller buffers. Blocks
+// up to timeout_us when the queue is empty (call via CDLL: GIL released).
+// Returns the item count, 0 on timeout, -1 when stopping.
+// Buffers: keys (name+unique_key concatenated per item; cap key_cap) with
+// key_off[n+1] entry bounds and name_len[n] split points; i64
+// hits/limit/duration; i32 algorithm/behavior/method/idx; u64
+// conn_token/rid — all length max_n.
+int pls_next_batch(void* h, long long timeout_us, char* keys, int key_cap,
+                   int* key_off, int* name_len, long long* hits,
+                   long long* limit, long long* duration, int* algorithm,
+                   int* behavior, int* method, int* idx,
+                   unsigned long long* conn_token, unsigned long long* rid,
+                   int max_n) {
+  auto* s = (Server*)h;
+  std::unique_lock<std::mutex> g(s->mu);
+  if (s->queue.empty()) {
+    s->cv.wait_for(g, std::chrono::microseconds(timeout_us),
+                   [&] { return !s->queue.empty() || s->stopping; });
+  }
+  if (s->stopping) return -1;
+  int n = 0, koff = 0;
+  key_off[0] = 0;
+  while (!s->queue.empty()) {
+    Frame& f = s->queue.front();
+    if (n + (int)f.items.size() > max_n) break;
+    int kbytes = 0;
+    for (auto& it : f.items) kbytes += (int)it.name_and_key.size();
+    if (koff + kbytes > key_cap) break;
+    for (size_t i = 0; i < f.items.size(); i++) {
+      Item& it = f.items[i];
+      memcpy(keys + koff, it.name_and_key.data(), it.name_and_key.size());
+      koff += (int)it.name_and_key.size();
+      key_off[n + 1] = koff;
+      name_len[n] = (int)it.name_len;
+      hits[n] = it.hits;
+      limit[n] = it.limit;
+      duration[n] = it.duration;
+      algorithm[n] = (int)it.algorithm;
+      behavior[n] = (int)it.behavior;
+      method[n] = (int)f.method;
+      idx[n] = (int)i;
+      conn_token[n] = f.conn_token;
+      rid[n] = f.rid;
+      n++;
+    }
+    s->queue.pop_front();
+    if (n == max_n) break;
+  }
+  return n;
+}
+
+// Hand back n reply items (same tag arrays as pls_next_batch). Items of a
+// rid may arrive across multiple calls; a frame is written once complete.
+void pls_send_responses(void* h, int n, const unsigned long long* conn_token,
+                        const unsigned long long* rid, const int* idx,
+                        const int* status, const long long* limit,
+                        const long long* remaining, const long long* reset,
+                        const int* err_off, const char* err_buf) {
+  auto* s = (Server*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  for (int i = 0; i < n; i++) {
+    auto cit = s->conns.find(conn_token[i]);
+    if (cit == s->conns.end()) continue;  // client vanished
+    Conn* c = cit->second.get();
+    auto pit = c->pending.find(rid[i]);
+    if (pit == c->pending.end()) continue;
+    PendingReply& pr = pit->second;
+    if (idx[i] < 0 || idx[i] >= pr.expected) continue;
+    int elen = err_off[i + 1] - err_off[i];
+    std::string part;
+    part.reserve(30 + elen);
+    int32_t st = status[i];
+    part.append((const char*)&st, 4);
+    part.append((const char*)&limit[i], 8);
+    part.append((const char*)&remaining[i], 8);
+    part.append((const char*)&reset[i], 8);
+    uint16_t el = (uint16_t)elen;
+    part.append((const char*)&el, 2);
+    if (elen) part.append(err_buf + err_off[i], elen);
+    if (pr.parts[idx[i]].empty()) pr.got++;
+    pr.parts[idx[i]] = std::move(part);
+    if (pr.got == pr.expected) {
+      std::string frame;
+      uint32_t len = 11;
+      for (auto& p : pr.parts) len += (uint32_t)p.size();
+      frame.reserve(4 + len);
+      frame.append((const char*)&len, 4);
+      uint64_t r = rid[i];
+      frame.append((const char*)&r, 8);
+      frame.push_back((char)pr.method);
+      uint16_t cnt = pr.expected;
+      frame.append((const char*)&cnt, 2);
+      for (auto& p : pr.parts) frame += p;
+      c->pending.erase(pit);
+      direct_send(s, c, frame);
+    }
+  }
+}
+
+int pls_port(void* h) { return ((Server*)h)->port; }
+
+}  // extern "C"

@@ -32,6 +32,12 @@ class BehaviorConfig:
     multi_region_sync_wait_s: float = 1.0
     multi_region_batch_limit: int = MAX_BATCH_SIZE
 
+    # peerlink: the native peer transport (service/peerlink.py). A peer's
+    # link listens at its gRPC port + this offset; 0 disables and every
+    # peer call rides gRPC. Transparent per-peer fallback to gRPC when the
+    # link can't connect (mixed fleets with reference nodes keep working).
+    peer_link_offset: int = 1000
+
 
 @dataclasses.dataclass
 class InstanceConfig:

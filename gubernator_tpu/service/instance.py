@@ -327,6 +327,22 @@ class Instance:
         """Apply requests we own to the TPU backend in one batched call,
         queueing GLOBAL broadcasts / multi-region replication first
         (reference: gubernator.go:327-347)."""
+        return self.combiner.submit(
+            self._strip_owner_batch(requests), now_ms=now_ms)
+
+    def apply_owner_batch_direct(
+        self, requests: List[RateLimitReq], now_ms: Optional[int] = None
+    ) -> List[RateLimitResp]:
+        """apply_owner_batch minus the combiner hop, for callers that
+        already aggregated a batch (the peerlink workers): the engine's own
+        lock serializes concurrent windows, and skipping the combiner saves
+        two thread handoffs on the lone-request latency path."""
+        return self.backend.get_rate_limits(
+            self._strip_owner_batch(requests), now_ms=now_ms)
+
+    def _strip_owner_batch(
+        self, requests: List[RateLimitReq]
+    ) -> List[RateLimitReq]:
         stripped = []
         for req in requests:
             if has_behavior(req.behavior, Behavior.GLOBAL):
@@ -341,7 +357,7 @@ class Instance:
                 # the standalone-mesh GLOBAL path)
                 req = without_behavior(req, Behavior.GLOBAL)
             stripped.append(req)
-        return self.combiner.submit(stripped, now_ms=now_ms)
+        return stripped
 
     # ------------------------------------------------------------ internals
 

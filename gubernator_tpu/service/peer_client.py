@@ -15,6 +15,7 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
+from concurrent.futures import TimeoutError as _FutureTimeout
 from typing import List, Optional, Sequence
 
 import grpc
@@ -47,6 +48,51 @@ class PeerClient:
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self.last_errs = LRUCache(max_size=100)
+        # native peer transport (service/peerlink.py); None until connected,
+        # False while in gRPC-fallback backoff
+        self._link = None
+        self._link_retry_at = 0.0
+
+    # ------------------------------------------------------- native link
+
+    LINK_RETRY_S = 30.0
+
+    def _peer_link(self):
+        """The native link to this peer, or None (disabled / unreachable —
+        callers fall back to gRPC; reference peers in a mixed fleet never
+        answer the link port, so the fallback IS the compatibility path)."""
+        offset = getattr(self.conf, "peer_link_offset", 0)
+        if offset <= 0 or self._closing:
+            return None
+        link = self._link
+        if link is not None:
+            return link
+        if time.monotonic() < self._link_retry_at:
+            return None
+        from gubernator_tpu.service.peerlink import (
+            PeerLinkClient,
+            PeerLinkError,
+        )
+
+        host, _, port = self.info.address.rpartition(":")
+        try:
+            link = PeerLinkClient(f"{host}:{int(port) + offset}")
+        except (OSError, ValueError, PeerLinkError):
+            self._link_retry_at = time.monotonic() + self.LINK_RETRY_S
+            return None
+        with self._lock:
+            if self._link is None and not self._closing:
+                self._link = link
+                return link
+        link.close()  # lost the race or closing
+        return self._link
+
+    def _drop_link(self) -> None:
+        with self._lock:
+            link, self._link = self._link, None
+        self._link_retry_at = time.monotonic() + self.LINK_RETRY_S
+        if link is not None:
+            link.close()
 
     # ------------------------------------------------------------ lifecycle
 
@@ -101,6 +147,8 @@ class PeerClient:
             _, fut = item
             if not fut.done():
                 fut.set_exception(PeerNotReadyError(self.info.address))
+        if self._link is not None:
+            self._link.close()
         if self._channel is not None:
             self._channel.close()
 
@@ -124,12 +172,28 @@ class PeerClient:
             self._queue.put((req, fut))
         try:
             return fut.result(timeout=self.conf.batch_timeout_s)
-        except TimeoutError:
+        except _FutureTimeout:
             self._record_err("batch response timeout")
             raise
 
     def get_peer_rate_limits(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
-        """One direct unary RPC carrying the whole batch."""
+        """One peer call carrying the whole batch: the native link when the
+        peer answers it (~4-5x cheaper than Python gRPC), else gRPC."""
+        link = self._peer_link()
+        if link is not None:
+            from gubernator_tpu.service.peerlink import (
+                METHOD_GET_PEER_RATE_LIMITS,
+                PeerLinkError,
+            )
+
+            try:
+                return link.call(METHOD_GET_PEER_RATE_LIMITS, list(reqs),
+                                 self.conf.batch_timeout_s)
+            except PeerLinkError as e:
+                # broken link: back off to gRPC for a while (the peer may
+                # have restarted without the link, or be a reference node)
+                self._record_err(f"peerlink: {e}")
+                self._drop_link()
         stub = self._connect()
         msg = peers_pb.GetPeerRateLimitsReq(requests=[req_to_pb(r) for r in reqs])
         try:

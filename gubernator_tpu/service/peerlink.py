@@ -1,0 +1,335 @@
+"""The native serving shim's Python half: peerlink server + client.
+
+The reference's peer hop is a ~30 µs Go gRPC unary call (reference:
+README.md:104, peer_client.go:127-140); Python gRPC pays ~0.4 ms per RPC in
+GIL-held machinery. peerlink moves everything per-RPC into C++
+(native/peerlink.cpp: epoll IO, frame parse, adaptive micro-batch
+aggregation) and enters Python once per BATCH:
+
+    worker loop:  pls_next_batch (blocks in C, GIL released)
+                  -> decode arrays into RateLimitReqs
+                  -> Instance handler (one batched call)
+                  -> pls_send_responses (C++ serializes + writes)
+
+Two methods ride the same frames: GetPeerRateLimits (method 1, the peer
+hop — owner-apply semantics) and GetRateLimits (method 0, the lean public
+surface with full router semantics). The public gRPC+HTTP surface remains
+wire-compatible with the reference and untouched; peerlink is the
+framework-internal fast path, negotiated by port convention
+(peer grpc port + GUBER_PEER_LINK_OFFSET) with transparent fallback to
+gRPC when the peer doesn't answer it.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import socket
+import struct
+import threading
+from concurrent.futures import Future
+from concurrent.futures import TimeoutError as FutureTimeout
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from gubernator_tpu.types import (
+    MAX_BATCH_SIZE,
+    RateLimitReq,
+    RateLimitResp,
+)
+
+log = logging.getLogger("gubernator_tpu.peerlink")
+
+METHOD_GET_RATE_LIMITS = 0
+METHOD_GET_PEER_RATE_LIMITS = 1
+
+_ITEM = struct.Struct("<qqqII")  # hits, limit, duration, algorithm, behavior
+_REPLY = struct.Struct("<iqqqH")  # status, limit, remaining, reset, err_len
+
+
+class PeerLinkError(RuntimeError):
+    """Transport-level failure: callers fall back to the gRPC tier."""
+
+
+# per-field wire bound (server closes the conn on anything bigger); the
+# gRPC tier has no such cap, so oversized keys fall back there
+MAX_FIELD_BYTES = 1024
+MAX_FRAME_ITEMS = 1024
+
+
+def encode_request_frame(rid: int, method: int,
+                         reqs: Sequence[RateLimitReq]) -> bytes:
+    """Raises PeerLinkError for anything the wire format cannot carry —
+    callers route those requests over gRPC instead."""
+    if not 0 < len(reqs) <= MAX_FRAME_ITEMS:
+        raise PeerLinkError(f"frame must carry 1..{MAX_FRAME_ITEMS} requests")
+    out = bytearray()
+    out += struct.pack("<QBH", rid, method, len(reqs))
+    for r in reqs:
+        name = r.name.encode()
+        ukey = r.unique_key.encode()
+        if len(name) > MAX_FIELD_BYTES or len(ukey) > MAX_FIELD_BYTES:
+            raise PeerLinkError("key too long for peerlink")
+        out += struct.pack("<HH", len(name), len(ukey))
+        out += name
+        out += ukey
+        out += _ITEM.pack(r.hits, r.limit, r.duration,
+                          int(r.algorithm), int(r.behavior))
+    return struct.pack("<I", len(out)) + bytes(out)
+
+
+def decode_response_frame(payload: memoryview) -> List[RateLimitResp]:
+    rid, method, count = struct.unpack_from("<QBH", payload, 0)
+    off = 11
+    out = []
+    for _ in range(count):
+        status, limit, remaining, reset, elen = _REPLY.unpack_from(
+            payload, off)
+        off += _REPLY.size
+        err = bytes(payload[off:off + elen]).decode() if elen else ""
+        off += elen
+        out.append(RateLimitResp(status=status, limit=limit,
+                                 remaining=remaining, reset_time=reset,
+                                 error=err))
+    return out
+
+
+class PeerLinkClient:
+    """One persistent framed connection: writers interleave under a lock,
+    a reader thread demuxes responses by rid into futures."""
+
+    def __init__(self, address: str, connect_timeout_s: float = 1.0):
+        host, _, port = address.rpartition(":")
+        self._sock = socket.create_connection(
+            (host or "127.0.0.1", int(port)), timeout=connect_timeout_s)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(None)
+        self._wlock = threading.Lock()
+        self._futures: Dict[int, Future] = {}
+        self._flock = threading.Lock()
+        self._rid = 0
+        self._closed = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"peerlink-read-{address}",
+            daemon=True)
+        self._reader.start()
+
+    def call(self, method: int, reqs: Sequence[RateLimitReq],
+             timeout_s: float) -> List[RateLimitResp]:
+        if not reqs:
+            return []
+        fut, rid = self.call_async(method, reqs)
+        try:
+            return fut.result(timeout=timeout_s)
+        except FutureTimeout:
+            with self._flock:
+                self._futures.pop(rid, None)
+            raise PeerLinkError("peerlink response timeout") from None
+
+    def call_async(self, method: int, reqs: Sequence[RateLimitReq]):
+        """Fire one frame; returns (future, rid). The future resolves to
+        the response list (pipelined callers keep several in flight)."""
+        if self._closed:
+            raise PeerLinkError("link closed")
+        # encode BEFORE registering: an unencodable request must not leak
+        # a future that nobody will ever complete
+        with self._flock:
+            self._rid += 1
+            rid = self._rid
+        frame = encode_request_frame(rid, method, reqs)
+        fut: Future = Future()
+        with self._flock:
+            self._futures[rid] = fut
+        try:
+            with self._wlock:
+                self._sock.sendall(frame)
+        except OSError as e:
+            self._fail(e)
+            raise PeerLinkError(str(e)) from e
+        return fut, rid
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    # ------------------------------------------------------------ internals
+
+    def _read_loop(self) -> None:
+        buf = bytearray()
+        try:
+            while True:
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    raise PeerLinkError("peer closed the link")
+                buf += chunk
+                while len(buf) >= 4:
+                    (length,) = struct.unpack_from("<I", buf, 0)
+                    if len(buf) - 4 < length:
+                        break
+                    payload = memoryview(buf)[4:4 + length]
+                    (rid,) = struct.unpack_from("<Q", payload, 0)
+                    resps = decode_response_frame(payload)
+                    del payload
+                    del buf[:4 + length]
+                    with self._flock:
+                        fut = self._futures.pop(rid, None)
+                    if fut is not None and not fut.done():
+                        fut.set_result(resps)
+        except Exception as e:  # noqa: BLE001 — reader dies: fail all waiters
+            self._fail(e)
+
+    def _fail(self, exc: Exception) -> None:
+        self._closed = True
+        with self._flock:
+            futs, self._futures = self._futures, {}
+        for fut in futs.values():
+            if not fut.done():
+                fut.set_exception(PeerLinkError(str(exc)))
+
+
+class PeerLinkService:
+    """The server: C++ transport + Python batch workers over an Instance."""
+
+    MAX_N = 8192  # per-pull item cap (several frames aggregate per pull)
+    KEY_CAP = 2 << 20  # > one max frame's keys (4096 items x 255 B)
+
+    def __init__(self, instance, port: int = 0, workers: int = 2):
+        from gubernator_tpu.native import load_peerlink
+
+        self._lib = load_peerlink()
+        bound = ctypes.c_int(0)
+        self._handle = self._lib.pls_start(port, ctypes.byref(bound))
+        if not self._handle:
+            raise PeerLinkError(f"peerlink: cannot bind port {port}")
+        self.port = bound.value
+        self.instance = instance
+        self.stats = {"batches": 0, "requests": 0, "errors": 0}
+        self._stop = False
+        self._threads = []
+        for i in range(workers):
+            t = threading.Thread(target=self._worker, name=f"peerlink-{i}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def close(self) -> None:
+        self._stop = True
+        self._lib.pls_stop(self._handle)  # wakes blocked pullers (-1)
+        for t in self._threads:
+            t.join(timeout=2.0)
+        if not any(t.is_alive() for t in self._threads):
+            # free only once no puller can touch the handle again
+            self._lib.pls_free(self._handle)
+
+    # ------------------------------------------------------------ internals
+
+    def _worker(self) -> None:
+        n = self.MAX_N
+        b = {
+            "keys": ctypes.create_string_buffer(self.KEY_CAP),
+            "key_off": np.zeros(n + 1, np.int32),
+            "name_len": np.zeros(n, np.int32),
+            "hits": np.zeros(n, np.int64),
+            "limit": np.zeros(n, np.int64),
+            "duration": np.zeros(n, np.int64),
+            "algorithm": np.zeros(n, np.int32),
+            "behavior": np.zeros(n, np.int32),
+            "method": np.zeros(n, np.int32),
+            "idx": np.zeros(n, np.int32),
+            "conn": np.zeros(n, np.uint64),
+            "rid": np.zeros(n, np.uint64),
+            # response buffers, reused across batches (allocation costs
+            # real microseconds on the lone-call latency path)
+            "status": np.zeros(n, np.int32),
+            "r_limit": np.zeros(n, np.int64),
+            "r_remaining": np.zeros(n, np.int64),
+            "r_reset": np.zeros(n, np.int64),
+            "err_off": np.zeros(n + 1, np.int32),
+        }
+
+        def p(a):
+            return a.ctypes.data_as(ctypes.c_void_p)
+
+        args = (b["keys"], self.KEY_CAP, p(b["key_off"]), p(b["name_len"]),
+                p(b["hits"]), p(b["limit"]), p(b["duration"]),
+                p(b["algorithm"]), p(b["behavior"]), p(b["method"]),
+                p(b["idx"]), p(b["conn"]), p(b["rid"]), n)
+        resp_ptrs = (p(b["conn"]), p(b["rid"]), p(b["idx"]), p(b["status"]),
+                     p(b["r_limit"]), p(b["r_remaining"]), p(b["r_reset"]),
+                     p(b["err_off"]))
+        while not self._stop:
+            got = self._lib.pls_next_batch(
+                self._handle, 200_000, *args)  # 200 ms idle tick
+            if got <= 0:
+                if got < 0:
+                    return  # stopping
+                continue
+            try:
+                err_buf = self._handle_batch(got, b)
+                self._lib.pls_send_responses(
+                    self._handle, got, *resp_ptrs, err_buf)
+            except Exception:  # noqa: BLE001 — a worker must never die
+                log.exception("peerlink batch failed")
+                self.stats["errors"] += 1
+
+    def _handle_batch(self, got: int, b: dict) -> bytes:
+        """Decode -> handler calls -> fill the reusable response buffers.
+        Returns the concatenated error-string buffer."""
+        self.stats["batches"] += 1
+        self.stats["requests"] += got
+        raw_keys, key_off, name_len = b["keys"], b["key_off"], b["name_len"]
+        hits, limit, duration = b["hits"], b["limit"], b["duration"]
+        algorithm, behavior, method = b["algorithm"], b["behavior"], b["method"]
+        reqs: List[RateLimitReq] = []
+        for j in range(got):
+            lo, hi = int(key_off[j]), int(key_off[j + 1])
+            split = lo + int(name_len[j])
+            name = raw_keys[lo:split].decode()
+            unique = raw_keys[split:hi].decode()
+            reqs.append(RateLimitReq(
+                name=name, unique_key=unique, hits=int(hits[j]),
+                limit=int(limit[j]), duration=int(duration[j]),
+                algorithm=int(algorithm[j]), behavior=int(behavior[j])))
+
+        status, r_limit = b["status"], b["r_limit"]
+        r_remaining, r_reset, err_off = b["r_remaining"], b["r_reset"], b["err_off"]
+        err_parts: List[bytes] = []
+        err_len = 0
+
+        # one handler call per contiguous same-method run (chunked at the
+        # batch cap — the aggregation may have merged many frames)
+        j = 0
+        while j < got:
+            m = int(method[j])
+            k = j
+            while k < got and int(method[k]) == m and k - j < MAX_BATCH_SIZE:
+                k += 1
+            chunk = reqs[j:k]
+            try:
+                if m == METHOD_GET_PEER_RATE_LIMITS:
+                    # this worker's pull IS the batch window: go straight to
+                    # the backend (owner semantics preserved; combiner hop
+                    # saved — see Instance.apply_owner_batch_direct)
+                    resps = self.instance.apply_owner_batch_direct(chunk)
+                else:
+                    resps = self.instance.get_rate_limits(chunk)
+            except Exception as e:  # noqa: BLE001 — per-item error replies
+                resps = [RateLimitResp(error=str(e)) for _ in chunk]
+            for o, resp in enumerate(resps):
+                i = j + o
+                status[i] = int(resp.status)
+                r_limit[i] = resp.limit
+                r_remaining[i] = resp.remaining
+                r_reset[i] = resp.reset_time
+                if resp.error:
+                    e = resp.error.encode()
+                    err_parts.append(e)
+                    err_len += len(e)
+                err_off[i + 1] = err_len
+            j = k
+        return b"".join(err_parts)

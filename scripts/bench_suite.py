@@ -359,6 +359,65 @@ def main(argv=None) -> int:
                 args.seconds,
             )
 
+        def bench_peerlink_hop():
+            # the native peer transport vs get_peer_no_batching's gRPC hop
+            # (VERDICT r1 item 1: the reference's forwarded hop is ~30 µs,
+            # README.md:104; python gRPC pays ~0.4-0.8 ms)
+            from gubernator_tpu.service.peerlink import (
+                METHOD_GET_PEER_RATE_LIMITS,
+                PeerLinkClient,
+                PeerLinkService,
+            )
+
+            ci = rng.choice(cluster.instances)
+            svc = PeerLinkService(ci.instance, port=0)
+            cli = PeerLinkClient(f"127.0.0.1:{svc.port}")
+            try:
+                return run_serial(
+                    lambda: cli.call(
+                        METHOD_GET_PEER_RATE_LIMITS,
+                        [req("peerlink_benchmark", _rand_key(rng),
+                             duration=5)],
+                        5.0,
+                    ),
+                    args.seconds,
+                )
+            finally:
+                cli.close()
+                svc.close()
+
+        def bench_peerlink_unbatched_rps():
+            # server capacity under pipelined UNBATCHED load: every RPC is
+            # one single-request frame; WINDOW outstanding keeps the link
+            # busy the way a fleet of independent callers would. Done bar
+            # (VERDICT r1 item 1): >= 20k unbatched RPC/s/node.
+            from gubernator_tpu.service import peerlink as pl
+
+            ci = rng.choice(cluster.instances)
+            svc = pl.PeerLinkService(ci.instance, port=0)
+            cli = pl.PeerLinkClient(f"127.0.0.1:{svc.port}")
+            try:
+                WINDOW = 64
+                done = 0
+                inflight = []
+                deadline = time.perf_counter() + args.seconds
+                t0 = time.perf_counter()
+                while time.perf_counter() < deadline or inflight:
+                    while (len(inflight) < WINDOW
+                           and time.perf_counter() < deadline):
+                        fut, _rid = cli.call_async(
+                            pl.METHOD_GET_PEER_RATE_LIMITS,
+                            [req("peerlink_rps", _rand_key(rng), duration=5)])
+                        inflight.append(fut)
+                    inflight.pop(0).result(timeout=30.0)
+                    done += 1
+                el = time.perf_counter() - t0
+                return {"ops": done, "ops_per_s": round(done / el, 1),
+                        "pipeline_window": WINDOW}
+            finally:
+                cli.close()
+                svc.close()
+
         def bench_multi_region():
             return run_serial(
                 lambda: client.get_rate_limits(
@@ -378,6 +437,8 @@ def main(argv=None) -> int:
             "get_rate_limit": bench_get_rate_limit,
             "get_rate_limit_batch100": bench_get_rate_limit_batch,
             "get_peer_no_batching": bench_get_peer_no_batching,
+            "peerlink_hop": bench_peerlink_hop,
+            "peerlink_unbatched_rps": bench_peerlink_unbatched_rps,
             "health_check": bench_health_check,
             "thundering_herd": bench_thundering_herd,
             "thundering_herd_mp": bench_thundering_herd_mp,
