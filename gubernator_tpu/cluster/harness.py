@@ -66,6 +66,31 @@ class ClusterInstance:
         close_channels(self.address)
 
 
+def wire_peerlink(cluster: "LocalCluster"):
+    """Attach a peerlink service to every instance at grpc port + one
+    shared offset (the daemon's production convention) and point the
+    instances' peer clients at it. Returns the service list (callers own
+    closing them), or [] when no offset binds cleanly — gRPC then carries
+    every peer call, exactly like a fleet with the link disabled."""
+    from gubernator_tpu.service.peerlink import PeerLinkError, PeerLinkService
+
+    ports = [int(ci.address.rsplit(":", 1)[1]) for ci in cluster.instances]
+    for offset in (1000, 2000, 3000, 5000):
+        attempt: List[PeerLinkService] = []
+        try:
+            for i, ci in enumerate(cluster.instances):
+                attempt.append(
+                    PeerLinkService(ci.instance, port=ports[i] + offset))
+        except PeerLinkError:
+            for svc in attempt:
+                svc.close()
+            continue
+        for ci in cluster.instances:
+            ci.instance.conf.behaviors.peer_link_offset = offset
+        return attempt
+    return []
+
+
 class LocalCluster:
     """A loopback cluster of real servers (reference: cluster/cluster.go)."""
 

@@ -13,12 +13,21 @@
 // surface stays wire-compatible with the reference and is served by the
 // Python tier unchanged):
 //
-//   frame   := u32 len | u64 rid | u8 method | u16 count | item*
-//   request := u16 name_len | u16 ukey_len | name | unique_key
-//              | i64 hits | i64 limit | i64 duration
-//              | u32 algorithm | u32 behavior
-//   reply   := i32 status | i64 limit | i64 remaining | i64 reset
-//              | u16 err_len | err
+// Frames are COLUMNAR — the same staging-format philosophy as the device
+// path: a batch's fields ride as contiguous arrays, so both ends encode
+// and decode with bulk copies (numpy on the Python side, memcpy here)
+// instead of per-item marshalling:
+//
+//   request frame := u32 len | u64 rid | u8 method | u16 count
+//                  | u16 name_len[count] | u16 ukey_len[count]
+//                  | keys blob (name_i + ukey_i, item order)
+//                  | i64 hits[count] | i64 limit[count]
+//                  | i64 duration[count]
+//                  | u32 algorithm[count] | u32 behavior[count]
+//   reply frame   := u32 len | u64 rid | u8 method | u16 count
+//                  | i32 status[count] | i64 limit[count]
+//                  | i64 remaining[count] | i64 reset[count]
+//                  | u16 err_len[count] | err blob
 //
 // name and unique_key ride as separate fields (splitting a concatenated
 // hash_key would mis-attribute embedded underscores and diverge from the
@@ -60,26 +69,27 @@ namespace {
 
 constexpr uint32_t kMaxFrame = 4u << 20;  // 4 MB, > 1000-item batches
 
-struct Item {
-  std::string name_and_key;  // name immediately followed by unique_key
-  uint16_t name_len;
-  int64_t hits, limit, duration;
-  uint32_t algorithm, behavior;
-};
-
 struct Frame {
   uint64_t conn_token;
   uint64_t rid;
   uint8_t method;
-  std::vector<Item> items;
+  uint16_t count = 0;
+  // columnar request payload, exactly as parsed off the wire
+  std::vector<uint16_t> name_len, ukey_len;
+  std::string keys;  // name_i + ukey_i concatenated in item order
+  std::vector<int64_t> hits, limit, duration;
+  std::vector<uint32_t> algorithm, behavior;
 };
 
 struct PendingReply {
   uint8_t method = 0;
   uint16_t expected = 0;
   uint16_t got = 0;
-  // serialized reply items, by index
-  std::vector<std::string> parts;
+  // columnar reply assembly, by item index
+  std::vector<int32_t> status;
+  std::vector<int64_t> limit, remaining, reset;
+  std::vector<std::string> err;
+  std::vector<uint8_t> filled;
 };
 
 struct Conn {
@@ -129,6 +139,15 @@ bool rd(const char*& p, const char* end, T* out) {
   return true;
 }
 
+template <typename T>
+bool rd_vec(const char*& p, const char* end, std::vector<T>* out, size_t n) {
+  if (p + n * sizeof(T) > end) return false;
+  out->resize(n);
+  memcpy(out->data(), p, n * sizeof(T));
+  p += n * sizeof(T);
+  return true;
+}
+
 // Parse every complete frame in c->inbuf; enqueue under s->mu.
 // Returns false on protocol violation (caller closes the conn).
 bool drain_inbuf(Server* s, Conn* c) {
@@ -144,29 +163,29 @@ bool drain_inbuf(Server* s, Conn* c) {
     const char* end = p + len;
     Frame f;
     f.conn_token = c->token;
-    uint16_t count;
     if (!rd(p, end, &f.rid)) return false;
     if (!rd(p, end, &f.method)) return false;
-    if (!rd(p, end, &count)) return false;
+    if (!rd(p, end, &f.count)) return false;
     // bounds keep one frame always deliverable in a single pull
     // (count <= 1024 < MAX_N, fields <= 1024 B -> ~2 MB = KEY_CAP); a
     // count of 0 is rejected too — it could never complete a reply
+    uint16_t count = f.count;
     if (count == 0 || count > 1024) return false;
-    f.items.reserve(count);
+    if (!rd_vec(p, end, &f.name_len, count)) return false;
+    if (!rd_vec(p, end, &f.ukey_len, count)) return false;
+    size_t kbytes = 0;
     for (uint16_t i = 0; i < count; i++) {
-      Item it;
-      uint16_t nlen, klen;
-      if (!rd(p, end, &nlen) || !rd(p, end, &klen)) return false;
-      if (nlen > 1024 || klen > 1024 || p + nlen + klen > end) return false;
-      it.name_and_key.assign(p, (size_t)nlen + klen);
-      it.name_len = nlen;
-      p += (size_t)nlen + klen;
-      if (!rd(p, end, &it.hits) || !rd(p, end, &it.limit) ||
-          !rd(p, end, &it.duration) || !rd(p, end, &it.algorithm) ||
-          !rd(p, end, &it.behavior))
-        return false;
-      f.items.push_back(std::move(it));
+      if (f.name_len[i] > 1024 || f.ukey_len[i] > 1024) return false;
+      kbytes += (size_t)f.name_len[i] + f.ukey_len[i];
     }
+    if (p + kbytes > end) return false;
+    f.keys.assign(p, kbytes);
+    p += kbytes;
+    if (!rd_vec(p, end, &f.hits, count)) return false;
+    if (!rd_vec(p, end, &f.limit, count)) return false;
+    if (!rd_vec(p, end, &f.duration, count)) return false;
+    if (!rd_vec(p, end, &f.algorithm, count)) return false;
+    if (!rd_vec(p, end, &f.behavior, count)) return false;
     if (p != end) return false;
     off += 4 + len;
     {
@@ -175,7 +194,12 @@ bool drain_inbuf(Server* s, Conn* c) {
       pr.method = f.method;
       pr.expected = count;
       pr.got = 0;
-      pr.parts.assign(count, std::string());
+      pr.status.assign(count, 0);
+      pr.limit.assign(count, 0);
+      pr.remaining.assign(count, 0);
+      pr.reset.assign(count, 0);
+      pr.err.assign(count, std::string());
+      pr.filled.assign(count, 0);
       s->queue.push_back(std::move(f));
       enqueued = true;
     }
@@ -425,27 +449,26 @@ int pls_next_batch(void* h, long long timeout_us, char* keys, int key_cap,
   key_off[0] = 0;
   while (!s->queue.empty()) {
     Frame& f = s->queue.front();
-    if (n + (int)f.items.size() > max_n) break;
-    int kbytes = 0;
-    for (auto& it : f.items) kbytes += (int)it.name_and_key.size();
-    if (koff + kbytes > key_cap) break;
-    for (size_t i = 0; i < f.items.size(); i++) {
-      Item& it = f.items[i];
-      memcpy(keys + koff, it.name_and_key.data(), it.name_and_key.size());
-      koff += (int)it.name_and_key.size();
-      key_off[n + 1] = koff;
-      name_len[n] = (int)it.name_len;
-      hits[n] = it.hits;
-      limit[n] = it.limit;
-      duration[n] = it.duration;
-      algorithm[n] = (int)it.algorithm;
-      behavior[n] = (int)it.behavior;
-      method[n] = (int)f.method;
-      idx[n] = (int)i;
-      conn_token[n] = f.conn_token;
-      rid[n] = f.rid;
-      n++;
+    int count = f.count;
+    if (n + count > max_n) break;
+    if (koff + (int)f.keys.size() > key_cap) break;
+    // columnar frame -> columnar caller buffers: bulk copies
+    memcpy(keys + koff, f.keys.data(), f.keys.size());
+    for (int i = 0; i < count; i++) {
+      koff += (int)f.name_len[i] + (int)f.ukey_len[i];
+      key_off[n + i + 1] = koff;
+      name_len[n + i] = (int)f.name_len[i];
+      algorithm[n + i] = (int)f.algorithm[i];
+      behavior[n + i] = (int)f.behavior[i];
+      method[n + i] = (int)f.method;
+      idx[n + i] = i;
+      conn_token[n + i] = f.conn_token;
+      rid[n + i] = f.rid;
     }
+    memcpy(hits + n, f.hits.data(), count * 8);
+    memcpy(limit + n, f.limit.data(), count * 8);
+    memcpy(duration + n, f.duration.data(), count * 8);
+    n += count;
     s->queue.pop_front();
     if (n == max_n) break;
   }
@@ -468,32 +491,37 @@ void pls_send_responses(void* h, int n, const unsigned long long* conn_token,
     auto pit = c->pending.find(rid[i]);
     if (pit == c->pending.end()) continue;
     PendingReply& pr = pit->second;
-    if (idx[i] < 0 || idx[i] >= pr.expected) continue;
+    int j = idx[i];
+    if (j < 0 || j >= pr.expected) continue;
+    if (!pr.filled[j]) pr.got++;
+    pr.filled[j] = 1;
+    pr.status[j] = status[i];
+    pr.limit[j] = limit[i];
+    pr.remaining[j] = remaining[i];
+    pr.reset[j] = reset[i];
     int elen = err_off[i + 1] - err_off[i];
-    std::string part;
-    part.reserve(30 + elen);
-    int32_t st = status[i];
-    part.append((const char*)&st, 4);
-    part.append((const char*)&limit[i], 8);
-    part.append((const char*)&remaining[i], 8);
-    part.append((const char*)&reset[i], 8);
-    uint16_t el = (uint16_t)elen;
-    part.append((const char*)&el, 2);
-    if (elen) part.append(err_buf + err_off[i], elen);
-    if (pr.parts[idx[i]].empty()) pr.got++;
-    pr.parts[idx[i]] = std::move(part);
+    pr.err[j].assign(err_buf + err_off[i], (size_t)elen);
     if (pr.got == pr.expected) {
+      uint16_t cnt = pr.expected;
+      size_t ebytes = 0;
+      for (auto& e : pr.err) ebytes += e.size();
+      uint32_t len = 11 + cnt * (4 + 8 + 8 + 8 + 2) + (uint32_t)ebytes;
       std::string frame;
-      uint32_t len = 11;
-      for (auto& p : pr.parts) len += (uint32_t)p.size();
       frame.reserve(4 + len);
       frame.append((const char*)&len, 4);
       uint64_t r = rid[i];
       frame.append((const char*)&r, 8);
       frame.push_back((char)pr.method);
-      uint16_t cnt = pr.expected;
       frame.append((const char*)&cnt, 2);
-      for (auto& p : pr.parts) frame += p;
+      frame.append((const char*)pr.status.data(), cnt * 4);
+      frame.append((const char*)pr.limit.data(), cnt * 8);
+      frame.append((const char*)pr.remaining.data(), cnt * 8);
+      frame.append((const char*)pr.reset.data(), cnt * 8);
+      for (auto& e : pr.err) {
+        uint16_t el = (uint16_t)e.size();
+        frame.append((const char*)&el, 2);
+      }
+      for (auto& e : pr.err) frame += e;
       c->pending.erase(pit);
       direct_send(s, c, frame);
     }

@@ -66,7 +66,12 @@ class PeerClient:
             return None
         link = self._link
         if link is not None:
-            return link
+            if not link._closed:
+                return link
+            # the reader died since the last call (peer restarted, network
+            # blip): retire the dead client and back off to gRPC
+            self._drop_link()
+            return None
         if time.monotonic() < self._link_retry_at:
             return None
         from gubernator_tpu.service.peerlink import (
@@ -184,11 +189,23 @@ class PeerClient:
             from gubernator_tpu.service.peerlink import (
                 METHOD_GET_PEER_RATE_LIMITS,
                 PeerLinkError,
+                PeerLinkTimeout,
+                PeerLinkUnencodable,
             )
 
             try:
                 return link.call(METHOD_GET_PEER_RATE_LIMITS, list(reqs),
                                  self.conf.batch_timeout_s)
+            except PeerLinkUnencodable:
+                pass  # THIS request can't ride the wire format; the link
+                # is healthy — route just this call over gRPC below
+            except PeerLinkTimeout as e:
+                # the frame may already be applying at the peer: re-sending
+                # over gRPC could double-count hits (the invariant
+                # Instance._forward_group documents) — surface the error,
+                # exactly as a gRPC deadline would
+                self._record_err(f"peerlink: {e}")
+                raise
             except PeerLinkError as e:
                 # broken link: back off to gRPC for a while (the peer may
                 # have restarted without the link, or be a reference node)

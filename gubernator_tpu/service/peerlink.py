@@ -44,12 +44,27 @@ log = logging.getLogger("gubernator_tpu.peerlink")
 METHOD_GET_RATE_LIMITS = 0
 METHOD_GET_PEER_RATE_LIMITS = 1
 
-_ITEM = struct.Struct("<qqqII")  # hits, limit, duration, algorithm, behavior
-_REPLY = struct.Struct("<iqqqH")  # status, limit, remaining, reset, err_len
+# Columnar wire layout (see native/peerlink.cpp): fields ride as arrays,
+# encoded/decoded with numpy bulk ops — per-item marshalling cost is what
+# made the gRPC tier slow, so the frames avoid it on both ends.
+_ONE_HDR = struct.Struct("<QBHHH")  # rid, method, count=1, name_len, ukey_len
+_ONE_FIX = struct.Struct("<qqqII")  # hits, limit, duration, algo, behavior
 
 
 class PeerLinkError(RuntimeError):
-    """Transport-level failure: callers fall back to the gRPC tier."""
+    """Transport-level failure: the link is broken — callers drop it and
+    fall back to the gRPC tier for a while."""
+
+
+class PeerLinkTimeout(PeerLinkError):
+    """No response in time. The frame MAY already be applying at the peer,
+    so callers must NOT re-send (double-counted hits) — surface the error,
+    exactly as a gRPC deadline does."""
+
+
+class PeerLinkUnencodable(PeerLinkError):
+    """This request cannot ride the wire format (oversized key, too many
+    items). The link itself is healthy: route just this call over gRPC."""
 
 
 # per-field wire bound (server closes the conn on anything bigger); the
@@ -60,38 +75,115 @@ MAX_FRAME_ITEMS = 1024
 
 def encode_request_frame(rid: int, method: int,
                          reqs: Sequence[RateLimitReq]) -> bytes:
-    """Raises PeerLinkError for anything the wire format cannot carry —
-    callers route those requests over gRPC instead."""
-    if not 0 < len(reqs) <= MAX_FRAME_ITEMS:
-        raise PeerLinkError(f"frame must carry 1..{MAX_FRAME_ITEMS} requests")
-    out = bytearray()
-    out += struct.pack("<QBH", rid, method, len(reqs))
-    for r in reqs:
+    """Columnar encode. Raises PeerLinkError for anything the wire format
+    cannot carry — callers route those requests over gRPC instead."""
+    n = len(reqs)
+    if not 0 < n <= MAX_FRAME_ITEMS:
+        raise PeerLinkUnencodable(
+            f"frame must carry 1..{MAX_FRAME_ITEMS} requests")
+    if n == 1:
+        # the lone peer-hop path: two packs, zero numpy
+        r = reqs[0]
         name = r.name.encode()
         ukey = r.unique_key.encode()
         if len(name) > MAX_FIELD_BYTES or len(ukey) > MAX_FIELD_BYTES:
-            raise PeerLinkError("key too long for peerlink")
-        out += struct.pack("<HH", len(name), len(ukey))
-        out += name
-        out += ukey
-        out += _ITEM.pack(r.hits, r.limit, r.duration,
-                          int(r.algorithm), int(r.behavior))
-    return struct.pack("<I", len(out)) + bytes(out)
+            raise PeerLinkUnencodable("key too long for peerlink")
+        body = (_ONE_HDR.pack(rid, method, 1, len(name), len(ukey))
+                + name + ukey
+                + _ONE_FIX.pack(r.hits, r.limit, r.duration,
+                                int(r.algorithm), int(r.behavior)))
+        return struct.pack("<I", len(body)) + body
+    if n <= 4:
+        # numpy's fixed setup costs more than it saves on tiny frames (the
+        # lone peer-hop path is all tiny frames)
+        parts = [struct.pack("<QBH", rid, method, n)]
+        names = [r.name.encode() for r in reqs]
+        ukeys = [r.unique_key.encode() for r in reqs]
+        for a, b in zip(names, ukeys):
+            if len(a) > MAX_FIELD_BYTES or len(b) > MAX_FIELD_BYTES:
+                raise PeerLinkUnencodable("key too long for peerlink")
+        parts.append(struct.pack(f"<{n}H", *(len(a) for a in names)))
+        parts.append(struct.pack(f"<{n}H", *(len(b) for b in ukeys)))
+        parts.extend(a + b for a, b in zip(names, ukeys))
+        for col in ("hits", "limit", "duration"):
+            parts.append(struct.pack(
+                f"<{n}q", *(getattr(r, col) for r in reqs)))
+        parts.append(struct.pack(f"<{n}I", *(int(r.algorithm) for r in reqs)))
+        parts.append(struct.pack(f"<{n}I", *(int(r.behavior) for r in reqs)))
+        body = b"".join(parts)
+        return struct.pack("<I", len(body)) + body
+    names = [r.name.encode() for r in reqs]
+    ukeys = [r.unique_key.encode() for r in reqs]
+    nl = [len(b) for b in names]
+    ul = [len(b) for b in ukeys]
+    # bound-check BEFORE the uint16 casts: an oversized length would raise
+    # OverflowError (numpy 2) or silently wrap (numpy 1), not fall back
+    if max(nl) > MAX_FIELD_BYTES or max(ul) > MAX_FIELD_BYTES:
+        raise PeerLinkUnencodable("key too long for peerlink")
+    name_len = np.array(nl, np.uint16)
+    ukey_len = np.array(ul, np.uint16)
+    keys = b"".join(a + b for a, b in zip(names, ukeys))
+    cols = np.empty((3, n), np.int64)
+    meta = np.empty((2, n), np.uint32)
+    for j, r in enumerate(reqs):  # one pass builds every column
+        cols[0, j] = r.hits
+        cols[1, j] = r.limit
+        cols[2, j] = r.duration
+        meta[0, j] = int(r.algorithm)
+        meta[1, j] = int(r.behavior)
+    body = b"".join((
+        struct.pack("<QBH", rid, method, n),
+        name_len.tobytes(), ukey_len.tobytes(), keys,
+        cols.tobytes(), meta.tobytes(),
+    ))
+    return struct.pack("<I", len(body)) + body
 
 
 def decode_response_frame(payload: memoryview) -> List[RateLimitResp]:
-    rid, method, count = struct.unpack_from("<QBH", payload, 0)
+    _rid, _method, count = struct.unpack_from("<QBH", payload, 0)
     off = 11
+    if count <= 4:  # mirror the tiny-frame encode fast path
+        st = struct.unpack_from(f"<{count}i", payload, off)
+        off += 4 * count
+        li = struct.unpack_from(f"<{count}q", payload, off)
+        off += 8 * count
+        re = struct.unpack_from(f"<{count}q", payload, off)
+        off += 8 * count
+        rs = struct.unpack_from(f"<{count}q", payload, off)
+        off += 8 * count
+        el = struct.unpack_from(f"<{count}H", payload, off)
+        off += 2 * count
+        out = []
+        for i in range(count):
+            err = (bytes(payload[off:off + el[i]]).decode()
+                   if el[i] else "")
+            off += el[i]
+            out.append(RateLimitResp(status=st[i], limit=li[i],
+                                     remaining=re[i], reset_time=rs[i],
+                                     error=err))
+        return out
+    status = np.frombuffer(payload, np.int32, count, off)
+    off += 4 * count
+    limit = np.frombuffer(payload, np.int64, count, off)
+    off += 8 * count
+    remaining = np.frombuffer(payload, np.int64, count, off)
+    off += 8 * count
+    reset = np.frombuffer(payload, np.int64, count, off)
+    off += 8 * count
+    err_len = np.frombuffer(payload, np.uint16, count, off)
+    off += 2 * count
+    st, li, re, rs = (status.tolist(), limit.tolist(), remaining.tolist(),
+                      reset.tolist())
+    if not err_len.any():  # the common, error-free fast path
+        return [RateLimitResp(status=st[i], limit=li[i], remaining=re[i],
+                              reset_time=rs[i]) for i in range(count)]
     out = []
-    for _ in range(count):
-        status, limit, remaining, reset, elen = _REPLY.unpack_from(
-            payload, off)
-        off += _REPLY.size
+    for i in range(count):
+        elen = int(err_len[i])
         err = bytes(payload[off:off + elen]).decode() if elen else ""
         off += elen
-        out.append(RateLimitResp(status=status, limit=limit,
-                                 remaining=remaining, reset_time=reset,
-                                 error=err))
+        out.append(RateLimitResp(status=st[i], limit=li[i], remaining=re[i],
+                                 reset_time=rs[i], error=err))
     return out
 
 
@@ -125,7 +217,14 @@ class PeerLinkClient:
         except FutureTimeout:
             with self._flock:
                 self._futures.pop(rid, None)
-            raise PeerLinkError("peerlink response timeout") from None
+            raise PeerLinkTimeout("peerlink response timeout") from None
+        except PeerLinkError as e:
+            # the frame was already delivered to the socket when the link
+            # died: delivery is UNCERTAIN, so this must surface like a
+            # timeout (re-sending could double-apply), not like a pre-send
+            # transport error
+            raise PeerLinkTimeout(
+                f"link failed awaiting response: {e}") from e
 
     def call_async(self, method: int, reqs: Sequence[RateLimitReq]):
         """Fire one frame; returns (future, rid). The future resolves to
@@ -282,19 +381,25 @@ class PeerLinkService:
         Returns the concatenated error-string buffer."""
         self.stats["batches"] += 1
         self.stats["requests"] += got
-        raw_keys, key_off, name_len = b["keys"], b["key_off"], b["name_len"]
-        hits, limit, duration = b["hits"], b["limit"], b["duration"]
-        algorithm, behavior, method = b["algorithm"], b["behavior"], b["method"]
+        # one C-level tolist per column beats per-item numpy scalar casts
+        koff = b["key_off"][:got + 1].tolist()
+        nlen = b["name_len"][:got].tolist()
+        hits = b["hits"][:got].tolist()
+        limit = b["limit"][:got].tolist()
+        duration = b["duration"][:got].tolist()
+        algorithm = b["algorithm"][:got].tolist()
+        behavior = b["behavior"][:got].tolist()
+        method = b["method"]
+        raw_keys = b["keys"]
         reqs: List[RateLimitReq] = []
         for j in range(got):
-            lo, hi = int(key_off[j]), int(key_off[j + 1])
-            split = lo + int(name_len[j])
-            name = raw_keys[lo:split].decode()
-            unique = raw_keys[split:hi].decode()
+            lo, hi = koff[j], koff[j + 1]
+            split = lo + nlen[j]
             reqs.append(RateLimitReq(
-                name=name, unique_key=unique, hits=int(hits[j]),
-                limit=int(limit[j]), duration=int(duration[j]),
-                algorithm=int(algorithm[j]), behavior=int(behavior[j])))
+                name=raw_keys[lo:split].decode(),
+                unique_key=raw_keys[split:hi].decode(), hits=hits[j],
+                limit=limit[j], duration=duration[j],
+                algorithm=algorithm[j], behavior=behavior[j]))
 
         status, r_limit = b["status"], b["r_limit"]
         r_remaining, r_reset, err_off = b["r_remaining"], b["r_reset"], b["err_off"]

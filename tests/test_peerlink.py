@@ -167,28 +167,13 @@ class TestPeerClientIntegration:
     def test_forwarding_rides_the_link(self):
         """A 2-node cluster with peerlink wired: forwarded requests use the
         native transport (gRPC request counters stay flat)."""
+        from gubernator_tpu.cluster.harness import wire_peerlink
+
         cluster = LocalCluster().start(2)
         links = []
         try:
-            # the daemon's real convention: every node's link lives at its
-            # gRPC port + one shared positive offset. gRPC ports here are
-            # dynamic, so probe a few offsets until both binds succeed.
-            ports = [int(ci.address.rsplit(":", 1)[1])
-                     for ci in cluster.instances]
-            for offset in (1000, 2000, 3000, 5000):
-                attempt = []
-                try:
-                    for i, ci in enumerate(cluster.instances):
-                        attempt.append(PeerLinkService(
-                            ci.instance, port=ports[i] + offset))
-                    links = attempt
-                    break
-                except PeerLinkError:
-                    for svc in attempt:
-                        svc.close()
+            links = wire_peerlink(cluster)
             assert links, "no usable link offset"
-            for ci in cluster.instances:
-                ci.instance.conf.behaviors.peer_link_offset = offset
             ci0, ci1 = cluster.instances
 
             # find a key ci0 does not own; send it to ci0 -> forwarded
@@ -209,6 +194,34 @@ class TestPeerClientIntegration:
                     time.time() < deadline:
                 time.sleep(0.01)
             assert links[1].stats["requests"] > before  # rode the link
+        finally:
+            for svc in links:
+                svc.close()
+            cluster.stop()
+
+    def test_unencodable_request_keeps_link_healthy(self):
+        """An oversized key routes THIS call over gRPC without dropping the
+        shared link or entering the 30 s backoff."""
+        from gubernator_tpu.cluster.harness import wire_peerlink
+        from gubernator_tpu.service.peer_client import PeerClient
+        from gubernator_tpu.types import PeerInfo
+
+        cluster = LocalCluster().start(2)
+        links = []
+        try:
+            links = wire_peerlink(cluster)
+            assert links
+            ci0, ci1 = cluster.instances
+            pc = PeerClient(ci0.instance.conf.behaviors,
+                            PeerInfo(address=ci1.address))
+            r = pc.get_peer_rate_limits([_req("small")])[0]
+            assert r.error == "" and pc._link is not None  # link active
+            big = pc.get_peer_rate_limits([_req("k" * 2000)])[0]
+            assert big.error == ""  # served over gRPC
+            assert pc._link is not None  # link NOT dropped
+            r2 = pc.get_peer_rate_limits([_req("small")])[0]
+            assert r2.remaining == 8  # link still carrying traffic
+            pc.shutdown()
         finally:
             for svc in links:
                 svc.close()
