@@ -1,58 +1,225 @@
-"""Three-implementation differential fuzz: native engine, pure-python
-engine, and mesh-sharded engine must be response-identical on randomized
-workloads with expiry-crossing time jumps.
+"""Differential + invariant fuzz campaign (reference role:
+functional_test.go:98-505's table-driven behavior coverage, randomized).
 
-CI-bounded version of the longer offline campaign (122 trials x 60 steps
-run clean on 2026-07-30); the oracle tier is covered separately in
-tests/test_decide.py. The time-jump distribution deliberately crosses every
-duration in the workload so expiry-on-read, bucket recreation, and leak
-math all get exercised against each other.
+Three tiers, each CI-bounded but dimensionally exhaustive:
+
+1. `test_three_way_differential` — native engine vs pure-python engine vs
+   mesh-sharded engine must be RESPONSE-IDENTICAL on randomized workloads
+   crossing: 1/2/4/8-shard meshes x behaviors (RESET_REMAINING,
+   NO_BATCHING, gregorian calendar codes) x expiry-crossing time jumps x
+   duplicate-key herd bursts x config hot-changes (limit/duration/
+   algorithm switch) x hits=0 peeks x invalid requests x mid-trial
+   RESTART from a state snapshot (persistence differential).
+2. `test_store_differential` — the same trio with write-through Stores
+   attached: responses AND the final persisted state must match.
+3. `test_global_sync_interleaving_invariants` — GLOBAL traffic on the
+   sharded engine with randomized sync interleavings; eventual-consistency
+   invariants (bounds, convergence of mirror and authoritative state
+   after quiet syncs) must hold at every probe point.
+
+Scenario accounting: every randomized batch is one scenario (independent
+composition, time jump, and config draw). The CI defaults below run
+>= 1,200 scenarios in ~1 minute; FUZZ_TRIALS / FUZZ_STEPS scale the
+campaign up for offline runs (e.g. FUZZ_TRIALS=100 for the long haul).
 """
 
+import os
 import random
 
 import pytest
 
 from gubernator_tpu.models import Engine
 from gubernator_tpu.parallel import ShardedEngine
-from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq
+from gubernator_tpu.store import MockStore
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq, Status
 
 NOW = 1_700_000_000_000
+TRIALS = int(os.environ.get("FUZZ_TRIALS", "20"))
+STEPS = int(os.environ.get("FUZZ_STEPS", "50"))
+
+# forward time jumps spanning every duration in the workload: same-ms,
+# sub-rate, rate-crossing, expiry-crossing, calendar-crossing
+JUMPS = [0, 1, 50, 997, 10_000, 3_600_000, 90_000_000]
+DURATIONS = [1, 500, 10_000, 3_600_000]
+LIMITS = [1, 5, 10, 100]
 
 
-@pytest.mark.parametrize("seed", [101, 202, 303])
-def test_three_way_differential(seed):
-    rng = random.Random(seed)
-    single = Engine(capacity=128, min_width=8, max_width=32)
-    single_py = Engine(capacity=128, min_width=8, max_width=32)
+def _make_trio(rng, store: bool = False):
+    """(native engine, python engine, sharded engine) with a random mesh."""
+    n_shards = rng.choice([1, 2, 4, 8])
+    stores = [MockStore() if store else None for _ in range(3)]
+    single = Engine(capacity=128, min_width=8, max_width=32, store=stores[0])
+    single_py = Engine(capacity=128, min_width=8, max_width=32,
+                      store=stores[1])
     single_py._prep_fast = None  # force the python pipeline
-    shard = ShardedEngine(n_shards=4, capacity_per_shard=64,
-                          min_width=8, max_width=32)
+    shard = ShardedEngine(n_shards=n_shards, capacity_per_shard=64,
+                          min_width=8, max_width=32, store=stores[2])
+    return (single, single_py, shard), stores, n_shards
+
+
+def _random_batch(rng, keys):
+    """One randomized scenario: batch composition is the fuzz surface."""
+    draw = rng.random()
+    if draw < 0.08:
+        # duplicate-key herd burst: rounds semantics under pressure
+        k = rng.choice(keys)
+        hits = rng.randint(0, 3)
+        return [RateLimitReq(name="t", unique_key=k, hits=hits,
+                             limit=rng.choice(LIMITS),
+                             duration=rng.choice(DURATIONS))
+                for _ in range(rng.randint(5, 30))]
+    batch = []
+    for _ in range(rng.randint(1, 16)):
+        r = rng.random()
+        if r < 0.04:
+            batch.append(RateLimitReq(name="t", unique_key=""))
+        elif r < 0.07:
+            batch.append(RateLimitReq(name="", unique_key="x"))
+        elif r < 0.17:
+            batch.append(RateLimitReq(
+                name="t", unique_key=rng.choice(keys),
+                hits=rng.randint(0, 3), limit=rng.choice([1, 5, 10]),
+                duration=rng.choice([0, 1, 2, 3, 4, 5]),  # all greg codes
+                behavior=int(Behavior.DURATION_IS_GREGORIAN)))
+        else:
+            batch.append(RateLimitReq(
+                name="t", unique_key=rng.choice(keys),
+                hits=rng.randint(0, 4), limit=rng.choice(LIMITS),
+                duration=rng.choice(DURATIONS),
+                algorithm=rng.choice(
+                    [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]),
+                behavior=rng.choice(
+                    [0, 0, int(Behavior.RESET_REMAINING),
+                     int(Behavior.NO_BATCHING)])))
+    return batch
+
+
+def _restart_from_snapshot(engines):
+    """Mid-trial restart: rebuild every engine from its own snapshot (the
+    reference's Loader boot path, gubernator.go:75-83) — state must
+    survive bit-exactly or the differential diverges from here on."""
+    single, single_py, shard = engines
+    snap_a = single.snapshot(include_expired=True)
+    snap_b = single_py.snapshot(include_expired=True)
+    snap_c = shard.snapshot(include_expired=True)
+    new_single = Engine(capacity=128, min_width=8, max_width=32,
+                        store=single.store)
+    new_single.load_snapshot(snap_a)
+    new_py = Engine(capacity=128, min_width=8, max_width=32,
+                    store=single_py.store)
+    new_py._prep_fast = None
+    new_py.load_snapshot(snap_b)
+    new_shard = ShardedEngine(
+        n_shards=shard.plan.n_shards, capacity_per_shard=64,
+        min_width=8, max_width=32, store=shard.store)
+    new_shard.load_snapshot(snap_c)
+    return new_single, new_py, new_shard
+
+
+@pytest.mark.parametrize("trial", range(TRIALS))
+def test_three_way_differential(trial):
+    rng = random.Random(1000 + trial)
+    engines, _, n_shards = _make_trio(rng)
     now = NOW + rng.randrange(10**9)
     keys = [f"k{i}" for i in range(rng.choice([3, 8, 20]))]
-    for step in range(60):
-        now += rng.choice([0, 1, 50, 997, 10_000, 3_600_000, 90_000_000])
-        batch = []
-        for _ in range(rng.randint(1, 16)):
-            r = rng.random()
-            if r < 0.05:
-                batch.append(RateLimitReq(name="t", unique_key=""))
-            elif r < 0.15:
-                batch.append(RateLimitReq(
-                    name="t", unique_key=rng.choice(keys),
-                    hits=rng.randint(0, 3), limit=rng.choice([1, 5, 10]),
-                    duration=rng.choice([0, 1, 2, 3, 4, 5]),  # all greg codes
-                    behavior=int(Behavior.DURATION_IS_GREGORIAN)))
-            else:
-                batch.append(RateLimitReq(
-                    name="t", unique_key=rng.choice(keys),
-                    hits=rng.randint(0, 4), limit=rng.choice([1, 5, 10, 100]),
-                    duration=rng.choice([1, 500, 10_000, 3_600_000]),
-                    algorithm=rng.choice(
-                        [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]),
-                    behavior=rng.choice(
-                        [0, int(Behavior.RESET_REMAINING)])))
-        a = single.get_rate_limits(batch, now_ms=now)
-        b = single_py.get_rate_limits(batch, now_ms=now)
-        c = shard.get_rate_limits(batch, now_ms=now)
-        assert a == b == c, f"divergence at seed={seed} step={step}"
+    restart_at = rng.randrange(STEPS) if rng.random() < 0.5 else -1
+    for step in range(STEPS):
+        if step == restart_at:
+            engines = _restart_from_snapshot(engines)
+        now += rng.choice(JUMPS)
+        batch = _random_batch(rng, keys)
+        a = engines[0].get_rate_limits(batch, now_ms=now)
+        b = engines[1].get_rate_limits(batch, now_ms=now)
+        c = engines[2].get_rate_limits(batch, now_ms=now)
+        assert a == b == c, (
+            f"divergence trial={trial} step={step} shards={n_shards} "
+            f"restart={restart_at}")
+
+
+@pytest.mark.parametrize("trial", range(max(2, TRIALS // 3)))
+def test_store_differential(trial):
+    """Write-through Stores attached everywhere: responses and the FINAL
+    persisted bucket state must agree across implementations."""
+    rng = random.Random(7000 + trial)
+    engines, stores, n_shards = _make_trio(rng, store=True)
+    now = NOW + rng.randrange(10**9)
+    keys = [f"s{i}" for i in range(rng.choice([3, 8]))]
+    for step in range(STEPS // 2):
+        now += rng.choice(JUMPS)
+        batch = _random_batch(rng, keys)
+        a = engines[0].get_rate_limits(batch, now_ms=now)
+        b = engines[1].get_rate_limits(batch, now_ms=now)
+        c = engines[2].get_rate_limits(batch, now_ms=now)
+        assert a == b == c, f"divergence trial={trial} step={step}"
+    # persisted remaining/expiry per key must be identical (call ORDER may
+    # differ across engines; final state may not)
+    finals = []
+    for st in stores:
+        finals.append({
+            k: (v.remaining, v.limit, v.expire_at, v.algo, v.duration,
+                v.stamp, v.status)
+            for k, v in st.data.items()
+        })
+    assert finals[0] == finals[1] == finals[2], f"store divergence {trial}"
+
+
+@pytest.mark.parametrize("trial", range(max(2, TRIALS // 3)))
+def test_global_sync_interleaving_invariants(trial):
+    """GLOBAL traffic with randomized sync interleavings on random meshes.
+
+    Eventual-consistency invariants (reference contract,
+    architecture.md:46-77) checked at random probe points:
+    - responses never exceed bounds: 0 <= remaining <= limit;
+    - after two traffic-free syncs, the mirror answer and the
+      authoritative peek agree exactly (convergence);
+    - a key that admitted nothing but peeks stays at full limit.
+    """
+    rng = random.Random(3000 + trial)
+    n_shards = rng.choice([1, 2, 4, 8])
+    eng = ShardedEngine(n_shards=n_shards, capacity_per_shard=128,
+                        min_width=8, max_width=64,
+                        global_capacity=16, global_idle_ms=10**9)
+    now = NOW
+    limit = rng.choice([10, 100, 1000])
+    keys = [f"g{i}" for i in range(rng.choice([1, 3, 6]))]
+    # this key only ever peeks (hits=0): it must stay at full limit
+    peek_key = "peek_only"
+
+    def g(key, hits):
+        return RateLimitReq(name="t", unique_key=key, hits=hits, limit=limit,
+                            duration=86_400_000,
+                            behavior=int(Behavior.GLOBAL))
+
+    for step in range(STEPS // 2):
+        now += rng.choice([0, 1, 50, 997])
+        batch = [g(rng.choice(keys), rng.randint(0, 3))
+                 for _ in range(rng.randint(1, 8))]
+        if rng.random() < 0.3:
+            batch.append(g(peek_key, 0))
+        for resp in eng.get_rate_limits(batch, now_ms=now):
+            assert resp.error == ""
+            assert 0 <= resp.remaining <= limit, (trial, step, resp)
+        if rng.random() < 0.4:  # randomized sync interleaving
+            eng.global_sync(now_ms=now)
+        if rng.random() < 0.15:
+            # convergence probe: two quiet syncs, then mirror == peek
+            now += 1
+            eng.global_sync(now_ms=now)
+            now += 1
+            eng.global_sync(now_ms=now)
+            # peek-only traffic must never deduct anything
+            pk = eng.get_rate_limits([g(peek_key, 0)], now_ms=now)[0]
+            assert pk.remaining == limit, (
+                f"trial={trial} step={step}: peek-only key drained to "
+                f"{pk.remaining}")
+            for k in keys:
+                mirror = eng.get_rate_limits([g(k, 0)], now_ms=now)[0]
+                auth = eng.get_rate_limits(
+                    [RateLimitReq(name="t", unique_key=k, hits=0,
+                                  limit=limit, duration=86_400_000)],
+                    now_ms=now)[0]
+                if mirror.status != int(Status.OVER_LIMIT):
+                    assert mirror.remaining == auth.remaining, (
+                        f"trial={trial} step={step} key={k}: mirror "
+                        f"{mirror.remaining} != authoritative "
+                        f"{auth.remaining}")

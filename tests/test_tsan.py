@@ -1,0 +1,229 @@
+"""ThreadSanitizer pass over the native tier (reference parity:
+`go test ./... -race`, Makefile:7-8).
+
+Both C++ components are rebuilt with -fsanitize=thread and hammered under
+their REAL concurrency disciplines in a subprocess running with libtsan
+preloaded:
+
+- peerlink (native/peerlink.cpp) is genuinely multithreaded: one epoll IO
+  thread, N puller threads blocking in pls_next_batch, responder threads
+  writing directly to sockets, concurrent client connects/closes. The
+  stress speaks raw frames over sockets so the subprocess needs no
+  package imports (TSan's ~10x slowdown stays off the jax import path).
+- keydir (native/keydir.cpp) is caller-locked by contract (like the
+  reference's Cache, cache.go:32-43): the stress exercises lookup/drop/
+  dump from many threads under one mutex — the discipline the engine
+  lock provides — so TSan checks the library's internals (allocator,
+  statics) under real thread churn.
+
+A data race makes TSan print "WARNING: ThreadSanitizer" and exit 66
+(TSAN_OPTIONS exitcode); the test asserts a clean run.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+NATIVE = os.path.join(HERE, "..", "gubernator_tpu", "native")
+
+
+def _tsan_lib(src_name: str, prefix: str, extra=()):
+    """Build the TSan variant of a native source (cached by mtime)."""
+    src = os.path.join(NATIVE, src_name)
+    mtime = int(os.stat(src).st_mtime)
+    path = os.path.join(NATIVE, f"{prefix}{mtime}.so")
+    if not os.path.exists(path):
+        tmp = path + ".tmp"
+        subprocess.run(
+            ["g++", "-O1", "-g", "-shared", "-fPIC", "-std=c++17",
+             "-fsanitize=thread", "-pthread", *extra, "-o", tmp, src],
+            check=True, capture_output=True)
+        os.replace(tmp, path)
+        for name in os.listdir(NATIVE):
+            if name.startswith(prefix) and name.endswith(".so") and \
+                    os.path.join(NATIVE, name) != path:
+                try:
+                    os.unlink(os.path.join(NATIVE, name))
+                except OSError:
+                    pass
+    return path
+
+
+def _find_libtsan():
+    for root in ("/usr/lib/gcc/x86_64-linux-gnu",):
+        if os.path.isdir(root):
+            for ver in sorted(os.listdir(root), reverse=True):
+                p = os.path.join(root, ver, "libtsan.so")
+                if os.path.exists(p):
+                    return p
+    return None
+
+
+LIBTSAN = _find_libtsan()
+
+_PEERLINK_STRESS = textwrap.dedent("""
+    import ctypes, socket, struct, sys, threading, time
+    lib = ctypes.CDLL(sys.argv[1])
+    c = ctypes
+    lib.pls_start.restype = c.c_void_p
+    lib.pls_start.argtypes = [c.c_int, c.POINTER(c.c_int)]
+    lib.pls_stop.argtypes = [c.c_void_p]
+    lib.pls_free.argtypes = [c.c_void_p]
+    lib.pls_next_batch.restype = c.c_int
+    lib.pls_next_batch.argtypes = [c.c_void_p, c.c_longlong, c.c_char_p,
+        c.c_int] + [c.c_void_p] * 11 + [c.c_int]
+    lib.pls_send_responses.argtypes = [c.c_void_p, c.c_int] + \\
+        [c.c_void_p] * 8 + [c.c_char_p]
+
+    port = c.c_int(0)
+    h = lib.pls_start(0, c.byref(port))
+    assert h
+
+    N = 256
+    stop = False
+
+    def puller():
+        keys = c.create_string_buffer(1 << 20)
+        arrs = [(c.c_int32 * (N + 1))(), (c.c_int32 * N)(),
+                (c.c_int64 * N)(), (c.c_int64 * N)(), (c.c_int64 * N)(),
+                (c.c_int32 * N)(), (c.c_int32 * N)(), (c.c_int32 * N)(),
+                (c.c_int32 * N)(), (c.c_uint64 * N)(), (c.c_uint64 * N)()]
+        ptrs = [c.cast(a, c.c_void_p) for a in arrs]
+        status = (c.c_int32 * N)(); lim = (c.c_int64 * N)()
+        rem = (c.c_int64 * N)(); rst = (c.c_int64 * N)()
+        eoff = (c.c_int32 * (N + 1))()
+        while not stop:
+            got = lib.pls_next_batch(h, 50_000, keys, 1 << 20, *ptrs, N)
+            if got <= 0:
+                if got < 0:
+                    return
+                continue
+            for i in range(got):
+                status[i] = 0; lim[i] = 10; rem[i] = 9
+                rst[i] = 12345; eoff[i + 1] = 0
+            lib.pls_send_responses(h, got, ptrs[9], ptrs[10], ptrs[8],
+                c.cast(status, c.c_void_p), c.cast(lim, c.c_void_p),
+                c.cast(rem, c.c_void_p), c.cast(rst, c.c_void_p),
+                c.cast(eoff, c.c_void_p), b"")
+
+    def frame(rid, n=1):
+        name, ukey = b"t", b"key%d" % rid
+        body = struct.pack("<QBH", rid, 1, 1)
+        body += struct.pack("<H", len(name)) + struct.pack("<H", len(ukey))
+        body += name + ukey
+        body += struct.pack("<q", 1) + struct.pack("<q", 10)
+        body += struct.pack("<q", 60000)
+        body += struct.pack("<I", 0) + struct.pack("<I", 0)
+        return struct.pack("<I", len(body)) + body
+
+    def client(tid, calls):
+        s = socket.create_connection(("127.0.0.1", port.value), timeout=10)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        buf = b""
+        for i in range(calls):
+            s.sendall(frame(tid * 100000 + i))
+            # pipelined: read whenever data is there
+            while len(buf) >= 4:
+                (ln,) = struct.unpack_from("<I", buf, 0)
+                if len(buf) - 4 < ln:
+                    break
+                buf = buf[4 + ln:]
+            s.setblocking(True)
+            buf += s.recv(4096)
+        s.close()
+
+    def churner(n):
+        # rapid connect/half-frame/close: exercises close_conn vs responders
+        for i in range(n):
+            s = socket.create_connection(("127.0.0.1", port.value), timeout=10)
+            s.sendall(struct.pack("<I", 40))  # length, then vanish
+            s.close()
+
+    pullers = [threading.Thread(target=puller) for _ in range(3)]
+    [t.start() for t in pullers]
+    clients = [threading.Thread(target=client, args=(t, 120))
+               for t in range(6)] + [threading.Thread(target=churner,
+                                                      args=(60,))]
+    [t.start() for t in clients]
+    [t.join(timeout=120) for t in clients]
+    stop = True
+    lib.pls_stop(h)
+    [t.join(timeout=10) for t in pullers]
+    lib.pls_free(h)
+    print("PEERLINK_STRESS_OK")
+""")
+
+_KEYDIR_STRESS = textwrap.dedent("""
+    import ctypes, sys, threading
+    lib = ctypes.CDLL(sys.argv[1])
+    c = ctypes
+    lib.keydir_new.restype = c.c_void_p
+    lib.keydir_new.argtypes = [c.c_int64]
+    lib.keydir_free.argtypes = [c.c_void_p]
+    lib.keydir_lookup_batch.restype = c.c_int64
+    lib.keydir_lookup_batch.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
+                                        c.c_int32, c.c_void_p, c.c_void_p]
+    # offsets are int64_t[n+1] bounds into the packed key bytes
+    lib.keydir_drop.argtypes = [c.c_void_p, c.c_char_p, c.c_int32]
+    lib.keydir_dump.restype = c.c_int64
+    lib.keydir_dump.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                c.c_void_p, c.c_void_p, c.c_int64]
+
+    kd = lib.keydir_new(512)
+    lock = threading.Lock()  # the engine-lock discipline
+
+    def hammer(tid):
+        W = 16
+        slots = (c.c_int32 * W)()
+        fresh = (c.c_uint8 * W)()
+        for i in range(400):
+            parts = [b"k%d_%d" % (tid, (i + j) % 64) for j in range(W)]
+            keys = b"".join(parts)
+            offs = (c.c_int64 * (W + 1))()
+            pos = 0
+            for j, part in enumerate(parts):
+                pos += len(part)
+                offs[j + 1] = pos
+            with lock:
+                lib.keydir_lookup_batch(kd, keys, offs, W,
+                                        c.cast(slots, c.c_void_p),
+                                        c.cast(fresh, c.c_void_p))
+            if i % 50 == 0:
+                k = b"k%d_%d" % (tid, i % 64)
+                with lock:
+                    lib.keydir_drop(kd, k, len(k))
+
+    ts = [threading.Thread(target=hammer, args=(t,)) for t in range(6)]
+    [t.start() for t in ts]
+    [t.join(timeout=120) for t in ts]
+    lib.keydir_free(kd)
+    print("KEYDIR_STRESS_OK")
+""")
+
+
+@pytest.mark.skipif(LIBTSAN is None, reason="libtsan not installed")
+@pytest.mark.parametrize("name,src,prefix,extra,script,sentinel", [
+    ("peerlink", "peerlink.cpp", "_tsan_peerlink_", (),
+     _PEERLINK_STRESS, "PEERLINK_STRESS_OK"),
+    ("keydir", "keydir.cpp", "_tsan_keydir_",
+     ("-I" + __import__("sysconfig").get_paths()["include"],),
+     _KEYDIR_STRESS, "KEYDIR_STRESS_OK"),
+])
+def test_tsan_clean(tmp_path, name, src, prefix, extra, script, sentinel):
+    lib = _tsan_lib(src, prefix, extra)
+    worker = tmp_path / f"stress_{name}.py"
+    worker.write_text(script)
+    env = dict(os.environ)
+    env["LD_PRELOAD"] = LIBTSAN
+    env["TSAN_OPTIONS"] = "exitcode=66 halt_on_error=0"
+    proc = subprocess.run(
+        [sys.executable, str(worker), lib],
+        env=env, capture_output=True, text=True, timeout=300)
+    out = proc.stdout + proc.stderr
+    assert "WARNING: ThreadSanitizer" not in out, out[-4000:]
+    assert proc.returncode == 0, out[-4000:]
+    assert sentinel in proc.stdout, out[-2000:]
