@@ -1,0 +1,110 @@
+"""Device-resident key directory: open-addressing probe on the chip.
+
+PROTOTYPE (round-1 review item 6). The production engines map key strings
+to table slots in the host key directory (native/keydir.cpp) — the
+admitted host-side bottleneck at multi-M decisions/s (keydir.cpp:5-8,
+SURVEY §7 hard part #1: "without host round-trips per key"). This module
+moves the probe on-device: the host ships only an 8-byte hash fingerprint
+per request, and the chip resolves (or claims) the slot with a vectorized
+open-addressing probe — the slot never returns to the host, feeding
+decide() directly in the same compiled program.
+
+Design:
+- the directory is one i64[C] fingerprint column; slot IS the probe
+  position, so directory and bucket table share indexing (the bucket
+  row's algo=-1 vacancy remains the state authority).
+- probe: D candidate positions (h + d) % C gathered in ONE [B, D] gather
+  (the row-major lesson: batched gathers beat per-element probes), then a
+  branchless first-match / first-empty select.
+- fingerprints are fnv1a64 masked to 63 bits, +1 to keep 0 = empty.
+
+Known prototype limits (documented, not hidden):
+- two DIFFERENT keys colliding on the same empty position within ONE
+  batch both claim it (last scatter wins); the engines' rounds machinery
+  dedups same-key repeats but not distinct-key hash collisions. A
+  production version needs an in-batch priority pass.
+- no LRU eviction: a probe that finds neither match nor vacancy within D
+  returns slot -1 (host fallback lane). Capacity is over-provisioned 2x
+  instead, and expiry recycles rows lazily via refresh_vacancies().
+
+Honest verdict from the bench comparison (DESIGN.md "Device-resident key
+lookup"): see the numbers there — the host C++ directory stays the
+default; this path wins only when host CPU, not the device, is the
+serving bottleneck.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from gubernator_tpu.ops.decide import I32, I64, ROW_ALGO, pad_to_drop
+from gubernator_tpu.utils.fnv import fnv1a_64_str
+
+PROBE_DEPTH = 16  # candidate positions per key; full = host-fallback lane
+
+
+def key_fingerprint(key: str) -> int:
+    """63-bit nonzero fingerprint of a key (0 is the empty sentinel)."""
+    return (fnv1a_64_str(key) & ((1 << 63) - 1)) | 1
+
+
+def make_fingerprints(capacity: int) -> jax.Array:
+    return jnp.zeros((capacity,), I64)
+
+
+def probe_assign(
+    fps: jax.Array, hashes: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Resolve-or-claim a slot for every key hash, on device.
+
+    fps: i64[C] fingerprint column; hashes: i64[B] (0 for padding lanes).
+    Returns (new_fps, slot i32[B], fresh bool[B]); slot is -1 for padding
+    lanes and for probes that exhausted PROBE_DEPTH (host fallback).
+    """
+    C = fps.shape[0]
+    B = hashes.shape[0]
+    active = hashes != 0
+    base = jnp.abs(hashes) % C
+    # ONE [B, D] gather instead of D sequential probes
+    pos = (base[:, None] + jnp.arange(PROBE_DEPTH, dtype=I64)[None, :]) % C
+    cand = fps[pos]  # i64[B, D]
+
+    is_match = cand == hashes[:, None]
+    is_empty = cand == 0
+    big = jnp.asarray(PROBE_DEPTH + 1, I32)
+    d_idx = jnp.arange(PROBE_DEPTH, dtype=I32)[None, :]
+    first_match = jnp.min(jnp.where(is_match, d_idx, big), axis=1)
+    first_empty = jnp.min(jnp.where(is_empty, d_idx, big), axis=1)
+
+    matched = first_match <= PROBE_DEPTH
+    claimable = (~matched) & (first_empty <= PROBE_DEPTH)
+    depth = jnp.where(matched, first_match, first_empty)
+    slot64 = jnp.take_along_axis(
+        pos, jnp.minimum(depth, PROBE_DEPTH - 1)[:, None].astype(I64), axis=1
+    )[:, 0]
+    ok = active & (matched | claimable)
+    slot = jnp.where(ok, slot64, -1).astype(I32)
+    fresh = ok & claimable
+
+    # claim the fresh positions (duplicate hashes in one batch converge on
+    # the same position and write the same fingerprint — benign; DISTINCT
+    # colliding keys are the documented prototype limit)
+    claim_slot = pad_to_drop(jnp.where(fresh, slot, -1), C)
+    new_fps = fps.at[claim_slot].set(
+        jnp.where(fresh, hashes, 0), mode="drop")
+    return new_fps, slot, fresh
+
+
+def refresh_vacancies(fps: jax.Array, table: jax.Array,
+                      now_ms) -> jax.Array:
+    """Clear fingerprints whose bucket row is vacant or expired — the lazy
+    recycling pass (host directory handles this with its LRU; here one
+    full-column sweep, amortized across many windows)."""
+    from gubernator_tpu.ops.decide import ROW_EXPIRE
+
+    dead = (table[:, ROW_ALGO] < 0) | (
+        jnp.asarray(now_ms, I64) > table[:, ROW_EXPIRE])
+    return jnp.where(dead, jnp.zeros_like(fps), fps)
