@@ -214,8 +214,13 @@ def main(argv=None) -> int:
 
         channel = CollectiveGlobalChannel(conf.cross_host_capacity)
         collective = CollectiveGlobalSync(
-            instance, channel, interval_s=conf.cross_host_sync_s)
-        instance.attach_collective(collective)
+            instance, channel, interval_s=conf.cross_host_sync_s,
+            slot_candidates=conf.cross_host_candidates,
+            claim_secret=(conf.cross_host_secret or "").encode())
+        # GUBER_CROSS_HOST_GROUP lists the advertise addresses inside the
+        # process group; unset/empty = the whole fleet is in it (homogeneous)
+        instance.attach_collective(
+            collective, group_peers=conf.cross_host_group or None)
         collective.start()
         log.info(
             "cross-host GLOBAL collective: %d hosts, %d slots, tick %.0f ms",

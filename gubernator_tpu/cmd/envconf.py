@@ -149,6 +149,9 @@ class DaemonConfig:
     # every host in the process group must use the same value.
     cross_host_sync_s: float = 0.1
     cross_host_capacity: int = 1024
+    cross_host_candidates: int = 4
+    cross_host_secret: str = ""
+    cross_host_group: List[str] = dataclasses.field(default_factory=list)
     debug: bool = False
 
 
@@ -222,6 +225,9 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         host_id=_env_int("GUBER_HOST_ID", 0),
         cross_host_sync_s=_env_dur("GUBER_CROSS_HOST_SYNC", 0.1),
         cross_host_capacity=_env_int("GUBER_CROSS_HOST_CAPACITY", 1024),
+        cross_host_candidates=_env_int("GUBER_CROSS_HOST_CANDIDATES", 4),
+        cross_host_secret=_env_str("GUBER_CROSS_HOST_SECRET"),
+        cross_host_group=_env_slice("GUBER_CROSS_HOST_GROUP"),
         debug=opts.debug or bool(os.environ.get("GUBER_DEBUG")),
     )
     if conf.collectives not in ("psum", "ring"):

@@ -12,15 +12,33 @@ that rides ICI/DCN instead of the RPC stack.
 Slot identity without strings on the wire
 -----------------------------------------
 Collectives move numbers, not key strings, so every host must agree which
-vector slot a key occupies. Slots are assigned deterministically
-(fnv1a64(key) % G) and verified by a claims protocol: each host contributes
-a nonzero claim hash for every slot it uses; a slot is clean for me iff
-``claim_sum == claim_cnt * claim_max and claim_max == my_claim``. A new key
-spends its first tick in CLAIMING (claims contributed, no hits), so by the
-time any host contributes deltas on a slot, every host has had the chance
-to detect a collision. Conflicted keys demote permanently to the gRPC
-pipelines (GlobalManager) — correctness never depends on the collective
-tier, it is a transport upgrade.
+vector slot a key occupies. Each key derives R candidate slots (blake2b of
+the key, R independent 64-bit lanes mod G) and registers at its first
+locally-free candidate; the claims protocol verifies agreement: each host
+contributes a nonzero claim hash for every slot it uses; a slot is clean
+for me iff ``claim_sum == claim_cnt * claim_max and claim_max == my_claim``.
+A new key spends its first tick in CLAIMING (claims contributed, no hits),
+so by the time any host contributes deltas on a slot, every host has had
+the chance to detect a collision.
+
+The claim hash is INDEPENDENT of the slot hash (separate blake2b domains,
+optionally keyed with a shared deployment secret) so a chosen-key slot
+collision cannot also forge a claim match — two distinct keys on one slot
+are always detected. Hosts that disagree on a key's candidate (their local
+occupancy differs) stay safe via owner-seen gating: a non-owner contributes
+deltas only on a slot where the owner's state broadcast is visible, and
+HUNTS across its candidate cycle until it finds the owner's slot. A key
+that conflicts on every candidate demotes to the gRPC pipelines
+(GlobalManager) and is periodically re-promoted once the colliding key
+idles out — correctness never depends on the collective tier, it is a
+transport upgrade.
+
+Sizing: keep ``GUBER_CROSS_HOST_CAPACITY`` (G) at >=4x the expected number
+of concurrently-active GLOBAL keys. With R=4 candidates and load factor
+L = active/G, the probability a new key finds all candidates taken is
+~L^R (~0.4% at L=0.25, ~6% at L=0.5); the demoted fraction stays small
+and bounded until G itself is the bottleneck, and each tick moves O(G)
+i64 lanes regardless of traffic.
 
 Lockstep + stall behavior
 -------------------------
@@ -35,10 +53,11 @@ to the gRPC pipelines — queued hits are re-routed, none are lost.
 from __future__ import annotations
 
 import dataclasses
+import hashlib
 import logging
 import threading
 import time
-from typing import Callable, Dict, Optional
+from typing import Callable, Dict, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -48,7 +67,6 @@ from gubernator_tpu.types import (
     RateLimitReq,
     without_behavior,
 )
-from gubernator_tpu.utils.fnv import fnv1a_64_str
 
 log = logging.getLogger("gubernator_tpu.collective")
 
@@ -57,13 +75,17 @@ CLAIMING = 0  # claim contributed; deltas/state held back one tick
 ESTABLISHED = 1  # slot verified clean: collective transport active
 FALLBACK = 2  # collision or capacity: gRPC pipelines own this key
 
+_CLAIM_MASK = (1 << 55) - 1  # 55-bit claims: psum exact in int64 to 256 hosts
+
 
 class _CKey:
     __slots__ = ("slot", "claim", "req", "phase", "is_owner", "pending",
-                 "last_state", "last_touch_s", "owner_seen", "pending_age")
+                 "last_state", "last_touch_s", "owner_seen", "pending_age",
+                 "cands", "cand_i", "hunt_age", "conflict_n", "demoted_tick")
 
     def __init__(self, slot: int, claim: int, req: RateLimitReq,
-                 is_owner: bool, now_s: float):
+                 is_owner: bool, now_s: float,
+                 cands: Tuple[int, ...] = (), cand_i: int = 0):
         self.slot = slot
         self.claim = claim
         self.req = req
@@ -77,6 +99,11 @@ class _CKey:
         # then pending hits wait, and age out to the gRPC pipeline
         self.owner_seen = is_owner
         self.pending_age = 0  # ticks spent waiting for owner_seen
+        self.cands = cands or (slot,)  # candidate slots, deterministic order
+        self.cand_i = cand_i  # index of the candidate currently occupied
+        self.hunt_age = 0  # established-but-ownerless ticks (hunt trigger)
+        self.conflict_n = 0  # cross-host conflicts since (re)registration
+        self.demoted_tick = 0  # tick count when demoted (re-promote pacing)
 
 
 class CollectiveGlobalSync:
@@ -90,7 +117,10 @@ class CollectiveGlobalSync:
         stall_timeout_s: float = 10.0,
         idle_s: float = 300.0,
         owner_wait_ticks: int = 50,
-        slot_fn: Optional[Callable[[str], int]] = None,
+        slot_fn: Optional[Callable[[str], Union[int, Sequence[int]]]] = None,
+        slot_candidates: int = 4,
+        claim_secret: bytes = b"",
+        repromote_ticks: int = 100,
     ):
         self.instance = instance
         self.channel = channel
@@ -99,7 +129,18 @@ class CollectiveGlobalSync:
         self.stall_timeout_s = stall_timeout_s
         self.idle_s = idle_s
         self.owner_wait_ticks = owner_wait_ticks
-        self._slot_fn = slot_fn or (lambda key: fnv1a_64_str(key) % self.G)
+        # slot_fn (tests / custom policies) may return one slot or a
+        # candidate sequence; the default derives `slot_candidates`
+        # independent blake2b lanes
+        self._slot_fn = slot_fn
+        self.R = max(1, min(8, slot_candidates))
+        self.repromote_ticks = repromote_ticks
+        # the claim hash must agree across hosts, so a keyed claim needs a
+        # DEPLOYMENT-shared secret (GUBER_CROSS_HOST_SECRET); blake2b keys
+        # cap at 64 bytes, longer secrets are folded down first
+        if len(claim_secret) > 64:
+            claim_secret = hashlib.blake2b(claim_secret).digest()
+        self._claim_secret = claim_secret
         self._keys: Dict[str, _CKey] = {}
         self._by_slot: Dict[int, str] = {}
         self._lock = threading.Lock()
@@ -115,7 +156,20 @@ class CollectiveGlobalSync:
             "claims_established": 0,
             "conflicts": 0,
             "fallbacks": 0,
+            "hunt_moves": 0,
+            "repromotions": 0,
         }
+
+    def fallback_fraction(self) -> float:
+        """Registered GLOBAL keys currently demoted to the gRPC pipelines /
+        total registered — the 'how much of my traffic rides the upgrade'
+        health signal exported at /metrics."""
+        with self._lock:
+            n = len(self._keys)
+            if not n:
+                return 0.0
+            return sum(1 for e in self._keys.values()
+                       if e.phase == FALLBACK) / n
 
     # ------------------------------------------------------------ public API
 
@@ -146,12 +200,15 @@ class CollectiveGlobalSync:
             e = self._keys.get(key)
             if e is None:
                 e = self._register(key, req, is_owner=False)
-            if e is None or e.phase == FALLBACK:
+            if e is None:
                 return False
             e.req = req
+            # FALLBACK entries stay touch-fresh too: an actively-used
+            # demoted key must remain registered so re-promotion can retry
+            # it once its collider idles out
             e.last_touch_s = time.monotonic()
             if e.phase != ESTABLISHED:
-                return False  # still claiming: one window via gRPC
+                return False  # claiming/fallback: this window via gRPC
             e.pending += req.hits
         return True
 
@@ -166,12 +223,14 @@ class CollectiveGlobalSync:
             e = self._keys.get(key)
             if e is None:
                 e = self._register(key, req, is_owner=True)
-            if e is None or e.phase == FALLBACK:
+            if e is None:
                 return False
             e.req = req
             e.is_owner = True
-            e.owner_seen = True  # we ARE the owner
             e.last_touch_s = time.monotonic()
+            if e.phase == FALLBACK:
+                return False  # stays registered for re-promotion
+            e.owner_seen = True  # we ARE the owner
             return e.phase == ESTABLISHED
 
     def register_remote(self, req: RateLimitReq) -> None:
@@ -196,22 +255,74 @@ class CollectiveGlobalSync:
 
     # ------------------------------------------------------------- internals
 
+    def _candidates(self, key: str) -> Tuple[int, ...]:
+        """Deterministic candidate slots, identical on every host. The
+        default derives R independent 64-bit lanes from one blake2b call;
+        a custom slot_fn may return a single slot or its own sequence."""
+        if self._slot_fn is not None:
+            s = self._slot_fn(key)
+            return (s,) if isinstance(s, int) else tuple(s)
+        d = hashlib.blake2b(key.encode("utf-8"), digest_size=8 * self.R,
+                            person=b"guber-slot").digest()
+        cands, seen = [], set()
+        for i in range(self.R):
+            c = int.from_bytes(d[8 * i:8 * i + 8], "little") % self.G
+            if c not in seen:
+                seen.add(c)
+                cands.append(c)
+        return tuple(cands)
+
+    def _claim_for(self, key: str) -> int:
+        """Nonzero 55-bit claim, from a hash domain INDEPENDENT of the slot
+        hash (and keyed when a deployment secret is set): a chosen-key slot
+        collision cannot also forge a claim match (ADVICE r2 #2)."""
+        d = hashlib.blake2b(key.encode("utf-8"), digest_size=8,
+                            key=self._claim_secret,
+                            person=b"guber-claim").digest()
+        return (int.from_bytes(d, "little") & _CLAIM_MASK) + 1
+
     def _register(self, key: str, req: RateLimitReq,
                   is_owner: bool) -> Optional[_CKey]:
-        slot = self._slot_fn(key)
-        if self._by_slot.get(slot, key) != key:
-            # host-local collision: this key can never use the slot
-            self.stats["fallbacks"] += 1
-            e = _CKey(slot, 0, req, is_owner, time.monotonic())
-            e.phase = FALLBACK
-            self._keys[key] = e
-            return e
-        # 55-bit claims keep the psum exact in int64 up to 256 hosts
-        claim = (fnv1a_64_str(key) & ((1 << 55) - 1)) + 1  # nonzero
-        e = _CKey(slot, claim, req, is_owner, time.monotonic())
+        cands = self._candidates(key)
+        now = time.monotonic()
+        for i, slot in enumerate(cands):
+            if self._by_slot.get(slot, key) == key:
+                e = _CKey(slot, self._claim_for(key), req, is_owner, now,
+                          cands=cands, cand_i=i)
+                self._keys[key] = e
+                self._by_slot[slot] = key
+                return e
+        # every candidate is taken by another key on THIS host: demote (the
+        # periodic re-promotion pass retries once a collider idles out)
+        self.stats["fallbacks"] += 1
+        e = _CKey(cands[0], 0, req, is_owner, now, cands=cands)
+        e.phase = FALLBACK
+        e.demoted_tick = self.stats["ticks"]
         self._keys[key] = e
-        self._by_slot[slot] = key
         return e
+
+    def _move_to(self, key: str, e: _CKey, cand_i: int) -> None:
+        """Re-seat an entry at candidate `cand_i`: back to CLAIMING (the
+        new slot must be verified clean before any delta/state rides it)."""
+        if self._by_slot.get(e.slot) == key:
+            del self._by_slot[e.slot]
+        e.cand_i = cand_i
+        e.slot = e.cands[cand_i]
+        e.phase = CLAIMING
+        e.claim = self._claim_for(key)
+        e.owner_seen = e.is_owner
+        e.hunt_age = 0
+        self._by_slot[e.slot] = key
+
+    def _next_free_candidate(self, key: str, e: _CKey) -> Optional[int]:
+        """Next locally-free candidate index after the current one,
+        wrapping; None when every other candidate is taken."""
+        n = len(e.cands)
+        for step in range(1, n):
+            i = (e.cand_i + step) % n
+            if self._by_slot.get(e.cands[i], key) == key:
+                return i
+        return None
 
     def _refresh_ownership(self, key: str, e: _CKey) -> None:
         """Track membership changes: ownership is re-read from the picker
@@ -327,6 +438,8 @@ class CollectiveGlobalSync:
                     continue
                 if e.phase == CLAIMING:
                     e.phase = ESTABLISHED
+                    e.conflict_n = 0  # the slot proved clean: a later
+                    # transient conflict starts a fresh candidate budget
                     self.stats["claims_established"] += 1
                     # NO `continue`: establishment can straddle one tick
                     # across hosts (registration races the drains), so an
@@ -361,10 +474,23 @@ class CollectiveGlobalSync:
                     if int(st[0, s]) == 1:
                         e.owner_seen = True
                         e.pending_age = 0
+                        e.hunt_age = 0
                         apply_cache.append(
                             (key, e,
                              (int(st[1, s]), int(st[2, s]),
                               int(st[3, s]), int(st[4, s]))))
+                    elif not e.owner_seen and len(e.cands) > 1:
+                        # clean slot but no owner broadcasting on it: the
+                        # owner may sit at a different candidate (its local
+                        # occupancy differs) — hunt the candidate cycle
+                        e.hunt_age += 1
+                        if e.hunt_age > self.owner_wait_ticks:
+                            nxt = self._next_free_candidate(key, e)
+                            if nxt is not None:
+                                self._move_to(key, e, nxt)
+                                self.stats["hunt_moves"] += 1
+                            else:
+                                e.hunt_age = 0
             self._sweep_idle()
 
         # backend + cache work outside the registry lock
@@ -384,24 +510,35 @@ class CollectiveGlobalSync:
         self.stats["ticks"] += 1
 
     def _demote(self, key: str, e: _CKey, in_flight: Dict[str, int]) -> None:
-        """Cross-host claim conflict: this key permanently leaves the
-        collective tier. Hits contributed this tick were NOT applied by any
-        owner (the owner sees the same conflict), so they re-route through
-        the gRPC pipeline along with anything still pending."""
-        e.phase = FALLBACK
+        """Cross-host claim conflict: another host put a DIFFERENT key on
+        this slot. Hits contributed this tick were NOT applied by any owner
+        (the owner sees the same conflict), so they re-route through the
+        gRPC pipeline along with anything still pending; the key then tries
+        its next candidate slot, and only after conflicting on every
+        candidate leaves the collective tier (until re-promotion)."""
         self.stats["conflicts"] += 1
-        self.stats["fallbacks"] += 1
-        if self._by_slot.get(e.slot) == key:
-            del self._by_slot[e.slot]
         lost = in_flight.pop(key, 0) + e.pending
         e.pending = 0
         if lost:
             self.instance.global_manager.queue_hit(
                 dataclasses.replace(e.req, hits=lost))
+        e.conflict_n += 1
+        nxt = (self._next_free_candidate(key, e)
+               if e.conflict_n < len(e.cands) else None)
+        if nxt is None:
+            e.phase = FALLBACK
+            e.demoted_tick = self.stats["ticks"]
+            self.stats["fallbacks"] += 1
+            if self._by_slot.get(e.slot) == key:
+                del self._by_slot[e.slot]
+        else:
+            self._move_to(key, e, nxt)
 
     def _sweep_idle(self) -> None:
         """Idle keys release their slots (same role as the sharded backend's
-        registry sweep): eviction is safe once nothing is pending."""
+        registry sweep): eviction is safe once nothing is pending. The same
+        pass periodically re-promotes still-active FALLBACK keys — the
+        collider that forced them out may have idled away by now."""
         now = time.monotonic()
         for key in [
             k for k, e in self._keys.items()
@@ -410,6 +547,20 @@ class CollectiveGlobalSync:
             e = self._keys.pop(key)
             if self._by_slot.get(e.slot) == key:
                 del self._by_slot[e.slot]
+        if self.repromote_ticks:
+            tick = self.stats["ticks"]
+            for key, e in self._keys.items():
+                if e.phase != FALLBACK or \
+                        tick - e.demoted_tick < self.repromote_ticks:
+                    continue
+                for i, slot in enumerate(e.cands):
+                    if self._by_slot.get(slot, key) == key:
+                        e.conflict_n = 0
+                        self._move_to(key, e, i)
+                        self.stats["repromotions"] += 1
+                        break
+                else:
+                    e.demoted_tick = tick  # all taken: retry a period later
 
     def _requeue_all_pending(self) -> None:
         with self._lock:

@@ -110,11 +110,39 @@ class Instance:
         # it absorbs queue_hit/queue_update and the gRPC pipelines remain the
         # fallback (service/collective_global.py)
         self.collective_global = None
+        self._collective_group = None  # None = every peer is in the group
+        self._collective_covers = True
         self._closed = False
 
-    def attach_collective(self, sync) -> None:
-        """Wire a CollectiveGlobalSync (multi-host daemons only)."""
+    def attach_collective(self, sync, group_peers=None) -> None:
+        """Wire a CollectiveGlobalSync (multi-host daemons only).
+
+        `group_peers` lists the advertise addresses of the daemons in the
+        jax.distributed process group. The collective only reaches THOSE
+        hosts — in a mixed fleet (peers outside the group: reference nodes,
+        staged rollouts) the gRPC broadcast must keep running for the
+        others or their GLOBAL caches stay empty (ADVICE r2 #3). None means
+        the whole fleet is in the group (the homogeneous default)."""
         self.collective_global = sync
+        self._collective_group = (
+            None if group_peers is None else frozenset(group_peers))
+        self._recompute_collective_coverage()
+
+    def _in_collective_group(self, address: str) -> bool:
+        g = self._collective_group
+        return g is None or address in g or address == self.advertise_address
+
+    def _recompute_collective_coverage(self) -> None:
+        """Cache 'does the process group cover every local picker peer'
+        (refreshed on membership change): only then may the collective
+        replace the gRPC GLOBAL broadcast entirely."""
+        if self._collective_group is None:
+            self._collective_covers = True
+            return
+        with self._peer_lock:
+            self._collective_covers = all(
+                self._in_collective_group(p.info.address)
+                for p in self.local_picker.peers())
 
     # ----------------------------------------------------------- public API
 
@@ -192,7 +220,7 @@ class Instance:
                 f"'PeerRequest.rate_limits' list too large; max size is "
                 f"'{MAX_BATCH_SIZE}'",
             )
-        return self.apply_owner_batch(list(requests))
+        return self.apply_owner_batch(list(requests), from_peer_rpc=True)
 
     def update_peer_globals(self, updates) -> None:
         """Receive an owner's GLOBAL broadcast (reference: gubernator.go:251-264).
@@ -273,6 +301,7 @@ class Instance:
                 "peers updated: %d local, %d region, self=%s",
                 new_local.size(), new_region.size(),
                 self.advertise_address or "?")
+        self._recompute_collective_coverage()
 
         shutdown = [
             p for p in old_local.peers()
@@ -322,32 +351,46 @@ class Instance:
             return dict(self.region_picker.pickers())
 
     def apply_owner_batch(
-        self, requests: List[RateLimitReq], now_ms: Optional[int] = None
+        self, requests: List[RateLimitReq], now_ms: Optional[int] = None,
+        from_peer_rpc: bool = False,
     ) -> List[RateLimitResp]:
         """Apply requests we own to the TPU backend in one batched call,
         queueing GLOBAL broadcasts / multi-region replication first
         (reference: gubernator.go:327-347)."""
         return self.combiner.submit(
-            self._strip_owner_batch(requests), now_ms=now_ms)
+            self._strip_owner_batch(requests, from_peer_rpc), now_ms=now_ms)
 
     def apply_owner_batch_direct(
-        self, requests: List[RateLimitReq], now_ms: Optional[int] = None
+        self, requests: List[RateLimitReq], now_ms: Optional[int] = None,
+        from_peer_rpc: bool = False,
     ) -> List[RateLimitResp]:
         """apply_owner_batch minus the combiner hop, for callers that
         already aggregated a batch (the peerlink workers): the engine's own
         lock serializes concurrent windows, and skipping the combiner saves
         two thread handoffs on the lone-request latency path."""
         return self.backend.get_rate_limits(
-            self._strip_owner_batch(requests), now_ms=now_ms)
+            self._strip_owner_batch(requests, from_peer_rpc), now_ms=now_ms)
 
     def _strip_owner_batch(
-        self, requests: List[RateLimitReq]
+        self, requests: List[RateLimitReq], from_peer_rpc: bool = False
     ) -> List[RateLimitReq]:
         stripped = []
         for req in requests:
             if has_behavior(req.behavior, Behavior.GLOBAL):
                 cg = self.collective_global
-                if cg is None or not cg.queue_update(req):
+                covered = cg is not None and cg.queue_update(req)
+                # The collective may skip the gRPC broadcast only for
+                # owner-LOCAL traffic with the whole fleet in the process
+                # group. A GLOBAL request arriving over peer RPC is itself
+                # proof that some peer is NOT riding the collective for
+                # this key (key-level FALLBACK on its side, first touch,
+                # out-of-group node) — that peer's cache is fed by gRPC
+                # broadcasts alone, so keep them flowing. Collective-tier
+                # owner applies never re-enter here (the tick strips
+                # GLOBAL first), and in-group hosts installing the same
+                # authoritative state twice is harmless.
+                if from_peer_rpc or not (covered and
+                                         self._collective_covers):
                     self.global_manager.queue_update(req)
             if has_behavior(req.behavior, Behavior.MULTI_REGION):
                 self.multiregion_manager.queue_hits(req)
@@ -451,7 +494,13 @@ class Instance:
                         st.remaining -= req.hits
                         status = st.status
                 cg = self.collective_global
-                if cg is None or not cg.queue_hit(req):
+                # hits ride the collective only when the OWNER host is in
+                # the process group — otherwise nobody would apply the slot
+                # (the psum'd deltas would just age out back to gRPC)
+                if cg is None or \
+                        not self._in_collective_group(
+                            owner_peer.info.address) or \
+                        not cg.queue_hit(req):
                     self.global_manager.queue_hit(req)
                 return RateLimitResp(
                     status=status,
@@ -465,10 +514,12 @@ class Instance:
         try:
             resp = owner_peer.get_peer_rate_limit(req)
             resp.metadata["owner"] = owner_peer.info.address
-            if self.collective_global is not None:
+            if self.collective_global is not None and \
+                    self._in_collective_group(owner_peer.info.address):
                 # start claiming the key's slot so the owner's collective
                 # broadcasts can reach this host's cache (no strings ride
-                # the collective — registration is how key<->slot binds)
+                # the collective — registration is how key<->slot binds);
+                # pointless when the owner is outside the process group
                 self.collective_global.register_remote(req)
             return resp
         except Exception:  # noqa: BLE001

@@ -124,6 +124,31 @@ class Metrics:
                  "Authoritative GLOBAL states installed from the collective."),
                 ("conflicts", "Slot claim conflicts (keys demoted to gRPC)."),
                 ("fallbacks", "GLOBAL keys using the gRPC pipelines."),
+                ("hunt_moves",
+                 "Non-owner candidate moves hunting the owner's slot."),
+                ("repromotions",
+                 "Demoted keys re-promoted to the collective tier."),
+            )
+        }
+        self.cross_host_fallback_fraction = Gauge(
+            "cross_host_fallback_fraction",
+            "Fraction of registered GLOBAL keys currently demoted to the "
+            "gRPC pipelines (0 = every key rides the collective).",
+            registry=self.registry,
+        )
+        # multi-region replication loss accounting (multiregion.py)
+        self.multiregion = {
+            name: Counter(
+                f"multiregion_{name}_total", help_, registry=self.registry)
+            for name, help_ in (
+                ("replicated", "Aggregates replicated to foreign regions."),
+                ("errors", "Failed region replication sends."),
+                ("refunded_hits",
+                 "Hits deferred into the region's next window after a "
+                 "PRE-send failure (may still drop if the retry fails)."),
+                ("dropped_hits",
+                 "Hits lost to a region: delivery-uncertain send failure, "
+                 "failed retry of a deferred window, or unroutable."),
             )
         }
 
@@ -164,6 +189,12 @@ class Metrics:
         if collective is not None:
             for name, counter in self.cross_host.items():
                 self._set_counter(counter, collective.stats.get(name, 0))
+            self.cross_host_fallback_fraction.set(
+                collective.fallback_fraction())
+        mr = getattr(instance, "multiregion_manager", None)
+        if mr is not None:
+            for name, counter in self.multiregion.items():
+                self._set_counter(counter, mr.stats.get(name, 0))
         cache = getattr(instance, "_global_cache", None)
         if cache is not None:
             self.cache_size.set(len(cache))

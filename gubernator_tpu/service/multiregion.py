@@ -13,11 +13,14 @@ Within one host's mesh the same tier exists as the "region" mesh axis
 
 from __future__ import annotations
 
+import dataclasses
 import logging
+import threading
 from typing import Dict
 
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.global_manager import _Pipeline
+from gubernator_tpu.service.peer_client import PeerNotReadyError
 from gubernator_tpu.types import RateLimitReq
 
 log = logging.getLogger("gubernator_tpu.multiregion")
@@ -36,7 +39,15 @@ class MultiRegionManager:
             behaviors.multi_region_batch_limit,
             self._send_hits,
         )
-        self.stats = {"replicated": 0, "errors": 0}
+        # per-region aggregates whose send failed BEFORE anything hit the
+        # wire: folded into that region's next window. Keyed per dc — a
+        # window fans the same aggregate to every foreign region, so a
+        # refund into the shared pipeline would re-send to regions that
+        # already received it (cross-region double count).
+        self._deferred: Dict[str, Dict[str, RateLimitReq]] = {}
+        self._deferred_lock = threading.Lock()
+        self.stats = {"replicated": 0, "errors": 0,
+                      "refunded_hits": 0, "dropped_hits": 0}
 
     def queue_hits(self, req: RateLimitReq) -> None:
         """(reference: multiregion.go:27-29)"""
@@ -47,36 +58,108 @@ class MultiRegionManager:
 
     def close(self) -> None:
         self._pipeline.close()
+        with self._deferred_lock:
+            for bucket in self._deferred.values():
+                self.stats["dropped_hits"] += sum(
+                    r.hits for r in bucket.values())
+            self._deferred.clear()
 
     # ------------------------------------------------------------ internals
 
+    def _defer(self, dc: str, reqs) -> None:
+        with self._deferred_lock:
+            bucket = self._deferred.setdefault(dc, {})
+            for req in reqs:
+                self.stats["refunded_hits"] += req.hits
+                prev = bucket.get(req.hash_key())
+                if prev is not None:
+                    req = dataclasses.replace(
+                        req, hits=req.hits + prev.hits)
+                bucket[req.hash_key()] = req
+
     def _send_hits(self, batch: Dict[str, RateLimitReq]) -> None:
         """One batch per owning peer per foreign region — the transport the
-        reference stubbed out (multiregion.go:78-82)."""
-        by_peer: Dict[int, tuple] = {}
-        for key, req in batch.items():
-            for dc, picker in self.instance.region_pickers().items():
-                if dc == self.instance.data_center:
-                    continue
+        reference stubbed out (multiregion.go:78-82).
+
+        Failure accounting: a PRE-SEND failure (PeerNotReadyError — the
+        request never reached the wire) safely folds that region's
+        aggregates into its next window; anything after the send is
+        delivery-UNCERTAIN (timeout, link death, RPC error) and the
+        aggregates drop — re-sending could double-apply in that region.
+        The carry is ONE window deep: deferred hits that fail a second
+        time drop (counted), so a long-dead region neither accumulates an
+        unbounded backlog nor bursts stale hits on recovery. Accounting:
+        every hit ends up delivered or counted in `dropped_hits`;
+        `refunded_hits` counts deferral EVENTS (a deferred hit that later
+        drops appears in both — it was refunded, then lost on retry)."""
+        regions = {
+            dc: picker
+            for dc, picker in self.instance.region_pickers().items()
+            if dc != self.instance.data_center
+        }
+        with self._deferred_lock:
+            deferred, self._deferred = self._deferred, {}
+        for dc in list(deferred):
+            if dc not in regions:  # region left the fleet: nothing to owe
+                dropped = deferred.pop(dc)
+                self.stats["dropped_hits"] += sum(
+                    r.hits for r in dropped.values())
+        for dc, picker in regions.items():
+            carried = {k: r.hits for k, r in deferred.get(dc, {}).items()}
+            per_key = dict(batch)
+            for key, req in deferred.get(dc, {}).items():
+                prev = per_key.get(key)
+                if prev is not None:
+                    req = dataclasses.replace(
+                        req, hits=req.hits + prev.hits)
+                per_key[key] = req
+            by_peer: Dict[int, tuple] = {}
+            for key, req in per_key.items():
                 try:
                     peer = picker.get(key)
-                except Exception:  # noqa: BLE001 — empty foreign region
+                except Exception:  # noqa: BLE001 — region has no peers:
+                    # these hits go nowhere; keep the accounting complete
+                    self.stats["dropped_hits"] += req.hits
                     continue
                 by_peer.setdefault(id(peer), (peer, []))[1].append(req)
-        for peer, reqs in by_peer.values():
-            try:
-                peer.get_peer_rate_limits(reqs)
-                self.stats["replicated"] += len(reqs)
-            except Exception as e:  # noqa: BLE001
-                self.stats["errors"] += 1
-                # one line, no traceback: an unreachable region peer is a
-                # normal runtime condition (peer down, cluster draining);
-                # this window's hits to that region are dropped, the next
-                # window carries fresh aggregates. RpcError's str() is
-                # multi-line, so log its status code instead.
-                code = getattr(e, "code", None)
-                log.warning(
-                    "error replicating hits to region peer '%s': %s",
-                    peer.info.address,
-                    code().name if callable(code) else e,
-                )
+            for peer, reqs in by_peer.values():
+                try:
+                    peer.get_peer_rate_limits(reqs)
+                    self.stats["replicated"] += len(reqs)
+                except PeerNotReadyError as e:
+                    # channel was closed/draining before the send: safe to
+                    # carry the FRESH aggregates into this region's next
+                    # window; hits already carried once drop instead
+                    # (bounded carry — no backlog, no recovery burst)
+                    self.stats["errors"] += 1
+                    fresh = []
+                    for req in reqs:
+                        stale = min(carried.get(req.hash_key(), 0),
+                                    req.hits)
+                        if stale:
+                            self.stats["dropped_hits"] += stale
+                        if req.hits > stale:
+                            fresh.append(dataclasses.replace(
+                                req, hits=req.hits - stale))
+                    if fresh:
+                        self._defer(dc, fresh)
+                    log.warning(
+                        "region peer '%s' not ready; %d aggregates "
+                        "deferred to the next window: %s",
+                        peer.info.address, len(fresh), e)
+                except Exception as e:  # noqa: BLE001
+                    self.stats["errors"] += 1
+                    self.stats["dropped_hits"] += sum(
+                        r.hits for r in reqs)
+                    # one line, no traceback: an unreachable region peer is
+                    # a normal runtime condition (peer down, cluster
+                    # draining); delivery is uncertain, so this window's
+                    # hits to that region are dropped — the next window
+                    # carries fresh aggregates. RpcError's str() is
+                    # multi-line, so log its status code instead.
+                    code = getattr(e, "code", None)
+                    log.warning(
+                        "error replicating hits to region peer '%s': %s",
+                        peer.info.address,
+                        code().name if callable(code) else e,
+                    )

@@ -370,11 +370,31 @@ class PeerLinkService:
                 continue
             try:
                 err_buf = self._handle_batch(got, b)
-                self._lib.pls_send_responses(
-                    self._handle, got, *resp_ptrs, err_buf)
             except Exception:  # noqa: BLE001 — a worker must never die
                 log.exception("peerlink batch failed")
                 self.stats["errors"] += 1
+                # Respond ANYWAY: an unanswered pull strands every
+                # co-batched frame (other connections included) in
+                # PeerLinkTimeout and leaks the C++ Conn::pending entries.
+                err_buf = self._fail_batch(got, b)
+            try:
+                self._lib.pls_send_responses(
+                    self._handle, got, *resp_ptrs, err_buf)
+            except Exception:  # noqa: BLE001
+                log.exception("peerlink send_responses failed")
+                self.stats["errors"] += 1
+
+    @staticmethod
+    def _fail_batch(got: int, b: dict) -> bytes:
+        """Last-resort response fill: every item in the pull gets an error
+        reply so no client (or C++ pending entry) is left hanging."""
+        msg = b"peerlink: internal batch failure"
+        b["status"][:got] = 0
+        b["r_limit"][:got] = 0
+        b["r_remaining"][:got] = 0
+        b["r_reset"][:got] = 0
+        b["err_off"][:got + 1] = np.arange(got + 1, dtype=np.int32) * len(msg)
+        return msg * got
 
     def _handle_batch(self, got: int, b: dict) -> bytes:
         """Decode -> handler calls -> fill the reusable response buffers.
@@ -391,15 +411,21 @@ class PeerLinkService:
         behavior = b["behavior"][:got].tolist()
         method = b["method"]
         raw_keys = b["keys"]
-        reqs: List[RateLimitReq] = []
+        # None marks an item whose wire bytes are invalid (the link port is
+        # unauthenticated: one crafted non-UTF-8 key must produce a per-item
+        # error reply, never kill the whole aggregated pull)
+        reqs: List[RateLimitReq | None] = []
         for j in range(got):
             lo, hi = koff[j], koff[j + 1]
             split = lo + nlen[j]
-            reqs.append(RateLimitReq(
-                name=raw_keys[lo:split].decode(),
-                unique_key=raw_keys[split:hi].decode(), hits=hits[j],
-                limit=limit[j], duration=duration[j],
-                algorithm=algorithm[j], behavior=behavior[j]))
+            try:
+                reqs.append(RateLimitReq(
+                    name=raw_keys[lo:split].decode(),
+                    unique_key=raw_keys[split:hi].decode(), hits=hits[j],
+                    limit=limit[j], duration=duration[j],
+                    algorithm=algorithm[j], behavior=behavior[j]))
+            except UnicodeDecodeError:
+                reqs.append(None)
 
         status, r_limit = b["status"], b["r_limit"]
         r_remaining, r_reset, err_off = b["r_remaining"], b["r_reset"], b["err_off"]
@@ -415,16 +441,26 @@ class PeerLinkService:
             while k < got and int(method[k]) == m and k - j < MAX_BATCH_SIZE:
                 k += 1
             chunk = reqs[j:k]
+            good = [r for r in chunk if r is not None]
             try:
-                if m == METHOD_GET_PEER_RATE_LIMITS:
+                if not good:
+                    handled = []
+                elif m == METHOD_GET_PEER_RATE_LIMITS:
                     # this worker's pull IS the batch window: go straight to
                     # the backend (owner semantics preserved; combiner hop
                     # saved — see Instance.apply_owner_batch_direct)
-                    resps = self.instance.apply_owner_batch_direct(chunk)
+                    handled = self.instance.apply_owner_batch_direct(
+                        good, from_peer_rpc=True)
                 else:
-                    resps = self.instance.get_rate_limits(chunk)
+                    handled = self.instance.get_rate_limits(good)
             except Exception as e:  # noqa: BLE001 — per-item error replies
-                resps = [RateLimitResp(error=str(e)) for _ in chunk]
+                handled = [RateLimitResp(error=str(e)) for _ in good]
+            if len(good) == len(chunk):
+                resps = handled
+            else:  # scatter handler results back around the bad items
+                it = iter(handled)
+                resps = [RateLimitResp(error="invalid utf-8 in key")
+                         if r is None else next(it) for r in chunk]
             for o, resp in enumerate(resps):
                 i = j + o
                 status[i] = int(resp.status)

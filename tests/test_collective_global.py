@@ -468,6 +468,210 @@ class TestOwnershipTransitions:
         assert inst.applied  # owner branch peeks/applies now
 
 
+class TestMixedFleetCoverage:
+    """ADVICE r2 #3: the collective reaches only the jax.distributed
+    process group; with picker peers OUTSIDE it, the gRPC broadcast keeps
+    running (else those peers' GLOBAL caches stay empty forever)."""
+
+    def test_broadcast_still_queued_when_group_partial(self, duo):
+        cluster, syncs = duo
+        owner, non, key = _owner_nonowner(cluster)
+        a = syncs[cluster.instances.index(owner)]
+        # establish the key while the fleet is homogeneous
+        owner.instance.get_rate_limits([_greq(key, 1)])
+        lockstep(syncs)
+        lockstep(syncs)
+        assert a._keys[f"col_{key}"].phase == ESTABLISHED
+        gm = owner.instance.global_manager
+        gm._broadcasts._pending.clear()
+
+        # now declare the process group as ONLY the owner host: the other
+        # peer is outside (reference node / staged rollout)
+        owner.instance.attach_collective(a, group_peers=[owner.address])
+        assert not owner.instance._collective_covers
+        owner.instance.get_rate_limits([_greq(key, 2)])
+        # queue_update returned True (collective still covers in-group
+        # hosts) but the gRPC broadcast ALSO queued for the outsider
+        assert f"col_{key}" in gm._broadcasts._pending
+
+        # homogeneous declaration restores the skip
+        owner.instance.attach_collective(
+            a, group_peers=[ci.address for ci in cluster.instances])
+        assert owner.instance._collective_covers
+        gm._broadcasts._pending.clear()
+        owner.instance.get_rate_limits([_greq(key, 2)])
+        assert f"col_{key}" not in gm._broadcasts._pending
+
+    def test_peer_rpc_arrival_keeps_grpc_broadcast(self, duo):
+        """A GLOBAL request reaching the owner over peer RPC proves some
+        peer is not riding the collective for that key (key-level FALLBACK,
+        first touch) — the gRPC broadcast must keep flowing to feed that
+        peer's cache, even with full group coverage."""
+        cluster, syncs = duo
+        owner, non, key = _owner_nonowner(cluster)
+        a = syncs[cluster.instances.index(owner)]
+        owner.instance.get_rate_limits([_greq(key, 1)])
+        lockstep(syncs)
+        lockstep(syncs)
+        assert a._keys[f"col_{key}"].phase == ESTABLISHED
+        gm = owner.instance.global_manager
+        gm._broadcasts._pending.clear()
+        # owner-local traffic on a covered key: broadcast suppressed
+        owner.instance.get_rate_limits([_greq(key, 1)])
+        assert f"col_{key}" not in gm._broadcasts._pending
+        # the same request arriving over the peer-RPC surface: queued
+        owner.instance.get_peer_rate_limits([_greq(key, 1)])
+        assert f"col_{key}" in gm._broadcasts._pending
+
+    def test_hits_skip_collective_when_owner_outside_group(self, duo):
+        cluster, syncs = duo
+        owner, non, key = _owner_nonowner(cluster)
+        b = syncs[cluster.instances.index(non)]
+        # populate the non-owner's GLOBAL cache the normal way first
+        non.instance.get_rate_limits([_greq(key, 1)])
+        for _ in range(3):
+            lockstep(syncs)
+        assert len(non.instance._global_cache) == 1
+
+        # owner leaves the process group (from the non-owner's view)
+        non.instance.attach_collective(b, group_peers=[non.address])
+        r = non.instance.get_rate_limits([_greq(key, 4)])[0]
+        assert r.error == ""
+        # the hit went to the gRPC pipeline, not the collective
+        assert non.instance.global_manager._hits._pending[
+            f"col_{key}"].hits == 4
+        assert b._keys[f"col_{key}"].pending == 0
+
+
+class TestCandidateSlots:
+    """Round-3 additions: multi-candidate slot assignment, claim-hash
+    independence, owner hunting, and re-promotion of demoted keys."""
+
+    def test_claim_hash_independent_of_slot_hash(self):
+        """ADVICE r2 #2: slot and claim must come from independent hash
+        domains, so a chosen-key slot collision cannot forge a claim
+        match. With G=1 every key shares THE slot; their claims must still
+        differ (the old design derived both from one fnv1a64)."""
+        inst = _StubInstance()
+        s = CollectiveGlobalSync(inst, FakeFabric(1, 1).endpoints[0])
+        claims = {s._claim_for(f"k{i}") for i in range(200)}
+        assert len(claims) == 200  # no accidental collisions in a tiny set
+        cands = {s._candidates(f"k{i}") for i in range(8)}
+        assert cands == {(0,)}  # all slot-colliding by construction
+        # a deployment secret re-keys the claim domain entirely
+        sec = CollectiveGlobalSync(
+            inst, FakeFabric(1, 1).endpoints[0], claim_secret=b"deploy-key")
+        assert all(s._claim_for(f"k{i}") != sec._claim_for(f"k{i}")
+                   for i in range(8))
+        # claims are deterministic across hosts (same secret -> same claim)
+        sec2 = CollectiveGlobalSync(
+            inst, FakeFabric(1, 1).endpoints[0], claim_secret=b"deploy-key")
+        assert sec._claim_for("k0") == sec2._claim_for("k0")
+
+    def test_cross_host_conflict_advances_to_next_candidate(self):
+        """Two hosts, two DIFFERENT keys whose first candidate collides:
+        instead of both demoting permanently (round-2 behavior), each moves
+        to its next candidate and establishes there."""
+        insts = [_StubInstance(is_owner=True), _StubInstance(is_owner=True)]
+        fabric = FakeFabric(2, 16)
+        cand_map = {"col_keyX": [7, 9], "col_keyY": [7, 11]}
+        syncs = []
+        for i in range(2):
+            s = CollectiveGlobalSync(insts[i], fabric.endpoints[i],
+                                     slot_fn=cand_map.__getitem__)
+            syncs.append(s)
+        syncs[0].queue_update(_greq("keyX", 1))
+        syncs[1].queue_update(_greq("keyY", 1))
+        lockstep(syncs)  # conflict on 7: both advance, back to CLAIMING
+        ex = syncs[0]._keys["col_keyX"]
+        ey = syncs[1]._keys["col_keyY"]
+        assert (ex.slot, ey.slot) == (9, 11)
+        assert ex.phase == CLAIMING and ey.phase == CLAIMING
+        lockstep(syncs)  # clean on the new slots
+        assert ex.phase == ESTABLISHED and ey.phase == ESTABLISHED
+        assert syncs[0].stats["conflicts"] == 1
+        assert syncs[0].stats["fallbacks"] == 0
+
+    def test_nonowner_hunts_to_owners_candidate(self):
+        """Hosts can seat the same key at different candidates (their local
+        occupancy differs). The non-owner holds its deltas (owner-seen
+        gating), hunts across the candidate cycle, and converges on the
+        slot where the owner broadcasts."""
+        owner_inst = _StubInstance(is_owner=True)
+        non_inst = _StubInstance(is_owner=False)
+        fabric = FakeFabric(2, 16)
+        # simulate divergent seating with per-host candidate orders
+        a = CollectiveGlobalSync(owner_inst, fabric.endpoints[0],
+                                 slot_fn=lambda k: [5, 3],
+                                 owner_wait_ticks=1)
+        b = CollectiveGlobalSync(non_inst, fabric.endpoints[1],
+                                 slot_fn=lambda k: [3, 5],
+                                 owner_wait_ticks=1)
+        a.queue_update(_greq("k", 1))  # owner at slot 5
+        b.register_remote(_greq("k", 1))  # non-owner at slot 3
+        lockstep([a, b])  # both clean (different slots!) -> ESTABLISHED
+        assert b._keys["col_k"].slot == 3
+        assert b.queue_hit(_greq("k", 5))
+        lockstep([a, b])  # hits held (no owner on 3); hunt_age grows
+        assert b._keys["col_k"].pending == 5
+        lockstep([a, b])  # hunt fires: move to 5, CLAIMING
+        assert b._keys["col_k"].slot == 5
+        assert b.stats["hunt_moves"] == 1
+        lockstep([a, b])  # claims agree on 5; owner state seen
+        assert b._keys["col_k"].phase == ESTABLISHED
+        assert b._keys["col_k"].owner_seen
+        lockstep([a, b])  # delta finally rides the collective
+        assert b._keys["col_k"].pending == 0
+        assert b.stats["hits_synced"] == 5
+        assert any(r.hits == 5 for r in owner_inst.applied)
+
+    def test_demoted_key_repromotes_after_collider_idles(self):
+        inst = _StubInstance(is_owner=True)
+        s = CollectiveGlobalSync(
+            inst, FakeFabric(1, 16).endpoints[0],
+            slot_fn=lambda k: [2], repromote_ticks=2, idle_s=0.02)
+        s.queue_update(_greq("first", 1))
+        assert not s.queue_update(_greq("second", 1))  # local collision
+        assert s._keys["col_second"].phase == FALLBACK
+        s.tick()
+        assert s._keys["col_first"].phase == ESTABLISHED
+        time.sleep(0.05)  # "first" idles out; keep "second" touch-fresh
+        s.queue_update(_greq("second", 1))
+        s.tick()  # sweep evicts "first" (slot 2 frees)
+        assert "col_first" not in s._keys
+        for _ in range(4):  # repromote pacing: >= repromote_ticks later
+            s.queue_update(_greq("second", 1))
+            s.tick()
+        e = s._keys["col_second"]
+        assert e.phase == ESTABLISHED and e.slot == 2
+        assert s.stats["repromotions"] == 1
+        assert s.fallback_fraction() == 0.0
+
+    def test_churn_fallback_fraction_stays_bounded(self):
+        """Sizing story: 4x G distinct keys churning through (working set
+        ~G/3) must keep the demoted fraction small — the round-2 design
+        had single-candidate slots and permanent demotion, where ~half of
+        1.2*G keys would conflict forever."""
+        G = 64
+        inst = _StubInstance(is_owner=True)
+        s = CollectiveGlobalSync(
+            inst, FakeFabric(1, G).endpoints[0], idle_s=0.02,
+            repromote_ticks=1)
+        total, waves = 0, 16
+        for w in range(waves):
+            for i in range(G // 3):
+                s.queue_update(_greq(f"churn_{w}_{i}", 1))
+                total += 1
+            s.tick()
+            time.sleep(0.03)  # the whole wave idles out
+            s.tick()  # sweep frees the slots
+        assert total == waves * (G // 3)  # 4x G keys passed through
+        # demotions happened only on transient intra-wave collisions
+        frac = s.stats["fallbacks"] / total
+        assert frac < 0.08, f"fallback fraction {frac:.3f}"
+        assert s.fallback_fraction() <= 0.10
+
+
 def test_idle_sweep_releases_slots(duo):
     cluster, syncs = duo
     b = syncs[1]

@@ -1,0 +1,197 @@
+"""Multi-region replication loss accounting (service/multiregion.py).
+
+The reference stubbed the transport entirely (multiregion.go:78-82); we
+implement it, so we also owe an honest failure story: a PRE-send failure
+(PeerNotReadyError — the request never reached the wire) folds that
+region's aggregates into its next window; anything after the send is
+delivery-uncertain and drops (re-sending could double-apply). Refunds are
+per-REGION: a window fans the same aggregate to every foreign region, so a
+shared-pipeline refund would double-count in the regions that already
+received it.
+"""
+
+import threading
+
+import pytest
+
+from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.service.multiregion import MultiRegionManager
+from gubernator_tpu.service.peer_client import PeerNotReadyError
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq
+
+
+def _req(key, hits):
+    return RateLimitReq(
+        name="mr", unique_key=key, hits=hits, limit=100, duration=60_000,
+        algorithm=Algorithm.TOKEN_BUCKET, behavior=Behavior.MULTI_REGION)
+
+
+class _Peer:
+    """Scriptable region peer: fail modes 'ok', 'not_ready', 'uncertain'."""
+
+    def __init__(self, address):
+        self.mode = "ok"
+        self.batches = []  # list of [(key, hits), ...] per delivered call
+        import types
+
+        self.info = types.SimpleNamespace(address=address)
+
+    def get_peer_rate_limits(self, reqs):
+        if self.mode == "not_ready":
+            raise PeerNotReadyError(self.info.address)
+        if self.mode == "uncertain":
+            raise RuntimeError("deadline exceeded after send")
+        self.batches.append([(r.unique_key, r.hits) for r in reqs])
+        return []
+
+
+class _Picker:
+    def __init__(self, peer):
+        self._peer = peer
+
+    def get(self, key):
+        return self._peer
+
+
+class _Inst:
+    data_center = "dc-home"
+
+    def __init__(self, regions):
+        self._regions = regions
+
+    def region_pickers(self):
+        return {dc: _Picker(peer) for dc, peer in self._regions.items()}
+
+
+@pytest.fixture()
+def mgr():
+    peers = {"dc-a": _Peer("a:81"), "dc-b": _Peer("b:81")}
+    conf = BehaviorConfig(multi_region_sync_wait_s=3600,  # manual flushes
+                          multi_region_batch_limit=1000)
+    m = MultiRegionManager(_Inst(peers), conf)
+    yield m, peers
+    m.close()
+
+
+def _window(m, reqs):
+    """Drive one explicit window through the transport (the pipeline's
+    flush thread is frozen by the 3600 s wait)."""
+    batch = {}
+    for r in reqs:
+        prev = batch.get(r.hash_key())
+        if prev is not None:
+            import dataclasses
+
+            r = dataclasses.replace(r, hits=r.hits + prev.hits)
+        batch[r.hash_key()] = r
+    m._send_hits(batch)
+
+
+class TestLossAccounting:
+    def test_pre_send_failure_refunds_into_next_window(self, mgr):
+        m, peers = mgr
+        peers["dc-a"].mode = "not_ready"
+        _window(m, [_req("k1", 5)])
+        assert m.stats["refunded_hits"] == 5
+        assert m.stats["dropped_hits"] == 0
+        assert m.stats["errors"] == 1
+        # dc-b received this window normally
+        assert peers["dc-b"].batches == [[("k1", 5)]]
+
+        # dc-a recovers: the next window carries old + new aggregates to
+        # dc-a, while dc-b gets ONLY the new hits (no double count)
+        peers["dc-a"].mode = "ok"
+        _window(m, [_req("k1", 2)])
+        assert peers["dc-a"].batches == [[("k1", 7)]]
+        assert peers["dc-b"].batches == [[("k1", 5)], [("k1", 2)]]
+        assert m.stats["replicated"] == 3  # b:k1, a:k1, b:k1
+
+    def test_uncertain_failure_drops_and_counts(self, mgr):
+        m, peers = mgr
+        peers["dc-a"].mode = "uncertain"
+        _window(m, [_req("k2", 9)])
+        assert m.stats["dropped_hits"] == 9
+        assert m.stats["refunded_hits"] == 0
+        # the next window must NOT resend the dropped hits anywhere
+        peers["dc-a"].mode = "ok"
+        _window(m, [_req("k2", 1)])
+        assert peers["dc-a"].batches == [[("k2", 1)]]
+
+    def test_carry_is_one_window_deep(self, mgr):
+        """Hits deferred once that fail AGAIN drop (counted): a long-dead
+        region must not accumulate an unbounded backlog that bursts onto
+        its current traffic at recovery."""
+        m, peers = mgr
+        peers["dc-a"].mode = "not_ready"
+        _window(m, [_req("k3", 3)])
+        assert m.stats["refunded_hits"] == 3
+        _window(m, [_req("k3", 4)])  # carried 3 drop; fresh 4 defer
+        assert m.stats["dropped_hits"] == 3
+        assert m.stats["refunded_hits"] == 3 + 4
+        peers["dc-a"].mode = "ok"
+        _window(m, [_req("k3", 1)])
+        assert peers["dc-a"].batches == [[("k3", 5)]]  # 4 carried + 1 fresh
+        # every hit is accounted exactly once across the three outcomes:
+        # 8 queued total = 5 delivered + 3 dropped
+        assert m.stats["dropped_hits"] == 3
+
+    def test_empty_region_picker_counts_dropped(self, mgr):
+        """A region present in the picker map but with zero peers routes
+        nothing — those hits must land in dropped_hits, not vanish."""
+        m, peers = mgr
+
+        class _EmptyPicker:
+            def get(self, key):
+                raise RuntimeError("no peers in region")
+
+        regions = m.instance.region_pickers
+
+        def patched():
+            d = regions()
+            d["dc-a"] = _EmptyPicker()
+            return d
+
+        m.instance.region_pickers = patched
+        _window(m, [_req("k7", 5)])
+        assert m.stats["dropped_hits"] == 5  # dc-a leg
+        assert peers["dc-b"].batches == [[("k7", 5)]]  # dc-b unaffected
+
+    def test_departed_region_owes_nothing(self, mgr):
+        m, peers = mgr
+        peers["dc-a"].mode = "not_ready"
+        _window(m, [_req("k4", 6)])
+        assert m.stats["refunded_hits"] == 6
+        del m.instance._regions["dc-a"]  # region leaves the fleet
+        _window(m, [_req("k4", 1)])
+        assert m.stats["dropped_hits"] == 6  # the deferred debt is voided
+
+    def test_close_counts_undelivered_deferrals(self):
+        peers = {"dc-a": _Peer("a:81")}
+        conf = BehaviorConfig(multi_region_sync_wait_s=3600,
+                              multi_region_batch_limit=1000)
+        m = MultiRegionManager(_Inst(peers), conf)
+        peers["dc-a"].mode = "not_ready"
+        _window(m, [_req("k5", 4)])
+        m.close()
+        assert m.stats["dropped_hits"] == 4
+
+    def test_defer_is_thread_safe_with_queueing(self, mgr):
+        """_send_hits runs on the pipeline flush thread while request
+        threads queue more hits; the deferred map has its own lock."""
+        m, peers = mgr
+        peers["dc-a"].mode = "not_ready"
+        stop = threading.Event()
+
+        def spam():
+            while not stop.is_set():
+                m._defer("dc-a", [_req("k6", 1)])
+
+        t = threading.Thread(target=spam)
+        t.start()
+        try:
+            for _ in range(50):
+                _window(m, [_req("k6", 1)])
+        finally:
+            stop.set()
+            t.join(timeout=10)
+        assert not t.is_alive()

@@ -146,6 +146,68 @@ class TestTransportCorrectness:
         r = cli.call(METHOD_GET_PEER_RATE_LIMITS, [_req("fine")], 5.0)[0]
         assert r.error == ""
 
+    def test_non_utf8_key_gets_per_item_error(self, served):
+        """The link port is unauthenticated: a crafted frame with invalid
+        UTF-8 key bytes must produce a per-item error reply — and must not
+        poison co-batched items riding the same aggregated pull."""
+        import socket as socket_mod
+        import struct as struct_mod
+
+        _, svc, cli = served
+        # hand-build a 2-item frame: item 0 has a non-UTF-8 unique_key,
+        # item 1 is a normal request (same wire layout as the n<=4 encoder)
+        rid, method, n = 7001, METHOD_GET_PEER_RATE_LIMITS, 2
+        names = [b"pl", b"pl"]
+        ukeys = [b"\xff\xfe\xfd", b"utf8-ok"]
+        parts = [struct_mod.pack("<QBH", rid, method, n)]
+        parts.append(struct_mod.pack("<2H", *(len(a) for a in names)))
+        parts.append(struct_mod.pack("<2H", *(len(b) for b in ukeys)))
+        parts.extend(a + b for a, b in zip(names, ukeys))
+        parts.append(struct_mod.pack("<2q", 1, 1))            # hits
+        parts.append(struct_mod.pack("<2q", 10, 10))          # limit
+        parts.append(struct_mod.pack("<2q", 60_000, 60_000))  # duration
+        parts.append(struct_mod.pack("<2I", 0, 0))            # algorithm
+        parts.append(struct_mod.pack("<2I", 0, 0))            # behavior
+        body = b"".join(parts)
+        with socket_mod.create_connection(("127.0.0.1", svc.port), 5.0) as s:
+            s.sendall(struct_mod.pack("<I", len(body)) + body)
+            buf = b""
+            while True:
+                chunk = s.recv(65536)
+                assert chunk, "server closed without responding"
+                buf += chunk
+                if len(buf) >= 4:
+                    (length,) = struct_mod.unpack_from("<I", buf, 0)
+                    if len(buf) - 4 >= length:
+                        break
+        from gubernator_tpu.service.peerlink import decode_response_frame
+        resps = decode_response_frame(memoryview(buf)[4:4 + length])
+        assert len(resps) == 2
+        assert "utf-8" in resps[0].error
+        assert resps[1].error == ""
+        assert resps[1].remaining == 9
+        # the shared client on the same service is unaffected
+        r = cli.call(METHOD_GET_PEER_RATE_LIMITS, [_req("after-bad")], 5.0)[0]
+        assert r.error == ""
+
+    def test_handler_blowup_still_answers_the_pull(self, served):
+        """If _handle_batch itself dies, every item in the pull must still
+        get an (error) response — no stranded futures, no C++ pending leak."""
+        _, svc, cli = served
+        orig = svc._handle_batch
+        svc._handle_batch = lambda got, b: (_ for _ in ()).throw(
+            RuntimeError("boom"))
+        try:
+            resps = cli.call(METHOD_GET_PEER_RATE_LIMITS,
+                             [_req("blowup-a"), _req("blowup-b")], 5.0)
+            assert len(resps) == 2
+            assert all("internal batch failure" in r.error for r in resps)
+        finally:
+            svc._handle_batch = orig
+        r = cli.call(METHOD_GET_PEER_RATE_LIMITS, [_req("blowup-after")],
+                     5.0)[0]
+        assert r.error == ""
+
     def test_empty_request_list_is_local_noop(self, served):
         _, _, cli = served
         assert cli.call(METHOD_GET_PEER_RATE_LIMITS, [], 5.0) == []
