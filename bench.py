@@ -187,11 +187,94 @@ def main() -> None:
     force(resp)
     compact_rate = c_iters * SCAN_K * BATCH_WIDTH / (time.perf_counter() - t0)
 
+    # ---- extra: FULL serving path — key directory + columnar prep +
+    # staging + kernel + demux (VERDICT r2 item 1). Real key strings
+    # resolve through the 10M-entry C++ LRU directory and the GIL-free
+    # columnar prep (native/keydir.cpp keydir_prep_pack_columnar) into a
+    # K-deep staging stack; the stack compacts to the i32 wire format
+    # (20 B/decision instead of 72 — the tunnel's upload bandwidth and RTT
+    # are the rig's constraint, not the chip's), ships in ONE transfer,
+    # decides in ONE scan dispatch, and reads back in ONE fetch; the demux
+    # scatters each window's four response rows to its items. On local
+    # hardware the same path runs per-window with µs readbacks. ---------------
+    from gubernator_tpu import native
+    from gubernator_tpu.models.engine import Engine
+    from gubernator_tpu.ops.decide import widen_compact_out
+
+    eng = Engine(capacity=TABLE_CAPACITY, min_width=BATCH_WIDTH,
+                 max_width=BATCH_WIDTH)
+    serving_row = {}
+    if eng.supports_columnar():
+        rng = np.random.RandomState(7)
+        CH = 100_000
+        for s in range(0, TABLE_CAPACITY, CH):  # resident directory: 10M keys
+            eng.directory.lookup([f"b_k{i}" for i in range(s, s + CH)])
+        variants = []
+        for _ in range(N_VARIANTS):
+            ids = rng.choice(TABLE_CAPACITY, BATCH_WIDTH, replace=False)
+            ukeys = [b"k%d" % i for i in ids]
+            keys = b"".join(b"b" + u for u in ukeys)
+            off = np.zeros(BATCH_WIDTH + 1, np.int32)
+            np.cumsum([1 + len(u) for u in ukeys], out=off[1:])
+            variants.append((
+                keys, off, np.ones(BATCH_WIDTH, np.int32),
+                np.ones(BATCH_WIDTH, np.int64),
+                np.full(BATCH_WIDTH, 1 << 30, np.int64),
+                np.full(BATCH_WIDTH, 3_600_000, np.int64),
+                np.zeros(BATCH_WIDTH, np.int32),
+                np.zeros(BATCH_WIDTH, np.int32)))
+        K_SERVE = 128
+        big = np.zeros((K_SERVE, 9, BATCH_WIDTH), np.int64)
+        lanes = [None] * K_SERVE
+        st = np.zeros(BATCH_WIDTH, np.int32)
+        li = np.zeros(BATCH_WIDTH, np.int64)
+        re = np.zeros(BATCH_WIDTH, np.int64)
+        rs = np.zeros(BATCH_WIDTH, np.int64)
+
+        def cycle(state, w):
+            for d in range(K_SERVE):  # host tier: directory + prep + pack
+                v = variants[(w + d) % N_VARIANTS]
+                n0, lane, left, _inj = native.prep_pack_columnar(
+                    eng.directory, BATCH_WIDTH, v[0], v[1], v[2], v[3],
+                    v[4], v[5], v[6], v[7], 0, big[d])
+                assert n0 == BATCH_WIDTH and not len(left)
+                lanes[d] = lane
+            cw = compact_window(big)
+            state, out = compact_step(state, jnp.asarray(cw), now + w)
+            wide = widen_compact_out(out, now + w)  # one readback fetch
+            for d in range(K_SERVE):  # demux scatter per window
+                lane = lanes[d]
+                st[lane] = wide[d, 0]
+                li[lane] = wide[d, 1]
+                re[lane] = wide[d, 2]
+                rs[lane] = wide[d, 3]
+            return state
+
+        state = cycle(state, 0)  # warm (compile already cached)
+        t0 = time.perf_counter()
+        state = cycle(state, K_SERVE)
+        per_cycle = max(time.perf_counter() - t0, 1e-6)
+        cycles = max(3, min(60, int(2 * TARGET_SECONDS / per_cycle)))
+        w = 2 * K_SERVE
+        t0 = time.perf_counter()
+        for _ in range(cycles):
+            state = cycle(state, w)
+            w += K_SERVE
+        serving_rate = cycles * K_SERVE * BATCH_WIDTH / (
+            time.perf_counter() - t0)
+        serving_row = {
+            "serving_path_decisions_per_sec": round(serving_rate, 1),
+            "serving_path_scope":
+                "keydir(10M resident)+columnar prep+compact staging+"
+                f"kernel+demux, {K_SERVE} windows/transfer",
+        }
+
     print(
         json.dumps(
             {
                 "metric": METRIC,
                 "value": round(decisions_per_sec, 1),
+                **serving_row,
                 "unit": UNIT,
                 "vs_baseline": round(decisions_per_sec / REFERENCE_BASELINE_RPS, 2),
                 "batch_width": BATCH_WIDTH,

@@ -48,7 +48,12 @@ from gubernator_tpu.ops.decide import (
 )
 from gubernator_tpu.native import PREP_OVERCOMMIT
 from gubernator_tpu.store import BucketSnapshot, Loader, Store
-from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp
+from gubernator_tpu.types import (
+    SLOW_PATH_BEHAVIOR_MASK as _NATIVE_SINGLE_SLOW_MASK,
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+)
 from gubernator_tpu.utils.interval import millisecond_now
 
 _GREG_MASK = int(Behavior.DURATION_IS_GREGORIAN)
@@ -118,12 +123,13 @@ class EngineStats:
         self.rounds = 0
         self.over_limit = 0
         self.errors = 0
+        self.native_singles = 0  # lone requests decided in C (no dispatch)
         self.stage_ns = {s: 0 for s in self.STAGES}
 
     def as_dict(self) -> Dict[str, int]:
         d = dict(requests=self.requests, batches=self.batches,
                  rounds=self.rounds, over_limit=self.over_limit,
-                 errors=self.errors)
+                 errors=self.errors, native_singles=self.native_singles)
         for s, ns in self.stage_ns.items():
             d[f"{s}_ns"] = ns
         return d
@@ -264,9 +270,13 @@ class Engine:
         packed = np.zeros((9, w), np.int64)
         with self._lock:
             t0 = time.perf_counter_ns()  # excludes the lock wait
-            n0, lane_item, leftover = self._prep_fast(
+            n0, lane_item, leftover, inject = self._prep_fast(
                 self.directory, requests, packed, _GREG_MASK)
             if n0 == PREP_OVERCOMMIT:
+                # mirror rows collected before the abort must still land
+                # (unreachable on this engine — max_width <= capacity —
+                # but the invariant is cheap to keep)
+                self._apply_inject_rows(inject)
                 raise RuntimeError(
                     f"key directory over-committed: >{self.capacity} "
                     "distinct keys in one lookup")
@@ -277,6 +287,7 @@ class Engine:
             stage["prep"] += t1 - t0
             self.stats.requests += n0
             self.stats.batches += 1
+            self._apply_inject_rows(inject)
             responses: List[Optional[RateLimitResp]] = [None] * len(requests)
             if n0:
                 self.stats.rounds += 1
@@ -303,6 +314,154 @@ class Engine:
             for i, resp in zip(idxs, tail):
                 responses[i] = resp
         return responses  # type: ignore[return-value]
+
+    # ------------------------------------------------------- columnar path
+
+    def supports_columnar(self) -> bool:
+        """True when the zero-object serving path is available: native
+        directory + no Store hooks (stores need per-round host calls)."""
+        return self._prep_fast is not None and self.store is None
+
+    def submit_columnar(self, n: int, keys, key_off, name_len, hits, limit,
+                        duration, algorithm, behavior, slow_mask: int,
+                        now_ms: Optional[int] = None):
+        """Dispatch one columnar window: the wire columns (peerlink's
+        pls_next_batch layout) go through the GIL-free C prep straight into
+        the staging buffer and onto the device — no RateLimitReq objects.
+
+        Returns a handle for complete_columnar, or None when the columnar
+        path cannot take the window at all (nothing mutated). The dispatch
+        is ASYNC: callers may submit further windows before completing
+        earlier ones (≥2 in flight hides device latency; the state chain
+        orders them). Items the C pass can't take come back as `leftover`
+        indices from complete_columnar — run them through the request-object
+        path AFTER this round (per-key sequential order holds because a
+        leftover key's first occurrence, if packed, dispatched first)."""
+        if not 0 < n <= self.max_width:
+            return None
+        if now_ms is None:
+            now_ms = millisecond_now()
+        from gubernator_tpu import native
+
+        w = _bucket_width(n, self.min_width, self.max_width)
+        packed = np.zeros((9, w), np.int64)
+        with self._lock:
+            t0 = time.perf_counter_ns()
+            n0, lane_item, leftover, inject = native.prep_pack_columnar(
+                self.directory, n, keys, key_off, name_len, hits, limit,
+                duration, algorithm, behavior, slow_mask, packed)
+            if n0 == PREP_OVERCOMMIT:
+                self._apply_inject_rows(inject)
+                raise RuntimeError(
+                    f"key directory over-committed: >{self.capacity} "
+                    "distinct keys in one lookup")
+            if n0 < 0:
+                return None
+            t1 = time.perf_counter_ns()
+            self.stats.stage_ns["prep"] += t1 - t0
+            self.stats.requests += n0
+            self.stats.batches += 1
+            self._apply_inject_rows(inject)
+            out = None
+            if n0:
+                self.stats.rounds += 1
+                self.state, out = self._decide_packed(
+                    self.state, packed, now_ms)
+                self.stats.stage_ns["device"] += \
+                    time.perf_counter_ns() - t1
+        return (out, lane_item, leftover, n0)
+
+    def complete_columnar(self, handle, out_status, out_limit,
+                          out_remaining, out_reset) -> np.ndarray:
+        """Read back a submitted window and scatter the four response rows
+        into the caller's columns at the packed items' positions (runs
+        outside the engine lock — dispatch order is already fixed).
+        Returns the leftover item indices."""
+        out, lane_item, leftover, n0 = handle
+        if n0:
+            t0 = time.perf_counter_ns()
+            rows = np.asarray(out)  # device sync for THIS window
+            t1 = time.perf_counter_ns()
+            out_status[lane_item] = rows[0, :n0]
+            out_limit[lane_item] = rows[1, :n0]
+            out_remaining[lane_item] = rows[2, :n0]
+            out_reset[lane_item] = rows[3, :n0]
+            self.stats.over_limit += int(
+                np.count_nonzero(rows[0, :n0] == 1))
+            t2 = time.perf_counter_ns()
+            self.stats.stage_ns["device"] += t1 - t0
+            self.stats.stage_ns["demux"] += t2 - t1
+        return leftover
+
+    # --------------------------------------------- native lone-request path
+
+    def _apply_inject_rows(self, inject) -> None:
+        """Scatter reconciled mirror rows (native lone-path decisions,
+        keydir.cpp Mirror) into the device table BEFORE the window whose
+        lookup surfaced them. Caller holds the engine lock."""
+        if inject is None or len(inject) == 0:
+            return
+        m = len(inject)
+        w = _bucket_width(m, self.min_width, self.max_width)
+        pad = w - m
+        z = np.zeros(pad, np.int64)
+
+        def col(f):
+            return jnp.asarray(np.concatenate([inject[:, f], z]), I64)
+
+        self.state = self._inject(
+            self.state,
+            jnp.asarray(np.concatenate(
+                [inject[:, 0], np.full(pad, -1)]).astype(np.int32), I32),
+            col(1).astype(I32), col(2), col(3), col(4), col(5), col(6),
+            col(7).astype(I32),
+        )
+
+    def decide_native_single(self, req: RateLimitReq,
+                             now_ms: int = 0) -> Optional[RateLimitResp]:
+        """The native lone-request fast path (VERDICT r2 item 6): decide a
+        NO_BATCHING single against the key's directory-resident row mirror
+        entirely in C (keydir.cpp decide_one) — no kernel dispatch, no
+        engine lock (the KeyDir mutex serializes against batch lookups).
+        None = miss (cold/invalidated mirror, masked behavior, store
+        attached): take the kernel path, then seed_mirror()."""
+        d = self.directory
+        if self.store is not None or not hasattr(d, "decide_one"):
+            return None
+        if int(req.behavior) & _NATIVE_SINGLE_SLOW_MASK:
+            return None
+        if not req.name or not req.unique_key:
+            return None  # the kernel path produces the validation error
+        out = d.decide_one(req.hash_key(), req.hits, req.limit,
+                           req.duration, int(req.algorithm),
+                           int(req.behavior), now_ms)
+        if out is None:
+            return None
+        self.stats.requests += 1
+        self.stats.native_singles += 1
+        if out[0] == 1:
+            self.stats.over_limit += 1
+        return RateLimitResp(status=int(out[0]), limit=out[1],
+                             remaining=out[2], reset_time=out[3])
+
+    def seed_mirror(self, key: str) -> bool:
+        """Copy a key's post-window device row into its directory mirror so
+        subsequent lone requests decide natively. Called after a lone miss
+        took the kernel path (one gather dispatch, amortized across every
+        native decision the mirror then serves)."""
+        d = self.directory
+        if self.store is not None or not hasattr(d, "mirror_seed"):
+            return False
+        with self._lock:
+            slot = d.peek_slot(key)
+            if slot < 0:
+                return False
+            cols = self._gather(self.state, jnp.asarray([slot], I32))
+            row = [int(np.asarray(c)[0]) for c in cols]
+            if row[0] < 0:
+                return False  # vacant row: nothing to mirror
+            d.mirror_seed(key, row)
+        return True
 
     # ------------------------------------------------------- persistence SPI
 
@@ -337,6 +496,14 @@ class Engine:
         out: List[BucketSnapshot] = []
         now = millisecond_now()
         with self._lock:
+            if hasattr(self.directory, "mirror_flush"):
+                # native lone-path decisions newer than the device rows
+                # must reconcile before the gather
+                while True:
+                    inj = self.directory.mirror_flush()
+                    if not len(inj):
+                        break
+                    self._apply_inject_rows(inj)
             entries = self.directory.items()
             for start in range(0, len(entries), self.max_width):
                 chunk = entries[start:start + self.max_width]
@@ -381,13 +548,17 @@ class Engine:
         dispatch already, and admitting them would make the scan width
         unbounded (unwarmable shapes, oversized padding).
 
-        The Store hooks are per-round host calls (read-through before, write-
-        through after each round, reference: algorithms.go:26-33,64-68), so a
-        store disables the fast path entirely. The capacity guard keeps a
-        group's up-front directory lookups from recycling a slot an earlier
-        window in the group already claimed.
+        A Store keeps the scan path (VERDICT r2 item 5): its hooks batch to
+        one read-through before the tail (on the tail's first window — a
+        superset of every later round's keys, so it covers the whole tail)
+        and one write-through after it with each key's FINAL post-tail row.
+        The reference pays one OnChange per hit (algorithms.go:64-68); the
+        batched design persists the same end state in one host call per
+        window (PARITY #8). The capacity guard keeps a group's up-front
+        directory lookups from recycling a slot an earlier window in the
+        group already claimed.
         """
-        if self.store is not None or len(windows) <= 1:
+        if len(windows) <= 1:
             return windows, []
         split = len(windows)
         while split > 0 and len(windows[split - 1]) <= self.min_width:
@@ -406,21 +577,58 @@ class Engine:
         a tunneled device) per dispatch, while the kernel body is cheap."""
         stage = self.stats.stage_ns
         width = self.min_width  # _split_scannable guarantees every window fits
+        pre = None  # (keys, slots, fresh) for the tail's first window
+        union = None  # per-key first occurrence across the WHOLE tail
+        if self.store is not None and windows:
+            # one batched read-through / write-through for the WHOLE tail,
+            # over the union of its keys. (The first window alone is NOT a
+            # superset: when round 0 chunks at max_width, a later round's
+            # keys may live in a HEAD chunk — e.g. rounds [64+2, 4, 4]
+            # split the 4 duplicated keys away from tail window 0.)
+            seen_keys = {}
+            for wk in windows:
+                for item in wk:
+                    k = item[1].hash_key()
+                    if k not in seen_keys:
+                        seen_keys[k] = item
+            union = list(seen_keys.items())  # [(key, item)], window order
+            t = time.perf_counter_ns()
+            ukeys = [k for k, _ in union]
+            uslots, ufresh, inj0 = self.directory.lookup_inject(ukeys)
+            self._apply_inject_rows(inj0)
+            t2 = time.perf_counter_ns()
+            stage["lookup"] += t2 - t
+            uwork = [it for _, it in union]
+            ufresh = self._store_read_through(
+                uwork, ukeys, uslots, ufresh, now_ms)
+            stage["store"] += time.perf_counter_ns() - t2
+            union = (uwork, ukeys, uslots)
+            # window 0's keys are the union's prefix (iteration order)
+            n0 = len(windows[0])
+            pre = (ukeys[:n0], uslots[:n0], ufresh[:n0])
         for g0 in range(0, len(windows), self._MAX_SCAN):
             group = windows[g0:g0 + self._MAX_SCAN]
             if len(group) == 1:
                 # a trailing singleton (e.g. 33 windows -> groups [32, 1])
                 # rides the already-warmed single-window program; warmup
                 # compiles scan depths {2..32} only
-                self._apply_round(group[0], now_ms, responses)
+                self._apply_round(group[0], now_ms, responses,
+                                  skip_store=self.store is not None)
                 continue
             k = _bucket_pow2(len(group))
             stacked = np.zeros((k, 9, width), np.int64)
             stacked[:, 0, :] = -1  # pad windows are all padding lanes
             for gi, wk in enumerate(group):
                 t = time.perf_counter_ns()
-                keys = [item[1].hash_key() for item in wk]
-                slots, fresh = self.directory.lookup(keys)
+                if pre is not None and g0 == 0 and gi == 0:
+                    # reuse the read-through pass's lookup: a second
+                    # directory lookup would clear the fresh flags of keys
+                    # the store did NOT have (vacant device rows)
+                    keys, slots, fresh = pre
+                else:
+                    keys = [item[1].hash_key() for item in wk]
+                    slots, fresh, inj = self.directory.lookup_inject(keys)
+                    self._apply_inject_rows(inj)
                 t2 = time.perf_counter_ns()
                 stage["lookup"] += t2 - t
                 pack_window(wk, slots, fresh, width, out=stacked[gi])
@@ -441,16 +649,28 @@ class Engine:
                         status=st, limit=limit[j],
                         remaining=remaining[j], reset_time=reset[j])
             stage["demux"] += time.perf_counter_ns() - t2
+        if union is not None:
+            # one batched write-through with each key's FINAL post-tail row
+            uwork, ukeys, uslots = union
+            t = time.perf_counter_ns()
+            self._store_write_through(uwork, ukeys, uslots, now_ms)
+            stage["store"] += time.perf_counter_ns() - t
 
-    def _apply_round(self, round_work, now_ms, responses) -> None:
+    def _apply_round(self, round_work, now_ms, responses,
+                     skip_store: bool = False) -> None:
+        """One window, one dispatch. `skip_store` marks a tail singleton
+        inside _apply_windows_scanned, whose batched read/write-through
+        already covers these keys."""
         stage = self.stats.stage_ns
         n = len(round_work)
         t = time.perf_counter_ns()
         keys = [item[1].hash_key() for item in round_work]
-        slots, fresh = self.directory.lookup(keys)
+        slots, fresh, inj = self.directory.lookup_inject(keys)
+        self._apply_inject_rows(inj)
         stage["lookup"] += time.perf_counter_ns() - t
 
-        if self.store is not None:
+        use_store = self.store is not None and not skip_store
+        if use_store:
             t = time.perf_counter_ns()
             fresh = self._store_read_through(round_work, keys, slots, fresh, now_ms)
             stage["store"] += time.perf_counter_ns() - t
@@ -478,7 +698,7 @@ class Engine:
                 reset_time=reset[j])
         stage["demux"] += time.perf_counter_ns() - t3
 
-        if self.store is not None:
+        if use_store:
             t = time.perf_counter_ns()
             self._store_write_through(round_work, keys, slots, now_ms)
             stage["store"] += time.perf_counter_ns() - t

@@ -35,6 +35,15 @@ class KeyDirectory:
     def __contains__(self, key: str) -> bool:
         return key in self._map
 
+    def lookup_inject(self, keys: Sequence[str]):
+        """Native-API twin: (slots, fresh, inject). The python directory
+        has no row mirrors (the native lone-request fast path lives in
+        keydir.cpp), so the inject list is always empty."""
+        slots, fresh = self.lookup(keys)
+        import numpy as np
+
+        return slots, fresh, np.empty((0, 8), np.int64)
+
     def lookup(self, keys: Sequence[str]) -> Tuple[List[int], List[bool]]:
         """Map keys to slots, assigning (and recycling LRU) as needed.
 

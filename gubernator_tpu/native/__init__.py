@@ -81,7 +81,20 @@ def load_library() -> ctypes.CDLL:
         lib.keydir_free.argtypes = [c.c_void_p]
         lib.keydir_lookup_batch.restype = c.c_int64
         lib.keydir_lookup_batch.argtypes = [
-            c.c_void_p, c.c_char_p, c.c_void_p, c.c_int32, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_char_p, c.c_void_p, c.c_int32, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p,
+        ]
+        lib.keydir_mirror_seed.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_int32, c.c_void_p,
+        ]
+        lib.keydir_decide_one.restype = c.c_int32
+        lib.keydir_decide_one.argtypes = [
+            c.c_void_p, c.c_char_p, c.c_int32, c.c_int64, c.c_int64,
+            c.c_int64, c.c_int32, c.c_int32, c.c_int64, c.c_void_p,
+        ]
+        lib.keydir_mirror_flush.restype = c.c_int32
+        lib.keydir_mirror_flush.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_int32,
         ]
         lib.keydir_drop.argtypes = [c.c_void_p, c.c_char_p, c.c_int32]
         lib.keydir_peek.restype = c.c_int32
@@ -96,6 +109,15 @@ def load_library() -> ctypes.CDLL:
         lib.keydir_evictions.argtypes = [c.c_void_p]
         lib.fnv1a_owner_batch.argtypes = [
             c.c_char_p, c.c_void_p, c.c_int32, c.c_int32, c.c_void_p,
+        ]
+        # columnar prep is pure C (no CPython API): riding the CDLL handle
+        # releases the GIL for the whole pass
+        lib.keydir_prep_pack_columnar.restype = c.c_int32
+        lib.keydir_prep_pack_columnar.argtypes = [
+            c.c_void_p, c.c_int32, c.c_char_p, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_int64, c.c_void_p, c.c_int32, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p,
         ]
         _LIB = lib
         return lib
@@ -141,6 +163,11 @@ def load_peerlink() -> ctypes.CDLL:
             c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
             c.c_char_p,
         ]
+        lib.pls_set_native.argtypes = [
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_longlong,
+        ]
+        lib.pls_native_hits.restype = c.c_longlong
+        lib.pls_native_hits.argtypes = [c.c_void_p]
         _PL_LIB = lib
         return lib
 
@@ -163,7 +190,7 @@ def load_pydll() -> ctypes.PyDLL:
             lib.keydir_prep_pack_fast.restype = c.c_int32
             lib.keydir_prep_pack_fast.argtypes = [
                 c.c_void_p, c.py_object, c.c_void_p, c.c_int32, c.c_int64,
-                c.c_void_p, c.c_void_p, c.c_void_p,
+                c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
             ]
             lib.keydir_prep_route_sharded.restype = c.c_int32
             lib.keydir_prep_route_sharded.argtypes = [
@@ -185,23 +212,67 @@ def prep_pack_fast(directory: "NativeKeyDirectory", requests,
     + directory lookup + pack in one C call. `packed` must be a zeroed
     C-contiguous i64[9, width].
 
-    Returns (n0, lane_item, leftover): n0 lanes packed (lane j answers
-    requests[lane_item[j]]), with `leftover` the item indices the python
-    pipeline must run AFTER this round (invalid / gregorian / duplicate
-    occurrences). n0 is PREP_FALLBACK or PREP_OVERCOMMIT on the
-    non-sequence/oversize and over-commit paths."""
+    Returns (n0, lane_item, leftover, inject): n0 lanes packed (lane j
+    answers requests[lane_item[j]]), with `leftover` the item indices the
+    python pipeline must run AFTER this round (invalid / gregorian /
+    duplicate occurrences) and `inject` the i64[m, 8] dirty-mirror rows
+    (slot + 7 row values) the engine must scatter into the device table
+    BEFORE this window decides (native lone-path reconciliation). n0 is
+    PREP_FALLBACK or PREP_OVERCOMMIT on the non-sequence/oversize and
+    over-commit paths."""
     lib = load_pydll()
     width = packed.shape[1]
+    n = len(requests)
     lane_item = np.empty(width, np.int32)
-    leftover = np.empty(len(requests), np.int32)
+    leftover = np.empty(n, np.int32)
     n_left = np.zeros(1, np.int32)
+    inject = np.empty((n, 8), np.int64)
+    n_inj = np.zeros(1, np.int32)
     n0 = lib.keydir_prep_pack_fast(
         directory._kd, requests, packed.ctypes.data, width, greg_mask,
         lane_item.ctypes.data, leftover.ctypes.data, n_left.ctypes.data,
+        inject.ctypes.data, n_inj.ctypes.data,
     )
     if n0 < 0:
-        return n0, None, None
-    return n0, lane_item[:n0], leftover[:int(n_left[0])]
+        # over-commit may abort MID-lookup with dirty-mirror rows already
+        # collected (and their flags cleared): hand them back so the
+        # engine can still apply them before raising
+        return n0, None, None, inject[:int(n_inj[0])]
+    return (n0, lane_item[:n0], leftover[:int(n_left[0])],
+            inject[:int(n_inj[0])])
+
+
+def prep_pack_columnar(directory: "NativeKeyDirectory", n: int,
+                       keys, key_off, name_len, hits, limit, duration,
+                       algorithm, behavior, slow_mask: int,
+                       packed: np.ndarray):
+    """Columnar one-pass window prep: the peerlink wire columns straight
+    into the decide staging buffer — no RateLimitReq objects, no GIL.
+
+    `keys` is the name+unique_key byte arena (ctypes buffer or bytes);
+    key_off i32[>=n+1]; name_len/algorithm/behavior i32; hits/limit/
+    duration i64; `packed` a zeroed C-contiguous i64[9, width].
+
+    Returns (n0, lane_item, leftover, inject) like prep_pack_fast."""
+    lib = load_library()
+    width = packed.shape[1]
+    lane_item = np.empty(width, np.int32)
+    leftover = np.empty(n, np.int32)
+    n_left = np.zeros(1, np.int32)
+    inject = np.empty((n, 8), np.int64)
+    n_inj = np.zeros(1, np.int32)
+    n0 = lib.keydir_prep_pack_columnar(
+        directory._kd, n, keys,
+        key_off.ctypes.data, name_len.ctypes.data, hits.ctypes.data,
+        limit.ctypes.data, duration.ctypes.data, algorithm.ctypes.data,
+        behavior.ctypes.data, slow_mask, packed.ctypes.data, width,
+        lane_item.ctypes.data, leftover.ctypes.data, n_left.ctypes.data,
+        inject.ctypes.data, n_inj.ctypes.data,
+    )
+    if n0 < 0:
+        return n0, None, None, inject[:int(n_inj[0])]
+    return (n0, lane_item[:n0], leftover[:int(n_left[0])],
+            inject[:int(n_inj[0])])
 
 
 def prep_route_sharded(directories, requests, greg_mask: int):
@@ -303,20 +374,60 @@ class NativeKeyDirectory:
         return int(self._lib.keydir_evictions(self._kd))
 
     def lookup(self, keys: Sequence[str]) -> Tuple[List[int], List[bool]]:
+        slots, fresh, inject = self.lookup_inject(keys)
+        # a caller that discards the inject rows (snapshot load overwrites
+        # them anyway) still invalidated the mirrors, which is the contract
+        return slots, fresh
+
+    def lookup_inject(self, keys: Sequence[str]):
+        """lookup() + the dirty-mirror rows (i64[m, 8]: slot + 7 row
+        values) that must be scattered into the device table BEFORE the
+        window these slots feed (native lone-path reconciliation)."""
         data, offsets = _pack_keys(keys)
         n = len(keys)
         slots = np.empty(n, np.int32)
         fresh = np.empty(n, np.uint8)
+        inject = np.empty((n, 8), np.int64)
+        n_inj = np.zeros(1, np.int32)
         done = self._lib.keydir_lookup_batch(
             self._kd, data, offsets.ctypes.data, n,
             slots.ctypes.data, fresh.ctypes.data,
+            inject.ctypes.data, n_inj.ctypes.data,
         )
         if done != n:
             raise RuntimeError(
                 f"key directory over-committed: >{self.capacity} distinct "
                 "keys in one lookup"
             )
-        return slots.tolist(), fresh.astype(bool).tolist()
+        return (slots.tolist(), fresh.astype(bool).tolist(),
+                inject[:int(n_inj[0])])
+
+    def mirror_seed(self, key: str, row7: Sequence[int]) -> None:
+        """Install a device row copy as the key's mirror (see keydir.cpp
+        Mirror); subsequent decide_one calls serve natively until a batch
+        lookup invalidates it."""
+        b = key.encode("utf-8")
+        row = np.asarray(list(row7), np.int64)
+        self._lib.keydir_mirror_seed(self._kd, b, len(b), row.ctypes.data)
+
+    def mirror_flush(self, max_rows: int = 4096) -> np.ndarray:
+        """Drain dirty mirrors for snapshot/shutdown coherence: returns
+        i64[m, 8] reconciliation rows (callers loop until empty)."""
+        inject = np.empty((max_rows, 8), np.int64)
+        m = self._lib.keydir_mirror_flush(
+            self._kd, inject.ctypes.data, max_rows)
+        return inject[:m]
+
+    def decide_one(self, key: str, hits: int, limit: int, duration: int,
+                   algorithm: int, behavior: int, now_ms: int = 0):
+        """Native lone decision against the mirror; None = miss (take the
+        kernel path). now_ms=0 reads the wall clock in C."""
+        b = key.encode("utf-8")
+        out = np.empty(4, np.int64)
+        hit = self._lib.keydir_decide_one(
+            self._kd, b, len(b), hits, limit, duration, algorithm,
+            behavior, now_ms, out.ctypes.data)
+        return tuple(out.tolist()) if hit else None
 
     def drop(self, key: str) -> None:
         b = key.encode("utf-8")

@@ -21,6 +21,8 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <ctime>
+#include <mutex>
 #include <string>
 #include <unordered_set>
 #include <vector>
@@ -38,6 +40,63 @@ inline uint64_t fnv1a(const char* data, int32_t len) {
     return h;
 }
 
+// Strict UTF-8 validation (overlongs, surrogates, >U+10FFFF rejected —
+// CPython-equivalent). The columnar prep takes raw wire bytes from an
+// unauthenticated port; a non-UTF-8 key must never enter the directory
+// (snapshot/dump decode keys as UTF-8, and the request-object path would
+// reject the same key — the tiers must agree).
+inline bool valid_utf8(const char* p, int32_t len) {
+    const uint8_t* s = reinterpret_cast<const uint8_t*>(p);
+    int32_t i = 0;
+    while (i < len) {
+        const uint8_t c = s[i];
+        if (c < 0x80) { i += 1; continue; }
+        if ((c & 0xE0) == 0xC0) {
+            if (c < 0xC2 || i + 1 >= len ||
+                (s[i + 1] & 0xC0) != 0x80) return false;
+            i += 2;
+        } else if ((c & 0xF0) == 0xE0) {
+            if (i + 2 >= len || (s[i + 1] & 0xC0) != 0x80 ||
+                (s[i + 2] & 0xC0) != 0x80) return false;
+            if (c == 0xE0 && s[i + 1] < 0xA0) return false;  // overlong
+            if (c == 0xED && s[i + 1] > 0x9F) return false;  // surrogate
+            i += 3;
+        } else if ((c & 0xF8) == 0xF0) {
+            if (c > 0xF4 || i + 3 >= len ||
+                (s[i + 1] & 0xC0) != 0x80 || (s[i + 2] & 0xC0) != 0x80 ||
+                (s[i + 3] & 0xC0) != 0x80) return false;
+            if (c == 0xF0 && s[i + 1] < 0x90) return false;  // overlong
+            if (c == 0xF4 && s[i + 1] > 0x8F) return false;  // >U+10FFFF
+            i += 4;
+        } else {
+            return false;
+        }
+    }
+    return true;
+}
+
+// ASCII fast path: one pass for the high bit, full validation only when set.
+inline bool key_bytes_ok(const char* p, int32_t len) {
+    bool ascii = true;
+    for (int32_t i = 0; i < len; ++i) ascii &= !(p[i] & 0x80);
+    return ascii || valid_utf8(p, len);
+}
+
+// Row mirror: host-resident copy of the key's device-table row, used by the
+// native lone-request fast path (keydir_decide_one) to decide WITHOUT a
+// kernel dispatch. Lifecycle: seeded from a device gather after a lone
+// miss; `valid` while no batch window has touched the key since; `dirty`
+// once a native decision mutated it — the next batch lookup emits the row
+// for injection into the device table (the reconciliation contract:
+// whoever looks a key up for a kernel window takes ownership of flushing
+// its mirror) and clears both flags. Row field order matches
+// ops/decide.py TableState: algo,limit,remaining,duration,stamp,expire,status.
+struct Mirror {
+    int64_t row[7];
+    bool valid = false;
+    bool dirty = false;
+};
+
 struct Entry {
     std::string key;
     int32_t slot = -1;
@@ -45,6 +104,7 @@ struct Entry {
     int32_t lru_next = -1;
     uint64_t pin_gen = 0;
     bool used = false;
+    Mirror mirror;
 };
 
 class KeyDir {
@@ -64,39 +124,64 @@ class KeyDir {
     // Assign (or find) slots for a batch of keys. fresh_out[i] = 1 when the
     // slot was newly assigned and the device row must be treated as vacant.
     // Returns number resolved (== n unless the batch over-commits capacity).
+    //
+    // Mirror reconciliation: a key about to enter a kernel window must not
+    // leave a live mirror behind — the device row becomes authoritative the
+    // moment the window dispatches. A dirty mirror (native decisions since
+    // the seed) is emitted into `inject` (8 i64 per row: slot + the 7 row
+    // values) for the engine to scatter into the device table BEFORE the
+    // window decides; a merely-valid mirror is just invalidated.
     int64_t lookup_batch(const char* data, const int64_t* offsets, int32_t n,
-                         int32_t* slots_out, uint8_t* fresh_out) {
+                         int32_t* slots_out, uint8_t* fresh_out,
+                         int64_t* inject = nullptr,
+                         int32_t* n_inject = nullptr) {
+        std::lock_guard<std::mutex> g(mu_);
         ++gen_;
+        int32_t ninj = 0;
         for (int32_t i = 0; i < n; ++i) {
             const char* key = data + offsets[i];
             const int32_t len = static_cast<int32_t>(offsets[i + 1] - offsets[i]);
             int32_t e = find(key, len);
             if (e >= 0) {
+                Entry& ent = entries_[e];
                 lru_touch(e);
-                entries_[e].pin_gen = gen_;
-                slots_out[i] = entries_[e].slot;
+                ent.pin_gen = gen_;
+                slots_out[i] = ent.slot;
                 fresh_out[i] = 0;
+                if (ent.mirror.valid) {
+                    if (ent.mirror.dirty && inject != nullptr) {
+                        int64_t* out = inject + 8 * ninj++;
+                        out[0] = ent.slot;
+                        std::memcpy(out + 1, ent.mirror.row,
+                                    7 * sizeof(int64_t));
+                    }
+                    ent.mirror.valid = ent.mirror.dirty = false;
+                }
                 continue;
             }
             e = allocate();
             if (e < 0) {  // over-committed: >capacity distinct keys pinned
                 for (int32_t j = i; j < n; ++j) slots_out[j] = -1;
+                if (n_inject != nullptr) *n_inject = ninj;
                 return i;
             }
             Entry& ent = entries_[e];
             ent.key.assign(key, len);
             ent.used = true;
             ent.pin_gen = gen_;
+            ent.mirror.valid = ent.mirror.dirty = false;
             insert_bucket(e);
             lru_push_front(e);
             slots_out[i] = ent.slot;
             fresh_out[i] = 1;
         }
+        if (n_inject != nullptr) *n_inject = ninj;
         return n;
     }
 
     // Forget a key, returning its slot to the free list.
     void drop(const char* key, int32_t len) {
+        std::lock_guard<std::mutex> g(mu_);
         int32_t e = find(key, len);
         if (e < 0) return;
         // unlink from the LRU before touching buckets: remove_bucket may
@@ -105,13 +190,122 @@ class KeyDir {
         remove_bucket(e);
         entries_[e].used = false;
         entries_[e].key.clear();
+        entries_[e].mirror.valid = entries_[e].mirror.dirty = false;
         free_.push_back(e);
     }
 
     // Peek a key's slot without recency effects; -1 if absent.
     int32_t peek(const char* key, int32_t len) const {
+        std::lock_guard<std::mutex> g(mu_);
         int32_t e = find(key, len);
         return e < 0 ? -1 : entries_[e].slot;
+    }
+
+    // Drain every dirty mirror (snapshot/shutdown coherence): emits up to
+    // max_rows reconciliation rows (slot + 7 values) and clears the flags.
+    // Returns the count; callers loop until 0.
+    int32_t mirror_flush(int64_t* inject, int32_t max_rows) {
+        std::lock_guard<std::mutex> g(mu_);
+        int32_t ninj = 0;
+        for (int32_t e = lru_head_; e >= 0 && ninj < max_rows;
+             e = entries_[e].lru_next) {
+            Mirror& m = entries_[e].mirror;
+            if (!m.dirty) continue;
+            int64_t* out = inject + 8 * ninj++;
+            out[0] = entries_[e].slot;
+            std::memcpy(out + 1, m.row, 7 * sizeof(int64_t));
+            m.valid = m.dirty = false;
+        }
+        return ninj;
+    }
+
+    // Seed a key's mirror from a freshly-gathered device row. Only
+    // meaningful for a live row; the caller gathers under the engine lock
+    // so the row is post-window-authoritative.
+    void mirror_seed(const char* key, int32_t len, const int64_t* row7) {
+        std::lock_guard<std::mutex> g(mu_);
+        int32_t e = find(key, len);
+        if (e < 0) return;
+        std::memcpy(entries_[e].mirror.row, row7, 7 * sizeof(int64_t));
+        entries_[e].mirror.valid = true;
+        entries_[e].mirror.dirty = false;
+    }
+
+    // The native lone-request fast path: decide against the key's mirror
+    // row with the exact oracle semantics (ops/oracle.py, the executable
+    // spec of algorithms.go) — no Python, no GIL, no kernel dispatch.
+    // Returns 1 and fills out4 = {status, limit, remaining, reset_time}
+    // when the mirror is live; 0 = miss (caller takes the kernel path).
+    int decide_one(const char* key, int32_t len, int64_t hits, int64_t limit,
+                   int64_t duration, int32_t algorithm, int32_t behavior,
+                   int64_t now, int64_t* out4) {
+        std::lock_guard<std::mutex> g(mu_);
+        int32_t e = find(key, len);
+        if (e < 0 || !entries_[e].mirror.valid) return 0;
+        Entry& ent = entries_[e];
+        int64_t* r = ent.mirror.row;  // algo,limit,rem,dur,stamp,expire,status
+        const bool reset_rem = (behavior & 8) != 0;  // RESET_REMAINING
+        const bool alive = r[0] == algorithm && now <= r[5];
+        if (!alive) return 0;  // vacant/expired/switched: kernel path creates
+        ent.mirror.dirty = true;
+        lru_touch(e);
+        if (algorithm == 0) {  // ---- token bucket (oracle_decide) ----
+            if (reset_rem) {
+                // "delete the bucket": a vacant row reconciles to device
+                r[0] = -1;
+                out4[0] = 0; out4[1] = limit; out4[2] = limit; out4[3] = 0;
+                return 1;
+            }
+            int64_t rem = (r[1] != limit && r[2] > limit) ? limit : r[2];
+            const int64_t new_exp = r[4] + duration;
+            const bool dur_changed = r[3] != duration;
+            if (dur_changed && new_exp < now) {
+                // expired-under-new-duration: recreate (kernel-path rules)
+                const bool over = hits > limit;
+                const int64_t nrem = over ? limit : limit - hits;
+                const int64_t exp = now + duration;
+                r[0] = 0; r[1] = limit; r[2] = nrem; r[3] = duration;
+                r[4] = now; r[5] = exp; r[6] = 0;
+                out4[0] = over ? 1 : 0; out4[1] = limit; out4[2] = nrem;
+                out4[3] = exp;
+                return 1;
+            }
+            const int64_t exp = dur_changed ? new_exp : r[5];
+            int64_t status_resp = r[6], status_store = r[6];
+            if (hits != 0) {
+                if (rem == 0) {
+                    status_resp = status_store = 1;
+                } else if (hits > rem) {
+                    status_resp = 1;
+                } else {
+                    rem -= hits;
+                }
+            }
+            r[1] = limit; r[2] = rem; r[3] = duration; r[5] = exp;
+            r[6] = status_store;
+            out4[0] = status_resp; out4[1] = limit; out4[2] = rem;
+            out4[3] = exp;
+            return 1;
+        }
+        // ---- leaky bucket (oracle_decide) ----
+        int64_t rem = reset_rem ? limit : r[2];
+        const int64_t lim_div = limit > 1 ? limit : 1;
+        int64_t rate = duration / lim_div;
+        if (rate < 1) rate = 1;
+        int64_t elapsed = now - r[4];
+        if (elapsed < 0) elapsed = 0;
+        rem += elapsed / rate;
+        if (rem > limit) rem = limit;
+        const bool rem_zero = rem == 0;
+        const bool over = hits > rem;
+        const bool deduct = hits != 0 && !rem_zero && !over;
+        if (!rem_zero && hits != 0) r[4] = now;
+        if (deduct) r[5] = now + duration;
+        const int64_t new_rem = deduct ? rem - hits : rem;
+        r[1] = limit; r[3] = duration; r[2] = new_rem;
+        out4[0] = (rem_zero || (hits != 0 && over)) ? 1 : 0;
+        out4[1] = limit; out4[2] = new_rem; out4[3] = now + rate;
+        return 1;
     }
 
     // Dump all (key, slot) pairs, MRU->LRU. Keys are written back-to-back
@@ -119,6 +313,7 @@ class KeyDir {
     // -needed_bytes when key_buf is too small.
     int64_t dump(char* key_buf, int64_t buf_cap, int64_t* offsets,
                  int32_t* slots, int64_t max_items) const {
+        std::lock_guard<std::mutex> g(mu_);
         int64_t nbytes = 0, count = 0;
         for (int32_t e = lru_head_; e >= 0; e = entries_[e].lru_next) {
             nbytes += static_cast<int64_t>(entries_[e].key.size());
@@ -137,7 +332,10 @@ class KeyDir {
         return count;
     }
 
-    int64_t size() const { return capacity_ - static_cast<int64_t>(free_.size()); }
+    int64_t size() const {
+        std::lock_guard<std::mutex> g(mu_);
+        return capacity_ - static_cast<int64_t>(free_.size());
+    }
     int64_t evictions() const { return evictions_; }
 
   private:
@@ -261,6 +459,11 @@ class KeyDir {
     }
 
     static constexpr int32_t TOMBSTONE = -2;
+    // Guards every public entry point. The engine's own (Python) lock
+    // already serializes batch callers; this mutex exists so the native
+    // lone-request fast path (decide_one, called from the peerlink IO
+    // thread WITHOUT the GIL) is atomic against them.
+    mutable std::mutex mu_;
     int64_t capacity_;
     uint64_t nbuckets_;
     std::vector<Entry> entries_;
@@ -281,9 +484,36 @@ void* keydir_new(int64_t capacity) { return new KeyDir(capacity); }
 void keydir_free(void* kd) { delete static_cast<KeyDir*>(kd); }
 
 int64_t keydir_lookup_batch(void* kd, const char* data, const int64_t* offsets,
-                            int32_t n, int32_t* slots_out, uint8_t* fresh_out) {
+                            int32_t n, int32_t* slots_out, uint8_t* fresh_out,
+                            int64_t* inject, int32_t* n_inject) {
     return static_cast<KeyDir*>(kd)->lookup_batch(data, offsets, n, slots_out,
-                                                  fresh_out);
+                                                  fresh_out, inject, n_inject);
+}
+
+void keydir_mirror_seed(void* kd, const char* key, int32_t len,
+                        const int64_t* row7) {
+    static_cast<KeyDir*>(kd)->mirror_seed(key, len, row7);
+}
+
+int32_t keydir_mirror_flush(void* kd, int64_t* inject, int32_t max_rows) {
+    return static_cast<KeyDir*>(kd)->mirror_flush(inject, max_rows);
+}
+
+// The native lone-request decision (see KeyDir::decide_one). Safe to call
+// WITHOUT the GIL from any thread — the KeyDir mutex serializes it against
+// batch lookups. now_ms <= 0 means "read the wall clock here".
+int32_t keydir_decide_one(void* kd, const char* key, int32_t len,
+                          int64_t hits, int64_t limit, int64_t duration,
+                          int32_t algorithm, int32_t behavior, int64_t now_ms,
+                          int64_t* out4) {
+    if (now_ms <= 0) {
+        struct timespec ts;
+        clock_gettime(CLOCK_REALTIME, &ts);
+        now_ms = static_cast<int64_t>(ts.tv_sec) * 1000 +
+                 ts.tv_nsec / 1000000;
+    }
+    return static_cast<KeyDir*>(kd)->decide_one(
+        key, len, hits, limit, duration, algorithm, behavior, now_ms, out4);
 }
 
 void keydir_drop(void* kd, const char* key, int32_t len) {
@@ -405,7 +635,8 @@ ParsedItem parse_item(PyObject* o, int64_t slow_mask) {
 int32_t keydir_prep_pack_fast(void* kd, PyObject* items, int64_t* packed,
                               int32_t width, int64_t greg_mask,
                               int32_t* lane_item, int32_t* leftover,
-                              int32_t* n_leftover_out) {
+                              int32_t* n_leftover_out,
+                              int64_t* inject, int32_t* n_inject) {
     PyObject* seq = PySequence_Fast(items, "prep_pack_fast expects a sequence");
     if (seq == nullptr) {
         PyErr_Clear();
@@ -464,7 +695,7 @@ int32_t keydir_prep_pack_fast(void* kd, PyObject* items, int64_t* packed,
     std::vector<uint8_t> fresh(n0);
     const int64_t done = static_cast<KeyDir*>(kd)->lookup_batch(
         arena.data(), offsets.data(), static_cast<int32_t>(n0),
-        slots.data(), fresh.data());
+        slots.data(), fresh.data(), inject, n_inject);
     if (done != n0) return -2;  // over-commit: python lookup raises here too
 
     int64_t* const row_slot = packed;
@@ -479,6 +710,107 @@ int32_t keydir_prep_pack_fast(void* kd, PyObject* items, int64_t* packed,
     for (Py_ssize_t i = 0; i < n0; ++i) row_fresh[i] = fresh[i];
     std::memcpy(lane_item, lanes.data(), n0 * sizeof(int32_t));
     return static_cast<int32_t>(n0);
+}
+
+// Columnar one-pass window prep: the same contract as keydir_prep_pack_fast
+// (validate -> first-occurrence round split -> directory lookup -> pack) but
+// the input is COLUMNS instead of RateLimitReq objects — exactly the arrays
+// the peerlink transport already produces (peerlink.cpp pls_next_batch):
+// a key arena (name bytes + unique_key bytes back to back per item, split
+// by name_len) plus int columns. No CPython API anywhere, so this is called
+// through CDLL with the GIL RELEASED — on a multicore host the peerlink
+// workers' preps overlap each other and the device.
+//
+// The engine key is name + '_' + unique_key (reference: client.go:33).
+// A lane demotes to the python-pipeline leftovers when: empty name or
+// unique_key, behavior & slow_mask (gregorian needs host calendar math;
+// GLOBAL / MULTI_REGION must peel off to the host managers), or a
+// duplicate occurrence (per-key sequential order).
+//
+// Returns n0 lanes packed into `packed` (zeroed i64[9, width], decide
+// staging rows), PREP_FALLBACK (n<=0 or n>width, nothing mutated), or
+// PREP_OVERCOMMIT.
+int32_t keydir_prep_pack_columnar(
+    void* kd, int32_t n, const char* keys, const int32_t* key_off,
+    const int32_t* name_len, const int64_t* hits, const int64_t* limit,
+    const int64_t* duration, const int32_t* algorithm,
+    const int32_t* behavior, int64_t slow_mask, int64_t* packed,
+    int32_t width, int32_t* lane_item, int32_t* leftover,
+    int32_t* n_leftover_out, int64_t* inject, int32_t* n_inject) {
+    if (n <= 0 || n > width) return -1;
+
+    std::string arena;          // '_'-joined engine keys, back to back
+    std::vector<int64_t> offsets;
+    std::vector<int32_t> lanes;
+    std::vector<int64_t> col(5 * static_cast<size_t>(n));
+    std::unordered_set<std::string> seen;  // same per-key order rule as
+    seen.reserve(n);                       // keydir_prep_pack_fast
+    offsets.reserve(n + 1);
+    offsets.push_back(0);
+    lanes.reserve(n);
+    arena.reserve(static_cast<size_t>(key_off[n] - key_off[0]) + n);
+    std::string key;
+    int32_t n_left = 0;
+    for (int32_t i = 0; i < n; ++i) {
+        const int32_t lo = key_off[i], hi = key_off[i + 1];
+        const int32_t nl = name_len[i], ul = hi - lo - nl;
+        // name and unique_key validate SEPARATELY: a multi-byte sequence
+        // straddling the boundary must not pass (each field decodes on its
+        // own in the request-object path — the tiers must agree)
+        bool ok = nl > 0 && ul > 0 && (behavior[i] & slow_mask) == 0 &&
+                  key_bytes_ok(keys + lo, nl) &&
+                  key_bytes_ok(keys + lo + nl, ul);
+        if (ok) {
+            key.assign(keys + lo, nl);
+            key.push_back('_');
+            key.append(keys + lo + nl, ul);
+            ok = seen.insert(key).second;
+        } else if (nl > 0 && ul > 0) {
+            // slow-mask lane: its key still enters `seen` so any LATER
+            // occurrence of the same key also demotes (per-key order)
+            key.assign(keys + lo, nl);
+            key.push_back('_');
+            key.append(keys + lo + nl, ul);
+            seen.insert(key);
+        }
+        if (ok) {
+            const size_t lane = lanes.size();
+            col[0 * n + lane] = hits[i];
+            col[1 * n + lane] = limit[i];
+            col[2 * n + lane] = duration[i];
+            col[3 * n + lane] = algorithm[i];
+            col[4 * n + lane] = behavior[i];
+            arena += key;
+            offsets.push_back(static_cast<int64_t>(arena.size()));
+            lanes.push_back(i);
+        } else {
+            leftover[n_left++] = i;
+        }
+    }
+    *n_leftover_out = n_left;
+    const int32_t n0 = static_cast<int32_t>(lanes.size());
+    if (n0 == 0) return 0;
+
+    std::vector<int32_t> slots(n0);
+    std::vector<uint8_t> fresh(n0);
+    const int64_t done = static_cast<KeyDir*>(kd)->lookup_batch(
+        arena.data(), offsets.data(), n0, slots.data(), fresh.data(),
+        inject, n_inject);
+    if (done != n0) return -2;
+
+    int64_t* const row_slot = packed;
+    for (int32_t i = 0; i < n0; ++i) row_slot[i] = slots[i];
+    for (int32_t i = n0; i < width; ++i) row_slot[i] = -1;
+    for (int f = 0; f < 5; ++f) {
+        std::memcpy(packed + (f + 1) * width, col.data() + f * n,
+                    static_cast<size_t>(n0) * sizeof(int64_t));
+    }
+    // rows 6/7 (gregorian) stay zero; row 8 = fresh flags
+    int64_t* const row_fresh = packed + 8 * width;
+    for (int32_t i = 0; i < n0; ++i) row_fresh[i] = fresh[i];
+    std::memcpy(lane_item, lanes.data(),
+                static_cast<size_t>(n0) * sizeof(int32_t));
+    return n0;
 }
 
 // Sharded variant of keydir_prep_pack_fast: one pass that ALSO routes each

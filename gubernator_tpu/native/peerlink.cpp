@@ -46,6 +46,7 @@
 // arrays; the IO thread serializes and writes them (eventfd-kicked).
 
 #include <arpa/inet.h>
+#include <atomic>
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
@@ -68,6 +69,15 @@
 namespace {
 
 constexpr uint32_t kMaxFrame = 4u << 20;  // 4 MB, > 1000-item batches
+
+// The native lone-request fast path (VERDICT r2 item 6): a 1-item
+// GetPeerRateLimits frame can be decided right here in the IO thread —
+// keydir.cpp's decide_one against the key's row mirror — and answered
+// without waking a Python worker, without the GIL, without a kernel
+// dispatch. The signature matches keydir_decide_one's C ABI.
+using NativeDecideFn = int (*)(void*, const char*, int32_t, int64_t,
+                               int64_t, int64_t, int32_t, int32_t, int64_t,
+                               int64_t*);
 
 struct Frame {
   uint64_t conn_token;
@@ -119,7 +129,59 @@ struct Server {
   std::map<uint64_t, std::unique_ptr<Conn>> conns;  // token -> conn
   uint64_t next_token = 1;
   int port = 0;
+
+  // native lone-request fast path (atomics: set after start, read by the
+  // IO thread without s->mu)
+  std::atomic<NativeDecideFn> native_fn{nullptr};
+  std::atomic<void*> native_kd{nullptr};
+  std::atomic<int64_t> native_slow_mask{0};
+  std::atomic<long long> native_hits{0};
 };
+
+bool direct_send(Server* s, Conn* c, const std::string& frame);
+
+// Try the native decision for a 1-item method-1 frame. Returns true when
+// the reply was written (frame fully served); false = take the queue.
+bool try_native_single(Server* s, Conn* c, const Frame& f) {
+  NativeDecideFn fn = s->native_fn.load(std::memory_order_acquire);
+  if (fn == nullptr || f.count != 1 || f.method != 1) return false;
+  const int32_t nl = f.name_len[0], ul = f.ukey_len[0];
+  if (nl <= 0 || ul <= 0) return false;
+  if ((int64_t)f.behavior[0] &
+      s->native_slow_mask.load(std::memory_order_relaxed)) {
+    return false;
+  }
+  char kbuf[2 * 1024 + 1];  // fields are <= 1024 B each (drain_inbuf)
+  memcpy(kbuf, f.keys.data(), (size_t)nl);
+  kbuf[nl] = '_';  // the engine key is name + '_' + unique_key
+  memcpy(kbuf + nl + 1, f.keys.data() + nl, (size_t)ul);
+  int64_t out4[4];
+  if (!fn(s->native_kd.load(std::memory_order_relaxed), kbuf, nl + 1 + ul,
+          f.hits[0], f.limit[0], f.duration[0], (int32_t)f.algorithm[0],
+          (int32_t)f.behavior[0], /*now_ms=*/0, out4)) {
+    return false;  // cold/invalidated mirror: kernel path + re-seed
+  }
+  s->native_hits.fetch_add(1, std::memory_order_relaxed);
+  // 1-item reply frame, written straight from the IO thread
+  const uint16_t cnt = 1;
+  const uint32_t len = 11 + (4 + 8 + 8 + 8 + 2);
+  const int32_t status = (int32_t)out4[0];
+  const uint16_t elen = 0;
+  std::string frame;
+  frame.reserve(4 + len);
+  frame.append((const char*)&len, 4);
+  frame.append((const char*)&f.rid, 8);
+  frame.push_back((char)f.method);
+  frame.append((const char*)&cnt, 2);
+  frame.append((const char*)&status, 4);
+  frame.append((const char*)&out4[1], 8);  // limit
+  frame.append((const char*)&out4[2], 8);  // remaining
+  frame.append((const char*)&out4[3], 8);  // reset
+  frame.append((const char*)&elen, 2);
+  std::lock_guard<std::mutex> g(s->mu);
+  direct_send(s, c, frame);
+  return true;
+}
 
 void set_nonblock(int fd) {
   int fl = fcntl(fd, F_GETFL, 0);
@@ -188,6 +250,7 @@ bool drain_inbuf(Server* s, Conn* c) {
     if (!rd_vec(p, end, &f.behavior, count)) return false;
     if (p != end) return false;
     off += 4 + len;
+    if (try_native_single(s, c, f)) continue;  // answered in-thread
     {
       std::lock_guard<std::mutex> g(s->mu);
       PendingReply& pr = c->pending[f.rid];
@@ -529,5 +592,19 @@ void pls_send_responses(void* h, int n, const unsigned long long* conn_token,
 }
 
 int pls_port(void* h) { return ((Server*)h)->port; }
+
+// Enable the native lone-request fast path: `fn` is keydir_decide_one's
+// address, `kd` the engine's KeyDir handle, `slow_mask` the behavior bits
+// that must take the Python path (gregorian, GLOBAL, MULTI_REGION).
+void pls_set_native(void* h, void* fn, void* kd, long long slow_mask) {
+  auto* s = (Server*)h;
+  s->native_kd.store(kd, std::memory_order_relaxed);
+  s->native_slow_mask.store(slow_mask, std::memory_order_relaxed);
+  s->native_fn.store((NativeDecideFn)fn, std::memory_order_release);
+}
+
+long long pls_native_hits(void* h) {
+  return ((Server*)h)->native_hits.load(std::memory_order_relaxed);
+}
 
 }  // extern "C"

@@ -128,6 +128,16 @@ class Instance:
             None if group_peers is None else frozenset(group_peers))
         self._recompute_collective_coverage()
 
+    def columnar_backend(self):
+        """The backend when it offers the zero-object columnar serving
+        path (models/engine.py submit_columnar), else None. Used by the
+        peerlink server to keep wire columns columnar end to end."""
+        b = self.backend
+        try:
+            return b if b.supports_columnar() else None
+        except AttributeError:
+            return None
+
     def _in_collective_group(self, address: str) -> bool:
         g = self._collective_group
         return g is None or address in g or address == self.advertise_address

@@ -29,12 +29,13 @@ import struct
 import threading
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
-from typing import Dict, List, Sequence
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
 from gubernator_tpu.types import (
     MAX_BATCH_SIZE,
+    SLOW_PATH_BEHAVIOR_MASK as _COLUMNAR_SLOW_MASK,
     RateLimitReq,
     RateLimitResp,
 )
@@ -43,6 +44,7 @@ log = logging.getLogger("gubernator_tpu.peerlink")
 
 METHOD_GET_RATE_LIMITS = 0
 METHOD_GET_PEER_RATE_LIMITS = 1
+
 
 # Columnar wire layout (see native/peerlink.cpp): fields ride as arrays,
 # encoded/decoded with numpy bulk ops — per-item marshalling cost is what
@@ -298,6 +300,7 @@ class PeerLinkService:
     KEY_CAP = 2 << 20  # > one max frame's keys (4096 items x 255 B)
 
     def __init__(self, instance, port: int = 0, workers: int = 2):
+        from gubernator_tpu import native
         from gubernator_tpu.native import load_peerlink
 
         self._lib = load_peerlink()
@@ -308,6 +311,21 @@ class PeerLinkService:
         self.port = bound.value
         self.instance = instance
         self.stats = {"batches": 0, "requests": 0, "errors": 0}
+        # native lone-request fast path: 1-item peer-hop frames decide in
+        # the C++ IO thread against the engine's directory row mirrors
+        # (keydir.cpp decide_one) — no Python wakeup, no kernel dispatch.
+        # Misses fall through to the worker path below, which re-seeds.
+        self._seed_engine = None
+        cb = getattr(instance, "columnar_backend", None)
+        eng = cb() if callable(cb) else None
+        if eng is not None and hasattr(eng, "seed_mirror") and \
+                hasattr(eng.directory, "_kd"):
+            kd_lib = native.load_library()
+            fn = ctypes.cast(kd_lib.keydir_decide_one,
+                             ctypes.c_void_p).value
+            self._lib.pls_set_native(
+                self._handle, fn, eng.directory._kd, _COLUMNAR_SLOW_MASK)
+            self._seed_engine = eng
         self._stop = False
         self._threads = []
         for i in range(workers):
@@ -315,6 +333,10 @@ class PeerLinkService:
                                  daemon=True)
             t.start()
             self._threads.append(t)
+
+    def native_hits(self) -> int:
+        """Lone requests answered by the C++ IO thread (no Python)."""
+        return int(self._lib.pls_native_hits(self._handle))
 
     def close(self) -> None:
         self._stop = True
@@ -398,39 +420,21 @@ class PeerLinkService:
 
     def _handle_batch(self, got: int, b: dict) -> bytes:
         """Decode -> handler calls -> fill the reusable response buffers.
-        Returns the concatenated error-string buffer."""
+        Returns the concatenated error-string buffer.
+
+        Peer-hop chunks ride the COLUMNAR path when the backend offers it
+        (Engine.submit_columnar): the wire columns go through the GIL-free
+        C prep straight to the device and the response rows scatter back
+        into these buffers — no RateLimitReq/RateLimitResp objects at all
+        on the hot path. Items the columnar prep can't take (invalid,
+        gregorian, GLOBAL/MULTI_REGION, duplicate occurrences) run through
+        the request-object path AFTER the packed round."""
         self.stats["batches"] += 1
         self.stats["requests"] += got
-        # one C-level tolist per column beats per-item numpy scalar casts
-        koff = b["key_off"][:got + 1].tolist()
-        nlen = b["name_len"][:got].tolist()
-        hits = b["hits"][:got].tolist()
-        limit = b["limit"][:got].tolist()
-        duration = b["duration"][:got].tolist()
-        algorithm = b["algorithm"][:got].tolist()
-        behavior = b["behavior"][:got].tolist()
         method = b["method"]
-        raw_keys = b["keys"]
-        # None marks an item whose wire bytes are invalid (the link port is
-        # unauthenticated: one crafted non-UTF-8 key must produce a per-item
-        # error reply, never kill the whole aggregated pull)
-        reqs: List[RateLimitReq | None] = []
-        for j in range(got):
-            lo, hi = koff[j], koff[j + 1]
-            split = lo + nlen[j]
-            try:
-                reqs.append(RateLimitReq(
-                    name=raw_keys[lo:split].decode(),
-                    unique_key=raw_keys[split:hi].decode(), hits=hits[j],
-                    limit=limit[j], duration=duration[j],
-                    algorithm=algorithm[j], behavior=behavior[j]))
-            except UnicodeDecodeError:
-                reqs.append(None)
-
-        status, r_limit = b["status"], b["r_limit"]
-        r_remaining, r_reset, err_off = b["r_remaining"], b["r_reset"], b["err_off"]
-        err_parts: List[bytes] = []
-        err_len = 0
+        errs: List[tuple] = []  # (item index, error bytes), ascending
+        cb = getattr(self.instance, "columnar_backend", None)
+        eng = cb() if callable(cb) else None
 
         # one handler call per contiguous same-method run (chunked at the
         # batch cap — the aggregation may have merged many frames)
@@ -440,37 +444,157 @@ class PeerLinkService:
             k = j
             while k < got and int(method[k]) == m and k - j < MAX_BATCH_SIZE:
                 k += 1
-            chunk = reqs[j:k]
-            good = [r for r in chunk if r is not None]
-            try:
-                if not good:
-                    handled = []
-                elif m == METHOD_GET_PEER_RATE_LIMITS:
-                    # this worker's pull IS the batch window: go straight to
-                    # the backend (owner semantics preserved; combiner hop
-                    # saved — see Instance.apply_owner_batch_direct)
-                    handled = self.instance.apply_owner_batch_direct(
-                        good, from_peer_rpc=True)
-                else:
-                    handled = self.instance.get_rate_limits(good)
-            except Exception as e:  # noqa: BLE001 — per-item error replies
-                handled = [RateLimitResp(error=str(e)) for _ in good]
-            if len(good) == len(chunk):
-                resps = handled
-            else:  # scatter handler results back around the bad items
-                it = iter(handled)
-                resps = [RateLimitResp(error="invalid utf-8 in key")
-                         if r is None else next(it) for r in chunk]
-            for o, resp in enumerate(resps):
-                i = j + o
-                status[i] = int(resp.status)
-                r_limit[i] = resp.limit
-                r_remaining[i] = resp.remaining
-                r_reset[i] = resp.reset_time
-                if resp.error:
-                    e = resp.error.encode()
-                    err_parts.append(e)
-                    err_len += len(e)
-                err_off[i + 1] = err_len
+            if not (m == METHOD_GET_PEER_RATE_LIMITS and eng is not None
+                    and self._columnar_chunk(eng, j, k, b, errs)):
+                self._object_chunk(m, j, k, b, errs)
             j = k
-        return b"".join(err_parts)
+
+        if got == 1 and self._seed_engine is not None and \
+                int(method[0]) == METHOD_GET_PEER_RATE_LIMITS and \
+                not (int(b["behavior"][0]) & _COLUMNAR_SLOW_MASK):
+            # a lone peer-hop reached Python = the IO-thread fast path
+            # missed (cold/invalidated mirror). Seed it so the NEXT lone
+            # request for this key decides natively.
+            try:
+                lo, hi = int(b["key_off"][0]), int(b["key_off"][1])
+                split = lo + int(b["name_len"][0])
+                self._seed_engine.seed_mirror(
+                    b["keys"][lo:split].decode() + "_"
+                    + b["keys"][split:hi].decode())
+            except Exception:  # noqa: BLE001 — seeding is best-effort
+                pass
+
+        # error-offset fill: errors are sparse; one vectorized prefix sum
+        err_off = b["err_off"]
+        if not errs:
+            err_off[1:got + 1] = 0
+            return b""
+        errs.sort(key=lambda t: t[0])
+        lens = np.zeros(got, np.int64)
+        for i, e in errs:
+            lens[i] = len(e)
+        err_off[1:got + 1] = np.cumsum(lens)
+        return b"".join(e for _, e in errs)
+
+    def _columnar_chunk(self, eng, j: int, k: int, b: dict,
+                        errs: list) -> bool:
+        """Serve one peer-hop chunk columnar-end-to-end. False = the
+        engine can't take this window shape (caller falls back, nothing
+        mutated)."""
+        n = k - j
+        try:
+            handle = eng.submit_columnar(
+                n, b["keys"], b["key_off"][j:k + 1], b["name_len"][j:k],
+                b["hits"][j:k], b["limit"][j:k], b["duration"][j:k],
+                b["algorithm"][j:k], b["behavior"][j:k],
+                _COLUMNAR_SLOW_MASK)
+        except Exception as e:  # noqa: BLE001 — e.g. directory over-commit
+            msg = str(e).encode()
+            b["status"][j:k] = 0
+            b["r_limit"][j:k] = 0
+            b["r_remaining"][j:k] = 0
+            b["r_reset"][j:k] = 0
+            errs.extend((i, msg) for i in range(j, k))
+            return True
+        if handle is None:
+            return False
+        leftover = eng.complete_columnar(
+            handle, b["status"][j:k], b["r_limit"][j:k],
+            b["r_remaining"][j:k], b["r_reset"][j:k])
+        if len(leftover):
+            self._leftover_items(j, leftover.tolist(), b, errs)
+        return True
+
+    def _leftover_items(self, j: int, rel_idx: List[int], b: dict,
+                        errs: list) -> None:
+        """Request-object tail of a columnar chunk: the lanes the C prep
+        demoted (invalid, gregorian, GLOBAL/MULTI_REGION, duplicates).
+        Runs AFTER the packed round, preserving per-key order."""
+        idxs = [j + r for r in rel_idx]
+        reqs, good_idx = [], []
+        koff = b["key_off"]
+        nlen = b["name_len"]
+        raw_keys = b["keys"]
+        for i in idxs:
+            lo, hi = int(koff[i]), int(koff[i + 1])
+            split = lo + int(nlen[i])
+            try:
+                reqs.append(RateLimitReq(
+                    name=raw_keys[lo:split].decode(),
+                    unique_key=raw_keys[split:hi].decode(),
+                    hits=int(b["hits"][i]), limit=int(b["limit"][i]),
+                    duration=int(b["duration"][i]),
+                    algorithm=int(b["algorithm"][i]),
+                    behavior=int(b["behavior"][i])))
+                good_idx.append(i)
+            except UnicodeDecodeError:
+                self._fill_one(b, i, RateLimitResp(
+                    error="invalid utf-8 in key"), errs)
+        if not reqs:
+            return
+        try:
+            resps = self.instance.apply_owner_batch_direct(
+                reqs, from_peer_rpc=True)
+        except Exception as e:  # noqa: BLE001
+            resps = [RateLimitResp(error=str(e)) for _ in reqs]
+        for i, resp in zip(good_idx, resps):
+            self._fill_one(b, i, resp, errs)
+
+    @staticmethod
+    def _fill_one(b: dict, i: int, resp: RateLimitResp, errs: list) -> None:
+        b["status"][i] = int(resp.status)
+        b["r_limit"][i] = resp.limit
+        b["r_remaining"][i] = resp.remaining
+        b["r_reset"][i] = resp.reset_time
+        if resp.error:
+            errs.append((i, resp.error.encode()))
+
+    def _object_chunk(self, m: int, j: int, k: int, b: dict,
+                      errs: list) -> None:
+        """The request-object path (non-peer-hop methods, or no columnar
+        backend): decode -> one handler call -> fill."""
+        koff = b["key_off"][j:k + 1].tolist()
+        nlen = b["name_len"][j:k].tolist()
+        hits = b["hits"][j:k].tolist()
+        limit = b["limit"][j:k].tolist()
+        duration = b["duration"][j:k].tolist()
+        algorithm = b["algorithm"][j:k].tolist()
+        behavior = b["behavior"][j:k].tolist()
+        raw_keys = b["keys"]
+        # None marks an item whose wire bytes are invalid (the link port is
+        # unauthenticated: one crafted non-UTF-8 key must produce a
+        # per-item error reply, never kill the whole aggregated pull)
+        reqs: List[Optional[RateLimitReq]] = []
+        for o in range(k - j):
+            lo, hi = koff[o], koff[o + 1]
+            split = lo + nlen[o]
+            try:
+                reqs.append(RateLimitReq(
+                    name=raw_keys[lo:split].decode(),
+                    unique_key=raw_keys[split:hi].decode(), hits=hits[o],
+                    limit=limit[o], duration=duration[o],
+                    algorithm=algorithm[o], behavior=behavior[o]))
+            except UnicodeDecodeError:
+                reqs.append(None)
+        good = [r for r in reqs if r is not None]
+        try:
+            if not good:
+                handled = []
+            elif m == METHOD_GET_PEER_RATE_LIMITS:
+                # this worker's pull IS the batch window: go straight to
+                # the backend (owner semantics preserved; combiner hop
+                # saved — see Instance.apply_owner_batch_direct)
+                handled = self.instance.apply_owner_batch_direct(
+                    good, from_peer_rpc=True)
+            else:
+                handled = self.instance.get_rate_limits(good)
+        except Exception as e:  # noqa: BLE001 — per-item error replies
+            handled = [RateLimitResp(error=str(e)) for _ in good]
+        if len(good) == len(reqs):
+            resps = handled
+        else:  # scatter handler results back around the bad items
+            it = iter(handled)
+            resps = [RateLimitResp(error="invalid utf-8 in key")
+                     if r is None else next(it) for r in reqs]
+        for o, resp in enumerate(resps):
+            self._fill_one(b, j + o, resp, errs)

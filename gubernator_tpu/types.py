@@ -35,6 +35,16 @@ class Behavior(enum.IntFlag):
     MULTI_REGION = 16
 
 
+# Behaviors the native fast paths (columnar prep, lone-request decide_one)
+# must hand to the request-object pipeline: gregorian needs host calendar
+# math; GLOBAL / MULTI_REGION peel off to the host managers before the
+# backend sees them. ONE definition — the engine gate, the columnar prep
+# mask, and the peerlink IO-thread mask must never drift apart.
+SLOW_PATH_BEHAVIOR_MASK = (int(Behavior.DURATION_IS_GREGORIAN)
+                           | int(Behavior.GLOBAL)
+                           | int(Behavior.MULTI_REGION))
+
+
 class Status(enum.IntEnum):
     """Rate limit decision (reference: proto/gubernator.proto:161-164)."""
 

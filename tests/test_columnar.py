@@ -1,0 +1,231 @@
+"""The zero-object columnar serving path (VERDICT r2 item 1).
+
+Engine.submit_columnar/complete_columnar take the peerlink wire columns
+through the GIL-free C prep (native/keydir.cpp keydir_prep_pack_columnar)
+straight into the staging buffer and onto the device — no RateLimitReq /
+RateLimitResp objects on the hot path. The correctness bar: bit-exact
+equivalence with the request-object path on any workload, with the lanes
+the C pass can't take (invalid, gregorian, masked behaviors, duplicate
+occurrences) demoted to leftovers that the object path answers AFTER the
+packed round (per-key sequential order).
+"""
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.models.engine import Engine
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq
+
+NOW = 1_700_000_000_000
+SLOW = (int(Behavior.DURATION_IS_GREGORIAN) | int(Behavior.GLOBAL)
+        | int(Behavior.MULTI_REGION))
+
+
+def cols_from(reqs):
+    """Build the peerlink wire layout from request objects."""
+    names = [r.name.encode() for r in reqs]
+    ukeys = [r.unique_key.encode() for r in reqs]
+    keys = b"".join(a + b for a, b in zip(names, ukeys))
+    off = np.zeros(len(reqs) + 1, np.int32)
+    np.cumsum([len(a) + len(b) for a, b in zip(names, ukeys)],
+              out=off[1:])
+    return dict(
+        n=len(reqs), keys=keys, key_off=off,
+        name_len=np.array([len(a) for a in names], np.int32),
+        hits=np.array([r.hits for r in reqs], np.int64),
+        limit=np.array([r.limit for r in reqs], np.int64),
+        duration=np.array([r.duration for r in reqs], np.int64),
+        algorithm=np.array([int(r.algorithm) for r in reqs], np.int32),
+        behavior=np.array([int(r.behavior) for r in reqs], np.int32))
+
+
+def run_columnar(eng, reqs, now_ms):
+    """Drive one window through submit/complete + object-path leftovers,
+    returning (status, limit, remaining, reset) per item."""
+    c = cols_from(reqs)
+    n = c["n"]
+    st = np.zeros(n, np.int32)
+    li = np.zeros(n, np.int64)
+    re = np.zeros(n, np.int64)
+    rs = np.zeros(n, np.int64)
+    h = eng.submit_columnar(
+        n, c["keys"], c["key_off"], c["name_len"], c["hits"], c["limit"],
+        c["duration"], c["algorithm"], c["behavior"], SLOW, now_ms=now_ms)
+    assert h is not None
+    left = eng.complete_columnar(h, st, li, re, rs)
+    for i in left.tolist():
+        r = eng.get_rate_limits([reqs[i]], now_ms=now_ms)[0]
+        st[i], li[i], re[i], rs[i] = (r.status, r.limit, r.remaining,
+                                      r.reset_time)
+    return st, li, re, rs
+
+
+@pytest.fixture(scope="module")
+def engines():
+    a = Engine(capacity=4096, min_width=16, max_width=256)
+    b = Engine(capacity=4096, min_width=16, max_width=256)
+    a.warmup()
+    b.warmup()
+    return a, b
+
+
+class TestColumnarDifferential:
+    def test_random_workload_bit_exact(self, engines):
+        """Random batches (duplicates, both algorithms, RESET_REMAINING,
+        gregorian lanes, zero-hit peeks) through both paths on twin
+        engines must agree on every field."""
+        a, b = engines
+        rng = np.random.default_rng(11)
+        for it in range(25):
+            n = int(rng.integers(1, 200))
+            reqs = []
+            for _ in range(n):
+                beh = 0
+                if rng.random() < 0.1:
+                    beh |= int(Behavior.RESET_REMAINING)
+                if rng.random() < 0.05:
+                    beh |= int(Behavior.DURATION_IS_GREGORIAN)
+                reqs.append(RateLimitReq(
+                    name="cd", unique_key=f"k{rng.integers(0, 50)}",
+                    hits=int(rng.integers(0, 3)), limit=25,
+                    duration=60_000,
+                    algorithm=(Algorithm.TOKEN_BUCKET if rng.random() < .7
+                               else Algorithm.LEAKY_BUCKET),
+                    behavior=beh))
+            now = NOW + it * 500
+            want = a.get_rate_limits(reqs, now_ms=now)
+            st, li, re, rs = run_columnar(b, reqs, now)
+            for i, w in enumerate(want):
+                got = (st[i], li[i], re[i], rs[i])
+                assert got == (w.status, w.limit, w.remaining,
+                               w.reset_time), (it, i, reqs[i], got, w)
+
+    def test_duplicate_keys_keep_sequential_order(self, engines):
+        _, b = engines
+        reqs = [RateLimitReq(name="dup", unique_key="one", hits=1, limit=5,
+                             duration=60_000) for _ in range(7)]
+        st, _, re, _ = run_columnar(b, reqs, NOW)
+        # 7 hits against limit 5: remaining 4,3,2,1,0 then OVER_LIMIT
+        assert re.tolist() == [4, 3, 2, 1, 0, 0, 0]
+        assert st.tolist() == [0, 0, 0, 0, 0, 1, 1]
+
+    def test_masked_behaviors_and_invalid_demote(self, engines):
+        """GLOBAL-flagged, empty-key, and non-UTF-8 lanes all come back as
+        leftovers; clean lanes pack."""
+        _, b = engines
+        reqs = [
+            RateLimitReq(name="m", unique_key="clean", hits=1, limit=9,
+                         duration=60_000),
+            RateLimitReq(name="m", unique_key="glb", hits=1, limit=9,
+                         duration=60_000,
+                         behavior=int(Behavior.GLOBAL)),
+            RateLimitReq(name="", unique_key="noname", hits=1, limit=9,
+                         duration=60_000),
+        ]
+        c = cols_from(reqs)
+        n = c["n"]
+        bufs = [np.zeros(n, np.int32), np.zeros(n, np.int64),
+                np.zeros(n, np.int64), np.zeros(n, np.int64)]
+        h = b.submit_columnar(
+            n, c["keys"], c["key_off"], c["name_len"], c["hits"],
+            c["limit"], c["duration"], c["algorithm"], c["behavior"],
+            SLOW, now_ms=NOW)
+        left = b.complete_columnar(h, *bufs)
+        assert left.tolist() == [1, 2]
+        assert bufs[2][0] == 8  # the clean lane decided
+
+    def test_non_utf8_key_never_enters_directory(self, engines):
+        """Crafted wire bytes: invalid UTF-8 must demote (the directory's
+        dump/snapshot path decodes UTF-8, and the object path rejects the
+        same key — the tiers must agree)."""
+        _, b = engines
+        keys = b"nm\xff\xfe"  # name="nm", unique_key=\xff\xfe
+        h = b.submit_columnar(
+            1, keys, np.array([0, 4], np.int32), np.array([2], np.int32),
+            np.ones(1, np.int64), np.full(1, 9, np.int64),
+            np.full(1, 60_000, np.int64), np.zeros(1, np.int32),
+            np.zeros(1, np.int32), SLOW, now_ms=NOW)
+        bufs = [np.zeros(1, np.int32), np.zeros(1, np.int64),
+                np.zeros(1, np.int64), np.zeros(1, np.int64)]
+        left = b.complete_columnar(h, *bufs)
+        assert left.tolist() == [0]
+        assert all("\xff" not in k for k in b.directory.keys())
+
+    def test_pipelined_windows_chain_state(self, engines):
+        """Two windows in flight (submit N+1 before completing N): the
+        device state chain keeps them sequential."""
+        _, b = engines
+        def win(key, hits):
+            reqs = [RateLimitReq(name="pipe", unique_key=key, hits=hits,
+                                 limit=10, duration=60_000)]
+            c = cols_from(reqs)
+            return b.submit_columnar(
+                1, c["keys"], c["key_off"], c["name_len"], c["hits"],
+                c["limit"], c["duration"], c["algorithm"], c["behavior"],
+                SLOW, now_ms=NOW)
+        h1 = win("pk", 4)
+        h2 = win("pk", 3)  # dispatched before h1 is read back
+        bufs = lambda: [np.zeros(1, np.int32), np.zeros(1, np.int64),
+                        np.zeros(1, np.int64), np.zeros(1, np.int64)]
+        b1, b2 = bufs(), bufs()
+        b.complete_columnar(h1, *b1)
+        b.complete_columnar(h2, *b2)
+        assert b1[2][0] == 6   # 10 - 4
+        assert b2[2][0] == 3   # then - 3
+
+    def test_width_overflow_returns_none(self, engines):
+        _, b = engines
+        reqs = [RateLimitReq(name="w", unique_key=f"o{i}", hits=1, limit=9,
+                             duration=60_000) for i in range(300)]
+        c = cols_from(reqs)
+        h = b.submit_columnar(
+            c["n"], c["keys"], c["key_off"], c["name_len"], c["hits"],
+            c["limit"], c["duration"], c["algorithm"], c["behavior"],
+            SLOW, now_ms=NOW)
+        assert h is None  # 300 > max_width 256: caller falls back
+
+
+class TestPeerlinkColumnar:
+    def test_link_rides_columnar_end_to_end(self):
+        """A peerlink peer-hop batch is served by the columnar path (no
+        request objects): engine counters move, gRPC-tier semantics hold,
+        and a GLOBAL-flagged lane still peels off to the global manager."""
+        from gubernator_tpu.cluster.harness import LocalCluster  # noqa: F401
+        from gubernator_tpu.service.config import InstanceConfig
+        from gubernator_tpu.service.instance import Instance
+        from gubernator_tpu.service.peerlink import (
+            METHOD_GET_PEER_RATE_LIMITS,
+            PeerLinkClient,
+            PeerLinkService,
+        )
+
+        eng = Engine(capacity=2048, min_width=16, max_width=256)
+        eng.warmup()
+        inst = Instance(InstanceConfig(backend=eng),
+                        advertise_address="self")
+        assert inst.columnar_backend() is eng
+        svc = PeerLinkService(inst, port=0)
+        cli = PeerLinkClient(f"127.0.0.1:{svc.port}")
+        try:
+            reqs = [RateLimitReq(name="plc", unique_key=f"c{i % 5}", hits=1,
+                                 limit=3, duration=60_000)
+                    for i in range(20)]
+            out = cli.call(METHOD_GET_PEER_RATE_LIMITS, reqs, 10.0)
+            # 4 hits per key against limit 3: the 4th is OVER_LIMIT
+            per_key = {}
+            for r, o in zip(reqs, out):
+                per_key.setdefault(r.unique_key, []).append(o)
+            for outs in per_key.values():
+                assert [o.remaining for o in outs] == [2, 1, 0, 0]
+                assert outs[-1].status == 1
+            # GLOBAL lane peels to the manager via the leftover path
+            g = RateLimitReq(name="plc", unique_key="gkey", hits=1,
+                             limit=5, duration=60_000,
+                             behavior=int(Behavior.GLOBAL))
+            r = cli.call(METHOD_GET_PEER_RATE_LIMITS, [g], 10.0)[0]
+            assert r.error == "" and r.remaining == 4
+            assert "plc_gkey" in inst.global_manager._broadcasts._pending
+        finally:
+            cli.close()
+            svc.close()
+            inst.close()

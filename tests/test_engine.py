@@ -339,14 +339,38 @@ class TestScannedRounds:
             want = small.get_rate_limits(b, now_ms=NOW + k * 1000)
             assert got == want
 
-    def test_store_disables_scan(self):
+    def test_store_rides_scan_with_batched_hooks(self):
+        """VERDICT r2 item 5: a Store no longer disables scan dispatch —
+        the hooks batch to ONE read-through before the tail and ONE
+        write-through after it with the key's final row (the reference
+        pays one OnChange per hit, algorithms.go:64-68; PARITY #8)."""
         store = MockStore()
         eng = Engine(capacity=2048, min_width=8, max_width=64, store=store)
+        rounds_before = eng.stats.rounds
         rs = eng.get_rate_limits([req(key="sd", hits=2, limit=10)
                                   for _ in range(4)], now_ms=NOW)
         assert [r.remaining for r in rs] == [8, 6, 4, 2]
-        # write-through fired once per round, as the per-round path does
-        assert store.called["on_change"] == 4
+        # 4 duplicate rounds retired in ONE scan dispatch, not 4
+        assert eng.stats.rounds - rounds_before == 4
+        assert eng.stats.stage_ns["device"] > 0
+        # one get (miss) + one batched on_change with the FINAL state
+        assert store.called["get"] == 1
+        assert store.called["on_change"] == 1
+        assert store.data["test_sd"].remaining == 2
+
+    def test_store_scan_read_through_restores(self):
+        """Keys missing from the table but present in the store must be
+        injected before the scan tail decides them."""
+        store = MockStore()
+        store.data["test_sr"] = BucketSnapshot(
+            key="test_sr", algo=0, limit=10, remaining=3, duration=60_000,
+            stamp=NOW - 1000, expire_at=NOW + 59_000)
+        eng = Engine(capacity=2048, min_width=8, max_width=64, store=store)
+        rs = eng.get_rate_limits([req(key="sr", hits=1, limit=10)
+                                  for _ in range(3)], now_ms=NOW)
+        # resumes from remaining=3, not a fresh bucket
+        assert [r.remaining for r in rs] == [2, 1, 0]
+        assert store.data["test_sr"].remaining == 0
 
     def test_herd_33_singleton_group(self):
         # 33 windows -> scan groups [32, 1]; the singleton takes the
