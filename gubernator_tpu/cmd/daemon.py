@@ -215,6 +215,7 @@ def main(argv=None) -> int:
         channel = CollectiveGlobalChannel(conf.cross_host_capacity)
         collective = CollectiveGlobalSync(
             instance, channel, interval_s=conf.cross_host_sync_s,
+            stall_timeout_s=conf.cross_host_stall_s,
             slot_candidates=conf.cross_host_candidates,
             claim_secret=(conf.cross_host_secret or "").encode())
         # GUBER_CROSS_HOST_GROUP lists the advertise addresses inside the

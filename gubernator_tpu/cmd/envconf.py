@@ -150,6 +150,7 @@ class DaemonConfig:
     cross_host_sync_s: float = 0.1
     cross_host_capacity: int = 1024
     cross_host_candidates: int = 4
+    cross_host_stall_s: float = 10.0
     cross_host_secret: str = ""
     cross_host_group: List[str] = dataclasses.field(default_factory=list)
     debug: bool = False
@@ -226,6 +227,7 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         cross_host_sync_s=_env_dur("GUBER_CROSS_HOST_SYNC", 0.1),
         cross_host_capacity=_env_int("GUBER_CROSS_HOST_CAPACITY", 1024),
         cross_host_candidates=_env_int("GUBER_CROSS_HOST_CANDIDATES", 4),
+        cross_host_stall_s=_env_dur("GUBER_CROSS_HOST_STALL", 10.0),
         cross_host_secret=_env_str("GUBER_CROSS_HOST_SECRET"),
         cross_host_group=_env_slice("GUBER_CROSS_HOST_GROUP"),
         debug=opts.debug or bool(os.environ.get("GUBER_DEBUG")),

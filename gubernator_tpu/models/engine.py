@@ -386,11 +386,12 @@ class Engine:
             out_limit[lane_item] = rows[1, :n0]
             out_remaining[lane_item] = rows[2, :n0]
             out_reset[lane_item] = rows[3, :n0]
-            self.stats.over_limit += int(
-                np.count_nonzero(rows[0, :n0] == 1))
+            over = int(np.count_nonzero(rows[0, :n0] == 1))
             t2 = time.perf_counter_ns()
-            self.stats.stage_ns["device"] += t1 - t0
-            self.stats.stage_ns["demux"] += t2 - t1
+            with self._lock:  # concurrent completers: counters stay exact
+                self.stats.over_limit += over
+                self.stats.stage_ns["device"] += t1 - t0
+                self.stats.stage_ns["demux"] += t2 - t1
         return leftover
 
     # --------------------------------------------- native lone-request path
@@ -577,7 +578,6 @@ class Engine:
         a tunneled device) per dispatch, while the kernel body is cheap."""
         stage = self.stats.stage_ns
         width = self.min_width  # _split_scannable guarantees every window fits
-        pre = None  # (keys, slots, fresh) for the tail's first window
         union = None  # per-key first occurrence across the WHOLE tail
         if self.store is not None and windows:
             # one batched read-through / write-through for the WHOLE tail,
@@ -591,40 +591,51 @@ class Engine:
                     k = item[1].hash_key()
                     if k not in seen_keys:
                         seen_keys[k] = item
-            union = list(seen_keys.items())  # [(key, item)], window order
+            union_items = list(seen_keys.items())  # [(key, item)], in order
             t = time.perf_counter_ns()
-            ukeys = [k for k, _ in union]
+            ukeys = [k for k, _ in union_items]
             uslots, ufresh, inj0 = self.directory.lookup_inject(ukeys)
             self._apply_inject_rows(inj0)
             t2 = time.perf_counter_ns()
             stage["lookup"] += t2 - t
-            uwork = [it for _, it in union]
+            uwork = [it for _, it in union_items]
             ufresh = self._store_read_through(
                 uwork, ukeys, uslots, ufresh, now_ms)
             stage["store"] += time.perf_counter_ns() - t2
             union = (uwork, ukeys, uslots)
-            # window 0's keys are the union's prefix (iteration order)
-            n0 = len(windows[0])
-            pre = (ukeys[:n0], uslots[:n0], ufresh[:n0])
+            # Per-window slot/fresh come from THIS lookup, not re-lookups:
+            # a second directory lookup would clear the fresh flag of any
+            # first-occurrence key in a LATER tail window (round 0 chunked
+            # at max_width), making the kernel treat a recycled slot's
+            # stale row as live. `fresh` is consumed by the key's first
+            # window; later rounds of the same key see False.
+            slot_map = dict(zip(ukeys, uslots))
+            fresh_map = {k: f for k, f in zip(ukeys, ufresh) if f}
         for g0 in range(0, len(windows), self._MAX_SCAN):
             group = windows[g0:g0 + self._MAX_SCAN]
             if len(group) == 1:
                 # a trailing singleton (e.g. 33 windows -> groups [32, 1])
                 # rides the already-warmed single-window program; warmup
                 # compiles scan depths {2..32} only
+                resolved = None
+                if union is not None:
+                    wk = group[0]
+                    ks = [item[1].hash_key() for item in wk]
+                    resolved = ([slot_map[k] for k in ks],
+                                [fresh_map.pop(k, False) for k in ks])
                 self._apply_round(group[0], now_ms, responses,
-                                  skip_store=self.store is not None)
+                                  skip_store=self.store is not None,
+                                  resolved=resolved)
                 continue
             k = _bucket_pow2(len(group))
             stacked = np.zeros((k, 9, width), np.int64)
             stacked[:, 0, :] = -1  # pad windows are all padding lanes
             for gi, wk in enumerate(group):
                 t = time.perf_counter_ns()
-                if pre is not None and g0 == 0 and gi == 0:
-                    # reuse the read-through pass's lookup: a second
-                    # directory lookup would clear the fresh flags of keys
-                    # the store did NOT have (vacant device rows)
-                    keys, slots, fresh = pre
+                if union is not None:
+                    keys = [item[1].hash_key() for item in wk]
+                    slots = [slot_map[k] for k in keys]
+                    fresh = [fresh_map.pop(k, False) for k in keys]
                 else:
                     keys = [item[1].hash_key() for item in wk]
                     slots, fresh, inj = self.directory.lookup_inject(keys)
@@ -657,16 +668,20 @@ class Engine:
             stage["store"] += time.perf_counter_ns() - t
 
     def _apply_round(self, round_work, now_ms, responses,
-                     skip_store: bool = False) -> None:
+                     skip_store: bool = False, resolved=None) -> None:
         """One window, one dispatch. `skip_store` marks a tail singleton
         inside _apply_windows_scanned, whose batched read/write-through
-        already covers these keys."""
+        already covers these keys; `resolved` carries that pass's
+        (slots, fresh) so no re-lookup clears a fresh flag."""
         stage = self.stats.stage_ns
         n = len(round_work)
         t = time.perf_counter_ns()
         keys = [item[1].hash_key() for item in round_work]
-        slots, fresh, inj = self.directory.lookup_inject(keys)
-        self._apply_inject_rows(inj)
+        if resolved is not None:
+            slots, fresh = resolved
+        else:
+            slots, fresh, inj = self.directory.lookup_inject(keys)
+            self._apply_inject_rows(inj)
         stage["lookup"] += time.perf_counter_ns() - t
 
         use_store = self.store is not None and not skip_store

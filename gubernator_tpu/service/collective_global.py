@@ -147,6 +147,7 @@ class CollectiveGlobalSync:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._tick_started: Optional[float] = None  # wall clock, stall watch
+        self._stall_requeued = False  # one-shot re-route per stall episode
         self._failed: Optional[str] = None
         self.stats = {
             "ticks": 0,
@@ -192,8 +193,8 @@ class CollectiveGlobalSync:
     def queue_hit(self, req: RateLimitReq) -> bool:
         """Absorb a non-owner hit into the next collective tick. False means
         the caller must use the gRPC pipeline (key conflicted/unknown, or
-        the collective tier has failed)."""
-        if self._failed:
+        the collective tier has failed or is stalled)."""
+        if self._failed or self._check_stall():
             return False
         key = req.hash_key()
         with self._lock:
@@ -216,7 +217,7 @@ class CollectiveGlobalSync:
         """Owner-side: True when the collective broadcast covers this key
         (its post-apply state rides every tick), so the gRPC broadcast can
         be skipped."""
-        if self._failed:
+        if self._failed or self._check_stall():
             return False
         key = req.hash_key()
         with self._lock:
@@ -237,7 +238,7 @@ class CollectiveGlobalSync:
         """Non-owner first touch (relayed synchronously to the owner):
         start claiming the slot so the owner's broadcasts reach this host's
         cache on the next ticks."""
-        if self._failed:
+        if self._failed or self._check_stall():
             return
         with self._lock:
             if req.hash_key() not in self._keys:
@@ -246,12 +247,30 @@ class CollectiveGlobalSync:
     def health_error(self) -> Optional[str]:
         if self._failed:
             return f"cross-host GLOBAL sync failed: {self._failed}"
-        started = self._tick_started
-        if started is not None and \
-                time.monotonic() - started > self.stall_timeout_s:
+        if self._stalled():
             return ("cross-host GLOBAL sync stalled "
                     f">{self.stall_timeout_s}s (peer host not ticking?)")
         return None
+
+    def _stalled(self) -> bool:
+        started = self._tick_started
+        return started is not None and \
+            time.monotonic() - started > self.stall_timeout_s
+
+    def _check_stall(self) -> bool:
+        """Stall-aware intake gate: a tick blocked past the stall timeout
+        (dead peer mid-exchange) must not keep swallowing hits into limbo.
+        New traffic re-routes to the gRPC pipelines, queued-but-uncontributed
+        hits re-route ONCE (the in-flight contribution stays with the
+        blocked step — delivery-uncertain, restored only if it raises), and
+        intake resumes automatically when the tick completes."""
+        if not self._stalled():
+            return False
+        with self._lock:
+            if not self._stall_requeued:
+                self._stall_requeued = True
+                self._requeue_pending_locked()
+        return True
 
     # ------------------------------------------------------------- internals
 
@@ -508,6 +527,7 @@ class CollectiveGlobalSync:
                 key, int(e.req.algorithm), status, limit, remaining, reset)
             self.stats["broadcasts_applied"] += 1
         self.stats["ticks"] += 1
+        self._stall_requeued = False  # a completed tick ends the episode
 
     def _demote(self, key: str, e: _CKey, in_flight: Dict[str, int]) -> None:
         """Cross-host claim conflict: another host put a DIFFERENT key on
@@ -564,8 +584,11 @@ class CollectiveGlobalSync:
 
     def _requeue_all_pending(self) -> None:
         with self._lock:
-            for e in self._keys.values():
-                if e.pending:
-                    self.instance.global_manager.queue_hit(
-                        dataclasses.replace(e.req, hits=e.pending))
-                    e.pending = 0
+            self._requeue_pending_locked()
+
+    def _requeue_pending_locked(self) -> None:
+        for e in self._keys.values():
+            if e.pending:
+                self.instance.global_manager.queue_hit(
+                    dataclasses.replace(e.req, hits=e.pending))
+                e.pending = 0

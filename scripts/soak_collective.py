@@ -1,0 +1,276 @@
+"""Chaos soak for the cross-host collective GLOBAL tier (VERDICT r2 item 8).
+
+Two REAL daemons form a jax.distributed process group and exchange GLOBAL
+aggregates over the collective (50 ms lockstep ticks). A SIGKILL takes one
+daemon down MID-TICK, and the run is judged on the defined degradation
+behavior rather than scripted recovery:
+
+- STALL -> HEALTH: the survivor's blocked tick flips its /v1/HealthCheck
+  to unhealthy within the stall timeout (+ grace).
+- FALLBACK WITHOUT DOUBLE COUNT: traffic at the survivor keeps being
+  admitted through the gRPC tier; per-epoch admissions never exceed the
+  limit (the in-flight collective contribution is delivery-uncertain and
+  must NOT be re-sent; queued-but-uncontributed hits re-route once).
+- CLEAN RE-JOIN: the dead daemon restarts (standalone — a broken
+  jax.distributed group is not elastic; the restart rejoins the gRPC
+  fleet), serves its keys again, and reports healthy. The survivor keeps
+  serving through its gRPC pipelines; its health keeps reporting the
+  stalled collective (the group IS broken — an operator signal, not an
+  outage: correctness rides the fallback).
+
+Usage: python scripts/soak_collective.py [--seconds 20]
+Exit 0 = all invariants held; prints one JSON line per phase.
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def spawn(env_overrides, log_path, ready_timeout=240.0):
+    env = dict(os.environ)
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   os.path.join(REPO, "tests", ".jax_cache"))
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+    env.update(env_overrides)
+    stderr = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cmd.daemon"],
+        env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=stderr, text=True)
+    stderr.close()
+    ready = threading.Event()
+
+    def wait_ready():
+        while True:
+            line = proc.stdout.readline()
+            if not line:
+                return
+            if "Ready" in line:
+                ready.set()
+                return
+
+    threading.Thread(target=wait_ready, daemon=True).start()
+    if not ready.wait(ready_timeout):
+        proc.kill()
+        raise RuntimeError(f"daemon not ready in {ready_timeout}s")
+    return proc
+
+
+def post(port, body, timeout=10.0):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/GetRateLimits",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    return json.loads(urllib.request.urlopen(req, timeout=timeout).read())
+
+
+def health(port, timeout=5.0):
+    try:
+        raw = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/HealthCheck", timeout=timeout).read()
+        return json.loads(raw)
+    except Exception as e:  # noqa: BLE001
+        return {"status": f"unreachable: {e}"}
+
+
+def metric(port, name):
+    try:
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5).read().decode()
+    except Exception:  # noqa: BLE001
+        return None
+    for line in txt.splitlines():
+        if line.startswith(name + " ") or line.startswith(name + "{"):
+            return float(line.rsplit(" ", 1)[1])
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("soak_collective")
+    ap.add_argument("--seconds", type=float, default=20.0)
+    ap.add_argument("--limit", type=int, default=100_000)
+    args = ap.parse_args(argv)
+
+    coord = f"127.0.0.1:{free_port()}"
+    grpc_ports = [free_port(), free_port()]
+    http_ports = [free_port(), free_port()]
+    addrs = [f"127.0.0.1:{p}" for p in grpc_ports]
+    stall_s = 2.0
+    base_env = {
+        "JAX_PLATFORMS": "cpu",
+        "GUBER_BACKEND": "engine",
+        "GUBER_PEERS": ",".join(addrs),
+        "GUBER_CACHE_SIZE": "4096",
+        "GUBER_MIN_BATCH_WIDTH": "16",
+        "GUBER_MAX_BATCH_WIDTH": "128",
+        "GUBER_CROSS_HOST_SYNC": "50ms",
+        "GUBER_CROSS_HOST_STALL": "2s",
+        "GUBER_CROSS_HOST_CAPACITY": "1024",
+    }
+
+    def boot(i, group=True):
+        env = dict(base_env)
+        env.update({
+            "GUBER_GRPC_ADDRESS": addrs[i],
+            "GUBER_HTTP_ADDRESS": f"127.0.0.1:{http_ports[i]}",
+        })
+        if group:
+            env.update({
+                "GUBER_COORDINATOR_ADDRESS": coord,
+                "GUBER_NUM_HOSTS": "2",
+                "GUBER_HOST_ID": str(i),
+            })
+        return spawn(env, f"/tmp/soak_collective_d{i}.log")
+
+    # keys owned by the SURVIVOR (daemon 0), computed with the daemons' own
+    # picker (default replicated-hash over the static peer list): traffic on
+    # these must stay clean while daemon 1 is dead
+    sys.path.insert(0, REPO)
+    from gubernator_tpu.cluster.pickers import (  # noqa: E402
+        ReplicatedConsistentHashPicker,
+    )
+    from gubernator_tpu.types import PeerInfo  # noqa: E402
+
+    picker = ReplicatedConsistentHashPicker(None, replicas=512)
+    for a in addrs:
+        picker.add(type("P", (), {"info": PeerInfo(address=a)})())
+    d0_keys = []
+    i = 0
+    while len(d0_keys) < 4:
+        k = f"p{i}"
+        if picker.get(f"sc_{k}").info.address == addrs[0]:
+            d0_keys.append(k)
+        i += 1
+
+    procs = [None, None]
+    boots = [threading.Thread(target=lambda i=i: procs.__setitem__(
+        i, boot(i)), daemon=True) for i in range(2)]
+    for t in boots:
+        t.start()
+    for t in boots:
+        t.join(timeout=300)
+    assert all(procs), "daemon pair failed to boot"
+
+    failures = []
+    admitted = collections.Counter()  # (key, reset_time) -> admissions
+
+    def ok(cond, msg):
+        if not cond:
+            failures.append(msg)
+        return cond
+
+    def drive(port, keys, n, behavior="GLOBAL", allow_errors=False):
+        """n admission attempts round-robin over keys; SAFETY-counted."""
+        errs = 0
+        for i in range(n):
+            body = {"requests": [{
+                "name": "sc", "uniqueKey": keys[i % len(keys)], "hits": "1",
+                "limit": str(args.limit), "duration": "3600000",
+                "behavior": behavior}]}
+            try:
+                r = post(port, body)["responses"][0]
+            except Exception:  # noqa: BLE001
+                errs += 1
+                continue
+            if r.get("error"):
+                errs += 1
+                continue
+            if int(r.get("status", 0) or 0) == 0:
+                epoch = (keys[i % len(keys)], r.get("resetTime"))
+                admitted[epoch] += 1
+                if admitted[epoch] > args.limit:
+                    failures.append(f"DOUBLE COUNT: {epoch}")
+        if errs and not allow_errors:
+            failures.append(f"{errs}/{n} errors on port {port}")
+        return errs
+
+    # ---- phase 1: converge over the collective --------------------------
+    drive(http_ports[0], ["g0", "g1", "g2"], 60)
+    drive(http_ports[1], ["g0", "g1", "g2"], 60)
+    time.sleep(1.0)  # ~20 ticks
+    drive(http_ports[1], ["g0", "g1", "g2"], 60)
+    time.sleep(0.5)
+    synced = (metric(http_ports[0], "cross_host_hits_synced_total") or 0) + \
+             (metric(http_ports[1], "cross_host_hits_synced_total") or 0)
+    ok(synced > 0, f"collective moved no hits (synced={synced})")
+    ok(health(http_ports[0]).get("status") == "healthy", "d0 not healthy")
+    ok(health(http_ports[1]).get("status") == "healthy", "d1 not healthy")
+    print(json.dumps({"phase": "converged", "hits_synced": synced}),
+          flush=True)
+
+    # ---- phase 2: SIGKILL daemon 1 mid-tick -----------------------------
+    procs[1].send_signal(signal.SIGKILL)
+    procs[1].wait()
+    t_kill = time.monotonic()
+    # survivor keeps serving its OWN keys through the gRPC tier the whole
+    # time (forwards to the dead peer may error: allowed)
+    flip_deadline = t_kill + stall_s + 6.0
+    flipped = False
+    while time.monotonic() < flip_deadline:
+        drive(http_ports[0], ["g0", "g1", "g2"], 10, allow_errors=True)
+        h = health(http_ports[0])
+        if h.get("status") == "unhealthy":
+            flipped = True
+            break
+        time.sleep(0.25)
+    ok(flipped, "survivor health never flipped after peer death")
+    flip_s = time.monotonic() - t_kill
+    # degraded-but-correct: survivor-OWNED traffic is clean
+    errs = drive(http_ports[0], d0_keys, 40, behavior="BATCHING",
+                 allow_errors=True)
+    ok(errs == 0, f"survivor plain traffic errored while degraded ({errs})")
+    print(json.dumps({"phase": "killed", "health_flip_s": round(flip_s, 2)}),
+          flush=True)
+
+    # ---- phase 3: restart daemon 1 standalone (gRPC fleet re-join) ------
+    procs[1] = boot(1, group=False)
+    settle = time.monotonic() + 5.0
+    while time.monotonic() < settle:
+        drive(http_ports[0], ["g0", "g1", "g2"], 10, allow_errors=True)
+        drive(http_ports[1], ["g0", "g1", "g2"], 10, allow_errors=True)
+        time.sleep(0.2)
+    ok(health(http_ports[1]).get("status") == "healthy",
+       "restarted daemon not healthy")
+    # settled: traffic anywhere succeeds (the fleet is whole again on gRPC)
+    e0 = drive(http_ports[0], ["g0", "g1", "g2", "p0"], 40,
+               allow_errors=True)
+    e1 = drive(http_ports[1], ["g0", "g1", "g2", "p0"], 40,
+               allow_errors=True)
+    ok(e0 == 0, f"post-rejoin errors at survivor ({e0})")
+    ok(e1 == 0, f"post-rejoin errors at restarted daemon ({e1})")
+    print(json.dumps({"phase": "rejoined"}), flush=True)
+
+    for p in procs:
+        if p and p.poll() is None:
+            p.terminate()
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+                p.wait()
+
+    result = {"phase": "result", "ok": not failures, "failures": failures[:5]}
+    print(json.dumps(result), flush=True)
+    return 0 if not failures else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

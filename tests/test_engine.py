@@ -358,6 +358,24 @@ class TestScannedRounds:
         assert store.called["on_change"] == 1
         assert store.data["test_sd"].remaining == 2
 
+    def test_store_scan_chunked_round0_keeps_fresh_flags(self):
+        """Round 0 chunked at max_width puts FIRST-occurrence keys in a
+        later tail window; the union pre-lookup must not strip their
+        fresh flags (a recycled slot's stale device row would decide), and
+        later-round duplicates must still pack as live."""
+        store = MockStore()
+        eng = Engine(capacity=2048, min_width=16, max_width=16, store=store)
+        # 20 distinct never-seen keys, 4 of them twice ->
+        # rounds [20 -> chunks 16+4, 4]: tail = [16, 4, 4]
+        reqs = [req(key=f"cf{i}", hits=2, limit=10) for i in range(20)]
+        reqs += [req(key=f"cf{i}", hits=3, limit=10) for i in range(4)]
+        rs = eng.get_rate_limits(reqs, now_ms=NOW)
+        assert [r.remaining for r in rs[:20]] == [8] * 20  # all fresh
+        assert [r.remaining for r in rs[20:]] == [5] * 4  # sequential
+        # final rows persisted once per key
+        assert store.data["test_cf19"].remaining == 8
+        assert store.data["test_cf0"].remaining == 5
+
     def test_store_scan_read_through_restores(self):
         """Keys missing from the table but present in the store must be
         injected before the scan tail decides them."""

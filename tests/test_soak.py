@@ -14,6 +14,28 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+def test_collective_chaos_soak():
+    """Kill a daemon of a 2-host process group mid-tick (VERDICT r2 item
+    8): the survivor's health flips on the stall, survivor-owned traffic
+    stays clean through the gRPC fallback without double counts, and the
+    restarted daemon re-joins the (gRPC) fleet healthy. Full harness:
+    scripts/soak_collective.py."""
+    env = dict(os.environ, PYTHONPATH=REPO)
+    env.pop("XLA_FLAGS", None)  # conftest's 8-device CPU mesh leak
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "soak_collective.py")],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=420,
+    )
+    result = None
+    for line in proc.stdout.splitlines():
+        if line.startswith("{"):
+            d = json.loads(line)
+            if d.get("phase") == "result":
+                result = d
+    assert proc.returncode == 0, (proc.stdout[-1500:], proc.stderr[-1500:])
+    assert result is not None and result["ok"] is True, result
+
+
 def test_soak_invariants_hold():
     env = dict(os.environ, PYTHONPATH=REPO)
     proc = subprocess.run(
