@@ -120,6 +120,74 @@ class HttpClient:
         )
 
 
+class LinkClient:
+    """Framework-native public client: the columnar peerlink transport for
+    the PUBLIC surface (method 0 — full router semantics server-side),
+    with transparent per-call fallback to the wire-compatible gRPC tier.
+
+    The public gRPC surface stays untouched for reference-ecosystem
+    clients; this client exists because Python gRPC caps unbatched public
+    RPC at ~1-2k/s while the link's columnar frames (and, for lone
+    requests on a standalone node, the server's C++ IO-thread decision
+    path) serve the same contract 1-2 orders of magnitude faster
+    (BENCH_SUITE.md 'public link'). Negotiation mirrors the peer tier:
+    the link listens at grpc_port + GUBER_PEER_LINK_OFFSET (default
+    1000); servers that don't answer it get gRPC."""
+
+    def __init__(self, address: str, link_offset: int = 1000,
+                 connect_timeout_s: float = 1.0):
+        from gubernator_tpu.service.peerlink import PeerLinkClient
+
+        self.address = address
+        host, _, port = address.rpartition(":")
+        self._link = None
+        self._grpc: Optional[V1Client] = None
+        try:
+            self._link = PeerLinkClient(
+                f"{host or '127.0.0.1'}:{int(port) + link_offset}",
+                connect_timeout_s=connect_timeout_s)
+        except OSError:
+            pass  # server predates the link / link disabled: gRPC only
+
+    def get_rate_limits(
+        self, requests: Sequence[RateLimitReq], timeout: float = 5.0
+    ) -> List[RateLimitResp]:
+        from gubernator_tpu.service.peerlink import (
+            METHOD_GET_RATE_LIMITS,
+            PeerLinkTimeout,
+            PeerLinkUnencodable,
+        )
+        from gubernator_tpu.service.peerlink import (
+            PeerLinkError as _LinkErr,
+        )
+
+        if self._link is not None:
+            try:
+                return self._link.call(
+                    METHOD_GET_RATE_LIMITS, list(requests), timeout)
+            except PeerLinkUnencodable:
+                pass  # this call can't ride the frames: gRPC below
+            except PeerLinkTimeout:
+                raise  # delivery-uncertain: surface it like a deadline
+            except _LinkErr:
+                self._link.close()  # free the fd + reader thread
+                self._link = None  # broken link: stay on gRPC
+        return self._grpc_client().get_rate_limits(requests, timeout)
+
+    def health_check(self, timeout: float = 5.0) -> HealthCheckResp:
+        return self._grpc_client().health_check(timeout)
+
+    def close(self) -> None:
+        if self._link is not None:
+            self._link.close()
+            self._link = None
+
+    def _grpc_client(self) -> V1Client:
+        if self._grpc is None:
+            self._grpc = V1Client(self.address)
+        return self._grpc
+
+
 def random_peer(peers: Sequence[PeerInfo]) -> PeerInfo:
     """(reference: client.go:68-71)"""
     return random.choice(list(peers))

@@ -52,6 +52,11 @@ def build_backend(conf: DaemonConfig):
     if backend == "auto":
         backend = "sharded" if n_dev > 1 else "engine"
     if backend == "sharded":
+        if conf.device_directory:
+            raise ValueError(
+                "GUBER_DEVICE_DIRECTORY supports the single-table engine "
+                "only; the sharded backend keeps the host directory "
+                "(set GUBER_BACKEND=engine, or unset the flag)")
         from gubernator_tpu.parallel.mesh import make_mesh
         from gubernator_tpu.parallel.sharded import ShardedEngine
 
@@ -66,6 +71,21 @@ def build_backend(conf: DaemonConfig):
         )
         log.info("backend: sharded over %d devices, %d slots/shard (%s)",
                  n_dev, cap, conf.collectives)
+        return eng
+    if conf.device_directory:
+        # on-chip key directory: zero host round trips per key; no
+        # Store/Loader (the device keeps no key strings) — a loader
+        # config fails loudly here rather than silently dropping state
+        from gubernator_tpu.models.devdir_engine import DevDirEngine
+
+        eng = DevDirEngine(
+            capacity=conf.cache_size,
+            min_width=conf.min_batch_width,
+            max_width=conf.max_batch_width,
+            loader=_make_loader(conf),
+        )
+        log.info("backend: DEVICE-directory engine, %d slots",
+                 conf.cache_size)
         return eng
     from gubernator_tpu.models.engine import Engine
 

@@ -124,8 +124,9 @@ class DaemonConfig:
     peer_picker_hash: str = ""
     replicated_hash_replicas: int = 512
 
-    # TPU backend (no reference analogue)
-    backend: str = "auto"  # auto | engine | sharded
+    # TPU backend (no reference analogue): auto | engine | sharded
+    backend: str = "auto"
+    device_directory: bool = False  # on-chip key directory (engine only)
     min_batch_width: int = 64
     max_batch_width: int = 8192
     # durable bucket snapshot: load at boot, save at shutdown (FileLoader;
@@ -215,6 +216,7 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         peer_picker_hash=_env_str("GUBER_PEER_PICKER_HASH"),
         replicated_hash_replicas=_env_int("GUBER_REPLICATED_HASH_REPLICAS", 512),
         backend=_env_str("GUBER_BACKEND", "auto"),
+        device_directory=_env_bool("GUBER_DEVICE_DIRECTORY"),
         min_batch_width=_env_int("GUBER_MIN_BATCH_WIDTH", 64),
         max_batch_width=_env_int("GUBER_MAX_BATCH_WIDTH", 8192),
         snapshot_path=_env_str("GUBER_SNAPSHOT_PATH"),

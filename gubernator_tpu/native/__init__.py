@@ -110,6 +110,9 @@ def load_library() -> ctypes.CDLL:
         lib.fnv1a_owner_batch.argtypes = [
             c.c_char_p, c.c_void_p, c.c_int32, c.c_int32, c.c_void_p,
         ]
+        lib.fnv1a_fingerprint_batch.argtypes = [
+            c.c_char_p, c.c_void_p, c.c_int32, c.c_void_p,
+        ]
         # columnar prep is pure C (no CPython API): riding the CDLL handle
         # releases the GIL for the whole pass
         lib.keydir_prep_pack_columnar.restype = c.c_int32
@@ -168,6 +171,7 @@ def load_peerlink() -> ctypes.CDLL:
         ]
         lib.pls_native_hits.restype = c.c_longlong
         lib.pls_native_hits.argtypes = [c.c_void_p]
+        lib.pls_set_native_public.argtypes = [c.c_void_p, c.c_int]
         _PL_LIB = lib
         return lib
 
@@ -330,6 +334,17 @@ def _pack_keys(keys: Sequence[str]) -> Tuple[bytes, np.ndarray]:
         lens = np.fromiter(map(len, blobs), np.int64, count=n)
     np.cumsum(lens, out=offsets[1:])
     return data, offsets
+
+
+def fingerprint_batch(keys: Sequence[str]) -> np.ndarray:
+    """63-bit nonzero key fingerprints for the device directory
+    (ops/devdir.py key_fingerprint, C fast path)."""
+    lib = load_library()
+    data, offsets = _pack_keys(keys)
+    out = np.empty(len(keys), np.int64)
+    lib.fnv1a_fingerprint_batch(
+        data, offsets.ctypes.data, len(keys), out.ctypes.data)
+    return out
 
 
 def owner_batch(keys: Sequence[str], n_owners: int) -> np.ndarray:

@@ -546,6 +546,17 @@ void fnv1a_owner_batch(const char* data, const int64_t* offsets, int32_t n,
     }
 }
 
+// Batch 63-bit nonzero fingerprints for the device directory
+// (ops/devdir.py key_fingerprint: fnv1a64 masked to 63 bits, |1).
+void fnv1a_fingerprint_batch(const char* data, const int64_t* offsets,
+                             int32_t n, int64_t* out) {
+    for (int32_t i = 0; i < n; ++i) {
+        uint64_t h = fnv1a(data + offsets[i],
+                           static_cast<int32_t>(offsets[i + 1] - offsets[i]));
+        out[i] = static_cast<int64_t>((h & ((1ull << 63) - 1)) | 1ull);
+    }
+}
+
 namespace {
 
 // Shared per-item reader for the two prep entry points below: pulls the

@@ -136,6 +136,9 @@ struct Server {
   std::atomic<void*> native_kd{nullptr};
   std::atomic<int64_t> native_slow_mask{0};
   std::atomic<long long> native_hits{0};
+  // accept method-0 (public GetRateLimits) frames too: only safe while
+  // this node owns every key (no routing); re-armed on peer changes
+  std::atomic<bool> native_public{false};
 };
 
 bool direct_send(Server* s, Conn* c, const std::string& frame);
@@ -144,7 +147,11 @@ bool direct_send(Server* s, Conn* c, const std::string& frame);
 // the reply was written (frame fully served); false = take the queue.
 bool try_native_single(Server* s, Conn* c, const Frame& f) {
   NativeDecideFn fn = s->native_fn.load(std::memory_order_acquire);
-  if (fn == nullptr || f.count != 1 || f.method != 1) return false;
+  if (fn == nullptr || f.count != 1) return false;
+  if (f.method != 1 &&
+      !(f.method == 0 && s->native_public.load(std::memory_order_relaxed))) {
+    return false;
+  }
   const int32_t nl = f.name_len[0], ul = f.ukey_len[0];
   if (nl <= 0 || ul <= 0) return false;
   if ((int64_t)f.behavior[0] &
@@ -605,6 +612,12 @@ void pls_set_native(void* h, void* fn, void* kd, long long slow_mask) {
 
 long long pls_native_hits(void* h) {
   return ((Server*)h)->native_hits.load(std::memory_order_relaxed);
+}
+
+// Toggle IO-thread decisions for method-0 (public) lone frames — only
+// while the node owns every key (standalone); peer changes re-arm it.
+void pls_set_native_public(void* h, int on) {
+  ((Server*)h)->native_public.store(on != 0, std::memory_order_relaxed);
 }
 
 }  // extern "C"

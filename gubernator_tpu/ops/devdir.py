@@ -1,36 +1,39 @@
 """Device-resident key directory: open-addressing probe on the chip.
 
-PROTOTYPE (round-1 review item 6). The production engines map key strings
-to table slots in the host key directory (native/keydir.cpp) — the
-admitted host-side bottleneck at multi-M decisions/s (keydir.cpp:5-8,
-SURVEY §7 hard part #1: "without host round-trips per key"). This module
-moves the probe on-device: the host ships only an 8-byte hash fingerprint
-per request, and the chip resolves (or claims) the slot with a vectorized
+GRADUATED (round-3; prototype was round-1 review item 6, hardened per the
+round-2 verdict item 2). The production engines map key strings to table
+slots in the host key directory (native/keydir.cpp) — the admitted
+host-side cost at multi-M decisions/s (keydir.cpp:5-8, SURVEY §7 hard
+part #1: "without host round-trips per key"). This module moves the probe
+on-device: the host ships only an 8-byte hash fingerprint per request,
+and the chip resolves (or claims) the slot with a vectorized
 open-addressing probe — the slot never returns to the host, feeding
-decide() directly in the same compiled program.
+decide() directly in the same compiled program (models/devdir_engine.py).
 
 Design:
-- the directory is one i64[C] fingerprint column; slot IS the probe
-  position, so directory and bucket table share indexing (the bucket
-  row's algo=-1 vacancy remains the state authority).
+- the directory is one i64[C] fingerprint column plus an i64[C] last-use
+  stamp column; slot IS the probe position, so directory and bucket table
+  share indexing (the bucket row's algo=-1 vacancy remains the state
+  authority).
 - probe: D candidate positions (h + d) % C gathered in ONE [B, D] gather
   (the row-major lesson: batched gathers beat per-element probes), then a
   branchless first-match / first-empty select.
 - fingerprints are fnv1a64 masked to 63 bits, +1 to keep 0 = empty.
+- IN-BATCH PRIORITY PASS: two DISTINCT keys claiming one position in the
+  same batch are resolved by an argsort pass (duplicate claim positions
+  sort adjacent; the highest lane wins, losers demote to the retry lane)
+  — no last-scatter-wins races, and no O(C) scratch per window.
+- AGED EVICTION: a probe whose candidate window has no match and no
+  vacancy claims the LEAST-RECENTLY-USED candidate instead (touch stamps
+  maintained on every match/claim), after protecting positions matched or
+  claimed this batch. The evicted tenant's bucket simply ends (the host
+  directory's LRU semantics); un-evictable probes (every candidate
+  touched this very batch) return the retry lane.
 
-Known prototype limits (documented, not hidden):
-- two DIFFERENT keys colliding on the same empty position within ONE
-  batch both claim it (last scatter wins); the engines' rounds machinery
-  dedups same-key repeats but not distinct-key hash collisions. A
-  production version needs an in-batch priority pass.
-- no LRU eviction: a probe that finds neither match nor vacancy within D
-  returns slot -1 (host fallback lane). Capacity is over-provisioned 2x
-  instead, and expiry recycles rows lazily via refresh_vacancies().
-
-Honest verdict from the bench comparison (DESIGN.md "Device-resident key
-lookup"): see the numbers there — the host C++ directory stays the
-default; this path wins only when host CPU, not the device, is the
-serving bottleneck.
+Retry lanes (slot == -1) are re-dispatched by the engine in a follow-up
+window — by then the contested claims have settled. 63-bit fingerprint
+equality of two DISTINCT keys (~2^-63 per pair) aliases them to one
+bucket; documented, not defended.
 """
 
 from __future__ import annotations
@@ -43,7 +46,7 @@ import jax.numpy as jnp
 from gubernator_tpu.ops.decide import I32, I64, ROW_ALGO, pad_to_drop
 from gubernator_tpu.utils.fnv import fnv1a_64_str
 
-PROBE_DEPTH = 16  # candidate positions per key; full = host-fallback lane
+PROBE_DEPTH = 16  # candidate positions per key; full = retry lane
 
 
 def key_fingerprint(key: str) -> int:
@@ -55,14 +58,38 @@ def make_fingerprints(capacity: int) -> jax.Array:
     return jnp.zeros((capacity,), I64)
 
 
+def make_touch(capacity: int) -> jax.Array:
+    return jnp.zeros((capacity,), I64)
+
+
+def _claim_winners(claim_ok: jax.Array, cslot: jax.Array) -> jax.Array:
+    """In-batch priority pass: among lanes claiming the same position,
+    exactly one (the highest lane id) wins. Argsort groups duplicate
+    positions adjacently; a lane wins iff its (position, lane) key is the
+    last of its position group. O(B log B), no O(C) scratch."""
+    B = cslot.shape[0]
+    lane = jnp.arange(B, dtype=I64)
+    sent = jnp.asarray(jnp.iinfo(jnp.int64).max // 2, I64)
+    key = jnp.where(claim_ok, cslot.astype(I64) * B + lane, sent + lane)
+    order = jnp.argsort(key)
+    sorted_pos = key[order] // B
+    is_last = jnp.concatenate(
+        [sorted_pos[1:] != sorted_pos[:-1],
+         jnp.ones((1,), dtype=bool)])
+    won = jnp.zeros((B,), dtype=bool).at[order].set(is_last)
+    return won & claim_ok
+
+
 def probe_assign(
     fps: jax.Array, hashes: jax.Array
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Resolve-or-claim a slot for every key hash, on device.
+    """Resolve-or-claim a slot for every key hash, on device (no eviction
+    — the standalone building block; engines use probe_assign_evict).
 
     fps: i64[C] fingerprint column; hashes: i64[B] (0 for padding lanes).
     Returns (new_fps, slot i32[B], fresh bool[B]); slot is -1 for padding
-    lanes and for probes that exhausted PROBE_DEPTH (host fallback).
+    lanes, probes that exhausted PROBE_DEPTH, and in-batch claim LOSERS
+    (distinct keys contesting one empty position — retry next window).
     """
     C = fps.shape[0]
     B = hashes.shape[0]
@@ -85,17 +112,85 @@ def probe_assign(
     slot64 = jnp.take_along_axis(
         pos, jnp.minimum(depth, PROBE_DEPTH - 1)[:, None].astype(I64), axis=1
     )[:, 0]
-    ok = active & (matched | claimable)
-    slot = jnp.where(ok, slot64, -1).astype(I32)
-    fresh = ok & claimable
 
-    # claim the fresh positions (duplicate hashes in one batch converge on
-    # the same position and write the same fingerprint — benign; DISTINCT
-    # colliding keys are the documented prototype limit)
+    # in-batch priority pass: distinct keys contesting one empty position
+    # (duplicate hashes of the SAME key converge benignly, but the engine
+    # never sends same-key duplicates in one window anyway)
+    want = active & claimable
+    won = _claim_winners(want, slot64)
+    ok = active & (matched | won)
+    slot = jnp.where(ok, slot64, -1).astype(I32)
+    fresh = won
+
     claim_slot = pad_to_drop(jnp.where(fresh, slot, -1), C)
     new_fps = fps.at[claim_slot].set(
         jnp.where(fresh, hashes, 0), mode="drop")
     return new_fps, slot, fresh
+
+
+def probe_assign_evict(
+    fps: jax.Array, touch: jax.Array, hashes: jax.Array, seq
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """probe_assign + aged (LRU-approximate) eviction: a full candidate
+    window claims its least-recently-used position instead of failing.
+
+    `seq` is a per-DISPATCH monotone epoch (NOT wall time: many windows
+    run per millisecond, and eviction protection must cover exactly the
+    positions matched/claimed THIS batch — a wall-clock stamp would also
+    freeze out retries issued in the same millisecond).
+
+    Returns (fps, touch, slot i32[B], fresh bool[B], retry bool[B]);
+    retry lanes (in-batch claim losers, un-evictable windows) re-dispatch
+    in a follow-up window with a fresh epoch.
+    """
+    C = fps.shape[0]
+    B = hashes.shape[0]
+    now = jnp.asarray(seq, I64)
+    active = hashes != 0
+    base = jnp.abs(hashes) % C
+    pos = (base[:, None] + jnp.arange(PROBE_DEPTH, dtype=I64)[None, :]) % C
+    cand = fps[pos]
+
+    is_match = (cand == hashes[:, None]) & active[:, None]
+    is_empty = cand == 0
+    big = jnp.asarray(PROBE_DEPTH + 1, I32)
+    d_idx = jnp.arange(PROBE_DEPTH, dtype=I32)[None, :]
+    first_match = jnp.min(jnp.where(is_match, d_idx, big), axis=1)
+    first_empty = jnp.min(jnp.where(is_empty, d_idx, big), axis=1)
+    matched = active & (first_match <= PROBE_DEPTH)
+    mslot = jnp.take_along_axis(
+        pos, jnp.minimum(first_match, PROBE_DEPTH - 1)[:, None].astype(I64),
+        axis=1)[:, 0]
+
+    # protect matched positions from eviction BEFORE victims are chosen:
+    # their touch moves to `now`, so no victim this batch can be younger
+    mpos = pad_to_drop(jnp.where(matched, mslot, -1), C)
+    touch = touch.at[mpos].set(now, mode="drop")
+
+    has_empty = first_empty <= PROBE_DEPTH
+    eslot = jnp.take_along_axis(
+        pos, jnp.minimum(first_empty, PROBE_DEPTH - 1)[:, None].astype(I64),
+        axis=1)[:, 0]
+    ctouch = touch[pos]  # AFTER the match-touch scatter
+    oldest_d = jnp.argmin(ctouch, axis=1)
+    vslot = jnp.take_along_axis(pos, oldest_d[:, None], axis=1)[:, 0]
+    vtouch = jnp.take_along_axis(ctouch, oldest_d[:, None], axis=1)[:, 0]
+    can_evict = vtouch < now  # strictly older than this batch
+
+    want_claim = active & ~matched
+    cslot = jnp.where(has_empty, eslot, vslot)
+    claim_ok = want_claim & (has_empty | can_evict)
+    won = _claim_winners(claim_ok, cslot)
+
+    slot = jnp.where(matched, mslot,
+                     jnp.where(won, cslot, -1)).astype(I32)
+    fresh = won
+    retry = active & (slot < 0)
+
+    wpos = pad_to_drop(jnp.where(won, cslot, -1), C)
+    fps = fps.at[wpos].set(jnp.where(won, hashes, 0), mode="drop")
+    touch = touch.at[wpos].set(now, mode="drop")
+    return fps, touch, slot, fresh, retry
 
 
 def refresh_vacancies(fps: jax.Array, table: jax.Array,

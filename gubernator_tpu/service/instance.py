@@ -112,6 +112,7 @@ class Instance:
         self.collective_global = None
         self._collective_group = None  # None = every peer is in the group
         self._collective_covers = True
+        self._peer_listeners = []
         self._closed = False
 
     def attach_collective(self, sync, group_peers=None) -> None:
@@ -137,6 +138,26 @@ class Instance:
             return b if b.supports_columnar() else None
         except AttributeError:
             return None
+
+    def is_sole_owner(self) -> bool:
+        """True when this node owns every key (no other local-region
+        peers): public-surface requests need no routing, so the lean link
+        can serve them through the owner fast paths."""
+        with self._peer_lock:
+            return self.local_picker.size() <= 1
+
+    def on_peers_change(self, cb) -> None:
+        """Register a callback fired after every set_peers rebuild (the
+        peerlink service re-arms its native fast paths on it)."""
+        self._peer_listeners.append(cb)
+
+    def off_peers_change(self, cb) -> None:
+        """Unregister (a closing service MUST remove its callback — a
+        stale one would poke freed native state on the next rebuild)."""
+        try:
+            self._peer_listeners.remove(cb)
+        except ValueError:
+            pass
 
     def _in_collective_group(self, address: str) -> bool:
         g = self._collective_group
@@ -312,6 +333,11 @@ class Instance:
                 new_local.size(), new_region.size(),
                 self.advertise_address or "?")
         self._recompute_collective_coverage()
+        for cb in self._peer_listeners:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — listeners must not break
+                log.exception("peer-change listener failed")
 
         shutdown = [
             p for p in old_local.peers()

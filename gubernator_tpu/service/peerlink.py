@@ -311,6 +311,7 @@ class PeerLinkService:
         self.port = bound.value
         self.instance = instance
         self.stats = {"batches": 0, "requests": 0, "errors": 0}
+        self._public_fast = False  # method-0 owner paths (standalone only)
         # native lone-request fast path: 1-item peer-hop frames decide in
         # the C++ IO thread against the engine's directory row mirrors
         # (keydir.cpp decide_one) — no Python wakeup, no kernel dispatch.
@@ -326,6 +327,12 @@ class PeerLinkService:
             self._lib.pls_set_native(
                 self._handle, fn, eng.directory._kd, _COLUMNAR_SLOW_MASK)
             self._seed_engine = eng
+            # the PUBLIC lean surface (method 0) needs routing; while this
+            # node owns every key the IO-thread/columnar owner paths can
+            # serve it too — re-armed whenever membership changes
+            self._rearm_public()
+            if hasattr(instance, "on_peers_change"):
+                instance.on_peers_change(self._rearm_public)
         self._stop = False
         self._threads = []
         for i in range(workers):
@@ -338,8 +345,17 @@ class PeerLinkService:
         """Lone requests answered by the C++ IO thread (no Python)."""
         return int(self._lib.pls_native_hits(self._handle))
 
+    def _rearm_public(self) -> None:
+        sole = bool(getattr(self.instance, "is_sole_owner",
+                            lambda: False)())
+        self._public_fast = sole
+        self._lib.pls_set_native_public(self._handle, int(sole))
+
     def close(self) -> None:
         self._stop = True
+        # a stale peer-change listener would poke the freed native handle
+        if hasattr(self.instance, "off_peers_change"):
+            self.instance.off_peers_change(self._rearm_public)
         self._lib.pls_stop(self._handle)  # wakes blocked pullers (-1)
         for t in self._threads:
             t.join(timeout=2.0)
@@ -444,13 +460,21 @@ class PeerLinkService:
             k = j
             while k < got and int(method[k]) == m and k - j < MAX_BATCH_SIZE:
                 k += 1
-            if not (m == METHOD_GET_PEER_RATE_LIMITS and eng is not None
+            # method-1 chunks always qualify for the columnar owner path;
+            # method-0 (public) chunks qualify only while this node owns
+            # every key (no routing needed — standalone deployments)
+            columnar_ok = eng is not None and (
+                m == METHOD_GET_PEER_RATE_LIMITS
+                or (m == METHOD_GET_RATE_LIMITS and self._public_fast))
+            if not (columnar_ok
                     and self._columnar_chunk(eng, j, k, b, errs)):
                 self._object_chunk(m, j, k, b, errs)
             j = k
 
         if got == 1 and self._seed_engine is not None and \
-                int(method[0]) == METHOD_GET_PEER_RATE_LIMITS and \
+                (int(method[0]) == METHOD_GET_PEER_RATE_LIMITS
+                 or (int(method[0]) == METHOD_GET_RATE_LIMITS
+                     and self._public_fast)) and \
                 not (int(b["behavior"][0]) & _COLUMNAR_SLOW_MASK):
             # a lone peer-hop reached Python = the IO-thread fast path
             # missed (cold/invalidated mirror). Seed it so the NEXT lone
@@ -478,31 +502,39 @@ class PeerLinkService:
 
     def _columnar_chunk(self, eng, j: int, k: int, b: dict,
                         errs: list) -> bool:
-        """Serve one peer-hop chunk columnar-end-to-end. False = the
-        engine can't take this window shape (caller falls back, nothing
+        """Serve one peer-hop chunk columnar-end-to-end. Chunks wider than
+        the engine's max window split into sub-windows, applied
+        SEQUENTIALLY (complete i before submit i+1): the C prep's
+        duplicate tracking is per-submit, so a key demoted to the
+        leftover tail of sub-window i must finish before a later
+        sub-window packs its next occurrence — per-key wire order is the
+        contract. False = the engine can't take the shape at all (nothing
         mutated)."""
-        n = k - j
-        try:
-            handle = eng.submit_columnar(
-                n, b["keys"], b["key_off"][j:k + 1], b["name_len"][j:k],
-                b["hits"][j:k], b["limit"][j:k], b["duration"][j:k],
-                b["algorithm"][j:k], b["behavior"][j:k],
-                _COLUMNAR_SLOW_MASK)
-        except Exception as e:  # noqa: BLE001 — e.g. directory over-commit
-            msg = str(e).encode()
-            b["status"][j:k] = 0
-            b["r_limit"][j:k] = 0
-            b["r_remaining"][j:k] = 0
-            b["r_reset"][j:k] = 0
-            errs.extend((i, msg) for i in range(j, k))
-            return True
-        if handle is None:
-            return False
-        leftover = eng.complete_columnar(
-            handle, b["status"][j:k], b["r_limit"][j:k],
-            b["r_remaining"][j:k], b["r_reset"][j:k])
-        if len(leftover):
-            self._leftover_items(j, leftover.tolist(), b, errs)
+        step = max(int(getattr(eng, "max_width", 0)) or (k - j), 1)
+        for s0 in range(j, k, step):
+            s1 = min(s0 + step, k)
+            try:
+                h = eng.submit_columnar(
+                    s1 - s0, b["keys"], b["key_off"][s0:s1 + 1],
+                    b["name_len"][s0:s1], b["hits"][s0:s1],
+                    b["limit"][s0:s1], b["duration"][s0:s1],
+                    b["algorithm"][s0:s1], b["behavior"][s0:s1],
+                    _COLUMNAR_SLOW_MASK)
+            except Exception as e:  # noqa: BLE001 — directory over-commit
+                msg = str(e).encode()
+                b["status"][s0:k] = 0
+                b["r_limit"][s0:k] = 0
+                b["r_remaining"][s0:k] = 0
+                b["r_reset"][s0:k] = 0
+                errs.extend((i, msg) for i in range(s0, k))
+                return True
+            if h is None:  # only possible on the sole full-range try
+                return False
+            leftover = eng.complete_columnar(
+                h, b["status"][s0:s1], b["r_limit"][s0:s1],
+                b["r_remaining"][s0:s1], b["r_reset"][s0:s1])
+            if len(leftover):
+                self._leftover_items(s0, leftover.tolist(), b, errs)
         return True
 
     def _leftover_items(self, j: int, rel_idx: List[int], b: dict,

@@ -216,6 +216,35 @@ def main(argv=None) -> int:
     print(json.dumps({"phase": "converged", "hits_synced": synced}),
           flush=True)
 
+    # exact-accounting key: owned by the SURVIVOR, driven only at the
+    # survivor, small limit — any double-apply (e.g. a stall requeue
+    # re-sending an in-flight collective contribution) shows up as
+    # remaining < limit - admitted. This gives the double-count invariant
+    # teeth; the per-epoch counter alone could never reach args.limit.
+    acct_key, acct_limit, acct_admitted = None, 300, 0
+    i = 0
+    while acct_key is None:
+        k = f"acct{i}"
+        if picker.get(f"sc_{k}").info.address == addrs[0]:
+            acct_key = k
+        i += 1
+
+    def drive_acct(n):
+        nonlocal acct_admitted
+        for _ in range(n):
+            body = {"requests": [{
+                "name": "sc", "uniqueKey": acct_key, "hits": "1",
+                "limit": str(acct_limit), "duration": "3600000",
+                "behavior": "GLOBAL"}]}
+            try:
+                r = post(http_ports[0], body)["responses"][0]
+            except Exception:  # noqa: BLE001
+                continue
+            if not r.get("error") and int(r.get("status", 0) or 0) == 0:
+                acct_admitted += 1
+
+    drive_acct(40)
+
     # ---- phase 2: SIGKILL daemon 1 mid-tick -----------------------------
     procs[1].send_signal(signal.SIGKILL)
     procs[1].wait()
@@ -237,6 +266,7 @@ def main(argv=None) -> int:
     errs = drive(http_ports[0], d0_keys, 40, behavior="BATCHING",
                  allow_errors=True)
     ok(errs == 0, f"survivor plain traffic errored while degraded ({errs})")
+    drive_acct(40)  # admissions THROUGH the chaos window
     print(json.dumps({"phase": "killed", "health_flip_s": round(flip_s, 2)}),
           flush=True)
 
@@ -256,7 +286,20 @@ def main(argv=None) -> int:
                allow_errors=True)
     ok(e0 == 0, f"post-rejoin errors at survivor ({e0})")
     ok(e1 == 0, f"post-rejoin errors at restarted daemon ({e1})")
-    print(json.dumps({"phase": "rejoined"}), flush=True)
+    drive_acct(40)
+    time.sleep(0.5)  # let async pipelines settle before the exact peek
+    peek = post(http_ports[0], {"requests": [{
+        "name": "sc", "uniqueKey": acct_key, "hits": "0",
+        "limit": str(acct_limit), "duration": "3600000",
+        "behavior": "GLOBAL"}]})["responses"][0]
+    got_rem = int(peek.get("remaining", -1) or 0)
+    want_rem = acct_limit - acct_admitted
+    ok(got_rem == want_rem,
+       f"EXACT ACCOUNTING: remaining {got_rem} != "
+       f"{acct_limit} - {acct_admitted} admitted = {want_rem} "
+       "(double- or under-count through the chaos)")
+    print(json.dumps({"phase": "rejoined", "acct_admitted": acct_admitted,
+                      "acct_remaining": got_rem}), flush=True)
 
     for p in procs:
         if p and p.poll() is None:

@@ -203,6 +203,9 @@ class TestPeerlinkColumnar:
         eng.warmup()
         inst = Instance(InstanceConfig(backend=eng),
                         advertise_address="self")
+        # freeze the broadcast flusher: the assertion below inspects the
+        # pipeline's pending map, which a timed flush would drain
+        inst.global_manager._broadcasts._wait_s = 3600
         assert inst.columnar_backend() is eng
         svc = PeerLinkService(inst, port=0)
         cli = PeerLinkClient(f"127.0.0.1:{svc.port}")

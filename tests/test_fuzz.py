@@ -123,6 +123,13 @@ def test_three_way_differential(trial):
     now = NOW + rng.randrange(10**9)
     keys = [f"k{i}" for i in range(rng.choice([3, 8, 20]))]
     restart_at = rng.randrange(STEPS) if rng.random() < 0.5 else -1
+    # the device-directory engine joins the differential on non-restart
+    # trials (it keeps no key strings, so it cannot resume from snapshots)
+    dev = None
+    if restart_at < 0:
+        from gubernator_tpu.models.devdir_engine import DevDirEngine
+
+        dev = DevDirEngine(capacity=128, min_width=8, max_width=32)
     for step in range(STEPS):
         if step == restart_at:
             engines = _restart_from_snapshot(engines)
@@ -134,6 +141,10 @@ def test_three_way_differential(trial):
         assert a == b == c, (
             f"divergence trial={trial} step={step} shards={n_shards} "
             f"restart={restart_at}")
+        if dev is not None:
+            d = dev.get_rate_limits(batch, now_ms=now)
+            assert a == d, (
+                f"devdir divergence trial={trial} step={step}")
 
 
 @pytest.mark.parametrize("trial", range(max(2, TRIALS // 3)))
