@@ -10,11 +10,14 @@ preloaded:
   writing directly to sockets, concurrent client connects/closes. The
   stress speaks raw frames over sockets so the subprocess needs no
   package imports (TSan's ~10x slowdown stays off the jax import path).
-- keydir (native/keydir.cpp) is caller-locked by contract (like the
-  reference's Cache, cache.go:32-43): the stress exercises lookup/drop/
-  dump from many threads under one mutex — the discipline the engine
-  lock provides — so TSan checks the library's internals (allocator,
-  statics) under real thread churn.
+- keydir (native/keydir.cpp): batch callers (lookup/drop) keep the
+  engine-lock discipline, while the r3 native lone-request path —
+  decide_one / mirror_seed / mirror_flush — runs from separate threads
+  WITHOUT that lock, exactly as the peerlink IO thread does in
+  production; the internal KeyDir mutex is the only synchronization, and
+  that race (mirror math vs batch lookups on the same keys) is the main
+  thing this stress exists to check. Do NOT wrap native_decider in the
+  Python lock: that would silently destroy the coverage.
 
 A data race makes TSan print "WARNING: ThreadSanitizer" and exit 66
 (TSAN_OPTIONS exitcode); the test asserts a clean run.
@@ -166,20 +169,32 @@ _KEYDIR_STRESS = textwrap.dedent("""
     lib.keydir_free.argtypes = [c.c_void_p]
     lib.keydir_lookup_batch.restype = c.c_int64
     lib.keydir_lookup_batch.argtypes = [c.c_void_p, c.c_char_p, c.c_void_p,
-                                        c.c_int32, c.c_void_p, c.c_void_p]
+                                        c.c_int32, c.c_void_p, c.c_void_p,
+                                        c.c_void_p, c.c_void_p]
     # offsets are int64_t[n+1] bounds into the packed key bytes
     lib.keydir_drop.argtypes = [c.c_void_p, c.c_char_p, c.c_int32]
     lib.keydir_dump.restype = c.c_int64
     lib.keydir_dump.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
                                 c.c_void_p, c.c_void_p, c.c_int64]
+    lib.keydir_mirror_seed.argtypes = [c.c_void_p, c.c_char_p, c.c_int32,
+                                       c.c_void_p]
+    lib.keydir_decide_one.restype = c.c_int32
+    lib.keydir_decide_one.argtypes = [c.c_void_p, c.c_char_p, c.c_int32,
+                                      c.c_int64, c.c_int64, c.c_int64,
+                                      c.c_int32, c.c_int32, c.c_int64,
+                                      c.c_void_p]
+    lib.keydir_mirror_flush.restype = c.c_int32
+    lib.keydir_mirror_flush.argtypes = [c.c_void_p, c.c_void_p, c.c_int32]
 
     kd = lib.keydir_new(512)
-    lock = threading.Lock()  # the engine-lock discipline
+    lock = threading.Lock()  # batch callers keep the engine-lock discipline
 
     def hammer(tid):
         W = 16
         slots = (c.c_int32 * W)()
         fresh = (c.c_uint8 * W)()
+        inject = (c.c_int64 * (W * 8))()
+        n_inj = (c.c_int32 * 1)()
         for i in range(400):
             parts = [b"k%d_%d" % (tid, (i + j) % 64) for j in range(W)]
             keys = b"".join(parts)
@@ -191,13 +206,32 @@ _KEYDIR_STRESS = textwrap.dedent("""
             with lock:
                 lib.keydir_lookup_batch(kd, keys, offs, W,
                                         c.cast(slots, c.c_void_p),
-                                        c.cast(fresh, c.c_void_p))
+                                        c.cast(fresh, c.c_void_p),
+                                        c.cast(inject, c.c_void_p),
+                                        c.cast(n_inj, c.c_void_p))
             if i % 50 == 0:
                 k = b"k%d_%d" % (tid, i % 64)
                 with lock:
                     lib.keydir_drop(kd, k, len(k))
 
+    def native_decider(tid):
+        # the r3 lone-request path: decide_one + mirror seeds run WITHOUT
+        # the engine lock (the IO-thread contract) — the KeyDir mutex is
+        # the only synchronization, which is exactly what TSan must check
+        row = (c.c_int64 * 7)(0, 100, 50, 60000, 1, 10**15, 0)
+        out = (c.c_int64 * 4)()
+        inject = (c.c_int64 * (64 * 8))()
+        for i in range(600):
+            k = b"k%d_%d" % (i % 6, i % 64)  # collide with batch keys
+            lib.keydir_mirror_seed(kd, k, len(k), c.cast(row, c.c_void_p))
+            lib.keydir_decide_one(kd, k, len(k), 1, 100, 60000, 0, 0,
+                                  10**12 + i, c.cast(out, c.c_void_p))
+            if i % 97 == 0:
+                lib.keydir_mirror_flush(kd, c.cast(inject, c.c_void_p), 64)
+
     ts = [threading.Thread(target=hammer, args=(t,)) for t in range(6)]
+    ts += [threading.Thread(target=native_decider, args=(t,))
+           for t in range(3)]
     [t.start() for t in ts]
     [t.join(timeout=120) for t in ts]
     lib.keydir_free(kd)
