@@ -500,6 +500,62 @@ def main(argv=None) -> int:
                 args.seconds,
             )
 
+        def bench_native_lone_hop():
+            # r3: 1-item peer-hop frames decided in the C++ IO thread
+            # against the directory row mirror (keydir.cpp decide_one) —
+            # no Python worker, no kernel dispatch. The first call misses
+            # (kernel path) and seeds; the timed loop runs native.
+            from gubernator_tpu.service.peerlink import (
+                METHOD_GET_PEER_RATE_LIMITS,
+                PeerLinkClient,
+                PeerLinkService,
+            )
+
+            ci = rng.choice(cluster.instances)
+            svc = PeerLinkService(ci.instance, port=0)
+            cli = PeerLinkClient(f"127.0.0.1:{svc.port}")
+            try:
+                r = [req("native_hop", "hot", duration=3_600_000,
+                         limit=1 << 40)]
+                cli.call(METHOD_GET_PEER_RATE_LIMITS, r, 5.0)  # miss+seed
+                out = run_serial(
+                    lambda: cli.call(METHOD_GET_PEER_RATE_LIMITS, r, 5.0),
+                    args.seconds)
+                out["native_hits"] = svc.native_hits()
+                return out
+            finally:
+                cli.close()
+                svc.close()
+
+        def bench_public_link_serial():
+            # r3: the PUBLIC lean surface over the columnar link
+            # (client.LinkClient, method 0 — full router semantics). On
+            # this multi-node cluster frames take the routed object path
+            # server-side; the standalone IO-thread fast path is measured
+            # in BENCH_SUITE.md's round-3 rows.
+            from gubernator_tpu.client import LinkClient
+
+            if not node_links:
+                return {"skipped": "peerlink not wired"}
+            # SAME entry node as bench_get_rate_limit's V1Client, so the
+            # two rows compare the transports, not the key-ownership mix
+            idx = next(i for i, x in enumerate(cluster.instances)
+                       if x.address == client.address)
+            ci = cluster.instances[idx]
+            off = node_links[idx].port - int(
+                ci.address.rsplit(":", 1)[1])
+            cli = LinkClient(ci.address, link_offset=off)
+            try:
+                if cli._link is None:
+                    return {"skipped": "link did not connect"}
+                return run_serial(
+                    lambda: cli.get_rate_limits(
+                        [req("public_link", _rand_key(rng),
+                             limit=1_000_000)]),
+                    args.seconds)
+            finally:
+                cli.close()
+
         scenarios = {
             "get_rate_limit": bench_get_rate_limit,
             "get_rate_limit_batch100": bench_get_rate_limit_batch,
@@ -508,6 +564,8 @@ def main(argv=None) -> int:
             "peerlink_unbatched_rps": bench_peerlink_unbatched_rps,
             "peerlink_herd": bench_peerlink_herd,
             "peerlink_batch100": bench_peerlink_batch100,
+            "native_lone_hop": bench_native_lone_hop,
+            "public_link_serial": bench_public_link_serial,
             "health_check": bench_health_check,
             "thundering_herd": bench_thundering_herd,
             "thundering_herd_mp": bench_thundering_herd_mp,
