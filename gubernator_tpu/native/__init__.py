@@ -122,6 +122,13 @@ def load_library() -> ctypes.CDLL:
             c.c_int64, c.c_void_p, c.c_int32, c.c_void_p, c.c_void_p,
             c.c_void_p, c.c_void_p, c.c_void_p,
         ]
+        lib.keydir_prep_route_columnar.restype = c.c_int32
+        lib.keydir_prep_route_columnar.argtypes = [
+            c.c_void_p, c.c_int32, c.c_int32, c.c_char_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_int64, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p,
+        ]
         _LIB = lib
         return lib
 
@@ -277,6 +284,34 @@ def prep_pack_columnar(directory: "NativeKeyDirectory", n: int,
         return n0, None, None, inject[:int(n_inj[0])]
     return (n0, lane_item[:n0], leftover[:int(n_left[0])],
             inject[:int(n_inj[0])])
+
+
+def prep_route_columnar(directories, n: int, keys, key_off, name_len,
+                        hits, limit, duration, algorithm, behavior,
+                        slow_mask: int):
+    """Columnar sharded prep: the peerlink wire columns routed to owner
+    shards in one GIL-free C pass (see prep_route_sharded for the output
+    contract). Returns (n0, cols, lane_item, owner_count, leftover)."""
+    lib = load_library()
+    n_owners = len(directories)
+    handles = (ctypes.c_void_p * n_owners)(*[d._kd for d in directories])
+    cols = np.zeros((9, n), np.int64)
+    lane_item = np.empty(n, np.int32)
+    owner_count = np.empty(n_owners, np.int32)
+    leftover = np.empty(n, np.int32)
+    n_left = np.zeros(1, np.int32)
+    n0 = lib.keydir_prep_route_columnar(
+        handles, n_owners, n, keys,
+        key_off.ctypes.data, name_len.ctypes.data, hits.ctypes.data,
+        limit.ctypes.data, duration.ctypes.data, algorithm.ctypes.data,
+        behavior.ctypes.data, slow_mask,
+        cols.ctypes.data, lane_item.ctypes.data, owner_count.ctypes.data,
+        leftover.ctypes.data, n_left.ctypes.data,
+    )
+    if n0 < 0:
+        return n0, None, None, None, None
+    return (n0, cols, lane_item[:n0], owner_count,
+            leftover[:int(n_left[0])])
 
 
 def prep_route_sharded(directories, requests, greg_mask: int):

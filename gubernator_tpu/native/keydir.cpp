@@ -824,6 +824,110 @@ int32_t keydir_prep_pack_columnar(
     return n0;
 }
 
+
+namespace {
+
+// Owner-routed lane accumulator + drain shared by the two sharded preps:
+// per-owner directory lookup and the owner-major staging emit (the decide
+// staging row-order contract — slot / 5 request cols / gregorian zeros /
+// fresh — lives HERE only). Returns total lanes, or -2 on over-commit.
+struct OwnerLanes {
+    std::string arena;
+    std::vector<int64_t> offsets{0};
+    std::vector<int32_t> item;
+    std::vector<int64_t> col5;  // 5 values per lane
+};
+
+int32_t drain_owner_lanes(void** kds, int32_t n_owners,
+                          std::vector<OwnerLanes>& owners, int32_t n,
+                          int64_t* cols, int32_t* lane_item,
+                          int32_t* owner_count) {
+    int64_t pos = 0;
+    for (int32_t o = 0; o < n_owners; ++o) {
+        OwnerLanes& ol = owners[o];
+        const int32_t cnt = static_cast<int32_t>(ol.item.size());
+        owner_count[o] = cnt;
+        if (cnt == 0) continue;
+        std::vector<int32_t> slots(cnt);
+        std::vector<uint8_t> fresh(cnt);
+        const int64_t done = static_cast<KeyDir*>(kds[o])->lookup_batch(
+            ol.arena.data(), ol.offsets.data(), cnt, slots.data(),
+            fresh.data());
+        if (done != cnt) return -2;
+        for (int32_t j = 0; j < cnt; ++j) {
+            const int64_t lane = pos + j;
+            cols[0 * n + lane] = slots[j];
+            for (int f = 0; f < 5; ++f) {
+                cols[(f + 1) * n + lane] = ol.col5[5 * j + f];
+            }
+            // rows 6/7 (gregorian) stay zero
+            cols[8 * n + lane] = fresh[j];
+            lane_item[lane] = ol.item[j];
+        }
+        pos += cnt;
+    }
+    return static_cast<int32_t>(pos);
+}
+
+}  // namespace
+
+// Columnar sharded prep: keydir_prep_route_sharded's contract with the
+// COLUMNAR input of keydir_prep_pack_columnar (the peerlink wire layout)
+// — pure C, no CPython API, callable with the GIL released. Output lanes
+// are owner-major in `cols` (i64[9, n], decide staging row order) with
+// owner_count[o] lanes per owner; leftover/UTF-8/slow-mask semantics
+// match the columnar single-table prep.
+int32_t keydir_prep_route_columnar(
+    void** kds, int32_t n_owners, int32_t n, const char* keys,
+    const int32_t* key_off, const int32_t* name_len, const int64_t* hits,
+    const int64_t* limit, const int64_t* duration,
+    const int32_t* algorithm, const int32_t* behavior, int64_t slow_mask,
+    int64_t* cols, int32_t* lane_item, int32_t* owner_count,
+    int32_t* leftover, int32_t* n_leftover_out) {
+    if (n <= 0) return -1;
+
+    std::vector<OwnerLanes> owners(n_owners);
+    std::unordered_set<std::string> seen;
+    seen.reserve(n);
+    std::string key;
+    int32_t n_left = 0;
+    for (int32_t i = 0; i < n; ++i) {
+        const int32_t lo = key_off[i], hi = key_off[i + 1];
+        const int32_t nl = name_len[i], ul = hi - lo - nl;
+        bool ok = nl > 0 && ul > 0 && (behavior[i] & slow_mask) == 0 &&
+                  key_bytes_ok(keys + lo, nl) &&
+                  key_bytes_ok(keys + lo + nl, ul);
+        if (nl > 0 && ul > 0) {
+            key.assign(keys + lo, nl);
+            key.push_back('_');
+            key.append(keys + lo + nl, ul);
+            if (ok) {
+                ok = seen.insert(key).second;
+            } else {
+                seen.insert(key);  // later occurrences also demote
+            }
+        }
+        if (!ok) {
+            leftover[n_left++] = i;
+            continue;
+        }
+        const uint64_t h =
+            fnv1a(key.data(), static_cast<int32_t>(key.size()));
+        OwnerLanes& ol = owners[h % static_cast<uint64_t>(n_owners)];
+        ol.arena += key;
+        ol.offsets.push_back(static_cast<int64_t>(ol.arena.size()));
+        ol.item.push_back(i);
+        ol.col5.push_back(hits[i]);
+        ol.col5.push_back(limit[i]);
+        ol.col5.push_back(duration[i]);
+        ol.col5.push_back(algorithm[i]);
+        ol.col5.push_back(behavior[i]);
+    }
+    *n_leftover_out = n_left;
+    return drain_owner_lanes(kds, n_owners, owners, n, cols, lane_item,
+                             owner_count);
+}
+
 // Sharded variant of keydir_prep_pack_fast: one pass that ALSO routes each
 // lane to its owner shard (owner = fnv1a64(key) % n_owners, the
 // parallel/mesh.py shard_of_key contract) and looks the key up in that
@@ -851,12 +955,6 @@ int32_t keydir_prep_route_sharded(void** kds, int32_t n_owners,
         return -1;
     }
 
-    struct OwnerLanes {
-        std::string arena;
-        std::vector<int64_t> offsets{0};
-        std::vector<int32_t> item;
-        std::vector<int64_t> col5;  // 5 values per lane
-    };
     std::vector<OwnerLanes> owners(n_owners);
     std::unordered_set<std::string> seen;  // same per-key order rule as
     seen.reserve(n);                       // keydir_prep_pack_fast
@@ -878,33 +976,9 @@ int32_t keydir_prep_route_sharded(void** kds, int32_t n_owners,
     }
     Py_DECREF(seq);
     *n_leftover_out = n_left;
-
-    // per-owner lookup + owner-major output
-    int64_t pos = 0;
-    for (int32_t o = 0; o < n_owners; ++o) {
-        OwnerLanes& ol = owners[o];
-        const int32_t cnt = static_cast<int32_t>(ol.item.size());
-        owner_count[o] = cnt;
-        if (cnt == 0) continue;
-        std::vector<int32_t> slots(cnt);
-        std::vector<uint8_t> fresh(cnt);
-        const int64_t done = static_cast<KeyDir*>(kds[o])->lookup_batch(
-            ol.arena.data(), ol.offsets.data(), cnt, slots.data(),
-            fresh.data());
-        if (done != cnt) return -2;
-        for (int32_t j = 0; j < cnt; ++j) {
-            const int64_t lane = pos + j;
-            cols[0 * n + lane] = slots[j];
-            for (int f = 0; f < 5; ++f) {
-                cols[(f + 1) * n + lane] = ol.col5[5 * j + f];
-            }
-            // rows 6/7 (gregorian) stay zero
-            cols[8 * n + lane] = fresh[j];
-            lane_item[lane] = ol.item[j];
-        }
-        pos += cnt;
-    }
-    return static_cast<int32_t>(pos);
+    return drain_owner_lanes(kds, n_owners, owners,
+                             static_cast<int32_t>(n), cols, lane_item,
+                             owner_count);
 }
 
 }  // extern "C"

@@ -475,7 +475,6 @@ class ShardedEngine:
         invalid, gregorian, GLOBAL, duplicate occurrences — run through the
         python pipeline AFTER this round (same per-key order contract as
         Engine._fast_window)."""
-        R, S = self.plan.n_regions, self.plan.n_shards
         with self._lock:
             t0 = time.perf_counter_ns()  # excludes the lock wait
             n0, cols, lane_item, owner_count, leftover = self._prep_fast(
@@ -493,29 +492,14 @@ class ShardedEngine:
             self.stats["batches"] += 1
             responses: List[Optional[RateLimitResp]] = [None] * len(requests)
             if n0:
-                counts = owner_count.tolist()
-                w = bucket_width(max(counts), self.min_width, self.max_width)
-                packed = np.zeros((R, S, 9, w), np.int64)
-                packed[:, :, 0, :] = -1
-                placed = []
-                lanes = lane_item.tolist()
-                pos = 0
-                for o, cnt in enumerate(counts):
-                    if not cnt:
-                        continue
-                    r_, s_ = self.plan.owner_coords(o)
-                    packed[r_, s_, :, :cnt] = cols[:, pos:pos + cnt]
-                    placed.append((r_, s_, None, lanes[pos:pos + cnt]))
-                    pos += cnt
-                t2 = time.perf_counter_ns()
-                self.stats["pack_ns"] += t2 - t1
-                self.stats["rounds"] += 1
-                self.state, out = self._decide(self.state, packed, now_ms)
-                out = np.asarray(out)
+                out, placed = self._pack_and_decide(
+                    cols, lane_item, owner_count, now_ms, t1)
                 t3 = time.perf_counter_ns()
-                self.stats["device_ns"] += t3 - t2
+                out = np.asarray(out)  # readback sync
+                t4 = time.perf_counter_ns()
+                self.stats["device_ns"] += t4 - t3
                 self._demux(out, placed, responses)
-                self.stats["demux_ns"] += time.perf_counter_ns() - t3
+                self.stats["demux_ns"] += time.perf_counter_ns() - t4
         if len(leftover):
             idxs = leftover.tolist()
             tail = self._slow_window(
@@ -523,6 +507,108 @@ class ShardedEngine:
             for i, resp in zip(idxs, tail):
                 responses[i] = resp
         return responses  # type: ignore[return-value]
+
+    # ------------------------------------------------------- columnar path
+
+    def supports_columnar(self) -> bool:
+        """True when the zero-object columnar serving path is available
+        (models/engine.py Engine.supports_columnar's mesh twin)."""
+        return self._prep_fast is not None and self.store is None
+
+    def submit_columnar(self, n: int, keys, key_off, name_len, hits, limit,
+                        duration, algorithm, behavior, slow_mask: int,
+                        now_ms: Optional[int] = None):
+        """Dispatch one columnar window over the mesh: wire columns route
+        to owner shards in one GIL-free C pass
+        (native/keydir.cpp keydir_prep_route_columnar) and decide in one
+        shard_map'ped launch. Same contract as Engine.submit_columnar —
+        the peerlink server drives either backend through it."""
+        if not 0 < n <= self.max_width:
+            return None
+        if now_ms is None:
+            now_ms = millisecond_now()
+        from gubernator_tpu import native
+
+        with self._lock:
+            t0 = time.perf_counter_ns()
+            n0, cols, lane_item, owner_count, leftover = \
+                native.prep_route_columnar(
+                    self.directories, n, keys, key_off, name_len, hits,
+                    limit, duration, algorithm, behavior,
+                    slow_mask | _SLOW_MASK)
+            if n0 == PREP_OVERCOMMIT:
+                raise RuntimeError(
+                    "key directory over-committed: "
+                    f">{self.plan.capacity_per_shard} distinct keys on "
+                    "one shard in one lookup")
+            if n0 < 0:
+                return None
+            t1 = time.perf_counter_ns()
+            self.stats["prep_ns"] += t1 - t0
+            self.stats["requests"] += n0
+            self.stats["batches"] += 1
+            out, placed = None, []
+            if n0:
+                out, placed = self._pack_and_decide(
+                    cols, lane_item, owner_count, now_ms, t1)
+        return (out, placed, leftover, n0)
+
+    def _pack_and_decide(self, cols, lane_item, owner_count, now_ms, t1):
+        """Pack owner-major staging cols into the [R,S,9,w] mesh buffer
+        and dispatch one shard_map'ped window — the ONE copy of the mesh
+        packing contract, shared by the object and columnar fast paths.
+        Returns (out_device, placed) with placed rows (r, s, None, lanes).
+        Caller holds the lock; `t1` is the pack-start clock; pack/rounds/
+        dispatch stats recorded here, readback+demux by the caller."""
+        R, S = self.plan.n_regions, self.plan.n_shards
+        counts = owner_count.tolist()
+        w = bucket_width(max(counts), self.min_width, self.max_width)
+        packed = np.zeros((R, S, 9, w), np.int64)
+        packed[:, :, 0, :] = -1
+        placed = []
+        lanes = lane_item.tolist()
+        pos = 0
+        for o, cnt in enumerate(counts):
+            if not cnt:
+                continue
+            r_, s_ = self.plan.owner_coords(o)
+            packed[r_, s_, :, :cnt] = cols[:, pos:pos + cnt]
+            placed.append((r_, s_, None, lanes[pos:pos + cnt]))
+            pos += cnt
+        t2 = time.perf_counter_ns()
+        self.stats["pack_ns"] += t2 - t1
+        self.stats["rounds"] += 1
+        self.state, out = self._decide(self.state, packed, now_ms)
+        self.stats["device_ns"] += time.perf_counter_ns() - t2
+        return out, placed
+
+    def complete_columnar(self, handle, out_status, out_limit,
+                          out_remaining, out_reset) -> np.ndarray:
+        """Read back a submitted mesh window and scatter the owner blocks'
+        response rows to their item positions. Returns leftover indices
+        (run them through the request-object path AFTER this round)."""
+        out, placed, leftover, n0 = handle
+        if n0:
+            t0 = time.perf_counter_ns()
+            rows = np.asarray(out)  # device sync for THIS window
+            t1 = time.perf_counter_ns()
+            over = 0
+            for r_, s_, _k, lanes in placed:
+                blk = rows[r_, s_]
+                cnt = len(lanes)
+                li = np.asarray(lanes, np.int64)
+                out_status[li] = blk[0, :cnt]
+                out_limit[li] = blk[1, :cnt]
+                out_remaining[li] = blk[2, :cnt]
+                out_reset[li] = blk[3, :cnt]
+                over += int(np.count_nonzero(
+                    blk[0, :cnt] == int(Status.OVER_LIMIT)))
+            t2 = time.perf_counter_ns()
+            with self._lock:  # concurrent completers: counters stay exact
+                self.stats["over_limit"] += over
+                self.stats["device_ns"] += t1 - t0
+                self.stats["demux_ns"] += t2 - t1
+        return leftover
 
     def _slow_window(self, requests, now_ms,
                      count_batch: bool = True) -> List[RateLimitResp]:

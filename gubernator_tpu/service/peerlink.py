@@ -319,6 +319,14 @@ class PeerLinkService:
         self._seed_engine = None
         cb = getattr(instance, "columnar_backend", None)
         eng = cb() if callable(cb) else None
+        if eng is not None:
+            # the PUBLIC lean surface (method 0) needs routing; while this
+            # node owns every key the columnar owner path (and, on the
+            # single-table engine, the IO-thread mirror path) can serve it
+            # too — re-armed whenever membership changes
+            self._rearm_public()
+            if hasattr(instance, "on_peers_change"):
+                instance.on_peers_change(self._rearm_public)
         if eng is not None and hasattr(eng, "seed_mirror") and \
                 hasattr(eng.directory, "_kd"):
             kd_lib = native.load_library()
@@ -327,12 +335,6 @@ class PeerLinkService:
             self._lib.pls_set_native(
                 self._handle, fn, eng.directory._kd, _COLUMNAR_SLOW_MASK)
             self._seed_engine = eng
-            # the PUBLIC lean surface (method 0) needs routing; while this
-            # node owns every key the IO-thread/columnar owner paths can
-            # serve it too — re-armed whenever membership changes
-            self._rearm_public()
-            if hasattr(instance, "on_peers_change"):
-                instance.on_peers_change(self._rearm_public)
         self._stop = False
         self._threads = []
         for i in range(workers):

@@ -185,6 +185,96 @@ class TestColumnarDifferential:
         assert h is None  # 300 > max_width 256: caller falls back
 
 
+class TestShardedColumnar:
+    """The mesh twin (parallel/sharded.py submit/complete_columnar):
+    owner-routed columnar windows must be bit-identical to the sharded
+    object path and to the single-table engine."""
+
+    def test_sharded_columnar_differential(self):
+        from gubernator_tpu.parallel import ShardedEngine
+
+        host = Engine(capacity=2048, min_width=16, max_width=256)
+        obj = ShardedEngine(n_shards=4, capacity_per_shard=512,
+                            min_width=16, max_width=256)
+        col = ShardedEngine(n_shards=4, capacity_per_shard=512,
+                            min_width=16, max_width=256)
+        for e in (host, obj, col):
+            e.warmup()
+        assert col.supports_columnar()
+        rng = np.random.default_rng(31)
+        for it in range(12):
+            n = int(rng.integers(1, 150))
+            reqs = []
+            for _ in range(n):
+                beh = (int(Behavior.RESET_REMAINING)
+                       if rng.random() < 0.1 else 0)
+                reqs.append(RateLimitReq(
+                    name="sc", unique_key=f"k{rng.integers(0, 40)}",
+                    hits=int(rng.integers(0, 3)), limit=25,
+                    duration=60_000,
+                    algorithm=(Algorithm.TOKEN_BUCKET if rng.random() < .7
+                               else Algorithm.LEAKY_BUCKET),
+                    behavior=beh))
+            now = NOW + it * 700
+            want = host.get_rate_limits(reqs, now_ms=now)
+            wobj = obj.get_rate_limits(reqs, now_ms=now)
+            assert want == wobj, (it,)
+            c = cols_from(reqs)
+            st = np.zeros(n, np.int32)
+            li = np.zeros(n, np.int64)
+            re = np.zeros(n, np.int64)
+            rs = np.zeros(n, np.int64)
+            h = col.submit_columnar(
+                n, c["keys"], c["key_off"], c["name_len"], c["hits"],
+                c["limit"], c["duration"], c["algorithm"], c["behavior"],
+                SLOW, now_ms=now)
+            assert h is not None
+            left = col.complete_columnar(h, st, li, re, rs)
+            for i in left.tolist():
+                r = col.get_rate_limits([reqs[i]], now_ms=now)[0]
+                st[i], li[i], re[i], rs[i] = (r.status, r.limit,
+                                              r.remaining, r.reset_time)
+            for i, w in enumerate(want):
+                got = (st[i], li[i], re[i], rs[i])
+                assert got == (w.status, w.limit, w.remaining,
+                               w.reset_time), (it, i, reqs[i], got, w)
+
+    def test_peerlink_serves_sharded_columnar(self):
+        """The peerlink server drives the mesh backend through the same
+        submit/complete API (instance.columnar_backend)."""
+        from gubernator_tpu.parallel import ShardedEngine
+        from gubernator_tpu.service.config import InstanceConfig
+        from gubernator_tpu.service.instance import Instance
+        from gubernator_tpu.service.peerlink import (
+            METHOD_GET_PEER_RATE_LIMITS,
+            PeerLinkClient,
+            PeerLinkService,
+        )
+
+        eng = ShardedEngine(n_shards=4, capacity_per_shard=512,
+                            min_width=16, max_width=256)
+        eng.warmup()
+        inst = Instance(InstanceConfig(backend=eng),
+                        advertise_address="self")
+        assert inst.columnar_backend() is eng
+        svc = PeerLinkService(inst, port=0)
+        cli = PeerLinkClient(f"127.0.0.1:{svc.port}")
+        try:
+            reqs = [RateLimitReq(name="sp", unique_key=f"m{i % 7}", hits=1,
+                                 limit=4, duration=60_000)
+                    for i in range(21)]
+            out = cli.call(METHOD_GET_PEER_RATE_LIMITS, reqs, 10.0)
+            per_key = {}
+            for r, o in zip(reqs, out):
+                per_key.setdefault(r.unique_key, []).append(o)
+            for outs in per_key.values():
+                assert [o.remaining for o in outs] == [3, 2, 1]
+        finally:
+            cli.close()
+            svc.close()
+            inst.close()
+
+
 class TestPeerlinkColumnar:
     def test_link_rides_columnar_end_to_end(self):
         """A peerlink peer-hop batch is served by the columnar path (no
