@@ -480,10 +480,7 @@ class ShardedEngine:
             n0, cols, lane_item, owner_count, leftover = self._prep_fast(
                 self.directories, requests, _SLOW_MASK)
             if n0 == PREP_OVERCOMMIT:
-                raise RuntimeError(
-                    "key directory over-committed: "
-                    f">{self.plan.capacity_per_shard} distinct keys on one "
-                    "shard in one lookup")
+                self._raise_overcommit()
             if n0 < 0:
                 return None
             t1 = time.perf_counter_ns()
@@ -537,10 +534,7 @@ class ShardedEngine:
                     limit, duration, algorithm, behavior,
                     slow_mask | _SLOW_MASK)
             if n0 == PREP_OVERCOMMIT:
-                raise RuntimeError(
-                    "key directory over-committed: "
-                    f">{self.plan.capacity_per_shard} distinct keys on "
-                    "one shard in one lookup")
+                self._raise_overcommit()
             if n0 < 0:
                 return None
             t1 = time.perf_counter_ns()
@@ -552,6 +546,12 @@ class ShardedEngine:
                 out, placed = self._pack_and_decide(
                     cols, lane_item, owner_count, now_ms, t1)
         return (out, placed, leftover, n0)
+
+    def _raise_overcommit(self):
+        raise RuntimeError(
+            "key directory over-committed: "
+            f">{self.plan.capacity_per_shard} distinct keys on one shard "
+            "in one lookup")
 
     def _pack_and_decide(self, cols, lane_item, owner_count, now_ms, t1):
         """Pack owner-major staging cols into the [R,S,9,w] mesh buffer
@@ -793,9 +793,12 @@ class ShardedEngine:
 
         Round sizes only shrink, so the small duplicate-key rounds the scan
         path exists for always trail the list; wide windows keep the
-        per-round path (already one amortized dispatch). The Store hooks are
-        per-round host calls, so a store disables the fast path, exactly as
-        in models/engine.py."""
+        per-round path (already one amortized dispatch). A Store keeps the
+        per-round path HERE (unlike models/engine.py r3, which batches the
+        hooks around the scan tail): the sharded hooks stage per-owner mesh
+        gathers/injects whose batched variant would need resolved
+        slot/fresh maps threaded through _pack_lanes — deliberate scope,
+        store+mesh+hot-key-herd being the narrow corner (PARITY #8)."""
         if self.store is not None or len(windows) <= 1:
             return windows, []
         split = len(windows)

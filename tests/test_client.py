@@ -111,20 +111,39 @@ class TestClusterBinary:
             JAX_COMPILATION_CACHE_DIR=os.path.join(repo, "tests", ".jax_cache"),
             JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS="0.5",
         )
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "gubernator_tpu.cmd.cluster_main",
-             str(port)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-            text=True, env=env, cwd=repo)
+
+        def boot(p):
+            log = open(f"/tmp/guber_cluster_main_{p}.log", "w")
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "gubernator_tpu.cmd.cluster_main",
+                 str(p)],
+                stdout=subprocess.PIPE, stderr=log,
+                text=True, env=env, cwd=repo)
+            log.close()  # the child holds its own descriptor
+            return proc
+
+        proc = boot(port)
         try:
-            # a wedged warmup must fail the test, not hang the whole suite
-            got: list = []
-            reader = threading.Thread(
-                target=lambda: got.append(proc.stdout.readline()),
-                daemon=True)
-            reader.start()
-            reader.join(timeout=240)
-            assert got and got[0].strip() == "Ready", got
+            # a wedged warmup must fail the test, not hang the whole suite;
+            # a lost port-reservation race (another suite subprocess bound
+            # it first — the binary then exits without Ready) retries once
+            # on a fresh port
+            for _attempt in range(2):
+                got: list = []
+                reader = threading.Thread(
+                    target=lambda: got.append(proc.stdout.readline()),
+                    daemon=True)
+                reader.start()
+                reader.join(timeout=240)
+                if got and got[0].strip() == "Ready":
+                    break
+                if proc.poll() is None or _attempt == 1:
+                    break  # alive-but-silent (or out of retries): fail below
+                proc.stdout.close()  # don't leak the dead child's pipe fd
+                port = free_port()
+                proc = boot(port)
+            assert got and got[0].strip() == "Ready", (
+                got, open(f"/tmp/guber_cluster_main_{port}.log").read()[-1500:])
             r = V1Client(f"127.0.0.1:{port}").get_rate_limits(
                 [RateLimitReq(name="bin_t", unique_key="k", hits=1,
                               limit=5, duration=60_000)],
