@@ -794,12 +794,11 @@ class ShardedEngine:
         Round sizes only shrink, so the small duplicate-key rounds the scan
         path exists for always trail the list; wide windows keep the
         per-round path (already one amortized dispatch). A Store keeps the
-        per-round path HERE (unlike models/engine.py r3, which batches the
-        hooks around the scan tail): the sharded hooks stage per-owner mesh
-        gathers/injects whose batched variant would need resolved
-        slot/fresh maps threaded through _pack_lanes — deliberate scope,
-        store+mesh+hot-key-herd being the narrow corner (PARITY #8)."""
-        if self.store is not None or len(windows) <= 1:
+        scan path (models/engine.py r3 parity): ONE read-through before
+        the tail over the union of its keys, ONE write-through after with
+        each key's FINAL row — resolved slot/fresh maps thread through
+        _pack_lanes so no re-lookup strips a fresh flag (PARITY #8)."""
+        if len(windows) <= 1:
             return windows, []
         split = len(windows)
         while split > 0 and len(windows[split - 1]) <= self.min_width:
@@ -887,18 +886,61 @@ class ShardedEngine:
         R, S = self.plan.n_regions, self.plan.n_shards
         w = self.min_width  # _split_scannable guarantees every window fits
 
+        # Store hooks batch around the WHOLE tail (models/engine.py r3
+        # parity): one read-through over the union of its keys, one
+        # write-through after with final rows. Per-window slot/fresh come
+        # from the union lookup's maps — a re-lookup would strip the fresh
+        # flag of a first-occurrence key in a later tail window. `fresh`
+        # is consumed by the key's first window.
+        store_ctx = None
+        slot_map = fresh_map = None
+        if self.store is not None and windows:
+            seen_items = {}
+            for wk in windows:
+                for item in wk:
+                    seen_items.setdefault(item[1].hash_key(), item)
+            union_items = list(seen_items.values())
+            _lanes, per_owner, slotmat, _wu = \
+                self._store_lookup_owners(union_items, unbounded=True)
+            self._store_read_through_mesh(per_owner, slotmat, now_ms)
+            slot_map, fresh_map = {}, {}
+            for _o, _r, _s, _items, keys, slots, fresh in per_owner:
+                for j, key in enumerate(keys):
+                    slot_map[key] = slots[j]
+                    if fresh[j]:
+                        fresh_map[key] = True
+            store_ctx = (per_owner, slotmat)
+
+        def window_pre(lanes):
+            if store_ctx is None:
+                return None
+            pre = {}
+            for owner, items in enumerate(lanes):
+                if not items:
+                    continue
+                ks = [it[1].hash_key() for it in items]
+                pre[owner] = ([slot_map[k] for k in ks],
+                              [fresh_map.pop(k, False) for k in ks])
+            return pre
+
         for g0 in range(0, len(windows), self._MAX_SCAN):
             group = windows[g0:g0 + self._MAX_SCAN]
             if len(group) == 1:
-                # trailing singleton rides the warmed single-window program
-                self._apply_round(group[0], now_ms, responses)
+                # trailing singleton rides the warmed single-window
+                # program; inside a store tail it reuses the union's
+                # resolved maps (its keys are covered by the batched hooks)
+                lanes = self._route_lanes(group[0])
+                self._apply_round(group[0], now_ms, responses,
+                                  pre=window_pre(lanes), lanes=lanes)
                 continue
             k_pad = _bucket_pow2(len(group))
             packed = np.zeros((R, S, k_pad, 9, w), np.int64)
             packed[:, :, :, 0, :] = -1  # vacant lanes (incl. pad windows)
             placed: List[Tuple[int, int, Optional[int], List[int]]] = []
             for k, wk in enumerate(group):
-                self._pack_lanes(self._route_lanes(wk), w, packed, placed, k)
+                lanes = self._route_lanes(wk)
+                self._pack_lanes(lanes, w, packed, placed, k,
+                                 pre=window_pre(lanes))
 
             t = time.perf_counter_ns()
             self.state, out = self._decide_scan(self.state, packed, now_ms)
@@ -908,11 +950,21 @@ class ShardedEngine:
             self._demux(out, placed, responses)
             self.stats["demux_ns"] += time.perf_counter_ns() - t2
 
-    def _apply_round(self, round_work: List[WorkItem], now_ms, responses) -> None:
-        if self.store is not None:
+        if store_ctx is not None:
+            per_owner, slotmat = store_ctx
+            self._store_write_through_mesh(per_owner, slotmat, now_ms)
+
+    def _apply_round(self, round_work: List[WorkItem], now_ms, responses,
+                     pre=None, lanes=None) -> None:
+        """One window, one mesh dispatch. `pre` (owner -> (slots, fresh))
+        marks a tail singleton inside _apply_rounds_scanned's store tail,
+        whose batched read/write-through already covers these keys
+        (`lanes` carries the caller's routing so it isn't redone)."""
+        if self.store is not None and pre is None:
             return self._apply_round_store(round_work, now_ms, responses)
         R, S = self.plan.n_regions, self.plan.n_shards
-        lanes = self._route_lanes(round_work)
+        if lanes is None:
+            lanes = self._route_lanes(round_work)
         w = bucket_width(
             max(len(l) for l in lanes), self.min_width, self.max_width)
 
@@ -921,7 +973,7 @@ class ShardedEngine:
         packed = np.zeros((R, S, 9, w), np.int64)
         packed[:, :, 0, :] = -1  # vacant lanes
         placed: List[Tuple[int, int, Optional[int], List[int]]] = []
-        self._pack_lanes(lanes, w, packed, placed, None)
+        self._pack_lanes(lanes, w, packed, placed, None, pre=pre)
 
         t = time.perf_counter_ns()
         self.state, out = self._decide(self.state, packed, now_ms)
@@ -931,19 +983,20 @@ class ShardedEngine:
         self._demux(out, placed, responses)
         self.stats["demux_ns"] += time.perf_counter_ns() - t2
 
-    def _apply_round_store(self, round_work: List[WorkItem], now_ms,
-                           responses) -> None:
-        """Store-aware round: read-through before the kernel, write-through
-        after, per owner lane. Mirrors models/engine.py
-        _store_read_through/_store_write_through (reference:
-        algorithms.go:26-33,64-68,175-177); the extra cost is two mesh row
-        gathers and at most one row inject per window — all staged through
-        single [R,S,...] buffers like the decide path itself."""
+    def _store_lookup_owners(self, work_items: List[WorkItem],
+                             unbounded: bool = False):
+        """Route + per-owner directory lookup for the Store paths.
+        Returns (lanes, per_owner rows (owner, r, s, items, keys, slots,
+        fresh), slotmat [R,S,w], w). `unbounded` lifts the max_width clamp:
+        the scan tail's UNION spans many windows, and its slotmat only
+        feeds the store gather/inject — never a decide window — so its
+        lane width must fit the union, not the kernel."""
         R, S = self.plan.n_regions, self.plan.n_shards
-        lanes = self._route_lanes(round_work)
-        w = bucket_width(
-            max(len(l) for l in lanes), self.min_width, self.max_width)
-
+        lanes = self._route_lanes(work_items)
+        mx = max(len(l) for l in lanes)
+        cap = max(self.max_width, _bucket_pow2(mx)) if unbounded \
+            else self.max_width
+        w = bucket_width(mx, self.min_width, cap)
         per_owner = []  # (owner, r, s, items, keys, slots, fresh)
         slotmat = np.full((R, S, w), -1, np.int32)
         t = time.perf_counter_ns()
@@ -956,8 +1009,14 @@ class ShardedEngine:
             slotmat[r_, s_, :len(slots)] = slots
             per_owner.append((owner, r_, s_, items, keys, slots, list(fresh)))
         self.stats["lookup_ns"] += time.perf_counter_ns() - t
+        return lanes, per_owner, slotmat, w
 
-        # ---- read-through (reference: algorithms.go:26-33) ---------------
+    def _store_read_through_mesh(self, per_owner, slotmat, now_ms) -> None:
+        """Consult the store for rows the table can't serve (reference:
+        algorithms.go:26-33); injects returned rows and flips their fresh
+        flags (per_owner's fresh lists mutate in place)."""
+        R, S = self.plan.n_regions, self.plan.n_shards
+        w = slotmat.shape[-1]
         t = time.perf_counter_ns()
         rows = np.asarray(self._gather(self.state, slotmat))  # [R,S,7,w]
         inj_slot = np.full((R, S, w), -1, np.int32)
@@ -989,6 +1048,35 @@ class ShardedEngine:
             self.state = self._inject(self.state, inj_slot, inj_rows)
         self.stats["store_ns"] += time.perf_counter_ns() - t
 
+    def _store_write_through_mesh(self, per_owner, slotmat, now_ms) -> None:
+        """Report post-decision rows (reference: algorithms.go:64-68,
+        175-177); discarded buckets get remove + directory drop."""
+        t = time.perf_counter_ns()
+        rows = np.asarray(self._gather(self.state, slotmat))
+        for owner, r_, s_, items, keys, slots, fresh in per_owner:
+            for j, (_i, r, _ge, _gi) in enumerate(items):
+                if int(rows[r_, s_, 0, j]) < 0:
+                    # token RESET_REMAINING cleared the row
+                    # (reference: algorithms.go:37-39)
+                    self.store.remove(keys[j])
+                    self.directories[owner].drop(keys[j])
+                    continue
+                self.store.on_change(
+                    r, self._row_snapshot(rows, r_, s_, j, keys[j]))
+        self.stats["store_ns"] += time.perf_counter_ns() - t
+
+    def _apply_round_store(self, round_work: List[WorkItem], now_ms,
+                           responses) -> None:
+        """Store-aware round: read-through before the kernel, write-through
+        after, per owner lane. Mirrors models/engine.py
+        _store_read_through/_store_write_through (reference:
+        algorithms.go:26-33,64-68,175-177); the extra cost is two mesh row
+        gathers and at most one row inject per window — all staged through
+        single [R,S,...] buffers like the decide path itself."""
+        R, S = self.plan.n_regions, self.plan.n_shards
+        lanes, per_owner, slotmat, w = self._store_lookup_owners(round_work)
+        self._store_read_through_mesh(per_owner, slotmat, now_ms)
+
         # ---- decide ------------------------------------------------------
         packed = np.zeros((R, S, 9, w), np.int64)
         packed[:, :, 0, :] = -1
@@ -1004,20 +1092,7 @@ class ShardedEngine:
         self._demux(out, placed, responses)
         self.stats["demux_ns"] += time.perf_counter_ns() - t3
 
-        # ---- write-through (reference: algorithms.go:64-68,175-177) ------
-        t = time.perf_counter_ns()
-        rows = np.asarray(self._gather(self.state, slotmat))
-        for owner, r_, s_, items, keys, slots, fresh in per_owner:
-            for j, (_i, r, _ge, _gi) in enumerate(items):
-                if int(rows[r_, s_, 0, j]) < 0:
-                    # token RESET_REMAINING cleared the row
-                    # (reference: algorithms.go:37-39)
-                    self.store.remove(keys[j])
-                    self.directories[owner].drop(keys[j])
-                    continue
-                self.store.on_change(
-                    r, self._row_snapshot(rows, r_, s_, j, keys[j]))
-        self.stats["store_ns"] += time.perf_counter_ns() - t
+        self._store_write_through_mesh(per_owner, slotmat, now_ms)
 
     def _build_global_config(self, now_ms: int) -> GlobalConfig:
         import datetime as _dt

@@ -466,6 +466,54 @@ class TestShardedStoreSPI:
         return ShardedEngine(n_shards=4, capacity_per_shard=64,
                              min_width=8, max_width=32, store=store)
 
+    def test_store_rides_scan_with_batched_hooks(self):
+        """r3 parity with models/engine.py: a Store no longer disables the
+        sharded scan tail — ONE batched read-through before it, ONE
+        write-through after with final rows."""
+        from gubernator_tpu.store import MockStore
+
+        store = MockStore()
+        eng = self._eng(store)
+        rs = eng.get_rate_limits([_req("sscan", hits=2, limit=10)
+                                  for _ in range(4)], now_ms=NOW)
+        assert [r.remaining for r in rs] == [8, 6, 4, 2]
+        # one miss get + one batched on_change with the FINAL state
+        assert store.called["get"] == 1
+        assert store.called["on_change"] == 1
+        assert store.data["test_sscan"].remaining == 2
+
+    def test_store_scan_chunked_round0_keeps_fresh(self):
+        """First-occurrence keys in a later tail window (round 0 chunked
+        at max_width) must keep their fresh flags through the union
+        lookup — same hazard the engine fixed in r3."""
+        from gubernator_tpu.store import MockStore
+
+        store = MockStore()
+        eng = ShardedEngine(n_shards=2, capacity_per_shard=512,
+                            min_width=16, max_width=16, store=store)
+        reqs = [_req(f"sf{i}", hits=2, limit=10) for i in range(20)]
+        reqs += [_req(f"sf{i}", hits=3, limit=10) for i in range(4)]
+        rs = eng.get_rate_limits(reqs, now_ms=NOW)
+        assert [r.remaining for r in rs[:20]] == [8] * 20
+        assert [r.remaining for r in rs[20:]] == [5] * 4
+        assert store.data["test_sf19"].remaining == 8
+        assert store.data["test_sf0"].remaining == 5
+
+    def test_store_scan_union_wider_than_max_width(self):
+        """The tail's union spans many windows, so a per-owner union lane
+        can exceed max_width — its slotmat feeds only the store
+        gather/inject, never a decide window, and must size to the union
+        (regression: numpy broadcast crash at 60 keys over max_width=16)."""
+        from gubernator_tpu.store import MockStore
+
+        store = MockStore()
+        eng = ShardedEngine(n_shards=2, capacity_per_shard=1024,
+                            min_width=16, max_width=16, store=store)
+        reqs = [_req(f"uw{i}", hits=1, limit=10) for i in range(60)]
+        out = eng.get_rate_limits(reqs, now_ms=NOW)
+        assert all(r.remaining == 9 and r.error == "" for r in out)
+        assert store.called["on_change"] == 60
+
     def test_read_through_and_write_through(self):
         from gubernator_tpu.store import MockStore
 
