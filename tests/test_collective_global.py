@@ -515,6 +515,71 @@ class TestOwnershipTransitions:
         assert inst.applied  # owner branch peeks/applies now
 
 
+class TestThreeHostClaims:
+    """The claims algebra beyond pairs: clean needs claim_sum ==
+    claim_cnt * claim_max AND claim_max == my_claim — at three
+    contributors a 2-vs-1 split must demote all three, and three equal
+    claims must establish."""
+
+    def _trio(self, cand_map):
+        insts = [_StubInstance(is_owner=(i == 0)) for i in range(3)]
+        fabric = FakeFabric(3, 16)
+        syncs = [CollectiveGlobalSync(insts[i], fabric.endpoints[i],
+                                      slot_fn=cand_map.__getitem__)
+                 for i in range(3)]
+        return insts, syncs
+
+    def test_three_equal_claims_establish(self):
+        insts, syncs = self._trio({"col_k": [4]})
+        syncs[0].queue_update(_greq("k", 1))
+        for s in syncs[1:]:
+            s.register_remote(_greq("k", 1))
+        lockstep(syncs)
+        for s in syncs:
+            assert s._keys["col_k"].phase == ESTABLISHED
+        # owner applies; both non-owners see its broadcast within 2 ticks
+        lockstep(syncs)
+        lockstep(syncs)
+        for s in syncs[1:]:
+            assert s._keys["col_k"].owner_seen
+
+    def test_two_vs_one_split_demotes_every_claimant(self):
+        """Hosts 0+1 share key A on slot 9; host 2 puts key B there. The
+        minority's claim poisons c_sum for everyone — all three demote
+        (and with single candidates, all fall back)."""
+        cand_map = {"col_a": [9], "col_b": [9]}
+        insts, syncs = self._trio(cand_map)
+        syncs[0].queue_update(_greq("a", 1))
+        syncs[1].register_remote(_greq("a", 1))
+        syncs[2].register_remote(_greq("b", 1))
+        lockstep(syncs)
+        assert syncs[0]._keys["col_a"].phase == FALLBACK
+        assert syncs[1]._keys["col_a"].phase == FALLBACK
+        assert syncs[2]._keys["col_b"].phase == FALLBACK
+
+    def test_two_vs_one_with_candidates_reconverges(self):
+        """Same split with R=2 candidates: the trio advances and lands
+        clean — A's pair at one slot, B alone at another."""
+        cand_map = {"col_a": [9, 3], "col_b": [9, 5]}
+        insts, syncs = self._trio(cand_map)
+        syncs[0].queue_update(_greq("a", 1))
+        syncs[1].register_remote(_greq("a", 1))
+        syncs[2].register_remote(_greq("b", 1))
+        lockstep(syncs)  # conflict on 9: everyone moves to candidate 2
+        lockstep(syncs)  # clean on the new slots
+        a0, a1 = syncs[0]._keys["col_a"], syncs[1]._keys["col_a"]
+        b2 = syncs[2]._keys["col_b"]
+        assert a0.phase == a1.phase == ESTABLISHED
+        assert (a0.slot, a1.slot) == (3, 3)
+        assert b2.phase == ESTABLISHED and b2.slot == 5
+        # hits flow once the owner's broadcast lands at the shared slot
+        lockstep(syncs)
+        assert syncs[1].queue_hit(_greq("a", 4))
+        lockstep(syncs)
+        assert syncs[1].stats["hits_synced"] == 4
+        assert any(r.hits == 4 for r in insts[0].applied)
+
+
 class TestMixedFleetCoverage:
     """ADVICE r2 #3: the collective reaches only the jax.distributed
     process group; with picker peers OUTSIDE it, the gRPC broadcast keeps
