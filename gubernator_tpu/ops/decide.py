@@ -395,15 +395,22 @@ def decide_packed_compact(
         fresh=(meta & _META_FRESH) != 0,
     )
     new_state, resp = decide(state, reqs, now_ms)
+    return new_state, _compact_response(resp, now_ms)
+
+
+def _compact_response(resp, now_ms) -> jax.Array:
+    """Pack a RespBatch into the compact i32[4, B] wire rows (status, limit,
+    remaining, reset delta; absolute-zero reset encodes as -1). Shared by
+    the compact and interned kernels so the response contract has one
+    writer."""
     now = jnp.asarray(now_ms, I64)
     delta = jnp.where(resp.reset_time == 0, -1, resp.reset_time - now)
-    out = jnp.stack([
+    return jnp.stack([
         resp.status,
         resp.limit.astype(I32),
         resp.remaining.astype(I32),
         delta.astype(I32),
     ])
-    return new_state, out
 
 
 def decide_scan_packed_compact(
@@ -450,6 +457,113 @@ def widen_compact_out(out, now_ms: int):
     delta = wide[..., 3, :]
     wide[..., 3, :] = np.where(delta < 0, 0, now_ms + delta)
     return wide
+
+
+# ---------------------------------------------------------------- interned
+# Real fleets run a handful of limit CONFIGS (limit, duration pairs) over
+# millions of keys — the reference's requests repeat the same RateLimit
+# name/limit/duration per route (gubernator.proto RateLimitReq). The
+# interned wire format exploits that: the host interns each window's
+# (limit, duration) pairs into a tiny i64[N_CFG, 2] table shipped alongside
+# (4 KB — noise), and each lane carries only slot + one packed meta word:
+# i32[2, B] up = 8 bytes/decision instead of compact's 20 or wide's 72.
+# The kernel gathers limit/duration back out of the config table — a [B]
+# gather over a VMEM-resident 256-row table, free next to the HBM row
+# gather. Responses reuse the compact i32[4, B] contract.
+#
+# meta word layout (bit 31 clear, always non-negative):
+#   [14:0]  hits        (eligibility: 0 <= hits < 2^15)
+#   [15]    algorithm
+#   [21:16] behavior    (6 bits, same mask as compact)
+#   [22]    fresh
+#   [30:23] config id   (eligibility: <= 256 distinct pairs per stack)
+
+INTERN_ROWS = 2
+INTERN_MAX_CFG = 256
+_INT_HITS_BITS = 15
+_INT_HITS_MAX = (1 << _INT_HITS_BITS) - 1
+_INT_ALGO_SHIFT = 15
+_INT_BEHAVIOR_SHIFT = 16
+_INT_FRESH_SHIFT = 22
+_INT_CFG_SHIFT = 23
+
+
+def decide_packed_interned(
+    state: TableState, packed: jax.Array, cfg: jax.Array, now_ms: jax.Array
+) -> Tuple[TableState, jax.Array]:
+    """decide() over one interned i32[2, B] staging buffer + i64[N, 2]
+    config table. Bit-identical to decide_packed on any window
+    intern_window() accepts (TestInternedStaging differential).
+    Returns the compact i32[4, B] response rows."""
+    meta = packed[1]
+    cfgid = (meta >> _INT_CFG_SHIFT) & (INTERN_MAX_CFG - 1)
+    zero64 = jnp.zeros(packed.shape[-1], I64)
+    reqs = ReqBatch(
+        slot=packed[0],
+        hits=(meta & _INT_HITS_MAX).astype(I64),
+        limit=cfg[cfgid, 0],
+        duration=cfg[cfgid, 1],
+        algorithm=(meta >> _INT_ALGO_SHIFT) & 1,
+        behavior=(meta >> _INT_BEHAVIOR_SHIFT) & _META_BEHAVIOR_MASK,
+        greg_expire=zero64,
+        greg_interval=zero64,
+        fresh=(meta & (1 << _INT_FRESH_SHIFT)) != 0,
+    )
+    new_state, resp = decide(state, reqs, now_ms)
+    return new_state, _compact_response(resp, now_ms)
+
+
+def decide_scan_packed_interned(
+    state: TableState, packed_k: jax.Array, cfg: jax.Array, now_ms: jax.Array
+) -> Tuple[TableState, jax.Array]:
+    """K interned windows in one dispatch: i32[K, 2, B] + one shared
+    i64[N, 2] config table -> i32[K, 4, B], window k+1 observing window
+    k's writes (see decide_scan_packed)."""
+
+    def body(st, pk):
+        st2, out = decide_packed_interned(st, pk, cfg, now_ms)
+        return st2, out
+
+    return jax.lax.scan(body, state, packed_k)
+
+
+def intern_window(packed):
+    """Wide i64[9, W] (or [K, 9, W]) staging -> (interned i32 rows,
+    i64[INTERN_MAX_CFG, 2] config table), or None when any lane is
+    ineligible: gregorian, hits outside [0, 2^15), limit/duration outside
+    [0, 2^31), or more than INTERN_MAX_CFG distinct (limit, duration)
+    pairs in the stack. Padding lanes (slot == -1) intern like any other
+    (their zero config occupies one table row)."""
+    import numpy as np
+
+    hits = packed[..., 1, :]
+    if (hits < 0).any() or (hits > _INT_HITS_MAX).any():
+        return None
+    vals = packed[..., 2:4, :]
+    if (vals < 0).any() or (vals > _I32_MAX).any():
+        return None
+    if (packed[..., 5, :] & int(Behavior.DURATION_IS_GREGORIAN)).any():
+        return None
+    limit = packed[..., 2, :]
+    duration = packed[..., 3, :]
+    pair = (limit << 31) | duration  # both < 2^31: injective, fits i64
+    cfg_vals, inv = np.unique(pair, return_inverse=True)
+    if cfg_vals.size > INTERN_MAX_CFG:
+        return None
+    cfg = np.zeros((INTERN_MAX_CFG, 2), np.int64)
+    cfg[: cfg_vals.size, 0] = cfg_vals >> 31
+    cfg[: cfg_vals.size, 1] = cfg_vals & _I32_MAX
+    out = np.empty(packed.shape[:-2] + (INTERN_ROWS, packed.shape[-1]),
+                   np.int32)
+    out[..., 0, :] = packed[..., 0, :]
+    out[..., 1, :] = (
+        hits
+        | ((packed[..., 4, :] & 1) << _INT_ALGO_SHIFT)
+        | ((packed[..., 5, :] & _META_BEHAVIOR_MASK) << _INT_BEHAVIOR_SHIFT)
+        | ((packed[..., 8, :] != 0).astype(np.int64) << _INT_FRESH_SHIFT)
+        | (inv.reshape(pair.shape).astype(np.int64) << _INT_CFG_SHIFT)
+    )
+    return out, cfg
 
 
 def pack_window(items, slots, fresh, width: int, out=None):

@@ -1,0 +1,41 @@
+"""One-off tunnel calibration: upload/download bandwidth + fixed RTT.
+
+Run on the axon rig to size the serving-path byte budget (DESIGN.md
+"Off-chip transfers"). Not part of the bench suite.
+"""
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+dev = jax.devices()[0]
+print("device:", dev)
+
+
+def t(f, n=3):
+    best = 1e9
+    for _ in range(n):
+        t0 = time.perf_counter()
+        f()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+# --- fixed RTT: tiny scalar round trip
+x = jnp.zeros((), jnp.int32)
+f = jax.jit(lambda a: a + 1)
+y = f(x); _ = int(y)
+rtt = t(lambda: int(f(x)))
+print(f"scalar round trip: {rtt*1e3:.1f} ms")
+
+for mb in (2, 8, 32):
+    n = mb * (1 << 20) // 4
+    host = np.random.randint(0, 100, n, np.int32)
+    up = t(lambda: jax.device_put(host, dev).block_until_ready())
+    devarr = jax.device_put(host, dev)
+    g = jax.jit(lambda a: a + 1)
+    devarr2 = g(devarr); devarr2.block_until_ready()
+    down = t(lambda: np.asarray(devarr2))
+    print(f"{mb:3d} MB  up {up:6.3f}s ({mb/up:6.1f} MB/s)   "
+          f"down {down:6.3f}s ({mb/down:6.1f} MB/s)")

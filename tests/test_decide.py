@@ -566,3 +566,135 @@ class TestCompactStaging:
         assert out_w[3, 0] == 0  # absolute zero from the wide kernel
         np.testing.assert_array_equal(
             out_w, widen_compact_out(out_c, now + 5))
+
+
+class TestInternedStaging:
+    """The interned i32[2, B] + config-table wire format must be
+    bit-identical to the wide i64 format on every window it accepts, and
+    must refuse windows it cannot represent (hits >= 2^15, > 256 distinct
+    (limit, duration) pairs, gregorian, values outside i32)."""
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_differential_vs_wide(self, seed):
+        from gubernator_tpu.ops.decide import (
+            decide_packed,
+            decide_packed_interned,
+            intern_window,
+            widen_compact_out,
+        )
+
+        r = random.Random(seed)
+        rng = np.random.RandomState(seed)
+        C, B, now = 256, 32, 1_700_000_000_000
+        behaviors = [0, int(Behavior.RESET_REMAINING),
+                     int(Behavior.NO_BATCHING)]
+        wide_step = jax.jit(decide_packed)
+        int_step = jax.jit(decide_packed_interned)
+        st_w, st_i = make_table(C), make_table(C)
+        for i in range(12):
+            wide = TestCompactStaging._rand_wide(
+                rng, r, C, B, now + i * 1000, behaviors)
+            interned = intern_window(wide)
+            assert interned is not None
+            iw, cfg = interned
+            assert iw.dtype == np.int32 and iw.shape == (2, B)
+            assert cfg.shape == (256, 2)
+            st_w, out_w = wide_step(st_w, wide, now + i * 1000)
+            st_i, out_i = int_step(st_i, iw, cfg, now + i * 1000)
+            np.testing.assert_array_equal(
+                np.asarray(out_w),
+                widen_compact_out(out_i, now + i * 1000))
+        np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_i))
+
+    def test_scan_differential_vs_wide(self):
+        from gubernator_tpu.ops.decide import (
+            decide_scan_packed,
+            decide_scan_packed_interned,
+            intern_window,
+            widen_compact_out,
+        )
+
+        r = random.Random(11)
+        rng = np.random.RandomState(11)
+        C, K, B, now = 256, 6, 16, 1_700_000_000_000
+        wide = np.stack([
+            TestCompactStaging._rand_wide(rng, r, C, B, now, [0])
+            for _ in range(K)])
+        interned = intern_window(wide)
+        assert interned is not None
+        iw, cfg = interned
+        assert iw.shape == (K, 2, B)
+        st_w, out_w = jax.jit(decide_scan_packed)(make_table(C), wide, now)
+        st_i, out_i = jax.jit(decide_scan_packed_interned)(
+            make_table(C), iw, cfg, now)
+        np.testing.assert_array_equal(
+            np.asarray(out_w), widen_compact_out(out_i, now))
+        np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_i))
+
+    def test_rejects_what_it_cannot_represent(self):
+        from gubernator_tpu.ops.decide import intern_window
+
+        base = np.zeros((9, 4), np.int64)
+        base[0] = [0, 1, 2, -1]
+        base[1:4] = 1
+        assert intern_window(base) is not None
+        big_hits = base.copy()
+        big_hits[1, 1] = 1 << 15  # hits exceed the 15-bit lane
+        assert intern_window(big_hits) is None
+        neg = base.copy()
+        neg[1, 0] = -1
+        assert intern_window(neg) is None
+        too_big = base.copy()
+        too_big[2, 1] = 2**31  # limit exceeds i32
+        assert intern_window(too_big) is None
+        greg = base.copy()
+        greg[5, 2] = int(Behavior.DURATION_IS_GREGORIAN)
+        assert intern_window(greg) is None
+        # exactly INTERN_MAX_CFG distinct pairs -> accepted (boundary);
+        # one more -> refused. No padding lanes, so the pair count is
+        # exactly the distinct-limit count.
+        from gubernator_tpu.ops.decide import INTERN_MAX_CFG
+
+        many = np.zeros((9, INTERN_MAX_CFG + 1), np.int64)
+        many[0] = np.arange(INTERN_MAX_CFG + 1)
+        many[1] = 1
+        many[2] = np.arange(INTERN_MAX_CFG + 1) + 1  # 257 distinct limits
+        many[3] = 1000
+        assert intern_window(many) is None
+        many[2, INTERN_MAX_CFG] = many[2, 0]  # exactly 256 distinct
+        got = intern_window(many)
+        assert got is not None
+        iw, cfg = got
+        # every config row is populated and round-trips the right pair
+        assert sorted(cfg[:, 0].tolist()) == sorted(
+            many[2, :INTERN_MAX_CFG].tolist())
+        cfgids = (iw[1] >> 23) & 0xFF
+        np.testing.assert_array_equal(cfg[cfgids, 0], many[2])
+        np.testing.assert_array_equal(cfg[cfgids, 1], many[3])
+
+    def test_hits_zero_peek_and_fresh(self):
+        """hits=0 peek and the fresh flag survive the meta-word packing."""
+        from gubernator_tpu.ops.decide import (
+            decide_packed,
+            decide_packed_interned,
+            intern_window,
+            widen_compact_out,
+        )
+
+        now = 1_700_000_000_000
+        st_w, st_i = make_table(16), make_table(16)
+        mk = np.zeros((9, 2), np.int64)
+        mk[0] = [3, -1]
+        mk[1, 0], mk[2, 0], mk[3, 0], mk[8, 0] = 2, 10, 60_000, 1
+        iw, cfg = intern_window(mk)
+        st_w, _ = decide_packed(st_w, mk, now)
+        st_i, _ = decide_packed_interned(st_i, iw, cfg, now)
+        peek = mk.copy()
+        peek[1, 0] = 0  # hits=0: report, never deduct
+        peek[8, 0] = 0
+        iw2, cfg2 = intern_window(peek)
+        st_w, out_w = decide_packed(st_w, peek, now + 5)
+        st_i, out_i = decide_packed_interned(st_i, iw2, cfg2, now + 5)
+        np.testing.assert_array_equal(
+            np.asarray(out_w), widen_compact_out(out_i, now + 5))
+        np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_i))

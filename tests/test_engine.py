@@ -504,3 +504,65 @@ class TestNativeFastWindow:
             [req(key="bc"), req(key="bc")], now_ms=NOW)  # dup -> tail
         assert fast.stats.batches == 1
         assert fast.stats.requests == 2
+
+
+class TestStagingAutoSelect:
+    """The engine ships each window in the compact wire format whenever it
+    is eligible and falls back to wide otherwise (VERDICT r3 item 1);
+    GUBER_STAGING=wide pins the wide format. Observable via the dispatch
+    helper's handle: compact handles carry their now_ms."""
+
+    def test_compact_selected_for_eligible_window(self):
+        import numpy as np
+        eng = Engine(capacity=64, min_width=8, max_width=8)
+        packed = np.zeros((9, 8), np.int64)
+        packed[0] = [0, 1, 2, -1, -1, -1, -1, -1]
+        packed[1:4, :3] = [[1] * 3, [10] * 3, [60_000] * 3]
+        handle = eng._dispatch_staged(packed, NOW)
+        assert handle[1] == NOW  # compact: handle carries now_ms
+        out = eng._fetch_staged(handle)
+        assert out.dtype == np.int64 and out.shape == (4, 8)
+        assert out[3, 0] == NOW + 60_000  # widened back to absolute
+
+    def test_wide_kept_for_gregorian(self):
+        import numpy as np
+        eng = Engine(capacity=64, min_width=8, max_width=8)
+        packed = np.zeros((9, 8), np.int64)
+        packed[0] = [0, -1, -1, -1, -1, -1, -1, -1]
+        packed[1:4, 0] = [1, 10, 60_000]
+        packed[5, 0] = int(Behavior.DURATION_IS_GREGORIAN)
+        packed[6, 0] = NOW + 3_600_000
+        packed[7, 0] = 3_600_000
+        handle = eng._dispatch_staged(packed, NOW)
+        assert handle[1] is None  # wide path
+
+    def test_env_pin_wide(self, monkeypatch):
+        import numpy as np
+        monkeypatch.setenv("GUBER_STAGING", "wide")
+        eng = Engine(capacity=64, min_width=8, max_width=8)
+        packed = np.zeros((9, 8), np.int64)
+        packed[0] = -1
+        handle = eng._dispatch_staged(packed, NOW)
+        assert handle[1] is None
+
+    def test_responses_identical_across_modes(self, monkeypatch):
+        rng = random.Random(5)
+        keys = [f"sas{i}" for i in range(40)]
+
+        def traffic(e):
+            out = []
+            for step in range(6):
+                batch = [req(key=rng.choice(keys), hits=rng.randint(0, 3),
+                             limit=20, duration=60_000,
+                             algorithm=rng.randint(0, 1))
+                         for _ in range(rng.randint(1, 30))]
+                out.append(e.get_rate_limits(batch, now_ms=NOW + step * 500))
+            return out
+        rng_state = rng.getstate()
+        auto = Engine(capacity=128, min_width=8, max_width=32)
+        a = traffic(auto)
+        monkeypatch.setenv("GUBER_STAGING", "wide")
+        rng.setstate(rng_state)
+        wide = Engine(capacity=128, min_width=8, max_width=32)
+        b = traffic(wide)
+        assert a == b
