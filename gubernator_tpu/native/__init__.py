@@ -129,6 +129,23 @@ def load_library() -> ctypes.CDLL:
             c.c_void_p, c.c_int64, c.c_void_p, c.c_void_p, c.c_void_p,
             c.c_void_p, c.c_void_p,
         ]
+        lib.keydir_intern_max_cfg.restype = c.c_int64
+        lib.keydir_intern_max_cfg.argtypes = []
+        lib.keydir_intern_hash_slots.restype = c.c_int64
+        lib.keydir_intern_hash_slots.argtypes = []
+        lib.keydir_prep_pack_interned.restype = c.c_int32
+        lib.keydir_prep_pack_interned.argtypes = [
+            # kd, n, keys, key_off, name_len, hits, limit, duration,
+            # algorithm, behavior, slow_mask, iw, width, cfg, n_cfg,
+            # cfg_hash, lane_item, leftover, n_leftover_out, inject,
+            # n_inject — 21 params; a count mismatch here reads stale
+            # stack in C (wild pointers), so keep this list annotated
+            c.c_void_p, c.c_int32, c.c_char_p, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_int64, c.c_void_p, c.c_int32, c.c_void_p, c.c_void_p,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_void_p,
+        ]
         _LIB = lib
         return lib
 
@@ -277,6 +294,66 @@ def prep_pack_columnar(directory: "NativeKeyDirectory", n: int,
         key_off.ctypes.data, name_len.ctypes.data, hits.ctypes.data,
         limit.ctypes.data, duration.ctypes.data, algorithm.ctypes.data,
         behavior.ctypes.data, slow_mask, packed.ctypes.data, width,
+        lane_item.ctypes.data, leftover.ctypes.data, n_left.ctypes.data,
+        inject.ctypes.data, n_inj.ctypes.data,
+    )
+    if n0 < 0:
+        return n0, None, None, inject[:int(n_inj[0])]
+    return (n0, lane_item[:n0], leftover[:int(n_left[0])],
+            inject[:int(n_inj[0])])
+
+
+# keydir_prep_pack_interned: the window needs more distinct
+# (limit, duration) pairs than the config table holds — re-prep wide
+PREP_CFG_OVERFLOW = -3
+
+
+class InternPrepState:
+    """Caller-owned persistent state for the interned columnar prep: the
+    i64[256, 2] (limit, duration) config table the device receives, its
+    fill count, and the C-side find-or-insert map. One instance per
+    serving loop / engine; ships cfg to the device whenever n_cfg grows."""
+
+    def __init__(self):
+        lib = load_library()  # buffer sizes come from the C side so the
+        max_cfg = lib.keydir_intern_max_cfg()  # compile-time constants
+        slots = lib.keydir_intern_hash_slots()  # can never drift past the
+        self.cfg = np.zeros((max_cfg, 2), np.int64)  # allocations
+        self._n_cfg = np.zeros(1, np.int32)
+        self._hash = np.zeros((slots, 2), np.int64)
+
+    @property
+    def n_cfg(self) -> int:
+        return int(self._n_cfg[0])
+
+
+def prep_pack_interned(directory: "NativeKeyDirectory", n: int,
+                       keys, key_off, name_len, hits, limit, duration,
+                       algorithm, behavior, slow_mask: int,
+                       iw: np.ndarray, state: InternPrepState):
+    """Columnar one-pass prep emitting the INTERNED staging format
+    (ops/decide.py decide_packed_interned): `iw` is i32[2, width] (no
+    pre-zeroing needed — every lane is written), `state` persists the
+    config table across windows. Lanes the interned format cannot carry
+    demote to `leftover`; a window needing >256 distinct configs returns
+    PREP_CFG_OVERFLOW with the directory and config state untouched
+    (caller re-preps that window through prep_pack_columnar).
+
+    Returns (n0, lane_item, leftover, inject) like prep_pack_columnar."""
+    lib = load_library()
+    width = iw.shape[1]
+    lane_item = np.empty(width, np.int32)
+    leftover = np.empty(n, np.int32)
+    n_left = np.zeros(1, np.int32)
+    inject = np.empty((n, 8), np.int64)
+    n_inj = np.zeros(1, np.int32)
+    n0 = lib.keydir_prep_pack_interned(
+        directory._kd, n, keys,
+        key_off.ctypes.data, name_len.ctypes.data, hits.ctypes.data,
+        limit.ctypes.data, duration.ctypes.data, algorithm.ctypes.data,
+        behavior.ctypes.data, slow_mask, iw.ctypes.data, width,
+        state.cfg.ctypes.data, state._n_cfg.ctypes.data,
+        state._hash.ctypes.data,
         lane_item.ctypes.data, leftover.ctypes.data, n_left.ctypes.data,
         inject.ctypes.data, n_inj.ctypes.data,
     )

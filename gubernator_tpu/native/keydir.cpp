@@ -824,6 +824,188 @@ int32_t keydir_prep_pack_columnar(
     return n0;
 }
 
+namespace {
+
+// Open-addressing probe over the caller-owned interned-config map
+// (i64[INTERN_HASH_SLOTS][2] of {pair_key + 1, id}; 0 = empty). The map
+// persists across calls so the serving loop's per-window cost is one
+// probe per lane, not a sort.
+constexpr int64_t INTERN_HASH_SLOTS = 1024;  // >= 4x INTERN_MAX_CFG fill
+constexpr int64_t INTERN_MAX_CFG = 256;      // ops/decide.py INTERN_MAX_CFG
+constexpr int64_t INTERN_HITS_MAX = (1 << 15) - 1;
+constexpr int64_t INTERN_I32_MAX = (1LL << 31) - 1;
+
+inline uint64_t intern_hash(uint64_t x) {  // splitmix64 finalizer
+    x += 0x9e3779b97f4a7c15ULL;
+    x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+    x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+    return x ^ (x >> 31);
+}
+
+// Find-or-insert (pair -> id). Returns the id, or -1 when the table is
+// full (caller handles PREP_CFG_OVERFLOW).
+inline int64_t intern_cfg_id(int64_t pair, int64_t* cfg, int32_t* n_cfg,
+                             int64_t* cfg_hash) {
+    uint64_t h = intern_hash(static_cast<uint64_t>(pair));
+    for (;;) {
+        int64_t* slot = cfg_hash + 2 * (h & (INTERN_HASH_SLOTS - 1));
+        if (slot[0] == pair + 1) return slot[1];
+        if (slot[0] == 0) {
+            if (*n_cfg >= INTERN_MAX_CFG) return -1;
+            const int64_t id = (*n_cfg)++;
+            slot[0] = pair + 1;
+            slot[1] = id;
+            cfg[2 * id] = pair >> 31;
+            cfg[2 * id + 1] = pair & INTERN_I32_MAX;
+            return id;
+        }
+        ++h;
+    }
+}
+
+}  // namespace
+
+// Size contract for the caller-owned interned-config buffers: Python
+// allocates cfg/cfg_hash from THESE getters so the sizes cannot drift
+// from the compile-time constants the probe loop masks with.
+int64_t keydir_intern_max_cfg() { return INTERN_MAX_CFG; }
+int64_t keydir_intern_hash_slots() { return INTERN_HASH_SLOTS; }
+
+// Interned columnar prep: keydir_prep_pack_columnar's contract, but the
+// staging output is the INTERNED wire format (ops/decide.py "interned"):
+// iw i32[2, width] — row 0 = slot (pad -1), row 1 = hits | algo<<15 |
+// behavior<<16 | fresh<<22 | cfgid<<23 — 8 bytes/decision on the wire,
+// with the (limit, duration) pairs interned into a persistent caller-
+// owned config table shipped to the device separately. cfg is i64[256][2]
+// row-major; n_cfg its in/out fill count; cfg_hash a caller-ZEROED
+// i64[1024][2] map that persists across calls (find-or-insert per lane).
+//
+// Lanes the interned format cannot carry — hits outside [0, 2^15),
+// limit/duration outside [0, 2^31), behavior bits past the 6-bit meta
+// field — demote to `leftover` exactly like slow-mask lanes (the
+// request-object pipeline decides them through the wide format).
+// Returns n0 >= 0, PREP_FALLBACK, PREP_OVERCOMMIT, or PREP_CFG_OVERFLOW
+// (-3): the window needs more than 256 distinct (limit, duration) pairs —
+// cfg/n_cfg/cfg_hash roll back to their entry state and the caller
+// re-preps the same window through the wide columnar path. iw is written
+// for every lane (meta 0 on padding), so callers need not re-zero reused
+// buffers.
+int32_t keydir_prep_pack_interned(
+    void* kd, int32_t n, const char* keys, const int32_t* key_off,
+    const int32_t* name_len, const int64_t* hits, const int64_t* limit,
+    const int64_t* duration, const int32_t* algorithm,
+    const int32_t* behavior, int64_t slow_mask, int32_t* iw, int32_t width,
+    int64_t* cfg, int32_t* n_cfg, int64_t* cfg_hash, int32_t* lane_item,
+    int32_t* leftover, int32_t* n_leftover_out, int64_t* inject,
+    int32_t* n_inject) {
+    if (n <= 0 || n > width) return -1;
+
+    const int32_t n_cfg_entry = *n_cfg;
+    std::string arena;
+    std::vector<int64_t> offsets;
+    std::vector<int32_t> lanes;
+    std::vector<int32_t> meta;  // meta word sans fresh bit
+    std::unordered_set<std::string> seen;
+    seen.reserve(n);
+    offsets.reserve(n + 1);
+    offsets.push_back(0);
+    lanes.reserve(n);
+    meta.reserve(n);
+    arena.reserve(static_cast<size_t>(key_off[n] - key_off[0]) + n);
+    std::string key;
+    int32_t n_left = 0;
+    bool overflow = false;
+    for (int32_t i = 0; i < n; ++i) {
+        const int32_t lo = key_off[i], hi = key_off[i + 1];
+        const int32_t nl = name_len[i], ul = hi - lo - nl;
+        const bool keyok = nl > 0 && ul > 0 &&
+                           key_bytes_ok(keys + lo, nl) &&
+                           key_bytes_ok(keys + lo + nl, ul);
+        bool ok = keyok && (behavior[i] & slow_mask) == 0 &&
+                  hits[i] >= 0 && hits[i] <= INTERN_HITS_MAX &&
+                  limit[i] >= 0 && limit[i] <= INTERN_I32_MAX &&
+                  duration[i] >= 0 && duration[i] <= INTERN_I32_MAX &&
+                  (behavior[i] & ~0x3F) == 0 && (algorithm[i] & ~1) == 0;
+        if (keyok) {
+            key.assign(keys + lo, nl);
+            key.push_back('_');
+            key.append(keys + lo + nl, ul);
+            if (ok) {
+                ok = seen.insert(key).second;
+            } else {
+                seen.insert(key);  // later occurrences also demote
+            }
+        }
+        if (ok) {
+            const int64_t pair = (limit[i] << 31) | duration[i];
+            const int64_t id = intern_cfg_id(pair, cfg, n_cfg, cfg_hash);
+            if (id < 0) {
+                overflow = true;
+                break;
+            }
+            meta.push_back(static_cast<int32_t>(
+                hits[i] | (static_cast<int64_t>(algorithm[i] & 1) << 15) |
+                (static_cast<int64_t>(behavior[i] & 0x3F) << 16) |
+                (id << 23)));
+            arena += key;
+            offsets.push_back(static_cast<int64_t>(arena.size()));
+            lanes.push_back(i);
+        } else {
+            leftover[n_left++] = i;
+        }
+    }
+    if (overflow) {
+        // roll the config state back to entry and rebuild the map from
+        // the surviving table (rare: once per deployment config churn)
+        *n_cfg = n_cfg_entry;
+        std::memset(cfg_hash, 0,
+                    static_cast<size_t>(INTERN_HASH_SLOTS) * 2 *
+                        sizeof(int64_t));
+        for (int64_t id = 0; id < n_cfg_entry; ++id) {
+            const int64_t pair = (cfg[2 * id] << 31) | cfg[2 * id + 1];
+            uint64_t h = intern_hash(static_cast<uint64_t>(pair));
+            for (;;) {
+                int64_t* slot = cfg_hash + 2 * (h & (INTERN_HASH_SLOTS - 1));
+                if (slot[0] == 0) {
+                    slot[0] = pair + 1;
+                    slot[1] = id;
+                    break;
+                }
+                ++h;
+            }
+        }
+        return -3;
+    }
+    *n_leftover_out = n_left;
+    const int32_t n0 = static_cast<int32_t>(lanes.size());
+    int32_t* const row_slot = iw;
+    int32_t* const row_meta = iw + width;
+    if (n0 == 0) {
+        for (int32_t i = 0; i < width; ++i) row_slot[i] = -1;
+        std::memset(row_meta, 0, static_cast<size_t>(width) * sizeof(int32_t));
+        return 0;
+    }
+
+    std::vector<int32_t> slots(n0);
+    std::vector<uint8_t> fresh(n0);
+    const int64_t done = static_cast<KeyDir*>(kd)->lookup_batch(
+        arena.data(), offsets.data(), n0, slots.data(), fresh.data(),
+        inject, n_inject);
+    if (done != n0) return -2;
+
+    for (int32_t i = 0; i < n0; ++i) {
+        row_slot[i] = slots[i];
+        row_meta[i] = meta[i] | (fresh[i] ? (1 << 22) : 0);
+    }
+    for (int32_t i = n0; i < width; ++i) {
+        row_slot[i] = -1;
+        row_meta[i] = 0;
+    }
+    std::memcpy(lane_item, lanes.data(),
+                static_cast<size_t>(n0) * sizeof(int32_t));
+    return n0;
+}
+
 
 namespace {
 

@@ -527,15 +527,11 @@ def decide_scan_packed_interned(
     return jax.lax.scan(body, state, packed_k)
 
 
-def intern_window(packed):
-    """Wide i64[9, W] (or [K, 9, W]) staging -> (interned i32 rows,
-    i64[INTERN_MAX_CFG, 2] config table), or None when any lane is
-    ineligible: gregorian, hits outside [0, 2^15), limit/duration outside
-    [0, 2^31), or more than INTERN_MAX_CFG distinct (limit, duration)
-    pairs in the stack. Padding lanes (slot == -1) intern like any other
-    (their zero config occupies one table row)."""
-    import numpy as np
-
+def _intern_pairs(packed):
+    """Shared eligibility gate for the two Python interners: the
+    (limit << 31) | duration pair per lane, or None when any lane cannot
+    ride the interned format (gregorian, hits outside [0, 2^15),
+    limit/duration outside [0, 2^31))."""
     hits = packed[..., 1, :]
     if (hits < 0).any() or (hits > _INT_HITS_MAX).any():
         return None
@@ -544,26 +540,99 @@ def intern_window(packed):
         return None
     if (packed[..., 5, :] & int(Behavior.DURATION_IS_GREGORIAN)).any():
         return None
-    limit = packed[..., 2, :]
-    duration = packed[..., 3, :]
-    pair = (limit << 31) | duration  # both < 2^31: injective, fits i64
+    # both < 2^31: injective, fits i64
+    return (packed[..., 2, :] << 31) | packed[..., 3, :]
+
+
+def _emit_interned(packed, inv):
+    """Shared meta-word emission: wide staging + per-lane config ids ->
+    interned i32 rows. The bit layout has THREE writers (here, the two
+    callers' id assignment aside: keydir.cpp keydir_prep_pack_interned)
+    and one reader (decide_packed_interned) — keep them in sync."""
+    import numpy as np
+
+    out = np.empty(packed.shape[:-2] + (INTERN_ROWS, packed.shape[-1]),
+                   np.int32)
+    out[..., 0, :] = packed[..., 0, :]
+    out[..., 1, :] = (
+        packed[..., 1, :]
+        | ((packed[..., 4, :] & 1) << _INT_ALGO_SHIFT)
+        | ((packed[..., 5, :] & _META_BEHAVIOR_MASK) << _INT_BEHAVIOR_SHIFT)
+        | ((packed[..., 8, :] != 0).astype(np.int64) << _INT_FRESH_SHIFT)
+        | (inv.astype(np.int64) << _INT_CFG_SHIFT)
+    )
+    return out
+
+
+def intern_window(packed):
+    """Wide i64[9, W] (or [K, 9, W]) staging -> (interned i32 rows,
+    i64[INTERN_MAX_CFG, 2] config table), or None when any lane is
+    ineligible (see _intern_pairs) or the stack holds more than
+    INTERN_MAX_CFG distinct (limit, duration) pairs. Padding lanes
+    (slot == -1) intern like any other (their zero config occupies one
+    table row)."""
+    import numpy as np
+
+    pair = _intern_pairs(packed)
+    if pair is None:
+        return None
     cfg_vals, inv = np.unique(pair, return_inverse=True)
     if cfg_vals.size > INTERN_MAX_CFG:
         return None
     cfg = np.zeros((INTERN_MAX_CFG, 2), np.int64)
     cfg[: cfg_vals.size, 0] = cfg_vals >> 31
     cfg[: cfg_vals.size, 1] = cfg_vals & _I32_MAX
-    out = np.empty(packed.shape[:-2] + (INTERN_ROWS, packed.shape[-1]),
-                   np.int32)
-    out[..., 0, :] = packed[..., 0, :]
-    out[..., 1, :] = (
-        hits
-        | ((packed[..., 4, :] & 1) << _INT_ALGO_SHIFT)
-        | ((packed[..., 5, :] & _META_BEHAVIOR_MASK) << _INT_BEHAVIOR_SHIFT)
-        | ((packed[..., 8, :] != 0).astype(np.int64) << _INT_FRESH_SHIFT)
-        | (inv.reshape(pair.shape).astype(np.int64) << _INT_CFG_SHIFT)
-    )
-    return out, cfg
+    return _emit_interned(packed, inv.reshape(pair.shape)), cfg
+
+
+class InternCache:
+    """Stateful interner for a serving loop: the config table persists
+    across windows, so the per-window cost is one searchsorted against the
+    (tiny, sorted) known-pair array instead of np.unique's full sort of
+    every lane. New pairs grow the table (stable ids — already-issued
+    meta words stay valid); overflow past INTERN_MAX_CFG or any
+    ineligible lane returns None for that window (caller falls back to
+    wide/compact staging), leaving the cache intact."""
+
+    def __init__(self):
+        import numpy as np
+
+        self._sorted_pairs = np.empty(0, np.int64)  # sorted for searchsorted
+        self._sorted_ids = np.empty(0, np.int64)  # pair -> stable config id
+        self.cfg = np.zeros((INTERN_MAX_CFG, 2), np.int64)
+        self.n_cfg = 0
+
+    def intern(self, packed):
+        """Wide i64[..., 9, W] staging -> interned i32 rows (the shared
+        self.cfg table ships alongside), or None when ineligible."""
+        import numpy as np
+
+        pair = _intern_pairs(packed)
+        if pair is None:
+            return None
+        flat = pair.ravel()
+        pos = np.searchsorted(self._sorted_pairs, flat)
+        pos_c = np.minimum(pos, max(self._sorted_pairs.size - 1, 0))
+        known = (self._sorted_pairs.size > 0) \
+            and bool((self._sorted_pairs[pos_c] == flat).all())
+        if not known:
+            new = np.unique(flat) if self._sorted_pairs.size == 0 else \
+                np.setdiff1d(np.unique(flat), self._sorted_pairs,
+                             assume_unique=True)
+            if self.n_cfg + new.size > INTERN_MAX_CFG:
+                return None
+            ids = np.arange(self.n_cfg, self.n_cfg + new.size)
+            self.cfg[ids, 0] = new >> 31
+            self.cfg[ids, 1] = new & _I32_MAX
+            self.n_cfg += new.size
+            self._sorted_pairs = np.concatenate([self._sorted_pairs, new])
+            self._sorted_ids = np.concatenate([self._sorted_ids, ids])
+            order = np.argsort(self._sorted_pairs, kind="stable")
+            self._sorted_pairs = self._sorted_pairs[order]
+            self._sorted_ids = self._sorted_ids[order]
+            pos = np.searchsorted(self._sorted_pairs, flat)
+        inv = self._sorted_ids[pos].reshape(pair.shape)
+        return _emit_interned(packed, inv)
 
 
 def pack_window(items, slots, fresh, width: int, out=None):

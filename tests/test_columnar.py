@@ -322,3 +322,111 @@ class TestPeerlinkColumnar:
             cli.close()
             svc.close()
             inst.close()
+
+
+class TestInternedPrep:
+    """The interned C prep (keydir_prep_pack_interned) + interned kernel
+    must be bit-exact with the request-object path: eligible lanes decide
+    through the 8-byte wire format, ineligible lanes (huge hits/limits,
+    gregorian, invalid keys, duplicates) demote to leftovers, and config
+    overflow rolls back cleanly to the wide path."""
+
+    @staticmethod
+    def _run_interned(eng, istate, reqs, now_ms):
+        import jax
+
+        from gubernator_tpu import native
+        from gubernator_tpu.ops.decide import (
+            decide_packed_interned,
+            widen_compact_out,
+        )
+
+        c = cols_from(reqs)
+        n = c["n"]
+        st = np.zeros(n, np.int32)
+        li = np.zeros(n, np.int64)
+        re = np.zeros(n, np.int64)
+        rs = np.zeros(n, np.int64)
+        width = max(16, 1 << (n - 1).bit_length())
+        iw = np.empty((2, width), np.int32)
+        n0, lane, left, inj = native.prep_pack_interned(
+            eng.directory, n, c["keys"], c["key_off"], c["name_len"],
+            c["hits"], c["limit"], c["duration"], c["algorithm"],
+            c["behavior"], SLOW, iw, istate)
+        assert n0 >= 0
+        eng._apply_inject_rows(inj)
+        if n0:
+            eng.state, out = jax.jit(decide_packed_interned)(
+                eng.state, iw, istate.cfg, now_ms)
+            rows = widen_compact_out(out, now_ms)
+            st[lane] = rows[0, :n0]
+            li[lane] = rows[1, :n0]
+            re[lane] = rows[2, :n0]
+            rs[lane] = rows[3, :n0]
+        for i in left.tolist():
+            r = eng.get_rate_limits([reqs[i]], now_ms=now_ms)[0]
+            st[i], li[i], re[i], rs[i] = (r.status, r.limit, r.remaining,
+                                          r.reset_time)
+        return st, li, re, rs
+
+    def test_random_workload_bit_exact(self, engines):
+        from gubernator_tpu.native import InternPrepState
+
+        a, b = engines
+        istate = InternPrepState()
+        rng = np.random.default_rng(23)
+        for it in range(20):
+            n = int(rng.integers(1, 120))
+            reqs = []
+            for _ in range(n):
+                beh = 0
+                if rng.random() < 0.1:
+                    beh |= int(Behavior.RESET_REMAINING)
+                if rng.random() < 0.05:
+                    beh |= int(Behavior.DURATION_IS_GREGORIAN)
+                hits = int(rng.integers(0, 3))
+                if rng.random() < 0.05:
+                    hits = 1 << 20  # ineligible for the 15-bit lane
+                limit = 25 if rng.random() < 0.9 else (1 << 40)
+                reqs.append(RateLimitReq(
+                    name="ip", unique_key=f"k{rng.integers(0, 40)}",
+                    hits=hits, limit=limit, duration=60_000,
+                    algorithm=(Algorithm.TOKEN_BUCKET if rng.random() < .7
+                               else Algorithm.LEAKY_BUCKET),
+                    behavior=beh))
+            now = NOW + it * 500
+            want = a.get_rate_limits(reqs, now_ms=now)
+            st, li, re, rs = self._run_interned(b, istate, reqs, now)
+            for i, w in enumerate(want):
+                got = (st[i], li[i], re[i], rs[i])
+                assert got == (w.status, w.limit, w.remaining,
+                               w.reset_time), (it, i, reqs[i], got, w)
+
+    def test_overflow_falls_back_to_wide(self):
+        from gubernator_tpu import native
+        from gubernator_tpu.native import InternPrepState
+
+        eng = Engine(capacity=2048, min_width=16, max_width=1024)
+        istate = InternPrepState()
+        reqs = [RateLimitReq(name="of", unique_key=f"k{i}", hits=1,
+                             limit=100 + i, duration=60_000)
+                for i in range(300)]  # 300 distinct configs > 256
+        c = cols_from(reqs)
+        iw = np.empty((2, 512), np.int32)
+        n0, lane, left, inj = native.prep_pack_interned(
+            eng.directory, c["n"], c["keys"], c["key_off"], c["name_len"],
+            c["hits"], c["limit"], c["duration"], c["algorithm"],
+            c["behavior"], SLOW, iw, istate)
+        assert n0 == native.PREP_CFG_OVERFLOW
+        assert istate.n_cfg == 0  # rolled back
+        # the same window re-preps fine through the wide columnar path
+        st, li, re, rs = run_columnar(eng, reqs, NOW)
+        assert (st == 0).all() and (re == np.arange(300) + 99).all()
+        # and the interned path still serves smaller windows afterwards
+        small = reqs[:10]
+        c2 = cols_from(small)
+        n0, lane, left, inj = native.prep_pack_interned(
+            eng.directory, c2["n"], c2["keys"], c2["key_off"],
+            c2["name_len"], c2["hits"], c2["limit"], c2["duration"],
+            c2["algorithm"], c2["behavior"], SLOW, iw, istate)
+        assert n0 == 10 and istate.n_cfg == 10

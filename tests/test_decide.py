@@ -698,3 +698,59 @@ class TestInternedStaging:
         np.testing.assert_array_equal(
             np.asarray(out_w), widen_compact_out(out_i, now + 5))
         np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_i))
+
+    def test_intern_cache_matches_intern_window(self):
+        """InternCache must produce meta words that decode to the same
+        requests as the one-shot interner (ids may differ; the decoded
+        (limit, duration) must not), across windows that grow the table."""
+        from gubernator_tpu.ops.decide import (
+            INTERN_MAX_CFG,
+            InternCache,
+            decide_packed,
+            decide_packed_interned,
+            intern_window,
+            widen_compact_out,
+        )
+
+        r = random.Random(21)
+        rng = np.random.RandomState(21)
+        C, B, now = 256, 32, 1_700_000_000_000
+        cache = InternCache()
+        wide_step = jax.jit(decide_packed)
+        int_step = jax.jit(decide_packed_interned)
+        st_w, st_i = make_table(C), make_table(C)
+        for i in range(10):
+            wide = TestCompactStaging._rand_wide(rng, r, C, B, now, [0])
+            iw = cache.intern(wide)
+            assert iw is not None
+            st_w, out_w = wide_step(st_w, wide, now + i)
+            st_i, out_i = int_step(st_i, iw, cache.cfg, now + i)
+            np.testing.assert_array_equal(
+                np.asarray(out_w), widen_compact_out(out_i, now + i))
+        np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_i))
+        assert cache.n_cfg <= INTERN_MAX_CFG
+
+    def test_intern_cache_overflow_and_ineligible_leave_cache_intact(self):
+        from gubernator_tpu.ops.decide import INTERN_MAX_CFG, InternCache
+
+        cache = InternCache()
+        base = np.zeros((9, 4), np.int64)
+        base[0] = [0, 1, 2, -1]
+        base[1] = 1
+        base[2] = [7, 7, 7, 0]
+        base[3] = 1000
+        assert cache.intern(base) is not None
+        n0 = cache.n_cfg
+        greg = base.copy()
+        greg[5, 1] = int(Behavior.DURATION_IS_GREGORIAN)
+        assert cache.intern(greg) is None
+        assert cache.n_cfg == n0
+        # overflow: more new pairs than the table has room for
+        many = np.zeros((9, INTERN_MAX_CFG + 1), np.int64)
+        many[0] = np.arange(INTERN_MAX_CFG + 1)
+        many[1] = 1
+        many[2] = np.arange(INTERN_MAX_CFG + 1) + 100
+        many[3] = 999
+        assert cache.intern(many) is None
+        assert cache.n_cfg == n0  # rejected atomically
+        assert cache.intern(base) is not None  # still serving
