@@ -80,6 +80,14 @@ def shutdown(cluster, pools, etcd) -> None:
 def main(argv=None) -> int:
     import argparse
 
+    # honor JAX_PLATFORMS before the first backend use — without this the
+    # test fixtures' JAX_PLATFORMS=cpu is silently overridden by any
+    # platform plugin (e.g. a tunneled-TPU dev rig) and every engine op
+    # pays the remote device's compile/dispatch latency
+    from gubernator_tpu.cmd.daemon import _apply_jax_platforms
+
+    _apply_jax_platforms()
+
     parser = argparse.ArgumentParser("gubernator-cluster")
     parser.add_argument(
         "--etcd", action="store_true",

@@ -248,33 +248,69 @@ def main(argv=None) -> int:
             conf.num_hosts, conf.cross_host_capacity,
             conf.cross_host_sync_s * 1e3)
 
-    server, port = make_server(
-        instance,
-        conf.grpc_address,
-        stats_handler=GRPCStatsInterceptor(metrics),
-    )
-    server.start()
-    log.info("gRPC serving on %s (advertised as %s)", conf.grpc_address, advertise)
-
-    gateway = HttpGateway(instance, conf.http_address, metrics=metrics)
-    gateway.start()
-    log.info("HTTP gateway on %s", conf.http_address)
-
+    # Public gRPC surface: the native HTTP/2 front (native/peerlink.cpp)
+    # serves the wire-compatible protocol without the GIL when available —
+    # hot unary calls parse and (when eligible) decide in C; everything
+    # else punts to the same Python servicers grpcio binds. grpcio remains
+    # the fallback (GUBER_GRPC_NATIVE=0, dynamic :0 ports, or native
+    # build failure).
+    server = None
     peerlink = None
-    if conf.behaviors.peer_link_offset > 0:
-        # the native peer transport: peers reach it at grpc port + offset
-        # (service/peerlink.py; gRPC remains the compatibility fallback)
+    conf_grpc_port = 0
+    try:
+        conf_grpc_port = int(conf.grpc_address.rsplit(":", 1)[-1])
+    except ValueError:
+        pass
+    if (conf.grpc_native and conf_grpc_port > 0
+            and conf.behaviors.peer_link_offset > 0):
         from gubernator_tpu.service.peerlink import (
             PeerLinkError,
             PeerLinkService,
         )
 
-        link_port = port + conf.behaviors.peer_link_offset
+        conf_grpc_host = conf.grpc_address.rsplit(":", 1)[0]
         try:
-            peerlink = PeerLinkService(instance, port=link_port)
-            log.info("peerlink serving on port %d", peerlink.port)
+            peerlink = PeerLinkService(
+                instance,
+                port=conf_grpc_port + conf.behaviors.peer_link_offset,
+                grpc_port=conf_grpc_port, grpc_host=conf_grpc_host,
+                metrics=metrics)
+            port = conf_grpc_port
+            metrics.set_native_front(peerlink.native_hits)
+            log.info("native gRPC front on :%d (peerlink on %d, "
+                     "advertised as %s)", port, peerlink.port, advertise)
         except (PeerLinkError, RuntimeError) as e:
-            log.warning("peerlink disabled: %s (peer calls ride gRPC)", e)
+            log.warning("native gRPC front unavailable: %s "
+                        "(grpcio serves)", e)
+            peerlink = None
+    if peerlink is None:
+        server, port = make_server(
+            instance,
+            conf.grpc_address,
+            stats_handler=GRPCStatsInterceptor(metrics),
+        )
+        server.start()
+        log.info("gRPC serving on %s (advertised as %s)",
+                 conf.grpc_address, advertise)
+        if conf.behaviors.peer_link_offset > 0:
+            # the native peer transport: peers reach it at grpc port +
+            # offset (service/peerlink.py; gRPC remains the fallback)
+            from gubernator_tpu.service.peerlink import (
+                PeerLinkError,
+                PeerLinkService,
+            )
+
+            link_port = port + conf.behaviors.peer_link_offset
+            try:
+                peerlink = PeerLinkService(instance, port=link_port)
+                log.info("peerlink serving on port %d", peerlink.port)
+            except (PeerLinkError, RuntimeError) as e:
+                log.warning("peerlink disabled: %s (peer calls ride gRPC)",
+                            e)
+
+    gateway = HttpGateway(instance, conf.http_address, metrics=metrics)
+    gateway.start()
+    log.info("HTTP gateway on %s", conf.http_address)
 
     pool = build_pool(conf, instance)
 
@@ -295,7 +331,8 @@ def main(argv=None) -> int:
     gateway.close()
     if peerlink is not None:
         peerlink.close()
-    server.stop(grace=1.0)
+    if server is not None:
+        server.stop(grace=1.0)
     instance.close()
     if tracing:
         import jax

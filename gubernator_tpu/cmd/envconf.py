@@ -126,6 +126,9 @@ class DaemonConfig:
 
     # TPU backend (no reference analogue): auto | engine | sharded
     backend: str = "auto"
+    # serve the public gRPC address from the native HTTP/2 front
+    # (native/peerlink.cpp) when available; "0" reverts to grpcio
+    grpc_native: bool = True
     device_directory: bool = False  # on-chip key directory (engine only)
     min_batch_width: int = 64
     max_batch_width: int = 8192
@@ -185,6 +188,7 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
 
     conf = DaemonConfig(
         grpc_address=_env_str("GUBER_GRPC_ADDRESS", "0.0.0.0:81"),
+        grpc_native=_env_str("GUBER_GRPC_NATIVE", "1") != "0",
         http_address=_env_str("GUBER_HTTP_ADDRESS", "0.0.0.0:80"),
         advertise_address=_env_str("GUBER_ADVERTISE_ADDRESS"),
         cache_size=_env_int("GUBER_CACHE_SIZE", 50_000),

@@ -249,6 +249,17 @@ class Engine:
                     self.state, resp = self._decide_scan_compact(
                         self.state, compact_window(stacked), 0)
                 k *= 2
+            # serving-path auxiliary jits: the lone-miss mirror seed's
+            # 1-slot gather and the mirror-flush inject at its common
+            # (min-width) bucket. A cold compile of either inside a
+            # peerlink/gRPC-front worker stalls a LIVE response for the
+            # whole compile (~30 s on a tunneled TPU — observed as a
+            # first-RPC deadline, r4).
+            jax.block_until_ready(
+                self._gather(self.state, jnp.zeros(1, I32)))
+            warm_inject = np.zeros((1, 8), np.int64)
+            warm_inject[0, 0] = -1  # dropped lane: compile, mutate nothing
+            self._apply_inject_rows(warm_inject)
             if resp is not None:
                 jax.block_until_ready(resp)
 

@@ -186,9 +186,29 @@ def load_peerlink() -> ctypes.CDLL:
             c.c_int,
         ]
         lib.pls_send_responses.argtypes = [
+            # h, n, conn_token, rid, idx, status, limit, remaining, reset,
+            # err_off, err_buf, meta_off, meta_buf — 13 params (the meta
+            # sidecar carries pre-encoded pb metadata for gRPC replies)
             c.c_void_p, c.c_int, c.c_void_p, c.c_void_p, c.c_void_p,
             c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
-            c.c_char_p,
+            c.c_char_p, c.c_void_p, c.c_char_p,
+        ]
+        # ---- gRPC/HTTP/2 front ----
+        lib.pls_start_grpc.restype = c.c_int
+        lib.pls_start_grpc.argtypes = [c.c_void_p, c.c_int, c.c_char_p]
+        lib.pls_grpc_port.restype = c.c_int
+        lib.pls_grpc_port.argtypes = [c.c_void_p]
+        lib.pls_set_health.argtypes = [c.c_void_p, c.c_char_p, c.c_int]
+        lib.pls_next_raw.restype = c.c_int
+        lib.pls_next_raw.argtypes = [
+            # h, timeout_us, path, path_cap, path_len, body, body_cap,
+            # conn_token, stream_id — 9 params
+            c.c_void_p, c.c_longlong, c.c_char_p, c.c_int, c.c_void_p,
+            c.c_char_p, c.c_int, c.c_void_p, c.c_void_p,
+        ]
+        lib.pls_send_raw.argtypes = [
+            c.c_void_p, c.c_ulonglong, c.c_uint, c.c_char_p, c.c_int,
+            c.c_int, c.c_char_p,
         ]
         lib.pls_set_native.argtypes = [
             c.c_void_p, c.c_void_p, c.c_void_p, c.c_longlong,

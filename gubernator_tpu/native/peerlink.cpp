@@ -50,6 +50,7 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <cstdio>
 #include <condition_variable>
 #include <deque>
 #include <fcntl.h>
@@ -95,17 +96,482 @@ struct PendingReply {
   uint8_t method = 0;
   uint16_t expected = 0;
   uint16_t got = 0;
+  uint32_t h2_stream = 0;  // nonzero: reply as a gRPC/H2 response
   // columnar reply assembly, by item index
   std::vector<int32_t> status;
   std::vector<int64_t> limit, remaining, reset;
   std::vector<std::string> err;
+  std::vector<std::string> meta;  // pre-encoded pb field-6 bytes (H2 only)
   std::vector<uint8_t> filled;
 };
+
+// ===========================================================================
+// gRPC-over-HTTP/2 front (VERDICT r3 item 2): real gRPC framing on this
+// epoll loop, so existing gubernator clients (grpc-go, grpcio) talk
+// DIRECTLY to the native tier — no Python, no GIL, per RPC. A connection
+// accepted on the gRPC listener speaks RFC 7540 HTTP/2 + RFC 7541 HPACK;
+// unary GetRateLimits / GetPeerRateLimits bodies parse (hand-rolled
+// protobuf for the fixed field set, proto/gubernator.proto:46-67) into the
+// SAME columnar Frame queue the internal link protocol feeds — the Python
+// batch workers and the IO-thread native fast path serve both wire
+// protocols without knowing which one a request arrived on. Anything the
+// C parser cannot take verbatim (unknown fields, oversized, compressed
+// messages, other methods like UpdatePeerGlobals) is punted to Python as
+// raw bytes (pls_next_raw/pls_send_raw) and answered by the same servicer
+// objects the grpcio server binds — full wire compatibility, C fast lane.
+// ===========================================================================
+
+// ---------------------------------------------------------------- HPACK
+struct HuffCode { uint32_t code; uint8_t bits; };
+// RFC 7541 Appendix B code table (symbols 0-255 + EOS)
+const HuffCode kHuff[257] = {
+    {0x1ff8u, 13}, {0x7fffd8u, 23}, {0xfffffe2u, 28}, {0xfffffe3u, 28}, {0xfffffe4u, 28}, {0xfffffe5u, 28}, {0xfffffe6u, 28}, {0xfffffe7u, 28},
+    {0xfffffe8u, 28}, {0xffffeau, 24}, {0x3ffffffcu, 30}, {0xfffffe9u, 28}, {0xfffffeau, 28}, {0x3ffffffdu, 30}, {0xfffffebu, 28}, {0xfffffecu, 28},
+    {0xfffffedu, 28}, {0xfffffeeu, 28}, {0xfffffefu, 28}, {0xffffff0u, 28}, {0xffffff1u, 28}, {0xffffff2u, 28}, {0x3ffffffeu, 30}, {0xffffff3u, 28},
+    {0xffffff4u, 28}, {0xffffff5u, 28}, {0xffffff6u, 28}, {0xffffff7u, 28}, {0xffffff8u, 28}, {0xffffff9u, 28}, {0xffffffau, 28}, {0xffffffbu, 28},
+    {0x14u, 6}, {0x3f8u, 10}, {0x3f9u, 10}, {0xffau, 12}, {0x1ff9u, 13}, {0x15u, 6}, {0xf8u, 8}, {0x7fau, 11},
+    {0x3fau, 10}, {0x3fbu, 10}, {0xf9u, 8}, {0x7fbu, 11}, {0xfau, 8}, {0x16u, 6}, {0x17u, 6}, {0x18u, 6},
+    {0x0u, 5}, {0x1u, 5}, {0x2u, 5}, {0x19u, 6}, {0x1au, 6}, {0x1bu, 6}, {0x1cu, 6}, {0x1du, 6},
+    {0x1eu, 6}, {0x1fu, 6}, {0x5cu, 7}, {0xfbu, 8}, {0x7ffcu, 15}, {0x20u, 6}, {0xffbu, 12}, {0x3fcu, 10},
+    {0x1ffau, 13}, {0x21u, 6}, {0x5du, 7}, {0x5eu, 7}, {0x5fu, 7}, {0x60u, 7}, {0x61u, 7}, {0x62u, 7},
+    {0x63u, 7}, {0x64u, 7}, {0x65u, 7}, {0x66u, 7}, {0x67u, 7}, {0x68u, 7}, {0x69u, 7}, {0x6au, 7},
+    {0x6bu, 7}, {0x6cu, 7}, {0x6du, 7}, {0x6eu, 7}, {0x6fu, 7}, {0x70u, 7}, {0x71u, 7}, {0x72u, 7},
+    {0xfcu, 8}, {0x73u, 7}, {0xfdu, 8}, {0x1ffbu, 13}, {0x7fff0u, 19}, {0x1ffcu, 13}, {0x3ffcu, 14}, {0x22u, 6},
+    {0x7ffdu, 15}, {0x3u, 5}, {0x23u, 6}, {0x4u, 5}, {0x24u, 6}, {0x5u, 5}, {0x25u, 6}, {0x26u, 6},
+    {0x27u, 6}, {0x6u, 5}, {0x74u, 7}, {0x75u, 7}, {0x28u, 6}, {0x29u, 6}, {0x2au, 6}, {0x7u, 5},
+    {0x2bu, 6}, {0x76u, 7}, {0x2cu, 6}, {0x8u, 5}, {0x9u, 5}, {0x2du, 6}, {0x77u, 7}, {0x78u, 7},
+    {0x79u, 7}, {0x7au, 7}, {0x7bu, 7}, {0x7ffeu, 15}, {0x7fcu, 11}, {0x3ffdu, 14}, {0x1ffdu, 13}, {0xffffffcu, 28},
+    {0xfffe6u, 20}, {0x3fffd2u, 22}, {0xfffe7u, 20}, {0xfffe8u, 20}, {0x3fffd3u, 22}, {0x3fffd4u, 22}, {0x3fffd5u, 22}, {0x7fffd9u, 23},
+    {0x3fffd6u, 22}, {0x7fffdau, 23}, {0x7fffdbu, 23}, {0x7fffdcu, 23}, {0x7fffddu, 23}, {0x7fffdeu, 23}, {0xffffebu, 24}, {0x7fffdfu, 23},
+    {0xffffecu, 24}, {0xffffedu, 24}, {0x3fffd7u, 22}, {0x7fffe0u, 23}, {0xffffeeu, 24}, {0x7fffe1u, 23}, {0x7fffe2u, 23}, {0x7fffe3u, 23},
+    {0x7fffe4u, 23}, {0x1fffdcu, 21}, {0x3fffd8u, 22}, {0x7fffe5u, 23}, {0x3fffd9u, 22}, {0x7fffe6u, 23}, {0x7fffe7u, 23}, {0xffffefu, 24},
+    {0x3fffdau, 22}, {0x1fffddu, 21}, {0xfffe9u, 20}, {0x3fffdbu, 22}, {0x3fffdcu, 22}, {0x7fffe8u, 23}, {0x7fffe9u, 23}, {0x1fffdeu, 21},
+    {0x7fffeau, 23}, {0x3fffddu, 22}, {0x3fffdeu, 22}, {0xfffff0u, 24}, {0x1fffdfu, 21}, {0x3fffdfu, 22}, {0x7fffebu, 23}, {0x7fffecu, 23},
+    {0x1fffe0u, 21}, {0x1fffe1u, 21}, {0x3fffe0u, 22}, {0x1fffe2u, 21}, {0x7fffedu, 23}, {0x3fffe1u, 22}, {0x7fffeeu, 23}, {0x7fffefu, 23},
+    {0xfffeau, 20}, {0x3fffe2u, 22}, {0x3fffe3u, 22}, {0x3fffe4u, 22}, {0x7ffff0u, 23}, {0x3fffe5u, 22}, {0x3fffe6u, 22}, {0x7ffff1u, 23},
+    {0x3ffffe0u, 26}, {0x3ffffe1u, 26}, {0xfffebu, 20}, {0x7fff1u, 19}, {0x3fffe7u, 22}, {0x7ffff2u, 23}, {0x3fffe8u, 22}, {0x1ffffecu, 25},
+    {0x3ffffe2u, 26}, {0x3ffffe3u, 26}, {0x3ffffe4u, 26}, {0x7ffffdeu, 27}, {0x7ffffdfu, 27}, {0x3ffffe5u, 26}, {0xfffff1u, 24}, {0x1ffffedu, 25},
+    {0x7fff2u, 19}, {0x1fffe3u, 21}, {0x3ffffe6u, 26}, {0x7ffffe0u, 27}, {0x7ffffe1u, 27}, {0x3ffffe7u, 26}, {0x7ffffe2u, 27}, {0xfffff2u, 24},
+    {0x1fffe4u, 21}, {0x1fffe5u, 21}, {0x3ffffe8u, 26}, {0x3ffffe9u, 26}, {0xffffffdu, 28}, {0x7ffffe3u, 27}, {0x7ffffe4u, 27}, {0x7ffffe5u, 27},
+    {0xfffecu, 20}, {0xfffff3u, 24}, {0xfffedu, 20}, {0x1fffe6u, 21}, {0x3fffe9u, 22}, {0x1fffe7u, 21}, {0x1fffe8u, 21}, {0x7ffff3u, 23},
+    {0x3fffeau, 22}, {0x3fffebu, 22}, {0x1ffffeeu, 25}, {0x1ffffefu, 25}, {0xfffff4u, 24}, {0xfffff5u, 24}, {0x3ffffeau, 26}, {0x7ffff4u, 23},
+    {0x3ffffebu, 26}, {0x7ffffe6u, 27}, {0x3ffffecu, 26}, {0x3ffffedu, 26}, {0x7ffffe7u, 27}, {0x7ffffe8u, 27}, {0x7ffffe9u, 27}, {0x7ffffeau, 27},
+    {0x7ffffebu, 27}, {0xffffffeu, 28}, {0x7ffffecu, 27}, {0x7ffffedu, 27}, {0x7ffffeeu, 27}, {0x7ffffefu, 27}, {0x7fffff0u, 27}, {0x3ffffeeu, 26},
+    {0x3fffffffu, 30},
+};
+
+struct HuffNode { int16_t child[2]; int16_t sym; };  // sym -1 interior, -2 EOS
+
+const std::vector<HuffNode>& huff_tree() {
+  static const std::vector<HuffNode>* tree = [] {
+    auto* v = new std::vector<HuffNode>;
+    v->push_back({{-1, -1}, -1});
+    for (int s = 0; s < 257; s++) {
+      int n = 0;
+      for (int b = kHuff[s].bits - 1; b >= 0; b--) {
+        const int bit = (kHuff[s].code >> b) & 1;
+        if ((*v)[n].child[bit] < 0) {
+          (*v)[n].child[bit] = (int16_t)v->size();
+          v->push_back({{-1, -1}, -1});
+        }
+        n = (*v)[n].child[bit];
+      }
+      (*v)[n].sym = (int16_t)(s == 256 ? -2 : s);
+    }
+    return v;
+  }();
+  return *tree;
+}
+
+bool huff_decode(const uint8_t* p, size_t len, std::string* out) {
+  const auto& t = huff_tree();
+  int n = 0, depth = 0;
+  bool all_ones = true;  // padding must be a prefix of EOS (all 1 bits)
+  for (size_t i = 0; i < len; i++) {
+    for (int b = 7; b >= 0; b--) {
+      const int bit = (p[i] >> b) & 1;
+      n = t[n].child[bit];
+      if (n < 0) return false;
+      depth++;
+      all_ones = all_ones && bit;
+      if (t[n].sym != -1) {
+        if (t[n].sym == -2) return false;  // EOS inside the stream
+        out->push_back((char)t[n].sym);
+        n = 0;
+        depth = 0;
+        all_ones = true;
+      }
+    }
+  }
+  return depth <= 7 && all_ones;  // RFC 7541 §5.2 padding rules
+}
+
+// RFC 7541 Appendix A static table (1-based indices 1..61)
+const char* const kHpackStatic[61][2] = {
+    {":authority", ""}, {":method", "GET"}, {":method", "POST"},
+    {":path", "/"}, {":path", "/index.html"}, {":scheme", "http"},
+    {":scheme", "https"}, {":status", "200"}, {":status", "204"},
+    {":status", "206"}, {":status", "304"}, {":status", "400"},
+    {":status", "404"}, {":status", "500"}, {"accept-charset", ""},
+    {"accept-encoding", "gzip, deflate"}, {"accept-language", ""},
+    {"accept-ranges", ""}, {"accept", ""},
+    {"access-control-allow-origin", ""}, {"age", ""}, {"allow", ""},
+    {"authorization", ""}, {"cache-control", ""},
+    {"content-disposition", ""}, {"content-encoding", ""},
+    {"content-language", ""}, {"content-length", ""},
+    {"content-location", ""}, {"content-range", ""}, {"content-type", ""},
+    {"cookie", ""}, {"date", ""}, {"etag", ""}, {"expect", ""},
+    {"expires", ""}, {"from", ""}, {"host", ""}, {"if-match", ""},
+    {"if-modified-since", ""}, {"if-none-match", ""}, {"if-range", ""},
+    {"if-unmodified-since", ""}, {"last-modified", ""}, {"link", ""},
+    {"location", ""}, {"max-forwards", ""}, {"proxy-authenticate", ""},
+    {"proxy-authorization", ""}, {"range", ""}, {"referer", ""},
+    {"refresh", ""}, {"retry-after", ""}, {"server", ""},
+    {"set-cookie", ""}, {"strict-transport-security", ""},
+    {"transfer-encoding", ""}, {"user-agent", ""}, {"vary", ""},
+    {"via", ""}, {"www-authenticate", ""}};
+
+struct HpackDec {
+  // dynamic table, front = most recent (index 62 onward)
+  std::deque<std::pair<std::string, std::string>> dyn;
+  size_t dyn_bytes = 0;
+  size_t max_bytes = 4096;  // peer may resize up to our SETTINGS cap
+
+  void evict() {
+    while (dyn_bytes > max_bytes && !dyn.empty()) {
+      dyn_bytes -= dyn.back().first.size() + dyn.back().second.size() + 32;
+      dyn.pop_back();
+    }
+  }
+  void insert(std::string n, std::string v) {
+    dyn_bytes += n.size() + v.size() + 32;
+    dyn.emplace_front(std::move(n), std::move(v));
+    evict();
+  }
+  bool lookup(uint64_t idx, std::string* n, std::string* v) const {
+    if (idx == 0) return false;
+    if (idx <= 61) {
+      *n = kHpackStatic[idx - 1][0];
+      *v = kHpackStatic[idx - 1][1];
+      return true;
+    }
+    const uint64_t d = idx - 62;
+    if (d >= dyn.size()) return false;
+    *n = dyn[d].first;
+    *v = dyn[d].second;
+    return true;
+  }
+};
+
+bool hp_int(const uint8_t*& p, const uint8_t* end, int prefix,
+            uint64_t* out) {
+  if (p >= end) return false;
+  const uint64_t mask = (1u << prefix) - 1;
+  uint64_t v = *p++ & mask;
+  if (v < mask) {
+    *out = v;
+    return true;
+  }
+  int shift = 0;
+  while (p < end) {
+    const uint8_t b = *p++;
+    v += (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      if (v > (1ull << 32)) return false;  // sanity bound
+      *out = v;
+      return true;
+    }
+    shift += 7;
+    if (shift > 35) return false;
+  }
+  return false;
+}
+
+bool hp_str(const uint8_t*& p, const uint8_t* end, std::string* out) {
+  if (p >= end) return false;
+  const bool huff = (*p & 0x80) != 0;
+  uint64_t len;
+  if (!hp_int(p, end, 7, &len)) return false;
+  if (len > 64 * 1024 || (uint64_t)(end - p) < len) return false;
+  if (huff) {
+    if (!huff_decode(p, (size_t)len, out)) return false;
+  } else {
+    out->assign((const char*)p, (size_t)len);
+  }
+  p += len;
+  return true;
+}
+
+// Decode one complete header block, maintaining the connection's dynamic
+// table; captures :path. Returns false on any HPACK violation.
+bool hpack_decode_block(HpackDec* hp, const std::string& block,
+                       std::string* path) {
+  const uint8_t* p = (const uint8_t*)block.data();
+  const uint8_t* end = p + block.size();
+  while (p < end) {
+    const uint8_t b = *p;
+    std::string name, value;
+    if (b & 0x80) {  // indexed
+      uint64_t idx;
+      if (!hp_int(p, end, 7, &idx)) return false;
+      if (!hp->lookup(idx, &name, &value)) return false;
+    } else if (b & 0x40) {  // literal with incremental indexing
+      uint64_t idx;
+      if (!hp_int(p, end, 6, &idx)) return false;
+      if (idx) {
+        std::string dummy;
+        if (!hp->lookup(idx, &name, &dummy)) return false;
+      } else if (!hp_str(p, end, &name)) {
+        return false;
+      }
+      if (!hp_str(p, end, &value)) return false;
+      hp->insert(name, value);
+    } else if ((b & 0xe0) == 0x20) {  // dynamic table size update
+      uint64_t sz;
+      if (!hp_int(p, end, 5, &sz)) return false;
+      if (sz > 4096) return false;  // our advertised SETTINGS cap
+      hp->max_bytes = (size_t)sz;
+      hp->evict();
+      continue;
+    } else {  // literal without indexing / never indexed
+      uint64_t idx;
+      if (!hp_int(p, end, 4, &idx)) return false;
+      if (idx) {
+        std::string dummy;
+        if (!hp->lookup(idx, &name, &dummy)) return false;
+      } else if (!hp_str(p, end, &name)) {
+        return false;
+      }
+      if (!hp_str(p, end, &value)) return false;
+    }
+    if (path && name == ":path") *path = value;
+  }
+  return true;
+}
+
+// ------------------------------------------------------ protobuf (fixed)
+// Hand-rolled codec for exactly proto/gubernator.proto's field set — any
+// deviation punts the call to Python rather than risking silent drift.
+
+bool pb_varint(const uint8_t*& p, const uint8_t* end, uint64_t* out) {
+  uint64_t v = 0;
+  int shift = 0;
+  while (p < end && shift < 64) {
+    const uint8_t b = *p++;
+    v |= (uint64_t)(b & 0x7f) << shift;
+    if (!(b & 0x80)) {
+      *out = v;
+      return true;
+    }
+    shift += 7;
+  }
+  return false;
+}
+
+void pb_put_varint(std::string* o, uint64_t v) {
+  while (v >= 0x80) {
+    o->push_back((char)(v | 0x80));
+    v >>= 7;
+  }
+  o->push_back((char)v);
+}
+
+void pb_put_tag(std::string* o, int field, int wt) {
+  pb_put_varint(o, (uint64_t)(field << 3 | wt));
+}
+
+// Parse one RateLimitReq submessage into the next Frame lane (appending
+// to f->keys). Returns 1 ok, 0 = punt to Python, -1 malformed.
+int pb_parse_rate_limit_req(const uint8_t* p, const uint8_t* end,
+                            Frame* f) {
+  std::string name, ukey;
+  int64_t hits = 0, limit = 0, duration = 0;
+  uint64_t algorithm = 0, behavior = 0;
+  while (p < end) {
+    uint64_t tag;
+    if (!pb_varint(p, end, &tag)) return -1;
+    const int field = (int)(tag >> 3), wt = (int)(tag & 7);
+    if (wt == 2) {
+      uint64_t len;
+      if (!pb_varint(p, end, &len)) return -1;
+      if ((uint64_t)(end - p) < len) return -1;
+      if (field == 1) name.assign((const char*)p, (size_t)len);
+      else if (field == 2) ukey.assign((const char*)p, (size_t)len);
+      else return 0;  // metadata map / unknown: punt
+      p += len;
+    } else if (wt == 0) {
+      uint64_t v;
+      if (!pb_varint(p, end, &v)) return -1;
+      switch (field) {
+        case 3: hits = (int64_t)v; break;
+        case 4: limit = (int64_t)v; break;
+        case 5: duration = (int64_t)v; break;
+        case 6: algorithm = v; break;
+        case 7: behavior = v; break;
+        default: return 0;  // unknown scalar: punt
+      }
+    } else {
+      return 0;  // unexpected wire type: punt
+    }
+  }
+  if (name.size() > 1024 || ukey.size() > 1024) return 0;
+  f->name_len.push_back((uint16_t)name.size());
+  f->ukey_len.push_back((uint16_t)ukey.size());
+  f->keys += name;
+  f->keys += ukey;
+  f->hits.push_back(hits);
+  f->limit.push_back(limit);
+  f->duration.push_back(duration);
+  f->algorithm.push_back((uint32_t)algorithm);
+  f->behavior.push_back((uint32_t)behavior);
+  return 1;
+}
+
+// GetRateLimitsReq / GetPeerRateLimitsReq (same shape: repeated field 1).
+int pb_parse_get_rate_limits(const uint8_t* p, const uint8_t* end,
+                             Frame* f) {
+  while (p < end) {
+    uint64_t tag;
+    if (!pb_varint(p, end, &tag)) return -1;
+    if (tag != (1 << 3 | 2)) return 0;  // only field-1 submessages
+    uint64_t len;
+    if (!pb_varint(p, end, &len)) return -1;
+    if ((uint64_t)(end - p) < len) return -1;
+    const int r = pb_parse_rate_limit_req(p, p + len, f);
+    if (r != 1) return r;
+    p += len;
+    if (f->name_len.size() > 1024) return 0;  // frame cap: punt
+  }
+  f->count = (uint16_t)f->name_len.size();
+  return f->count > 0 ? 1 : 0;  // empty request: punt (python replies)
+}
+
+// One RateLimitResp appended as field 1 of the response message. proto3
+// canonical form: zero-valued scalars are omitted.
+void pb_put_resp_item(std::string* o, int32_t status, int64_t limit,
+                      int64_t remaining, int64_t reset,
+                      const std::string& err,
+                      const std::string& meta = std::string()) {
+  std::string item;
+  if (status) {
+    pb_put_tag(&item, 1, 0);
+    pb_put_varint(&item, (uint64_t)status);
+  }
+  if (limit) {
+    pb_put_tag(&item, 2, 0);
+    pb_put_varint(&item, (uint64_t)limit);
+  }
+  if (remaining) {
+    pb_put_tag(&item, 3, 0);
+    pb_put_varint(&item, (uint64_t)remaining);
+  }
+  if (reset) {
+    pb_put_tag(&item, 4, 0);
+    pb_put_varint(&item, (uint64_t)reset);
+  }
+  if (!err.empty()) {
+    pb_put_tag(&item, 5, 2);
+    pb_put_varint(&item, err.size());
+    item += err;
+  }
+  item += meta;  // caller-encoded field-6 map entries, appended verbatim
+  pb_put_tag(o, 1, 2);
+  pb_put_varint(o, item.size());
+  *o += item;
+}
+
+// ------------------------------------------------------------- HTTP/2
+constexpr uint8_t H2_DATA = 0, H2_HEADERS = 1,
+                  H2_RST_STREAM = 3, H2_SETTINGS = 4, H2_PING = 6,
+                  H2_GOAWAY = 7, H2_WINDOW_UPDATE = 8, H2_CONTINUATION = 9;
+constexpr uint8_t H2F_END_STREAM = 0x1, H2F_ACK = 0x1,
+                  H2F_END_HEADERS = 0x4, H2F_PADDED = 0x8,
+                  H2F_PRIORITY = 0x20;
+const char kH2Preface[] = "PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n";
+constexpr size_t kH2PrefaceLen = 24;
+constexpr size_t kMaxH2Body = 4u << 20;  // matches kMaxFrame
+constexpr uint32_t kH2MaxStreams = 1024;   // advertised + enforced
+constexpr size_t kH2MaxBuffered = 64u << 20;  // per-conn request memory
+
+struct H2Stream {
+  std::string hdr_block;
+  std::string body;
+  std::string path;
+  bool hdr_end = false;
+  bool end_stream = false;
+};
+
+void h2_frame_hdr(std::string* o, uint32_t len, uint8_t type, uint8_t flags,
+                  uint32_t sid) {
+  o->push_back((char)(len >> 16));
+  o->push_back((char)(len >> 8));
+  o->push_back((char)len);
+  o->push_back((char)type);
+  o->push_back((char)flags);
+  o->push_back((char)(sid >> 24 & 0x7f));
+  o->push_back((char)(sid >> 16));
+  o->push_back((char)(sid >> 8));
+  o->push_back((char)sid);
+}
+
+// Response header block: ":status: 200" (static idx 8) + content-type
+// (literal w/o indexing, static name idx 31). We never insert into the
+// peer's decoder table, so there is no encoder state to corrupt.
+std::string h2_resp_headers_block() {
+  std::string b;
+  b.push_back((char)0x88);
+  b.push_back((char)0x0f);  // literal w/o indexing, name idx 31 = 15+16
+  b.push_back((char)0x10);
+  static const char ct[] = "application/grpc";
+  b.push_back((char)(sizeof(ct) - 1));
+  b.append(ct, sizeof(ct) - 1);
+  return b;
+}
+
+void hp_put_literal(std::string* b, const char* name, size_t nlen,
+                    const std::string& value) {
+  b->push_back((char)0x00);  // literal w/o indexing, new name
+  b->push_back((char)nlen);  // header names here are short (< 127)
+  b->append(name, nlen);
+  if (value.size() < 127) {
+    b->push_back((char)value.size());
+    *b += value;
+  } else {
+    b->push_back((char)0x7f);
+    uint64_t rest = value.size() - 127;
+    while (rest >= 0x80) {
+      b->push_back((char)(rest | 0x80));
+      rest >>= 7;
+    }
+    b->push_back((char)rest);
+    *b += value;
+  }
+}
 
 struct Conn {
   int fd = -1;
   uint64_t token = 0;
   std::string inbuf;
+  // ---- gRPC/HTTP/2 connections (accepted on the grpc listener) ----
+  bool h2 = false;
+  bool preface_ok = false;
+  HpackDec hpack;
+  std::map<uint32_t, H2Stream> streams;
+  uint32_t cont_stream = 0;     // stream awaiting CONTINUATION (0 = none)
+  uint32_t max_frame_send = 16384;  // peer SETTINGS_MAX_FRAME_SIZE
+  int64_t send_window = 65535;  // connection-level; DATA gated on it
+  int64_t peer_initial_window = 65535;  // per-stream send budget
+  size_t buffered_bytes = 0;  // total body+header bytes across streams
+  // responses whose DATA exceeds a window: sent incrementally as the
+  // peer's WINDOW_UPDATEs arrive (payload = gRPC-framed bytes; trailers
+  // follow the final DATA frame)
+  struct BlockedResp {
+    uint32_t sid;
+    std::string payload;
+    size_t off = 0;
+    int64_t stream_window;  // remaining per-stream budget
+  };
+  std::deque<BlockedResp> blocked;
   // write side is shared between the IO thread (EPOLLOUT flush) and
   // responder threads (direct send from pls_send_responses): wmu guards
   // outbuf + want_write + the fd's send() — two unsynchronized writers
@@ -127,8 +593,20 @@ struct Server {
   std::condition_variable cv;
   std::deque<Frame> queue;  // parsed request frames awaiting a puller
   std::map<uint64_t, std::unique_ptr<Conn>> conns;  // token -> conn
-  uint64_t next_token = 1;
+  uint64_t next_token = 2;  // 0 = columnar listener, 1 = grpc listener
   int port = 0;
+
+  // ---- gRPC/HTTP/2 front ----
+  int grpc_listen_fd = -1;
+  int grpc_port = 0;
+  struct RawReq {  // calls the C parser punts to Python (full pb bytes)
+    uint64_t conn_token;
+    uint32_t stream_id;
+    std::string path, body;
+  };
+  std::deque<RawReq> raw_queue;  // guarded by mu
+  std::condition_variable raw_cv;
+  std::string health_blob;  // pre-serialized HealthCheckResp (under mu)
 
   // native lone-request fast path (atomics: set after start, read by the
   // IO thread without s->mu)
@@ -143,9 +621,10 @@ struct Server {
 
 bool direct_send(Server* s, Conn* c, const std::string& frame);
 
-// Try the native decision for a 1-item method-1 frame. Returns true when
-// the reply was written (frame fully served); false = take the queue.
-bool try_native_single(Server* s, Conn* c, const Frame& f) {
+// The native-decision core shared by the columnar and gRPC fronts: decide
+// a 1-item frame in THIS thread (keydir.cpp decide_one against the row
+// mirror). Returns true with out4 = status/limit/remaining/reset filled.
+bool native_decide_frame(Server* s, const Frame& f, int64_t out4[4]) {
   NativeDecideFn fn = s->native_fn.load(std::memory_order_acquire);
   if (fn == nullptr || f.count != 1) return false;
   if (f.method != 1 &&
@@ -153,22 +632,29 @@ bool try_native_single(Server* s, Conn* c, const Frame& f) {
     return false;
   }
   const int32_t nl = f.name_len[0], ul = f.ukey_len[0];
-  if (nl <= 0 || ul <= 0) return false;
+  if (nl <= 0 || ul <= 0 || nl > 1024 || ul > 1024) return false;
   if ((int64_t)f.behavior[0] &
       s->native_slow_mask.load(std::memory_order_relaxed)) {
     return false;
   }
-  char kbuf[2 * 1024 + 1];  // fields are <= 1024 B each (drain_inbuf)
+  char kbuf[2 * 1024 + 1];  // fields are <= 1024 B each (checked above)
   memcpy(kbuf, f.keys.data(), (size_t)nl);
   kbuf[nl] = '_';  // the engine key is name + '_' + unique_key
   memcpy(kbuf + nl + 1, f.keys.data() + nl, (size_t)ul);
-  int64_t out4[4];
   if (!fn(s->native_kd.load(std::memory_order_relaxed), kbuf, nl + 1 + ul,
           f.hits[0], f.limit[0], f.duration[0], (int32_t)f.algorithm[0],
           (int32_t)f.behavior[0], /*now_ms=*/0, out4)) {
     return false;  // cold/invalidated mirror: kernel path + re-seed
   }
   s->native_hits.fetch_add(1, std::memory_order_relaxed);
+  return true;
+}
+
+// Try the native decision for a 1-item method-1 frame. Returns true when
+// the reply was written (frame fully served); false = take the queue.
+bool try_native_single(Server* s, Conn* c, const Frame& f) {
+  int64_t out4[4];
+  if (!native_decide_frame(s, f, out4)) return false;
   // 1-item reply frame, written straight from the IO thread
   const uint16_t cnt = 1;
   const uint32_t len = 11 + (4 + 8 + 8 + 8 + 2);
@@ -269,6 +755,7 @@ bool drain_inbuf(Server* s, Conn* c) {
       pr.remaining.assign(count, 0);
       pr.reset.assign(count, 0);
       pr.err.assign(count, std::string());
+      pr.meta.assign(count, std::string());
       pr.filled.assign(count, 0);
       s->queue.push_back(std::move(f));
       enqueued = true;
@@ -356,6 +843,430 @@ bool direct_send(Server* s, Conn* c, const std::string& frame) {
   return true;
 }
 
+// ------------------------------------------------- HTTP/2 processing
+
+uint32_t be32(const uint8_t* p) {
+  return (uint32_t)p[0] << 24 | (uint32_t)p[1] << 16 | (uint32_t)p[2] << 8 |
+         p[3];
+}
+
+// Trailers-only gRPC error response (grpc spec: HEADERS with END_STREAM
+// carrying :status 200 + grpc-status). Not flow-controlled (no DATA).
+std::string h2_grpc_error(uint32_t sid, int code, const std::string& msg) {
+  std::string hb = h2_resp_headers_block();
+  hp_put_literal(&hb, "grpc-status", 11, std::to_string(code));
+  if (!msg.empty()) hp_put_literal(&hb, "grpc-message", 12, msg);
+  std::string o;
+  h2_frame_hdr(&o, (uint32_t)hb.size(), H2_HEADERS,
+               H2F_END_HEADERS | H2F_END_STREAM, sid);
+  o += hb;
+  return o;
+}
+
+// Emit DATA frames for payload[off, off+n) split at the peer's max frame
+// size, plus the grpc-status trailers after the FINAL byte.
+void h2_emit_data(Conn* c, uint32_t sid, const std::string& payload,
+                  size_t off, size_t n, std::string* out) {
+  const size_t end = off + n;
+  while (off < end) {
+    const size_t chunk = std::min((size_t)c->max_frame_send, end - off);
+    h2_frame_hdr(out, (uint32_t)chunk, H2_DATA, 0, sid);
+    out->append(payload, off, chunk);
+    off += chunk;
+  }
+  if (end == payload.size()) {
+    std::string tb;
+    hp_put_literal(&tb, "grpc-status", 11, "0");
+    h2_frame_hdr(out, (uint32_t)tb.size(), H2_HEADERS,
+                 H2F_END_HEADERS | H2F_END_STREAM, sid);
+    *out += tb;
+  }
+}
+
+// Full unary gRPC response: HEADERS now; DATA gated on BOTH HTTP/2 flow-
+// control windows (connection + per-stream initial budget); trailers after
+// the final DATA byte. Whatever the windows cannot carry yet queues on
+// c->blocked and drains as the peer's WINDOW_UPDATEs arrive. Appends
+// ready-to-send bytes to *acc so a batch of responses coalesces into ONE
+// send() per connection. Caller holds s->mu.
+void h2_append_response(Server* s, Conn* c, uint32_t sid,
+                        const std::string& pb, std::string* acc) {
+  std::string hb = h2_resp_headers_block();
+  h2_frame_hdr(acc, (uint32_t)hb.size(), H2_HEADERS, H2F_END_HEADERS, sid);
+  *acc += hb;
+  std::string payload;
+  payload.reserve(5 + pb.size());
+  payload.push_back((char)0);  // uncompressed
+  payload.push_back((char)(pb.size() >> 24));
+  payload.push_back((char)(pb.size() >> 16));
+  payload.push_back((char)(pb.size() >> 8));
+  payload.push_back((char)pb.size());
+  payload += pb;
+  const int64_t stream_win = c->peer_initial_window;
+  const int64_t can = std::max<int64_t>(
+      0, std::min(stream_win, c->send_window));
+  const size_t n = std::min((size_t)can, payload.size());
+  h2_emit_data(c, sid, payload, 0, n, acc);
+  c->send_window -= (int64_t)n;
+  if (n < payload.size()) {
+    Conn::BlockedResp br;
+    br.sid = sid;
+    br.payload = std::move(payload);
+    br.off = n;
+    br.stream_window = stream_win - (int64_t)n;
+    c->blocked.push_back(std::move(br));
+  }
+}
+
+// Drain blocked responses as far as the current windows allow. Caller
+// holds s->mu; emitted bytes append to *out.
+void h2_flush_blocked(Server* s, Conn* c, std::string* out) {
+  for (auto it = c->blocked.begin(); it != c->blocked.end();) {
+    if (c->send_window <= 0) break;
+    const size_t rem = it->payload.size() - it->off;
+    const int64_t can = std::min(
+        (int64_t)rem, std::min(it->stream_window, c->send_window));
+    if (can > 0) {
+      h2_emit_data(c, it->sid, it->payload, it->off, (size_t)can, out);
+      it->off += (size_t)can;
+      it->stream_window -= can;
+      c->send_window -= can;
+    }
+    if (it->off == it->payload.size()) {
+      it = c->blocked.erase(it);
+    } else {
+      ++it;
+    }
+  }
+}
+
+void h2_send_response_locked(Server* s, Conn* c, uint32_t sid,
+                             const std::string& pb) {
+  std::string acc;
+  h2_append_response(s, c, sid, pb, &acc);
+  if (!acc.empty()) direct_send(s, c, acc);
+}
+
+// Native fast path for a parsed 1-item gRPC call: decide in the IO thread
+// and write the full H2 response — a lone GetRateLimits RPC never touches
+// Python. Mirrors try_native_single's columnar reply.
+bool try_native_single_h2(Server* s, Conn* c, uint32_t sid,
+                          const Frame& f) {
+  int64_t out4[4];
+  if (!native_decide_frame(s, f, out4)) return false;
+  std::string pb;
+  pb_put_resp_item(&pb, (int32_t)out4[0], out4[1], out4[2], out4[3],
+                   std::string());
+  std::lock_guard<std::mutex> g(s->mu);
+  h2_send_response_locked(s, c, sid, pb);
+  return true;
+}
+
+// Route one complete (headers + body) stream. Returns false only on
+// connection-fatal conditions.
+bool h2_route_complete(Server* s, Conn* c, uint32_t sid) {
+  H2Stream st = std::move(c->streams[sid]);
+  c->streams.erase(sid);
+  const size_t held = st.body.size() + st.hdr_block.size();
+  c->buffered_bytes -= std::min(c->buffered_bytes, held);
+  // gRPC message framing: 1-byte compressed flag + 4-byte BE length
+  std::string msg;
+  bool ok_msg = st.body.size() >= 5 && st.body[0] == 0;
+  if (ok_msg) {
+    const uint32_t mlen = be32((const uint8_t*)st.body.data() + 1);
+    ok_msg = (size_t)mlen + 5 == st.body.size();
+    if (ok_msg) msg.assign(st.body, 5, mlen);
+  }
+  if (!ok_msg) {
+    const bool compressed = !st.body.empty() && st.body[0] == 1;
+    std::lock_guard<std::mutex> g(s->mu);
+    direct_send(s, c,
+                compressed
+                    ? h2_grpc_error(sid, 12, "compression not supported")
+                    : h2_grpc_error(sid, 13, "malformed grpc framing"));
+    return true;
+  }
+  int method = -1;
+  if (st.path == "/pb.gubernator.V1/GetRateLimits") {
+    method = 0;
+  } else if (st.path == "/pb.gubernator.PeersV1/GetPeerRateLimits") {
+    method = 1;
+  } else if (st.path == "/pb.gubernator.V1/HealthCheck") {
+    bool served = false;
+    {
+      std::lock_guard<std::mutex> g(s->mu);
+      if (!s->health_blob.empty()) {
+        h2_send_response_locked(s, c, sid, s->health_blob);
+        served = true;
+      } else {
+        s->raw_queue.push_back({c->token, sid, st.path, std::move(msg)});
+      }
+    }
+    if (!served) s->raw_cv.notify_one();
+    return true;
+  } else {
+    // UpdatePeerGlobals and anything else: Python answers from the full
+    // pb bytes (unknown methods get UNIMPLEMENTED there)
+    {
+      std::lock_guard<std::mutex> g(s->mu);
+      s->raw_queue.push_back({c->token, sid, st.path, std::move(msg)});
+    }
+    s->raw_cv.notify_one();
+    return true;
+  }
+  Frame f;
+  f.conn_token = c->token;
+  f.rid = sid;
+  f.method = (uint8_t)method;
+  const int pr = pb_parse_get_rate_limits(
+      (const uint8_t*)msg.data(), (const uint8_t*)msg.data() + msg.size(),
+      &f);
+  if (pr < 0) {
+    std::lock_guard<std::mutex> g(s->mu);
+    direct_send(s, c, h2_grpc_error(sid, 13, "malformed protobuf"));
+    return true;
+  }
+  if (pr == 0) {  // fields the fast parser doesn't know: Python decides
+    {
+      std::lock_guard<std::mutex> g(s->mu);
+      s->raw_queue.push_back({c->token, sid, st.path, std::move(msg)});
+    }
+    s->raw_cv.notify_one();
+    return true;
+  }
+  if (try_native_single_h2(s, c, sid, f)) return true;
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    PendingReply& rep = c->pending[f.rid];
+    rep.method = f.method;
+    rep.h2_stream = sid;
+    rep.expected = f.count;
+    rep.got = 0;
+    rep.status.assign(f.count, 0);
+    rep.limit.assign(f.count, 0);
+    rep.remaining.assign(f.count, 0);
+    rep.reset.assign(f.count, 0);
+    rep.err.assign(f.count, std::string());
+    rep.meta.assign(f.count, std::string());
+    rep.filled.assign(f.count, 0);
+    s->queue.push_back(std::move(f));
+  }
+  s->cv.notify_all();
+  return true;
+}
+
+// Parse every complete HTTP/2 frame in c->inbuf (the gRPC-front analogue
+// of drain_inbuf). Returns false on protocol violation (conn closes).
+bool h2_drain(Server* s, Conn* c) {
+  size_t off = 0;
+  if (!c->preface_ok) {
+    if (c->inbuf.size() < kH2PrefaceLen) return true;
+    if (memcmp(c->inbuf.data(), kH2Preface, kH2PrefaceLen) != 0)
+      return false;
+    off = kH2PrefaceLen;
+    c->preface_ok = true;
+    std::string o;
+    // our SETTINGS: 4 MB initial stream window (no per-stream stalls for
+    // bodies up to the 4 MB cap) + a concurrent-stream cap (enforced in
+    // the HEADERS handler: the port is public and unauthenticated)
+    h2_frame_hdr(&o, 12, H2_SETTINGS, 0, 0);
+    const uint16_t id4 = htons(4);
+    o.append((const char*)&id4, 2);
+    const uint32_t iw = htonl(4u << 20);
+    o.append((const char*)&iw, 4);
+    const uint16_t id3 = htons(3);
+    o.append((const char*)&id3, 2);
+    const uint32_t mcs = htonl(kH2MaxStreams);
+    o.append((const char*)&mcs, 4);
+    // plus a large connection window so ingest is never throttled
+    h2_frame_hdr(&o, 4, H2_WINDOW_UPDATE, 0, 0);
+    const uint32_t inc = htonl(0x3fff0000);
+    o.append((const char*)&inc, 4);
+    std::lock_guard<std::mutex> g(s->mu);
+    direct_send(s, c, o);
+  }
+  while (true) {
+    if (c->inbuf.size() - off < 9) break;
+    const uint8_t* h = (const uint8_t*)c->inbuf.data() + off;
+    const uint32_t len =
+        (uint32_t)h[0] << 16 | (uint32_t)h[1] << 8 | h[2];
+    const uint8_t type = h[3], flags = h[4];
+    const uint32_t sid = be32(h + 5) & 0x7fffffff;
+    if (len > (1u << 20)) return false;  // far past our max frame size
+    if (c->inbuf.size() - off - 9 < len) break;
+    const uint8_t* p = h + 9;
+    const uint8_t* pe = p + len;
+    if (c->cont_stream && type != H2_CONTINUATION) return false;
+    switch (type) {
+      case H2_SETTINGS: {
+        if (sid != 0 || len % 6 != 0) return false;
+        if (flags & H2F_ACK) break;
+        {
+          // responder threads read these under s->mu (h2_append_response)
+          std::lock_guard<std::mutex> g(s->mu);
+          for (const uint8_t* q = p; q + 6 <= pe; q += 6) {
+            const uint16_t id = (uint16_t)(q[0] << 8 | q[1]);
+            const uint32_t val = be32(q + 2);
+            if (id == 5) {  // SETTINGS_MAX_FRAME_SIZE
+              if (val >= 16384 && val <= 16777215) c->max_frame_send = val;
+            } else if (id == 4) {  // SETTINGS_INITIAL_WINDOW_SIZE
+              if (val <= 0x7fffffff) c->peer_initial_window = (int64_t)val;
+            }
+          }
+        }
+        std::string o;
+        h2_frame_hdr(&o, 0, H2_SETTINGS, H2F_ACK, 0);
+        std::lock_guard<std::mutex> g(s->mu);
+        direct_send(s, c, o);
+        break;
+      }
+      case H2_PING: {
+        if (len != 8 || sid != 0) return false;
+        if (flags & H2F_ACK) break;
+        std::string o;
+        h2_frame_hdr(&o, 8, H2_PING, H2F_ACK, 0);
+        o.append((const char*)p, 8);
+        std::lock_guard<std::mutex> g(s->mu);
+        direct_send(s, c, o);
+        break;
+      }
+      case H2_WINDOW_UPDATE: {
+        if (len != 4) return false;
+        const uint32_t inc = be32(p) & 0x7fffffff;
+        if (inc) {
+          std::lock_guard<std::mutex> g(s->mu);
+          std::string out;
+          if (sid == 0) {
+            c->send_window += inc;
+          } else {
+            for (auto& br : c->blocked) {
+              if (br.sid == sid) {
+                br.stream_window += inc;
+                break;
+              }
+            }
+          }
+          h2_flush_blocked(s, c, &out);
+          if (!out.empty()) direct_send(s, c, out);
+        }
+        break;
+      }
+      case H2_HEADERS: {
+        if (sid == 0 || (sid & 1) == 0) return false;
+        const uint8_t* q = p;
+        uint8_t pad = 0;
+        if (flags & H2F_PADDED) {
+          if (q >= pe) return false;
+          pad = *q++;
+        }
+        if (flags & H2F_PRIORITY) {
+          if (pe - q < 5) return false;
+          q += 5;
+        }
+        if (pe - q < pad) return false;
+        if (c->streams.find(sid) == c->streams.end() &&
+            c->streams.size() >= kH2MaxStreams) {
+          return false;  // stream flood on the public port
+        }
+        H2Stream& st = c->streams[sid];
+        const size_t add_h = (size_t)(pe - pad - q);
+        st.hdr_block.append((const char*)q, add_h);
+        c->buffered_bytes += add_h;
+        if (c->buffered_bytes > kH2MaxBuffered) return false;
+        if (flags & H2F_END_STREAM) st.end_stream = true;
+        if (flags & H2F_END_HEADERS) {
+          if (!hpack_decode_block(&c->hpack, st.hdr_block, &st.path))
+            return false;
+          st.hdr_block.clear();
+          st.hdr_end = true;
+          if (st.end_stream && !h2_route_complete(s, c, sid)) return false;
+        } else {
+          c->cont_stream = sid;
+        }
+        break;
+      }
+      case H2_CONTINUATION: {
+        if (sid == 0 || sid != c->cont_stream) return false;
+        auto it = c->streams.find(sid);
+        if (it == c->streams.end()) return false;
+        H2Stream& st = it->second;
+        st.hdr_block.append((const char*)p, len);
+        c->buffered_bytes += len;
+        if (st.hdr_block.size() > (64u << 10) ||
+            c->buffered_bytes > kH2MaxBuffered) {
+          return false;
+        }
+        if (flags & H2F_END_HEADERS) {
+          c->cont_stream = 0;
+          if (!hpack_decode_block(&c->hpack, st.hdr_block, &st.path))
+            return false;
+          st.hdr_block.clear();
+          st.hdr_end = true;
+          if (st.end_stream && !h2_route_complete(s, c, sid)) return false;
+        }
+        break;
+      }
+      case H2_DATA: {
+        if (sid == 0) return false;
+        const uint8_t* q = p;
+        uint8_t pad = 0;
+        if (flags & H2F_PADDED) {
+          if (q >= pe) return false;
+          pad = *q++;
+        }
+        if (pe - q < pad) return false;
+        auto it = c->streams.find(sid);
+        if (it != c->streams.end()) {
+          H2Stream& st = it->second;
+          const size_t add_b = (size_t)(pe - pad - q);
+          st.body.append((const char*)q, add_b);
+          c->buffered_bytes += add_b;
+          if (st.body.size() > kMaxH2Body ||
+              c->buffered_bytes > kH2MaxBuffered) {
+            return false;
+          }
+          if (flags & H2F_END_STREAM) {
+            st.end_stream = true;
+            if (st.hdr_end && !h2_route_complete(s, c, sid)) return false;
+          }
+        }
+        // flow-control credit for consumed bytes (connection level; the
+        // 4 MB initial stream window covers per-stream budgets)
+        if (len) {
+          std::string o;
+          h2_frame_hdr(&o, 4, H2_WINDOW_UPDATE, 0, 0);
+          const uint32_t credit = htonl(len);
+          o.append((const char*)&credit, 4);
+          std::lock_guard<std::mutex> g(s->mu);
+          direct_send(s, c, o);
+        }
+        break;
+      }
+      case H2_RST_STREAM: {
+        if (len != 4 || sid == 0) return false;
+        {
+          auto sit = c->streams.find(sid);
+          if (sit != c->streams.end()) {
+            const size_t held = sit->second.body.size() +
+                                sit->second.hdr_block.size();
+            c->buffered_bytes -= std::min(c->buffered_bytes, held);
+            c->streams.erase(sit);
+          }
+        }
+        std::lock_guard<std::mutex> g(s->mu);
+        c->pending.erase((uint64_t)sid);  // drop late worker replies
+        break;
+      }
+      case H2_GOAWAY:
+      default:
+        break;  // PRIORITY / unknown frame types: skip
+    }
+    off += 9 + len;
+  }
+  if (off) c->inbuf.erase(0, off);
+  return true;
+}
+
 void io_loop(Server* s) {
   epoll_event evs[64];
   while (true) {
@@ -366,14 +1277,16 @@ void io_loop(Server* s) {
     }
     for (int i = 0; i < n; i++) {
       uint64_t token = evs[i].data.u64;
-      if (token == 0) {  // listener
+      if (token == 0 || token == 1) {  // columnar / grpc listener
+        const int lfd = token == 0 ? s->listen_fd : s->grpc_listen_fd;
         while (true) {
-          int fd = accept(s->listen_fd, nullptr, nullptr);
+          int fd = accept(lfd, nullptr, nullptr);
           if (fd < 0) break;
           set_nonblock(fd);
           set_nodelay(fd);
           auto c = std::make_unique<Conn>();
           c->fd = fd;
+          c->h2 = token == 1;
           {
             std::lock_guard<std::mutex> g(s->mu);
             c->token = s->next_token++;
@@ -416,7 +1329,8 @@ void io_loop(Server* s) {
           else if (errno != EAGAIN && errno != EWOULDBLOCK) dead = true;
           break;
         }
-        if (!dead && !drain_inbuf(s, c)) dead = true;
+        if (!dead && !(c->h2 ? h2_drain(s, c) : drain_inbuf(s, c)))
+          dead = true;
       }
       if (!dead && (evs[i].events & EPOLLOUT)) {
         if (!flush_out(s, c)) dead = true;
@@ -483,6 +1397,7 @@ void pls_stop(void* h) {
   uint64_t one = 1;
   (void)write(s->wake_fd, &one, 8);
   s->cv.notify_all();
+  s->raw_cv.notify_all();
   s->io.join();
 }
 
@@ -490,10 +1405,116 @@ void pls_free(void* h) {
   auto* s = (Server*)h;
   for (auto& [tok, c] : s->conns) close(c->fd);
   close(s->listen_fd);
+  if (s->grpc_listen_fd >= 0) close(s->grpc_listen_fd);
   close(s->epoll_fd);
   close(s->wake_fd);
   delete s;
 }
+
+// Open the gRPC/HTTP/2 listener on host:port (0 picks a port; host NULL
+// or "" binds every interface) and register it with the running IO loop.
+// Returns the bound port, -1 on failure. Wire-compatible with the
+// reference's public+peers gRPC surface; methods the C tier cannot serve
+// verbatim are pulled by Python via pls_next_raw.
+int pls_start_grpc(void* h, int port, const char* host) {
+  auto* s = (Server*)h;
+  const int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  if (host != nullptr && host[0] != 0 &&
+      strcmp(host, "0.0.0.0") != 0) {
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      close(fd);
+      return -1;  // GUBER_GRPC_ADDRESS host must be an IPv4 literal here
+    }
+  }
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) < 0 ||
+      listen(fd, 1024) < 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &alen);
+  set_nonblock(fd);
+  {
+    std::lock_guard<std::mutex> g(s->mu);
+    s->grpc_listen_fd = fd;
+    s->grpc_port = ntohs(addr.sin_port);
+  }
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.u64 = 1;  // grpc listener sentinel
+  epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
+  return s->grpc_port;
+}
+
+// Publish the pre-serialized HealthCheckResp the IO thread answers
+// /pb.gubernator.V1/HealthCheck with (len 0 reverts to the Python path).
+void pls_set_health(void* h, const char* blob, int len) {
+  auto* s = (Server*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  s->health_blob.assign(blob, (size_t)(len < 0 ? 0 : len));
+}
+
+// Pull one punted gRPC call (blocking; call via CDLL so the GIL drops).
+// Returns the body length (>= 0), -1 when stopping, -3 on timeout, -2
+// when a buffer is too small (the call is dropped with an error reply).
+int pls_next_raw(void* h, long long timeout_us, char* path, int path_cap,
+                 int* path_len, char* body, int body_cap,
+                 unsigned long long* conn_token, unsigned int* stream_id) {
+  auto* s = (Server*)h;
+  std::unique_lock<std::mutex> g(s->mu);
+  if (s->raw_queue.empty()) {
+    s->raw_cv.wait_for(g, std::chrono::microseconds(timeout_us), [&] {
+      return !s->raw_queue.empty() || s->stopping;
+    });
+  }
+  if (s->stopping) return -1;
+  if (s->raw_queue.empty()) return -3;
+  Server::RawReq r = std::move(s->raw_queue.front());
+  s->raw_queue.pop_front();
+  if ((int)r.path.size() > path_cap || (int)r.body.size() > body_cap) {
+    auto cit = s->conns.find(r.conn_token);
+    if (cit != s->conns.end()) {
+      direct_send(s, cit->second.get(),
+                  h2_grpc_error(r.stream_id, 8, "request too large"));
+    }
+    return -2;
+  }
+  memcpy(path, r.path.data(), r.path.size());
+  *path_len = (int)r.path.size();
+  memcpy(body, r.body.data(), r.body.size());
+  *conn_token = r.conn_token;
+  *stream_id = r.stream_id;
+  return (int)r.body.size();
+}
+
+// Answer a punted call: grpc_status 0 sends `resp` as the unary response
+// body; nonzero sends a trailers-only error with `grpc_msg`.
+void pls_send_raw(void* h, unsigned long long conn_token,
+                  unsigned int stream_id, const char* resp, int len,
+                  int grpc_status, const char* grpc_msg) {
+  auto* s = (Server*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  auto cit = s->conns.find(conn_token);
+  if (cit == s->conns.end()) return;  // client vanished
+  Conn* c = cit->second.get();
+  if (grpc_status != 0) {
+    direct_send(s, c,
+                h2_grpc_error(stream_id, grpc_status,
+                              grpc_msg ? grpc_msg : ""));
+    return;
+  }
+  h2_send_response_locked(s, c, stream_id,
+                          std::string(resp, (size_t)(len < 0 ? 0 : len)));
+}
+
+int pls_grpc_port(void* h) { return ((Server*)h)->grpc_port; }
 
 // Pull everything pending (up to max_n items) into caller buffers. Blocks
 // up to timeout_us when the queue is empty (call via CDLL: GIL released).
@@ -551,9 +1572,13 @@ void pls_send_responses(void* h, int n, const unsigned long long* conn_token,
                         const unsigned long long* rid, const int* idx,
                         const int* status, const long long* limit,
                         const long long* remaining, const long long* reset,
-                        const int* err_off, const char* err_buf) {
+                        const int* err_off, const char* err_buf,
+                        const int* meta_off, const char* meta_buf) {
   auto* s = (Server*)h;
   std::lock_guard<std::mutex> g(s->mu);
+  // coalesce: all of this call's completed replies to one conn leave in
+  // ONE send() (a 100-wide herd pays 1 syscall per conn, not 100)
+  std::map<Conn*, std::string> acc;
   for (int i = 0; i < n; i++) {
     auto cit = s->conns.find(conn_token[i]);
     if (cit == s->conns.end()) continue;  // client vanished
@@ -562,7 +1587,7 @@ void pls_send_responses(void* h, int n, const unsigned long long* conn_token,
     if (pit == c->pending.end()) continue;
     PendingReply& pr = pit->second;
     int j = idx[i];
-    if (j < 0 || j >= pr.expected) continue;
+      if (j < 0 || j >= pr.expected) continue;
     if (!pr.filled[j]) pr.got++;
     pr.filled[j] = 1;
     pr.status[j] = status[i];
@@ -571,6 +1596,23 @@ void pls_send_responses(void* h, int n, const unsigned long long* conn_token,
     pr.reset[j] = reset[i];
     int elen = err_off[i + 1] - err_off[i];
     pr.err[j].assign(err_buf + err_off[i], (size_t)elen);
+    if (meta_off != nullptr) {
+      const int mlen = meta_off[i + 1] - meta_off[i];
+      pr.meta[j].assign(meta_buf + meta_off[i], (size_t)mlen);
+    }
+    if (pr.got == pr.expected && pr.h2_stream) {
+          // gRPC/H2 connection: serialize the pb response and send
+      std::string pb;
+      for (int j2 = 0; j2 < pr.expected; j2++) {
+        pb_put_resp_item(&pb, pr.status[j2], pr.limit[j2],
+                         pr.remaining[j2], pr.reset[j2], pr.err[j2],
+                         pr.meta[j2]);
+      }
+      const uint32_t sid2 = pr.h2_stream;
+      c->pending.erase(pit);
+      h2_append_response(s, c, sid2, pb, &acc[c]);
+      continue;
+    }
     if (pr.got == pr.expected) {
       uint16_t cnt = pr.expected;
       size_t ebytes = 0;
@@ -593,8 +1635,11 @@ void pls_send_responses(void* h, int n, const unsigned long long* conn_token,
       }
       for (auto& e : pr.err) frame += e;
       c->pending.erase(pit);
-      direct_send(s, c, frame);
+      acc[c] += frame;
     }
+  }
+  for (auto& [c, bytes] : acc) {
+    if (!bytes.empty()) direct_send(s, c, bytes);
   }
 }
 

@@ -152,8 +152,22 @@ class Metrics:
             )
         }
 
+    def set_native_front(self, hits_fn) -> None:
+        """Register the native gRPC front's IO-thread decision counter
+        (RPCs answered entirely in C never reach the Python counters)."""
+        self._native_front_hits = hits_fn
+
     def observe_instance(self, instance) -> None:
         """Refresh gauges from live objects before exposition."""
+        hits_fn = getattr(self, "_native_front_hits", None)
+        if hits_fn is not None:
+            try:
+                self._set_counter(
+                    self.grpc_request_counts.labels(
+                        status="ok", method="GetRateLimits/native"),
+                    float(hits_fn()))
+            except Exception:  # noqa: BLE001 — a closing front must not
+                pass           # break /metrics
         stats = getattr(instance.backend, "stats", None)
         if stats is not None:
             d = stats.as_dict() if hasattr(stats, "as_dict") else dict(stats)

@@ -27,6 +27,7 @@ import logging
 import socket
 import struct
 import threading
+import time
 from concurrent.futures import Future
 from concurrent.futures import TimeoutError as FutureTimeout
 from typing import Dict, List, Optional, Sequence
@@ -51,6 +52,51 @@ METHOD_GET_PEER_RATE_LIMITS = 1
 # made the gRPC tier slow, so the frames avoid it on both ends.
 _ONE_HDR = struct.Struct("<QBHHH")  # rid, method, count=1, name_len, ukey_len
 _ONE_FIX = struct.Struct("<qqqII")  # hits, limit, duration, algo, behavior
+
+
+
+
+def _pb_varint(v: int) -> bytes:
+    out = bytearray()
+    while v >= 0x80:
+        out.append((v & 0x7F) | 0x80)
+        v >>= 7
+    out.append(v)
+    return bytes(out)
+
+
+def _encode_pb_metadata(md: Dict[str, str]) -> bytes:
+    """RateLimitResp.metadata (field 6 map<string,string>) as raw proto
+    bytes — the C++ gRPC front embeds them verbatim into the response
+    item, so routed/GLOBAL replies keep their owner metadata on the
+    wire-compatible surface (proto/gubernator.proto:67)."""
+    out = bytearray()
+    for k, v in md.items():
+        kb, vb = k.encode(), str(v).encode()
+        entry = (b"\x0a" + _pb_varint(len(kb)) + kb
+                 + b"\x12" + _pb_varint(len(vb)) + vb)
+        out += b"\x32" + _pb_varint(len(entry)) + entry
+    return bytes(out)
+
+
+class _RawAbort(Exception):
+    """context.abort() surfaced from a servicer on the raw gRPC-front
+    path; becomes a trailers-only grpc-status reply."""
+
+    def __init__(self, code: int, details: str):
+        super().__init__(details)
+        self.code = code
+        self.details = details
+
+
+class _RawCtx:
+    """Minimal grpc.ServicerContext stand-in for the raw-punt path: the
+    servicers only call abort()."""
+
+    @staticmethod
+    def abort(code, details: str = ""):
+        num = code.value[0] if hasattr(code, "value") else int(code)
+        raise _RawAbort(num, details)
 
 
 class PeerLinkError(RuntimeError):
@@ -299,7 +345,9 @@ class PeerLinkService:
     MAX_N = 8192  # per-pull item cap (several frames aggregate per pull)
     KEY_CAP = 2 << 20  # > one max frame's keys (4096 items x 255 B)
 
-    def __init__(self, instance, port: int = 0, workers: int = 2):
+    def __init__(self, instance, port: int = 0, workers: int = 2,
+                 grpc_port: Optional[int] = None, grpc_host: str = "",
+                 metrics=None):
         from gubernator_tpu import native
         from gubernator_tpu.native import load_peerlink
 
@@ -309,6 +357,20 @@ class PeerLinkService:
         if not self._handle:
             raise PeerLinkError(f"peerlink: cannot bind port {port}")
         self.port = bound.value
+        # wire-compatible gRPC/HTTP/2 front (native/peerlink.cpp): real
+        # gubernator clients connect HERE; hot unary calls are parsed and
+        # decided in C, the rest punts to the Python servicers below
+        self.grpc_port: Optional[int] = None
+        self._metrics = metrics
+        if grpc_port is not None:
+            gp = self._lib.pls_start_grpc(self._handle, grpc_port,
+                                          grpc_host.encode())
+            if gp < 0:
+                self._lib.pls_stop(self._handle)
+                self._lib.pls_free(self._handle)
+                raise PeerLinkError(
+                    f"peerlink: cannot bind gRPC port {grpc_port}")
+            self.grpc_port = gp
         self.instance = instance
         self.stats = {"batches": 0, "requests": 0, "errors": 0}
         self._public_fast = False  # method-0 owner paths (standalone only)
@@ -342,6 +404,14 @@ class PeerLinkService:
                                  daemon=True)
             t.start()
             self._threads.append(t)
+        if self.grpc_port is not None:
+            self._refresh_health()
+            if hasattr(instance, "on_peers_change"):
+                instance.on_peers_change(self._refresh_health)
+            t = threading.Thread(target=self._raw_worker,
+                                 name="peerlink-grpc-raw", daemon=True)
+            t.start()
+            self._threads.append(t)
 
     def native_hits(self) -> int:
         """Lone requests answered by the C++ IO thread (no Python)."""
@@ -353,11 +423,106 @@ class PeerLinkService:
         self._public_fast = sole
         self._lib.pls_set_native_public(self._handle, int(sole))
 
+    # ------------------------------------------- gRPC front (raw punts)
+
+    def _refresh_health(self) -> None:
+        """Re-publish the pre-serialized HealthCheckResp the C IO thread
+        answers /pb.gubernator.V1/HealthCheck with (refreshed on peer
+        changes and on raw-worker idle ticks — sub-second staleness)."""
+        try:
+            from gubernator_tpu.service.convert import health_to_pb
+
+            blob = health_to_pb(self.instance.health_check()) \
+                .SerializeToString()
+            self._lib.pls_set_health(self._handle, blob, len(blob))
+        except Exception:  # noqa: BLE001 — C falls back to the raw path
+            self._lib.pls_set_health(self._handle, b"", 0)
+
+    def _count_rpc(self, method: str, ok: bool, n: int = 1) -> None:
+        """Feed the daemon's Prometheus counters (the grpcio interceptor
+        did this when it served the port; the native front reports the
+        same families so dashboards keep working)."""
+        m = self._metrics
+        if m is None or n <= 0:
+            return
+        try:
+            m.grpc_request_counts.labels(
+                status="ok" if ok else "error", method=method).inc(n)
+        except Exception:  # noqa: BLE001 — metrics must never break serving
+            pass
+
+    def _raw_worker(self) -> None:
+        """Serve the calls the C gRPC front punts (UpdatePeerGlobals,
+        unknown fields/methods, oversized) through the SAME servicer
+        logic the grpcio server binds — wire compatibility has one
+        implementation; C is only a fast lane in front of it."""
+        from gubernator_tpu.service import server as srv
+        from gubernator_tpu.service.pb import gubernator_pb2 as pb
+        from gubernator_tpu.service.pb import peers_pb2 as peers_pb
+
+        v1 = srv.V1Servicer(self.instance)
+        peers = srv.PeersV1Servicer(self.instance)
+        path_buf = ctypes.create_string_buffer(1024)
+        body_buf = ctypes.create_string_buffer(5 << 20)
+        path_len = ctypes.c_int(0)
+        conn = ctypes.c_ulonglong(0)
+        sid = ctypes.c_uint(0)
+        last_health = 0.0
+        while not self._stop:
+            n = self._lib.pls_next_raw(
+                self._handle, 500_000, path_buf, len(path_buf),
+                ctypes.byref(path_len), body_buf, len(body_buf),
+                ctypes.byref(conn), ctypes.byref(sid))
+            if n == -1:
+                return  # stopping
+            # time-based refresh keeps HealthCheck honest even under
+            # SUSTAINED punted traffic (no idle ticks to piggyback on)
+            now = time.monotonic()
+            if now - last_health >= 1.0:
+                self._refresh_health()
+                last_health = now
+            if n < 0:
+                continue
+            path = path_buf.raw[:path_len.value].decode("ascii", "replace")
+            body = body_buf.raw[:n]
+            status, msg, resp = 0, b"", b""
+            try:
+                if path == "/pb.gubernator.V1/GetRateLimits":
+                    out = v1.GetRateLimits(
+                        pb.GetRateLimitsReq.FromString(body), _RawCtx())
+                elif path == "/pb.gubernator.V1/HealthCheck":
+                    out = v1.HealthCheck(
+                        pb.HealthCheckReq.FromString(body), _RawCtx())
+                elif path == "/pb.gubernator.PeersV1/GetPeerRateLimits":
+                    out = peers.GetPeerRateLimits(
+                        peers_pb.GetPeerRateLimitsReq.FromString(body),
+                        _RawCtx())
+                elif path == "/pb.gubernator.PeersV1/UpdatePeerGlobals":
+                    out = peers.UpdatePeerGlobals(
+                        peers_pb.UpdatePeerGlobalsReq.FromString(body),
+                        _RawCtx())
+                else:
+                    raise _RawAbort(12, f"unknown method {path}")
+                resp = out.SerializeToString()
+            except _RawAbort as e:
+                status, msg = e.code, e.details.encode()
+            except Exception as e:  # noqa: BLE001
+                log.exception("grpc raw call failed")
+                status, msg = 13, str(e).encode()
+            self._count_rpc(path.rsplit("/", 1)[-1], status == 0)
+            try:
+                self._lib.pls_send_raw(self._handle, conn.value, sid.value,
+                                       resp, len(resp), status, msg)
+            except Exception:  # noqa: BLE001
+                log.exception("grpc raw reply failed")
+
     def close(self) -> None:
         self._stop = True
         # a stale peer-change listener would poke the freed native handle
         if hasattr(self.instance, "off_peers_change"):
             self.instance.off_peers_change(self._rearm_public)
+            if self.grpc_port is not None:
+                self.instance.off_peers_change(self._refresh_health)
         self._lib.pls_stop(self._handle)  # wakes blocked pullers (-1)
         for t in self._threads:
             t.join(timeout=2.0)
@@ -389,6 +554,7 @@ class PeerLinkService:
             "r_remaining": np.zeros(n, np.int64),
             "r_reset": np.zeros(n, np.int64),
             "err_off": np.zeros(n + 1, np.int32),
+            "meta_off": np.zeros(n + 1, np.int32),
         }
 
         def p(a):
@@ -401,6 +567,7 @@ class PeerLinkService:
         resp_ptrs = (p(b["conn"]), p(b["rid"]), p(b["idx"]), p(b["status"]),
                      p(b["r_limit"]), p(b["r_remaining"]), p(b["r_reset"]),
                      p(b["err_off"]))
+        meta_ptr = p(b["meta_off"])
         while not self._stop:
             got = self._lib.pls_next_batch(
                 self._handle, 200_000, *args)  # 200 ms idle tick
@@ -409,7 +576,7 @@ class PeerLinkService:
                     return  # stopping
                 continue
             try:
-                err_buf = self._handle_batch(got, b)
+                err_buf, meta_buf = self._handle_batch(got, b)
             except Exception:  # noqa: BLE001 — a worker must never die
                 log.exception("peerlink batch failed")
                 self.stats["errors"] += 1
@@ -417,9 +584,12 @@ class PeerLinkService:
                 # co-batched frame (other connections included) in
                 # PeerLinkTimeout and leaks the C++ Conn::pending entries.
                 err_buf = self._fail_batch(got, b)
+                meta_buf = b""
+                b["meta_off"][:got + 1] = 0
             try:
                 self._lib.pls_send_responses(
-                    self._handle, got, *resp_ptrs, err_buf)
+                    self._handle, got, *resp_ptrs, err_buf, meta_ptr,
+                    meta_buf)
             except Exception:  # noqa: BLE001
                 log.exception("peerlink send_responses failed")
                 self.stats["errors"] += 1
@@ -449,8 +619,17 @@ class PeerLinkService:
         the request-object path AFTER the packed round."""
         self.stats["batches"] += 1
         self.stats["requests"] += got
+        if self._metrics is not None and got:
+            # one RPC per distinct frame in the pull (rid changes mark
+            # frame boundaries; the pull preserves frame order)
+            rids = b["rid"][:got]
+            conns = b["conn"][:got]
+            n_frames = 1 + int(np.count_nonzero(
+                (rids[1:] != rids[:-1]) | (conns[1:] != conns[:-1])))
+            self._count_rpc("GetRateLimits", True, n_frames)
         method = b["method"]
         errs: List[tuple] = []  # (item index, error bytes), ascending
+        metas: List[tuple] = []  # (item index, encoded pb metadata)
         cb = getattr(self.instance, "columnar_backend", None)
         eng = cb() if callable(cb) else None
 
@@ -469,8 +648,9 @@ class PeerLinkService:
                 m == METHOD_GET_PEER_RATE_LIMITS
                 or (m == METHOD_GET_RATE_LIMITS and self._public_fast))
             if not (columnar_ok
-                    and self._columnar_chunk(eng, j, k, b, errs)):
-                self._object_chunk(m, j, k, b, errs)
+                    and self._columnar_chunk(m, eng, j, k, b, errs,
+                                             metas)):
+                self._object_chunk(m, j, k, b, errs, metas)
             j = k
 
         if got == 1 and self._seed_engine is not None and \
@@ -490,20 +670,22 @@ class PeerLinkService:
             except Exception:  # noqa: BLE001 — seeding is best-effort
                 pass
 
-        # error-offset fill: errors are sparse; one vectorized prefix sum
-        err_off = b["err_off"]
-        if not errs:
-            err_off[1:got + 1] = 0
-            return b""
-        errs.sort(key=lambda t: t[0])
-        lens = np.zeros(got, np.int64)
-        for i, e in errs:
-            lens[i] = len(e)
-        err_off[1:got + 1] = np.cumsum(lens)
-        return b"".join(e for _, e in errs)
+        # offset fills: errors/metadata are sparse; one prefix sum each
+        def _sparse(pairs, off_col):
+            if not pairs:
+                off_col[1:got + 1] = 0
+                return b""
+            pairs.sort(key=lambda t: t[0])
+            lens = np.zeros(got, np.int64)
+            for i, e in pairs:
+                lens[i] = len(e)
+            off_col[1:got + 1] = np.cumsum(lens)
+            return b"".join(e for _, e in pairs)
 
-    def _columnar_chunk(self, eng, j: int, k: int, b: dict,
-                        errs: list) -> bool:
+        return _sparse(errs, b["err_off"]), _sparse(metas, b["meta_off"])
+
+    def _columnar_chunk(self, m: int, eng, j: int, k: int, b: dict,
+                        errs: list, metas: list) -> bool:
         """Serve one peer-hop chunk columnar-end-to-end. Chunks wider than
         the engine's max window split into sub-windows, applied
         SEQUENTIALLY (complete i before submit i+1): the C prep's
@@ -536,14 +718,18 @@ class PeerLinkService:
                 h, b["status"][s0:s1], b["r_limit"][s0:s1],
                 b["r_remaining"][s0:s1], b["r_reset"][s0:s1])
             if len(leftover):
-                self._leftover_items(s0, leftover.tolist(), b, errs)
+                self._leftover_items(m, s0, leftover.tolist(), b, errs,
+                                     metas)
         return True
 
-    def _leftover_items(self, j: int, rel_idx: List[int], b: dict,
-                        errs: list) -> None:
+    def _leftover_items(self, m: int, j: int, rel_idx: List[int], b: dict,
+                        errs: list, metas: list) -> None:
         """Request-object tail of a columnar chunk: the lanes the C prep
         demoted (invalid, gregorian, GLOBAL/MULTI_REGION, duplicates).
-        Runs AFTER the packed round, preserving per-key order."""
+        Runs AFTER the packed round, preserving per-key order. Method 0
+        (public) leftovers take the FULL router path — a GLOBAL-flagged
+        request on the wire-compatible surface must reach the global
+        pipelines, not owner-apply semantics."""
         idxs = [j + r for r in rel_idx]
         reqs, good_idx = [], []
         koff = b["key_off"]
@@ -563,28 +749,34 @@ class PeerLinkService:
                 good_idx.append(i)
             except UnicodeDecodeError:
                 self._fill_one(b, i, RateLimitResp(
-                    error="invalid utf-8 in key"), errs)
+                    error="invalid utf-8 in key"), errs, metas)
         if not reqs:
             return
         try:
-            resps = self.instance.apply_owner_batch_direct(
-                reqs, from_peer_rpc=True)
+            if m == METHOD_GET_PEER_RATE_LIMITS:
+                resps = self.instance.apply_owner_batch_direct(
+                    reqs, from_peer_rpc=True)
+            else:
+                resps = self.instance.get_rate_limits(reqs)
         except Exception as e:  # noqa: BLE001
             resps = [RateLimitResp(error=str(e)) for _ in reqs]
         for i, resp in zip(good_idx, resps):
-            self._fill_one(b, i, resp, errs)
+            self._fill_one(b, i, resp, errs, metas)
 
     @staticmethod
-    def _fill_one(b: dict, i: int, resp: RateLimitResp, errs: list) -> None:
+    def _fill_one(b: dict, i: int, resp: RateLimitResp, errs: list,
+                  metas: Optional[list] = None) -> None:
         b["status"][i] = int(resp.status)
         b["r_limit"][i] = resp.limit
         b["r_remaining"][i] = resp.remaining
         b["r_reset"][i] = resp.reset_time
         if resp.error:
             errs.append((i, resp.error.encode()))
+        if metas is not None and resp.metadata:
+            metas.append((i, _encode_pb_metadata(resp.metadata)))
 
     def _object_chunk(self, m: int, j: int, k: int, b: dict,
-                      errs: list) -> None:
+                      errs: list, metas: list) -> None:
         """The request-object path (non-peer-hop methods, or no columnar
         backend): decode -> one handler call -> fill."""
         koff = b["key_off"][j:k + 1].tolist()
@@ -631,4 +823,4 @@ class PeerLinkService:
             resps = [RateLimitResp(error="invalid utf-8 in key")
                      if r is None else next(it) for r in reqs]
         for o, resp in enumerate(resps):
-            self._fill_one(b, j + o, resp, errs)
+            self._fill_one(b, j + o, resp, errs, metas)

@@ -152,10 +152,28 @@ def test_two_daemon_collective_global_convergence():
                 f"peer_counts={[h.peer_count for h in health]} "
                 f"health={[h.status for h in health]}")
 
-        # wait for the owner's collective broadcast to populate the
-        # non-owner cache (a few 50 ms ticks), then pour hits into the
-        # non-owner — the frozen gRPC pipelines cannot carry them
-        time.sleep(1.0)
+        # wait until the owner's collective broadcast has APPLIED on the
+        # non-owner (its /metrics counter moves — that is the moment its
+        # cache is populated); a fixed sleep raced the claims protocol on
+        # this 1-core rig (claim tick + hunt + broadcast can exceed 1 s
+        # under CPU contention), and un-populated pours would relay
+        # synchronously instead of riding the collective
+        def metric_of(port_i, name):
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{http_ports[port_i]}/metrics",
+                timeout=10).read().decode()
+            for line in text.splitlines():
+                if line.startswith(name + " "):
+                    return float(line.split()[1])
+            return 0.0
+
+        bcast_deadline = time.time() + 30
+        while time.time() < bcast_deadline:
+            if metric_of(1, "cross_host_broadcasts_applied_total") >= 1:
+                break
+            time.sleep(0.2)
+        assert metric_of(1, "cross_host_broadcasts_applied_total") >= 1, \
+            "owner broadcast never reached the non-owner's cache"
         for _ in range(4):
             r = ask(non_stub, key, 3)
             assert r.error == "", r.error

@@ -99,6 +99,7 @@ _PEERLINK_STRESS = textwrap.dedent("""
         status = (c.c_int32 * N)(); lim = (c.c_int64 * N)()
         rem = (c.c_int64 * N)(); rst = (c.c_int64 * N)()
         eoff = (c.c_int32 * (N + 1))()
+        moff = (c.c_int32 * (N + 1))()
         while not stop:
             got = lib.pls_next_batch(h, 50_000, keys, 1 << 20, *ptrs, N)
             if got <= 0:
@@ -111,7 +112,8 @@ _PEERLINK_STRESS = textwrap.dedent("""
             lib.pls_send_responses(h, got, ptrs[9], ptrs[10], ptrs[8],
                 c.cast(status, c.c_void_p), c.cast(lim, c.c_void_p),
                 c.cast(rem, c.c_void_p), c.cast(rst, c.c_void_p),
-                c.cast(eoff, c.c_void_p), b"")
+                c.cast(eoff, c.c_void_p), b"", c.cast(moff, c.c_void_p),
+                b"")
 
     def frame(rid, n=1):
         name, ukey = b"t", b"key%d" % rid
