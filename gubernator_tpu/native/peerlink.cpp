@@ -561,6 +561,10 @@ struct Conn {
   uint32_t max_frame_send = 16384;  // peer SETTINGS_MAX_FRAME_SIZE
   int64_t send_window = 65535;  // connection-level; DATA gated on it
   int64_t peer_initial_window = 65535;  // per-stream send budget
+  // stream credit granted BEFORE the response was built (RFC 7540 §6.9:
+  // WINDOW_UPDATE may precede our HEADERS; losing it can stall a
+  // response forever when the peer's initial window is small)
+  std::map<uint32_t, int64_t> stream_credit;
   size_t buffered_bytes = 0;  // total body+header bytes across streams
   // responses whose DATA exceeds a window: sent incrementally as the
   // peer's WINDOW_UPDATEs arrive (payload = gRPC-framed bytes; trailers
@@ -855,7 +859,17 @@ uint32_t be32(const uint8_t* p) {
 std::string h2_grpc_error(uint32_t sid, int code, const std::string& msg) {
   std::string hb = h2_resp_headers_block();
   hp_put_literal(&hb, "grpc-status", 11, std::to_string(code));
-  if (!msg.empty()) hp_put_literal(&hb, "grpc-message", 12, msg);
+  if (!msg.empty()) {
+    // header values must be visible ASCII: a newline in an exception
+    // repr would be a connection-level protocol error at the client
+    std::string clean;
+    clean.reserve(std::min(msg.size(), (size_t)512));
+    for (char ch : msg) {
+      if (clean.size() >= 512) break;
+      clean.push_back(ch >= 0x20 && ch < 0x7f ? ch : ' ');
+    }
+    hp_put_literal(&hb, "grpc-message", 12, clean);
+  }
   std::string o;
   h2_frame_hdr(&o, (uint32_t)hb.size(), H2_HEADERS,
                H2F_END_HEADERS | H2F_END_STREAM, sid);
@@ -902,7 +916,12 @@ void h2_append_response(Server* s, Conn* c, uint32_t sid,
   payload.push_back((char)(pb.size() >> 8));
   payload.push_back((char)pb.size());
   payload += pb;
-  const int64_t stream_win = c->peer_initial_window;
+  int64_t stream_win = c->peer_initial_window;
+  auto credit = c->stream_credit.find(sid);
+  if (credit != c->stream_credit.end()) {
+    stream_win += credit->second;
+    c->stream_credit.erase(credit);
+  }
   const int64_t can = std::max<int64_t>(
       0, std::min(stream_win, c->send_window));
   const size_t n = std::min((size_t)can, payload.size());
@@ -933,6 +952,7 @@ void h2_flush_blocked(Server* s, Conn* c, std::string* out) {
       c->send_window -= can;
     }
     if (it->off == it->payload.size()) {
+      c->stream_credit.erase(it->sid);
       it = c->blocked.erase(it);
     } else {
       ++it;
@@ -1110,7 +1130,13 @@ bool h2_drain(Server* s, Conn* c) {
             if (id == 5) {  // SETTINGS_MAX_FRAME_SIZE
               if (val >= 16384 && val <= 16777215) c->max_frame_send = val;
             } else if (id == 4) {  // SETTINGS_INITIAL_WINDOW_SIZE
-              if (val <= 0x7fffffff) c->peer_initial_window = (int64_t)val;
+              if (val <= 0x7fffffff) {
+                const int64_t delta =
+                    (int64_t)val - c->peer_initial_window;
+                c->peer_initial_window = (int64_t)val;
+                // RFC 7540 §6.9.2: adjust every in-flight stream budget
+                for (auto& br : c->blocked) br.stream_window += delta;
+              }
             }
           }
         }
@@ -1139,11 +1165,16 @@ bool h2_drain(Server* s, Conn* c) {
           if (sid == 0) {
             c->send_window += inc;
           } else {
+            bool found = false;
             for (auto& br : c->blocked) {
               if (br.sid == sid) {
                 br.stream_window += inc;
+                found = true;
                 break;
               }
+            }
+            if (!found && c->stream_credit.size() < 4 * kH2MaxStreams) {
+              c->stream_credit[sid] += inc;  // response not built yet
             }
           }
           h2_flush_blocked(s, c, &out);
@@ -1255,6 +1286,14 @@ bool h2_drain(Server* s, Conn* c) {
         }
         std::lock_guard<std::mutex> g(s->mu);
         c->pending.erase((uint64_t)sid);  // drop late worker replies
+        c->stream_credit.erase(sid);
+        for (auto it2 = c->blocked.begin(); it2 != c->blocked.end();) {
+          if (it2->sid == sid) {
+            it2 = c->blocked.erase(it2);  // cancelled: free the payload
+          } else {
+            ++it2;
+          }
+        }
         break;
       }
       case H2_GOAWAY:

@@ -510,6 +510,13 @@ class PeerLinkService:
                 log.exception("grpc raw call failed")
                 status, msg = 13, str(e).encode()
             self._count_rpc(path.rsplit("/", 1)[-1], status == 0)
+            if self._metrics is not None:
+                try:
+                    self._metrics.grpc_request_duration.labels(
+                        method=path.rsplit("/", 1)[-1]).observe(
+                            (time.monotonic() - now) * 1e3)
+                except Exception:  # noqa: BLE001
+                    pass
             try:
                 self._lib.pls_send_raw(self._handle, conn.value, sid.value,
                                        resp, len(resp), status, msg)
@@ -619,14 +626,25 @@ class PeerLinkService:
         the request-object path AFTER the packed round."""
         self.stats["batches"] += 1
         self.stats["requests"] += got
+        t_batch0 = time.perf_counter()
         if self._metrics is not None and got:
             # one RPC per distinct frame in the pull (rid changes mark
-            # frame boundaries; the pull preserves frame order)
+            # frame boundaries; the pull preserves frame order), counted
+            # per method. Both wire protocols (gRPC front + columnar
+            # link) feed this queue; method is the honest label either
+            # way (the grpcio interceptor also counted peer hops under
+            # their method name).
             rids = b["rid"][:got]
             conns = b["conn"][:got]
-            n_frames = 1 + int(np.count_nonzero(
-                (rids[1:] != rids[:-1]) | (conns[1:] != conns[:-1])))
-            self._count_rpc("GetRateLimits", True, n_frames)
+            meth = b["method"][:got]
+            starts = np.ones(got, bool)
+            starts[1:] = ((rids[1:] != rids[:-1])
+                          | (conns[1:] != conns[:-1]))
+            n0 = int(np.count_nonzero(starts & (meth == 0)))
+            n1 = int(np.count_nonzero(starts & (meth != 0)))
+            self._count_rpc("GetRateLimits", True, n0)
+            self._count_rpc("GetPeerRateLimits", True, n1)
+            self._frames_in_batch = (n0, n1)
         method = b["method"]
         errs: List[tuple] = []  # (item index, error bytes), ascending
         metas: List[tuple] = []  # (item index, encoded pb metadata)
@@ -682,6 +700,21 @@ class PeerLinkService:
             off_col[1:got + 1] = np.cumsum(lens)
             return b"".join(e for _, e in pairs)
 
+        if self._metrics is not None and got:
+            # every frame in the pull experienced ~this service time (the
+            # batch IS the unit of work); native-lane RPCs never reach
+            # Python and carry no histogram sample — documented limit
+            ms = (time.perf_counter() - t_batch0) * 1e3
+            n0, n1 = getattr(self, "_frames_in_batch", (0, 0))
+            try:
+                if n0:
+                    self._metrics.grpc_request_duration.labels(
+                        method="GetRateLimits").observe(ms)
+                if n1:
+                    self._metrics.grpc_request_duration.labels(
+                        method="GetPeerRateLimits").observe(ms)
+            except Exception:  # noqa: BLE001
+                pass
         return _sparse(errs, b["err_off"]), _sparse(metas, b["meta_off"])
 
     def _columnar_chunk(self, m: int, eng, j: int, k: int, b: dict,

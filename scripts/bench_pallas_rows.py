@@ -1,0 +1,144 @@
+"""Pallas row-DMA probe (VERDICT r3 item 3): can hand-issued per-row DMAs
+beat XLA's gather/scatter lowering for the decide kernel's access pattern?
+
+One grid program loops over B random rows with a DEPTH-deep pipeline of
+async HBM->VMEM row copies, bumps each row, and DMAs it back. This is the
+"Pallas would have to issue per-element HBM DMAs" path DESIGN.md argues
+against — measured here instead of asserted. Table stays in ANY/HBM;
+slots ride scalar prefetch (SMEM).
+
+Prints one JSON line. Compare rows_per_s against
+scripts/bench_access_ceiling.py's gather_scatter variant.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import time
+
+import numpy as np
+
+CAP = 10_000_000
+BATCH = 8_192
+DEPTH = 16  # DMA pipeline depth
+TARGET_S = 3.0
+
+
+def main() -> None:
+    import sys
+    sys.setrecursionlimit(100_000)
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(slots_ref, table_ref, table_out_ref, out_ref, rbuf, wbuf,
+               rsems, wsems):
+        del table_out_ref  # aliased to table_ref (in-place rows)
+
+        def i32(x):  # loop bounds are i32 (below): the modulo stays i32
+            return x
+        def start_read(i):
+            d = i32(i % DEPTH)
+            pltpu.make_async_copy(
+                table_ref.at[slots_ref[i]], rbuf.at[d],
+                rsems.at[d]).start()
+
+        def body(i, carry):
+            s = slots_ref[i]
+            d = i32(i % DEPTH)
+            # row i has landed in rbuf[i%D]
+            pltpu.make_async_copy(
+                table_ref.at[s], rbuf.at[d],
+                rsems.at[d]).wait()
+
+            @pl.when(i >= DEPTH)
+            def _():  # wbuf[i%D]'s previous writeback must be done
+                pltpu.make_async_copy(
+                    wbuf.at[d], table_ref.at[s],
+                    wsems.at[d]).wait()
+
+            wbuf[d] = rbuf[d] + jnp.int32(1)
+            pltpu.make_async_copy(
+                wbuf.at[d], table_ref.at[s],
+                wsems.at[d]).start()
+
+            @pl.when(i + DEPTH < BATCH)
+            def _():  # rbuf[i%D] is free again: prefetch row i+DEPTH
+                start_read(i + DEPTH)
+
+            return carry
+
+        for j in range(DEPTH):
+            start_read(j)
+        jax.lax.fori_loop(jnp.int32(0), jnp.int32(BATCH), body, 0)
+
+        def drain(i, c):  # tail of in-flight writebacks
+            d = i32(i % DEPTH)
+            pltpu.make_async_copy(
+                wbuf.at[d], table_ref.at[slots_ref[i]],
+                wsems.at[d]).wait()
+            return c
+        jax.lax.fori_loop(jnp.int32(max(BATCH - DEPTH, 0)),
+                          jnp.int32(BATCH), drain, 0)
+        out_ref[0] = slots_ref[0]
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step(table, slots):
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=1,
+                grid=(1,),
+                in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+                out_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                           pl.BlockSpec(memory_space=pltpu.SMEM)],
+                scratch_shapes=[
+                    pltpu.VMEM((DEPTH, 128), jnp.int32),
+                    pltpu.VMEM((DEPTH, 128), jnp.int32),
+                    pltpu.SemaphoreType.DMA((DEPTH,)),
+                    pltpu.SemaphoreType.DMA((DEPTH,)),
+                ],
+            ),
+            out_shape=[
+                jax.ShapeDtypeStruct((CAP, 128), jnp.int32),
+                jax.ShapeDtypeStruct((1,), jnp.int32),
+            ],
+            input_output_aliases={1: 0},
+        )(slots, table)
+        return out[0], out[1]
+
+    rng = np.random.RandomState(5)
+    table = jnp.zeros((CAP, 128), jnp.int32)  # Mosaic tiling floor:
+    # HBM slices must span 128 lanes, so the smallest per-row DMA is
+    # 512 B (vs the production 64 B row) — the probe measures the
+    # per-DMA ISSUE rate, which is what binds at row granularity
+    # (same burst size as the i64[8] production rows; x64 + traced SMEM
+    # indices trips a jax recursion bug inside pallas tracing)
+    slot_sets = [jnp.asarray(
+        rng.choice(CAP, BATCH, replace=False).astype(np.int32))
+        for _ in range(4)]
+
+    table, out = step(table, slot_sets[0])
+    _ = int(np.asarray(out[0]))
+    t0 = time.perf_counter()
+    table, out = step(table, slot_sets[1])
+    _ = int(np.asarray(out[0]))
+    per_call = max(time.perf_counter() - t0, 1e-6)
+    iters = max(4, min(400, int(TARGET_S / per_call)))
+    t0 = time.perf_counter()
+    for i in range(iters):
+        table, out = step(table, slot_sets[i % 4])
+    _ = int(np.asarray(out[0]))
+    el = time.perf_counter() - t0
+    print(json.dumps({
+        "variant": "pallas_row_dma",
+        "rows_per_s": round(iters * BATCH / el, 1),
+        "depth": DEPTH, "iters": iters,
+        "device": str(jax.devices()[0]),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -300,3 +300,56 @@ class TestGrpcFrontProtocol:
             sk.close()
             svc.close()
             cl.stop()
+
+    def test_zero_initial_window_with_early_credit(self):
+        """A peer advertising INITIAL_WINDOW_SIZE=0 that grants stream
+        credit BEFORE the response is built must still get the response
+        (early credit is banked, not dropped — RFC 7540 §6.9)."""
+        import socket
+        import struct as s
+        import time
+
+        from gubernator_tpu.service.pb import gubernator_pb2 as pb
+
+        cl = LocalCluster().start(1)
+        svc = PeerLinkService(cl.instances[0].instance, port=0, grpc_port=0)
+        sk = socket.create_connection(("127.0.0.1", svc.grpc_port))
+        try:
+            sk.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
+                       + self._frame(4, 0, 0, s.pack(">HI", 4, 0)))
+            msg = pb.GetRateLimitsReq(requests=[
+                pb.RateLimitReq(name="zw", unique_key="k", hits=1,
+                                limit=9, duration=60_000)]
+            ).SerializeToString()
+            body = b"\x00" + s.pack(">I", len(msg)) + msg
+            # request + immediate stream/conn credit, before the worker
+            # can possibly have built the response
+            sk.sendall(self._frame(1, 0x4, 1, self._headers())
+                       + self._frame(0, 0x1, 1, body)
+                       + self._frame(8, 0, 1, s.pack(">I", 1 << 20))
+                       + self._frame(8, 0, 0, s.pack(">I", 1 << 20)))
+            sk.settimeout(0.25)
+            buf = b""
+            done = False
+            end = time.time() + 20
+            while time.time() < end and not done:
+                try:
+                    d = sk.recv(1 << 16)
+                    if not d:
+                        break
+                    buf += d
+                except socket.timeout:
+                    continue
+                off = 0
+                while len(buf) - off >= 9:
+                    ln = int.from_bytes(buf[off:off + 3], "big")
+                    if len(buf) - off - 9 < ln:
+                        break
+                    if buf[off + 3] == 1 and buf[off + 4] & 0x1:
+                        done = True
+                    off += 9 + ln
+            assert done, "response stalled behind a zero initial window"
+        finally:
+            sk.close()
+            svc.close()
+            cl.stop()
