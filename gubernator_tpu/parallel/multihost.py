@@ -137,6 +137,47 @@ class CollectiveGlobalChannel:
         ))
         self.steps = 0
 
+    def warm(self, timeout_s: float = 600.0) -> None:
+        """Compile the exchange and form the fabric context in LOCKSTEP.
+
+        The backend's first cross-host exchange has a fixed internal
+        context-formation deadline (Gloo on CPU: ~30 s). Hosts whose
+        compiles serialize — cold caches, shared CPUs, heterogeneous boot
+        times — enter their first exchange minutes apart and the earliest
+        one times out, killing the whole process group. So: (1) AOT-compile
+        the step locally (arbitrary skew is fine), (2) rendezvous every
+        host at the coordination service's barrier (already up — the
+        process group formed at boot), (3) run one all-zeros exchange with
+        every host inside the deadline window. Call at BOOT, before the
+        tick cadence starts: a broken fabric fails loudly here instead of
+        mid-serving."""
+        G = self.global_capacity
+        d = np.zeros((self._n_local, G), np.int64)
+        s = np.zeros((self._n_local, 5, G), np.int64)
+        args = (
+            jax.make_array_from_process_local_data(self._row, d),
+            jax.make_array_from_process_local_data(self._row, d),
+            jax.make_array_from_process_local_data(self._row3, s),
+        )
+        self._step.lower(*args).compile()  # local compile, no exchange
+        try:
+            from jax._src import distributed
+
+            client = distributed.global_state.client
+        except Exception:  # noqa: BLE001 — older jax layouts
+            client = None
+        if client is None:
+            log.warning(
+                "no distributed-client barrier available: hosts enter the "
+                "first exchange unsynchronized — serialized cold-cache "
+                "compiles can blow the fabric's context-formation deadline")
+        else:
+            client.wait_at_barrier(
+                "guber_collective_warm", int(timeout_s * 1000))
+        self.step(np.zeros(G, np.int64), np.zeros(G, np.int64),
+                  np.zeros((5, G), np.int64))
+        log.info("collective channel warmed (fabric context formed)")
+
     def step(self, delta: np.ndarray, claim: np.ndarray,
              state: np.ndarray):
         """One collective tick. Returns host arrays

@@ -1,0 +1,287 @@
+"""Collective GLOBAL under membership churn at 4 hosts (VERDICT r3 item 4).
+
+Four REAL daemons form one jax.distributed process group; the gRPC GLOBAL
+pipelines are frozen (1h windows) so the collective tick is the only
+transport that can move hits. The run then exercises:
+
+  A. steady state   — hits poured at three non-owners converge EXACTLY at
+                      the owner (claims never mix keys: the arithmetic is
+                      bit-exact), conflicts stay 0, the fallback fraction
+                      stays bounded;
+  B. join/leave     — the gubernator membership shrinks and re-grows via a
+                      watched peers FILE while GLOBAL traffic flows; the
+                      fleet keeps answering, and a fresh key after re-join
+                      again converges exactly (ownership rehash loses
+                      bucket state by design, as the reference does — the
+                      invariant is safety + exactness for keys registered
+                      under the settled membership);
+  C. rolling death  — SIGKILL one host: the survivors' blocked tick flips
+                      HealthCheck within the stall timeout, serving
+                      continues on the fallback with admissions never
+                      exceeding the limit, and the dead host rejoins the
+                      gRPC fleet standalone.
+
+(reference: global.go:159-239's broadcast pipelines, which this tier
+replaces; cluster churn semantics per cluster/cluster.go restarts.)
+"""
+
+import json
+import os
+import signal
+import threading
+import time
+import urllib.request
+
+from conftest import free_port, spawn_daemon, stop_daemon
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+N = 4
+GLOBAL = 2  # Behavior.GLOBAL wire value
+
+
+def _metric(http_port, name):
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{http_port}/metrics", timeout=10).read().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return 0.0
+
+
+def _wait_metric(http_port, name, want, deadline_s, cmp=lambda v, w: v >= w):
+    end = time.time() + deadline_s
+    v = _metric(http_port, name)
+    while time.time() < end:
+        if cmp(v, want):
+            return v
+        time.sleep(0.2)
+        v = _metric(http_port, name)
+    return v
+
+
+def test_four_host_collective_churn(tmp_path):
+    from gubernator_tpu.service.grpc_api import dial_v1
+    from gubernator_tpu.service.pb import gubernator_pb2 as pb
+
+    coord = f"127.0.0.1:{free_port()}"
+    grpc_ports = [free_port() for _ in range(N)]
+    http_ports = [free_port() for _ in range(N)]
+    addrs = [f"127.0.0.1:{p}" for p in grpc_ports]
+    peers_file = tmp_path / "peers.json"
+
+    def write_peers(active_addrs):
+        peers_file.write_text(json.dumps(
+            [{"address": a} for a in active_addrs]))
+
+    write_peers(addrs)
+
+    def env_for(i, num_hosts=N, host_id=None, coordinator=coord):
+        e = {
+            "JAX_PLATFORMS": "cpu",
+            # the suite's 8-virtual-device XLA_FLAGS must NOT leak in: a
+            # 4-host x 8-device Gloo ring (32 participants) cannot form
+            # within its 30 s init deadline while four daemons share one
+            # core — 1 device/host is the DCN topology under test anyway
+            "XLA_FLAGS": "",
+            "GUBER_BACKEND": "engine",
+            "GUBER_GRPC_ADDRESS": addrs[i],
+            "GUBER_HTTP_ADDRESS": f"127.0.0.1:{http_ports[i]}",
+            "GUBER_PEERS_FILE": str(peers_file),
+            "GUBER_CACHE_SIZE": "4096",
+            "GUBER_MIN_BATCH_WIDTH": "32",
+            "GUBER_MAX_BATCH_WIDTH": "128",
+            "GUBER_CROSS_HOST_SYNC": "50ms",
+            "GUBER_CROSS_HOST_CAPACITY": "1024",
+            "GUBER_CROSS_HOST_STALL": "3s",
+            "GUBER_GLOBAL_SYNC_WAIT": "1h",
+        }
+        if num_hosts > 1:
+            e["GUBER_COORDINATOR_ADDRESS"] = coordinator
+            e["GUBER_NUM_HOSTS"] = str(num_hosts)
+            e["GUBER_HOST_ID"] = str(host_id if host_id is not None else i)
+        return e
+
+    procs = [None] * N
+    errs = []
+
+    def boot(i):
+        try:
+            procs[i] = spawn_daemon(
+                env_for(i), ready_timeout=300,
+                stderr_path=f"/tmp/guber_churn_daemon{i}.log")
+        except Exception as e:  # noqa: BLE001
+            errs.append((i, e))
+
+    threads = [threading.Thread(target=boot, args=(i,)) for i in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=360)
+    assert not errs and all(procs), f"boot failed: {errs}"
+
+    stubs = [dial_v1(a) for a in addrs]
+
+    def ask(stub, key, hits, limit=1000, timeout=20):
+        r = stub.GetRateLimits(pb.GetRateLimitsReq(requests=[
+            pb.RateLimitReq(name="churn", unique_key=key, hits=hits,
+                            limit=limit, duration=3_600_000,
+                            behavior=GLOBAL)]),
+            timeout=timeout).responses[0]
+        return r
+
+    def owner_of(key_suffix, stub_i=1):
+        """Ask daemon stub_i; its response metadata names the owner."""
+        r = ask(stubs[stub_i], key_suffix, 0)
+        assert r.error == "", r.error
+        return r.metadata.get("owner", addrs[stub_i])
+
+    try:
+        # ---- Phase A: steady-state exact convergence --------------------
+        # pick a key owned by daemon 0 (probing from daemon 1)
+        key = None
+        for i in range(400):
+            cand = f"{i}conv"  # digits FIRST: fnv1 clusters trailing-suffix keys onto one arc (test_pickers.py::test_fnv1_trailing_suffix)
+            if owner_of(cand) == addrs[0]:
+                key = cand
+                break
+        assert key is not None
+        owner_stub, owner_http = stubs[0], http_ports[0]
+        pour_plan = [(1, 5, 12), (2, 7, 15), (3, 9, 18)]  # (i, first, poured)
+        spent = 0
+        for i, first, _ in pour_plan:
+            r = ask(stubs[i], key, first)  # first touch: relay + register
+            assert r.error == "", r.error
+            spent += first
+        # every non-owner must see the owner broadcast before pouring (the
+        # pour must ride the collective, not the synchronous relay)
+        for i, _, _ in pour_plan:
+            got = _wait_metric(http_ports[i],
+                               "cross_host_broadcasts_applied_total", 1, 30)
+            if got < 1:
+                for d in range(N):
+                    text = urllib.request.urlopen(
+                        f"http://127.0.0.1:{http_ports[d]}/metrics",
+                        timeout=10).read().decode()
+                    for line in text.splitlines():
+                        if line.startswith("cross_host") and \
+                                "_created" not in line:
+                            print(f"daemon{d} {line}")
+            assert got >= 1, f"daemon{i} never saw the owner broadcast"
+        for i, _, poured in pour_plan:
+            step = poured // 3
+            for _ in range(3):
+                r = ask(stubs[i], key, step)
+                assert r.error == "", r.error
+            spent += step * 3
+        # exact convergence at the owner: remaining == limit - every hit.
+        # Claims mixing keys would break this arithmetic — exactness IS
+        # the isolation assertion.
+        want = 1000 - spent
+        deadline = time.time() + 30
+        remaining = None
+        while time.time() < deadline:
+            remaining = ask(owner_stub, key, 0).remaining
+            if remaining == want:
+                break
+            time.sleep(0.25)
+        assert remaining == want, f"owner remaining {remaining}, want {want}"
+        for i in range(N):
+            assert _metric(http_ports[i], "cross_host_conflicts_total") == 0
+            frac = _metric(http_ports[i], "cross_host_fallback_fraction")
+            assert frac <= 0.1, f"daemon{i} fallback fraction {frac}"
+        assert _metric(owner_http, "cross_host_deltas_applied_total") >= 45
+        for i, _, poured in pour_plan:
+            assert _metric(http_ports[i],
+                           "cross_host_hits_synced_total") >= poured
+
+        # ---- Phase B: join/leave churn via the peers file ---------------
+        write_peers(addrs[:3])  # daemon 3 leaves the serving fleet
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            hc = [s.HealthCheck(pb.HealthCheckReq(), timeout=10).peer_count
+                  for s in stubs[:3]]
+            if all(c == 3 for c in hc):
+                break
+            time.sleep(0.3)
+        assert all(
+            s.HealthCheck(pb.HealthCheckReq(), timeout=10).peer_count == 3
+            for s in stubs[:3]), "membership never settled at 3"
+        # traffic keeps flowing during the shrunken membership
+        for it in range(6):
+            r = ask(stubs[it % 3], f"{it}churnB", 1)
+            assert r.error == "", r.error
+        write_peers(addrs)  # daemon 3 rejoins
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            hc = [s.HealthCheck(pb.HealthCheckReq(), timeout=10).peer_count
+                  for s in stubs]
+            if all(c == N for c in hc):
+                break
+            time.sleep(0.3)
+        assert all(
+            s.HealthCheck(pb.HealthCheckReq(), timeout=10).peer_count == N
+            for s in stubs), "membership never re-settled at 4"
+        # a FRESH key under the settled membership converges exactly again
+        key2 = None
+        for i in range(400):
+            cand = f"{i}convb"
+            if owner_of(cand, stub_i=2) == addrs[0]:
+                key2 = cand
+                break
+        assert key2 is not None
+        r = ask(stubs[2], key2, 4)
+        assert r.error == ""
+        got = _wait_metric(http_ports[2],
+                           "cross_host_broadcasts_applied_total", 1, 30)
+        assert got >= 1
+        for _ in range(3):
+            assert ask(stubs[2], key2, 2).error == ""
+        deadline = time.time() + 30
+        remaining = None
+        while time.time() < deadline:
+            remaining = ask(stubs[0], key2, 0).remaining
+            if remaining == 1000 - 10:
+                break
+            time.sleep(0.25)
+        assert remaining == 990, \
+            f"post-churn convergence broken: {remaining}"
+        # claims still never mixed
+        for i in range(N):
+            assert _metric(http_ports[i], "cross_host_conflicts_total") == 0
+
+        # ---- Phase C: rolling death -------------------------------------
+        procs[3].send_signal(signal.SIGKILL)
+        procs[3].wait(timeout=10)
+        # survivors' blocked tick must flip health within stall + grace
+        deadline = time.time() + 15
+        unhealthy = False
+        while time.time() < deadline:
+            h = stubs[0].HealthCheck(pb.HealthCheckReq(), timeout=10)
+            if h.status == "unhealthy":
+                unhealthy = True
+                break
+            time.sleep(0.3)
+        assert unhealthy, "survivor never reported the stalled collective"
+        # serving continues; admissions never exceed the limit (the
+        # delivery-uncertain in-flight contribution must not double-count)
+        admitted = 0
+        for it in range(12):
+            r = ask(stubs[it % 2 + 1], "chaosC", 1, limit=6)
+            assert r.error == "", r.error
+            if r.status == 0:
+                admitted += 1
+        assert admitted <= 6, f"over-admitted during degradation: {admitted}"
+        # the dead daemon rejoins the gRPC fleet standalone (a broken
+        # jax.distributed group is not elastic)
+        procs[3] = spawn_daemon(
+            env_for(3, num_hosts=1), ready_timeout=300,
+            stderr_path="/tmp/guber_churn_daemon3_restart.log")
+        stubs[3] = dial_v1(addrs[3])
+        h = stubs[3].HealthCheck(pb.HealthCheckReq(), timeout=20)
+        assert h.status == "healthy"
+        r = ask(stubs[3], "afterlife", 1)
+        assert r.error == "" and r.status == 0
+    finally:
+        for p in procs:
+            if p is not None and p.poll() is None:
+                stop_daemon(p)

@@ -796,3 +796,28 @@ def test_idle_sweep_releases_slots(duo):
     assert "col_sweepme" not in b._keys
     assert 0 not in b._by_slot or b._by_slot.get(
         b._slot_fn("col_sweepme")) != "col_sweepme"
+
+
+class _WarmBrokenChannel:
+    global_capacity = 16
+    steps = 0
+
+    def warm(self):
+        raise RuntimeError("fabric cannot form")
+
+    def step(self, *a):
+        raise AssertionError("step must never run after a warm failure")
+
+
+def test_warm_failure_degrades_instead_of_crashing_boot():
+    """A fabric that cannot form at boot must leave the daemon serving via
+    the gRPC pipelines (module contract: correctness never depends on the
+    collective tier), not abort startup."""
+    inst = _StubInstance()
+    s = CollectiveGlobalSync(inst, _WarmBrokenChannel(), interval_s=0.01)
+    s.start()  # must not raise
+    assert s.health_error() is not None
+    assert s._thread is None  # no tick loop on a dead fabric
+    # intake re-routes to the gRPC pipeline immediately
+    assert not s.queue_hit(_greq("wk", 2))
+    s.close()
