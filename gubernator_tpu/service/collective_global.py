@@ -199,7 +199,9 @@ class CollectiveGlobalSync:
         if callable(warm):
             try:
                 warm()
-            except BaseException as e:  # noqa: BLE001 — degrade, don't die
+            except Exception as e:  # noqa: BLE001 — degrade, don't die
+                # (Exception only: Ctrl-C/SystemExit during a blocked
+                # barrier must still shut the daemon down)
                 # the module contract: correctness never depends on this
                 # tier. A fabric that cannot form at boot leaves the daemon
                 # serving through the gRPC GLOBAL pipelines, same as a

@@ -94,7 +94,16 @@ class Instance:
         self.combiner = BackendCombiner(self.backend)
 
         self.local_picker = conf.local_picker or ReplicatedConsistentHashPicker()
-        self.region_picker = conf.region_picker or RegionPicker()
+        # The cross-region picker must route exactly like the DESTINATION
+        # region's own local picker (same algorithm, same hash, same vnode
+        # count — GUBER_PEER_PICKER is a fleet-wide contract, as in the
+        # reference): multi-region replication targets a key's owner in
+        # the other region, and a mismatched ring lands the hits on a
+        # node that region does not route the key to (caught by
+        # tests/test_multiregion_e2e.py). Template from the local picker
+        # unless explicitly configured.
+        self.region_picker = conf.region_picker or RegionPicker(
+            self.local_picker.new())
         self._peer_lock = threading.RLock()
 
         self.global_manager = GlobalManager(

@@ -21,7 +21,7 @@ from typing import Dict
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.global_manager import _Pipeline
 from gubernator_tpu.service.peer_client import PeerNotReadyError
-from gubernator_tpu.types import RateLimitReq
+from gubernator_tpu.types import Behavior, RateLimitReq, without_behavior
 
 log = logging.getLogger("gubernator_tpu.multiregion")
 
@@ -121,11 +121,23 @@ class MultiRegionManager:
                     # these hits go nowhere; keep the accounting complete
                     self.stats["dropped_hits"] += req.hits
                     continue
+                # the receiving owner must apply these hits WITHOUT
+                # re-queueing them for replication: a send that kept the
+                # MULTI_REGION flag would ping-pong between regions, each
+                # bounce re-applying the hits (replication storm — caught
+                # by tests/test_multiregion_e2e.py)
+                req = without_behavior(req, Behavior.MULTI_REGION)
                 by_peer.setdefault(id(peer), (peer, []))[1].append(req)
             for peer, reqs in by_peer.values():
                 try:
-                    peer.get_peer_rate_limits(reqs)
-                    self.stats["replicated"] += len(reqs)
+                    # wait_for_ready: a cold channel to a healthy region
+                    # must not insta-drop the window (failures here are
+                    # unretryable by design)
+                    peer.get_peer_rate_limits(reqs, wait_for_ready=True)
+                    # HIT units, same as dropped/refunded: the accounting
+                    # identity 'every hit replicates or drops' must
+                    # reconcile across the three counters
+                    self.stats["replicated"] += sum(r.hits for r in reqs)
                 except PeerNotReadyError as e:
                     # channel was closed/draining before the send: safe to
                     # carry the FRESH aggregates into this region's next

@@ -181,9 +181,18 @@ class PeerClient:
             self._record_err("batch response timeout")
             raise
 
-    def get_peer_rate_limits(self, reqs: Sequence[RateLimitReq]) -> List[RateLimitResp]:
+    def get_peer_rate_limits(
+        self, reqs: Sequence[RateLimitReq], wait_for_ready: bool = False,
+    ) -> List[RateLimitResp]:
         """One peer call carrying the whole batch: the native link when the
-        peer answers it (~4-5x cheaper than Python gRPC), else gRPC."""
+        peer answers it (~4-5x cheaper than Python gRPC), else gRPC.
+
+        `wait_for_ready=True` rides out a cold/reconnecting channel up to
+        the batch timeout instead of failing fast — for callers whose
+        failure handling DROPS the payload (multi-region replication:
+        delivery-uncertain errors cannot be retried without double
+        counting). Routed request traffic keeps fail-fast so owner-down
+        fallbacks stay prompt."""
         link = self._peer_link()
         if link is not None:
             from gubernator_tpu.service.peerlink import (
@@ -214,7 +223,9 @@ class PeerClient:
         stub = self._connect()
         msg = peers_pb.GetPeerRateLimitsReq(requests=[req_to_pb(r) for r in reqs])
         try:
-            out = stub.GetPeerRateLimits(msg, timeout=self.conf.batch_timeout_s)
+            out = stub.GetPeerRateLimits(
+                msg, timeout=self.conf.batch_timeout_s,
+                wait_for_ready=wait_for_ready)
         except grpc.RpcError as e:
             self._record_err(str(e.code()))
             raise

@@ -104,3 +104,30 @@ def stop_daemon(proc):
     except subprocess.TimeoutExpired:
         proc.kill()
         proc.wait()
+
+
+def http_metric(http_port, name):
+    """One Prometheus sample from a daemon's /metrics (shared by the
+    multi-daemon e2e suites)."""
+    import urllib.request
+
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{http_port}/metrics", timeout=10).read().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return 0.0
+
+
+def wait_http_metric(http_port, name, want, deadline_s,
+                     cmp=lambda v, w: v >= w):
+    import time
+
+    end = time.time() + deadline_s
+    v = http_metric(http_port, name)
+    while time.time() < end:
+        if cmp(v, want):
+            return v
+        time.sleep(0.2)
+        v = http_metric(http_port, name)
+    return v

@@ -32,31 +32,17 @@ import threading
 import time
 import urllib.request
 
-from conftest import free_port, spawn_daemon, stop_daemon
+from conftest import (
+    free_port,
+    http_metric as _metric,
+    spawn_daemon,
+    stop_daemon,
+    wait_http_metric as _wait_metric,
+)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 N = 4
 GLOBAL = 2  # Behavior.GLOBAL wire value
-
-
-def _metric(http_port, name):
-    text = urllib.request.urlopen(
-        f"http://127.0.0.1:{http_port}/metrics", timeout=10).read().decode()
-    for line in text.splitlines():
-        if line.startswith(name + " "):
-            return float(line.split()[1])
-    return 0.0
-
-
-def _wait_metric(http_port, name, want, deadline_s, cmp=lambda v, w: v >= w):
-    end = time.time() + deadline_s
-    v = _metric(http_port, name)
-    while time.time() < end:
-        if cmp(v, want):
-            return v
-        time.sleep(0.2)
-        v = _metric(http_port, name)
-    return v
 
 
 def test_four_host_collective_churn(tmp_path):
@@ -121,7 +107,7 @@ def test_four_host_collective_churn(tmp_path):
 
     stubs = [dial_v1(a) for a in addrs]
 
-    def ask(stub, key, hits, limit=1000, timeout=20):
+    def ask(stub, key, hits, limit=1000, timeout=60):
         r = stub.GetRateLimits(pb.GetRateLimitsReq(requests=[
             pb.RateLimitReq(name="churn", unique_key=key, hits=hits,
                             limit=limit, duration=3_600_000,
@@ -198,13 +184,13 @@ def test_four_host_collective_churn(tmp_path):
         write_peers(addrs[:3])  # daemon 3 leaves the serving fleet
         deadline = time.time() + 20
         while time.time() < deadline:
-            hc = [s.HealthCheck(pb.HealthCheckReq(), timeout=10).peer_count
+            hc = [s.HealthCheck(pb.HealthCheckReq(), timeout=30).peer_count
                   for s in stubs[:3]]
             if all(c == 3 for c in hc):
                 break
             time.sleep(0.3)
         assert all(
-            s.HealthCheck(pb.HealthCheckReq(), timeout=10).peer_count == 3
+            s.HealthCheck(pb.HealthCheckReq(), timeout=30).peer_count == 3
             for s in stubs[:3]), "membership never settled at 3"
         # traffic keeps flowing during the shrunken membership
         for it in range(6):
@@ -213,13 +199,13 @@ def test_four_host_collective_churn(tmp_path):
         write_peers(addrs)  # daemon 3 rejoins
         deadline = time.time() + 20
         while time.time() < deadline:
-            hc = [s.HealthCheck(pb.HealthCheckReq(), timeout=10).peer_count
+            hc = [s.HealthCheck(pb.HealthCheckReq(), timeout=30).peer_count
                   for s in stubs]
             if all(c == N for c in hc):
                 break
             time.sleep(0.3)
         assert all(
-            s.HealthCheck(pb.HealthCheckReq(), timeout=10).peer_count == N
+            s.HealthCheck(pb.HealthCheckReq(), timeout=30).peer_count == N
             for s in stubs), "membership never re-settled at 4"
         # a FRESH key under the settled membership converges exactly again
         key2 = None
@@ -250,13 +236,24 @@ def test_four_host_collective_churn(tmp_path):
             assert _metric(http_ports[i], "cross_host_conflicts_total") == 0
 
         # ---- Phase C: rolling death -------------------------------------
+        # pick the chaos key BEFORE the kill, owned by a SURVIVOR: a key
+        # owned by the dead daemon exercises the owner-unreachable local
+        # fallback, whose first hop waits out the peer-link timeout —
+        # legitimate behavior, but not what this phase measures
+        chaos_key = None
+        for i in range(5000):
+            cand = f"{i}chaos"
+            if owner_of(cand) == addrs[0]:
+                chaos_key = cand
+                break
+        assert chaos_key is not None
         procs[3].send_signal(signal.SIGKILL)
         procs[3].wait(timeout=10)
         # survivors' blocked tick must flip health within stall + grace
         deadline = time.time() + 15
         unhealthy = False
         while time.time() < deadline:
-            h = stubs[0].HealthCheck(pb.HealthCheckReq(), timeout=10)
+            h = stubs[0].HealthCheck(pb.HealthCheckReq(), timeout=30)
             if h.status == "unhealthy":
                 unhealthy = True
                 break
@@ -266,7 +263,7 @@ def test_four_host_collective_churn(tmp_path):
         # delivery-uncertain in-flight contribution must not double-count)
         admitted = 0
         for it in range(12):
-            r = ask(stubs[it % 2 + 1], "chaosC", 1, limit=6)
+            r = ask(stubs[it % 2 + 1], chaos_key, 1, limit=6)
             assert r.error == "", r.error
             if r.status == 0:
                 admitted += 1
@@ -277,7 +274,7 @@ def test_four_host_collective_churn(tmp_path):
             env_for(3, num_hosts=1), ready_timeout=300,
             stderr_path="/tmp/guber_churn_daemon3_restart.log")
         stubs[3] = dial_v1(addrs[3])
-        h = stubs[3].HealthCheck(pb.HealthCheckReq(), timeout=20)
+        h = stubs[3].HealthCheck(pb.HealthCheckReq(), timeout=60)
         assert h.status == "healthy"
         r = ask(stubs[3], "afterlife", 1)
         assert r.error == "" and r.status == 0

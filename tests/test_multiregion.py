@@ -36,11 +36,17 @@ class _Peer:
 
         self.info = types.SimpleNamespace(address=address)
 
-    def get_peer_rate_limits(self, reqs):
+    def get_peer_rate_limits(self, reqs, wait_for_ready=False):
         if self.mode == "not_ready":
             raise PeerNotReadyError(self.info.address)
         if self.mode == "uncertain":
             raise RuntimeError("deadline exceeded after send")
+        # the replication storm regression (test_multiregion_e2e): a sent
+        # aggregate must NEVER carry MULTI_REGION — the receiving owner
+        # would re-queue it for replication and the hits would ping-pong
+        # between regions, multiplying on every bounce
+        assert not any(int(r.behavior) & int(Behavior.MULTI_REGION)
+                       for r in reqs), "replicated send kept MULTI_REGION"
         self.batches.append([(r.unique_key, r.hits) for r in reqs])
         return []
 
@@ -104,7 +110,7 @@ class TestLossAccounting:
         _window(m, [_req("k1", 2)])
         assert peers["dc-a"].batches == [[("k1", 7)]]
         assert peers["dc-b"].batches == [[("k1", 5)], [("k1", 2)]]
-        assert m.stats["replicated"] == 3  # b:k1, a:k1, b:k1
+        assert m.stats["replicated"] == 14  # HIT units: b:5 + a:7 + b:2
 
     def test_uncertain_failure_drops_and_counts(self, mgr):
         m, peers = mgr
