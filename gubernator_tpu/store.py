@@ -126,31 +126,38 @@ class FileLoader(Loader):
         self.path = path
 
     def load(self) -> Iterable[BucketSnapshot]:
-        if not os.path.exists(self.path):
-            return []
-        out: List[BucketSnapshot] = []
-        with open(self.path, "r", encoding="utf-8") as f:
-            for lineno, line in enumerate(f, 1):
-                line = line.strip()
-                if not line:
-                    continue
-                # A truncated tail or schema-drifted row must not keep the
-                # daemon from booting; drop the row and keep serving. Fields
-                # are coerced because dataclasses don't validate types and a
-                # wrong-typed value would otherwise blow up later inside
-                # Engine.load_snapshot's jnp.asarray.
-                try:
-                    d = json.loads(line)
-                    out.append(BucketSnapshot(
-                        key=str(d["key"]), algo=int(d["algo"]),
-                        limit=int(d["limit"]), remaining=int(d["remaining"]),
-                        duration=int(d["duration"]), stamp=int(d["stamp"]),
-                        expire_at=int(d["expire_at"]),
-                        status=int(d.get("status", 0))))
-                except (ValueError, TypeError, KeyError) as e:
-                    log.warning("skipping bad snapshot row %s:%d: %r",
-                                self.path, lineno, e)
-        return out
+        """STREAMS rows (a 10M-key snapshot must never be materialized
+        as a list of dataclasses — Engine.load_snapshot consumes
+        incrementally)."""
+
+        def rows():
+            if not os.path.exists(self.path):
+                return
+            with open(self.path, "r", encoding="utf-8") as f:
+                for lineno, line in enumerate(f, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    # A truncated tail or schema-drifted row must not keep
+                    # the daemon from booting; drop the row and keep
+                    # serving. Fields are coerced because dataclasses don't
+                    # validate types and a wrong-typed value would blow up
+                    # later inside Engine.load_snapshot's jnp.asarray.
+                    try:
+                        d = json.loads(line)
+                        yield BucketSnapshot(
+                            key=str(d["key"]), algo=int(d["algo"]),
+                            limit=int(d["limit"]),
+                            remaining=int(d["remaining"]),
+                            duration=int(d["duration"]),
+                            stamp=int(d["stamp"]),
+                            expire_at=int(d["expire_at"]),
+                            status=int(d.get("status", 0)))
+                    except (ValueError, TypeError, KeyError) as e:
+                        log.warning("skipping bad snapshot row %s:%d: %r",
+                                    self.path, lineno, e)
+
+        return rows()
 
     def save(self, items: Iterable[BucketSnapshot]) -> None:
         tmp = self.path + ".tmp"
