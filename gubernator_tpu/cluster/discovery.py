@@ -104,10 +104,21 @@ class GossipPool(Pool):
     """UDP heartbeat gossip, the memberlist role (reference: memberlist.go).
 
     Every `heartbeat_s` each node sends its {grpc_address, datacenter,
-    peers-i-know} to `fanout` random known peers; a node unseen for
-    `timeout_s` is dropped. Membership changes call on_update. This favors
-    simplicity over memberlist's SWIM protocol — convergence is O(log n)
-    rounds for heartbeat dissemination, ample for rate-limiter clusters.
+    peers-i-know} to `fanout` random known peers. Liveness is two-tier
+    (the SWIM idea behind memberlist's suspicion mechanism,
+    memberlist.go:17-34, without the full protocol): a member unseen for
+    `timeout_s` becomes SUSPECT — still a member, now receiving DIRECT
+    probes every heartbeat (a probed node answers immediately with a
+    unicast heartbeat, independent of its own fanout choices) — and only
+    drops after a further `timeout_s` of silence. On a lossy network this
+    matters enormously: at 30% packet loss a single-tier design false-
+    expires a pair after ~5 lost heartbeats (~0.3^5 per window — minutes
+    to the first ring-rehashing flap), while the probe/ack round trips of
+    the suspicion window push false expiry below ~1e-5 per window
+    (verified by tests/test_control_plane.py's lossy-network test).
+    Worst-case detection of a REALLY dead node is bounded at
+    2 x timeout_s + heartbeat_s. Membership changes call on_update.
+    Convergence is O(log n) rounds of heartbeat dissemination.
     """
 
     MAGIC = b"gtpu1"
@@ -133,6 +144,15 @@ class GossipPool(Pool):
         self.fanout = fanout
         # gossip address -> (grpc_address, datacenter, last_seen)
         self._members: Dict[str, tuple] = {}
+        # SUSPECT members: gossip address -> drop deadline (monotonic)
+        self._suspects: Dict[str, float] = {}
+        # freshly-DROPPED members: gossip address -> tombstone deadline.
+        # Peers with skewed drop timers keep relaying a dead member for a
+        # while; resurrecting it from a relay would flap the ring
+        # 3->2->3->2 and double the detection bound. Only a DIRECT
+        # heartbeat from the member itself (it is alive after all, or
+        # restarted) clears the tombstone early.
+        self._tombstones: Dict[str, float] = {}
         self._lock = threading.Lock()
         self._closed = threading.Event()
         self._last_pushed: Optional[List[PeerInfo]] = None
@@ -158,15 +178,16 @@ class GossipPool(Pool):
 
     # ------------------------------------------------------------ internals
 
-    def _payload(self) -> bytes:
+    def _payload(self, probe: bool = False) -> bytes:
         with self._lock:
             members = {
                 addr: {"grpc": g, "dc": dc}
                 for addr, (g, dc, _) in self._members.items()
             }
-        return self.MAGIC + json.dumps(
-            {"from": self.gossip_address, "members": members}
-        ).encode()
+        msg = {"from": self.gossip_address, "members": members}
+        if probe:
+            msg["probe"] = True  # receiver acks with a direct heartbeat
+        return self.MAGIC + json.dumps(msg).encode()
 
     def _targets(self) -> List[str]:
         import random
@@ -177,15 +198,30 @@ class GossipPool(Pool):
         random.shuffle(pool)
         return pool[: max(self.fanout, len(self._seeds))]
 
+    def _send_to(self, target, payload: bytes) -> None:
+        # the target may come off the WIRE (probe acks reply to msg
+        # "from"): any malformed value must be a no-op, never an escape
+        # that kills the rx/tx thread
+        try:
+            host, _, port = target.rpartition(":")
+            self._sock.sendto(payload, (host, int(port)))
+        except (OSError, ValueError, AttributeError, TypeError):
+            pass
+
     def _send_loop(self) -> None:
         while not self._closed.wait(self.heartbeat_s):
             payload = self._payload()
             for target in self._targets():
-                host, _, port = target.rpartition(":")
-                try:
-                    self._sock.sendto(payload, (host, int(port)))
-                except OSError:
-                    pass
+                self._send_to(target, payload)
+            with self._lock:
+                suspects = list(self._suspects)
+            if suspects:
+                # direct probes: the ack (an immediate unicast heartbeat)
+                # refreshes last_seen without depending on the suspect's
+                # random fanout happening to pick us
+                probe = self._payload(probe=True)
+                for target in suspects:
+                    self._send_to(target, probe)
             self._expire()
 
     def _recv_loop(self) -> None:
@@ -203,18 +239,28 @@ class GossipPool(Pool):
             except json.JSONDecodeError:
                 continue
             now = time.monotonic()
+            if msg.get("probe") and msg.get("from"):
+                # answer NOW with a unicast heartbeat: the prober's
+                # suspicion clears on any direct packet from us
+                self._send_to(msg["from"], self._payload())
             changed = False
             with self._lock:
                 for addr, meta in msg.get("members", {}).items():
                     cur = self._members.get(addr)
                     if addr == self.gossip_address:
                         continue
+                    direct = addr == msg.get("from")
+                    if not direct and cur is None and \
+                            self._tombstones.get(addr, 0) > now:
+                        continue  # relayed ghost of a dropped member
+                    if direct:
+                        self._tombstones.pop(addr, None)
                     fresh = (meta.get("grpc", ""), meta.get("dc", ""), now)
                     if cur is None or cur[:2] != fresh[:2]:
                         changed = True
                     # only bump last_seen for the direct sender; relayed
                     # entries keep their own aging
-                    if addr == msg.get("from") or cur is None:
+                    if direct or cur is None:
                         self._members[addr] = fresh
                     else:
                         self._members[addr] = (fresh[0], fresh[1], cur[2])
@@ -222,17 +268,37 @@ class GossipPool(Pool):
                 self._push_update()
 
     def _expire(self) -> None:
-        cutoff = time.monotonic() - self.timeout_s
+        now = time.monotonic()
+        cutoff = now - self.timeout_s
         dropped = False
         with self._lock:
             for addr in list(self._members):
                 if addr == self.gossip_address:
                     continue
-                if self._members[addr][2] < cutoff:
+                if self._members[addr][2] >= cutoff:
+                    self._suspects.pop(addr, None)  # heard again: clear
+                    continue
+                deadline = self._suspects.get(addr)
+                if deadline is None:
+                    # tier 1: unseen past timeout_s -> SUSPECT, probed
+                    # directly for one more timeout_s before any drop
+                    self._suspects[addr] = now + self.timeout_s
+                elif now >= deadline:
                     del self._members[addr]
+                    del self._suspects[addr]
+                    # hold the tombstone long enough for every peer's own
+                    # (suspicion-delayed, clock-skewed) drop to complete
+                    self._tombstones[addr] = now + 2 * self.timeout_s \
+                        + self.heartbeat_s
                     dropped = True
+            for addr in [a for a, t in self._tombstones.items() if t <= now]:
+                del self._tombstones[addr]
         if dropped:
             self._push_update()
+
+    def suspects(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._suspects)
 
     def _push_update(self) -> None:
         with self._lock:

@@ -565,16 +565,21 @@ class Engine:
 
         Consumes any iterable INCREMENTALLY (a streamed Loader at 10M keys
         must not be materialized: the dataclasses alone would cost
-        gigabytes) — one max_width chunk of rows exists at a time."""
+        gigabytes) — one max_width chunk of rows exists at a time. The
+        engine lock is taken PER CHUNK and never while pulling the source
+        iterator: the source may be this engine's own snapshot_stream
+        (whose slab fetches take the same non-reentrant lock), and a
+        Loader's file/JSON work must not stall serving for the whole
+        restore."""
         import itertools
 
         it_stream = iter(items)
         n = 0
-        with self._lock:
-            while True:
-                chunk = list(itertools.islice(it_stream, self.max_width))
-                if not chunk:
-                    break
+        while True:
+            chunk = list(itertools.islice(it_stream, self.max_width))
+            if not chunk:
+                break
+            with self._lock:
                 slots, _ = self.directory.lookup([it.key for it in chunk])
                 w = _bucket_width(len(chunk), self.min_width, self.max_width)
                 pad = w - len(chunk)
