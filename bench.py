@@ -338,13 +338,29 @@ def main() -> None:
         per_cycle = max((time.perf_counter() - t0) / 2, 1e-6)
         # enough cycles that pipeline fill + the serial drain tail (~1.5
         # cycles of link time) amortize below ~10% of the measurement —
-        # 3-4 cycles UNDERSTATES the steady-state serving rate badly
-        cycles = max(24, min(60, int(8 * TARGET_SECONDS / per_cycle)))
+        # 3-4 cycles UNDERSTATES the steady-state serving rate badly.
+        # The tunnel's bandwidth swings 2-4x on minute timescales, so the
+        # headline is the MEDIAN of three independent completion-forced
+        # segments (each long enough to amortize fill/tail) rather than
+        # one roll of the link dice; min/max ride along as diagnostics.
+        # floor 16: the ~1.5-cycle fill/tail overhead stays <= ~10% of
+        # each segment, honoring the amortization bound above
+        seg_cycles = max(16, min(20, int(3 * TARGET_SECONDS / per_cycle)))
+        seg_rates = []
+        seg_elapsed = []
         prep_s = []
-        t0 = time.perf_counter()
-        run(cycles, 4 * K_SERVE, prep_s=prep_s)
-        serving_elapsed = time.perf_counter() - t0
-        serving_rate = cycles * K_SERVE * BATCH_WIDTH / serving_elapsed
+        w_base = 4 * K_SERVE
+        for _seg in range(3):
+            t0 = time.perf_counter()
+            run(seg_cycles, w_base, prep_s=prep_s)
+            seg_elapsed.append(time.perf_counter() - t0)
+            seg_rates.append(
+                seg_cycles * K_SERVE * BATCH_WIDTH / seg_elapsed[-1])
+            w_base += seg_cycles * K_SERVE
+        seg_rates.sort()
+        serving_rate = seg_rates[1]  # median of 3
+        cycles = 3 * seg_cycles
+        serving_elapsed = sum(seg_elapsed)  # measured, not back-computed
 
         # Latency decomposition (VERDICT r3 item 8): split a serving cycle
         # into host prep (measured), on-device kernel time (the kernel
@@ -363,6 +379,7 @@ def main() -> None:
                 f"(8 B/dec up, 8 back)+kernel+demux, {K_SERVE} windows/"
                 "transfer, 2 cycles in flight (tunnel rig: link-bound; "
                 "host tier 2.39M/s, DESIGN.md)",
+            "serving_segment_rates": [round(r, 1) for r in seg_rates],
             "serving_decomposition": {
                 "cycle_s": round(cycle_s, 4),
                 "host_prep_s": round(host_s, 4),
