@@ -10,10 +10,14 @@ This build ships:
 - StaticPool: fixed peer list (what the in-process harness and tests use;
   the reference injects peers the same way, cluster/cluster.go:124-127).
 - FilePool: watch a JSON peers file by mtime — operational middle ground.
+- MemberlistPool (cluster/memberlist.py): hashicorp/memberlist-v0.2.0-
+  wire-compatible SWIM gossip — joins existing reference fleets; the
+  GUBER_MEMBERLIST_* default since r4 (PARITY #11).
 - GossipPool: a dependency-free UDP heartbeat gossip carrying
-  {grpc_address, datacenter} metadata, the role memberlist plays in the
-  reference (memberlist.go:193-226); the only pool that feeds DataCenter
-  and thus enables MULTI_REGION (reference: memberlist.go:17-34).
+  {grpc_address, datacenter} metadata, the same role with a leaner
+  wire format (GUBER_MEMBERLIST_COMPAT=0); like MemberlistPool it
+  feeds DataCenter and thus enables MULTI_REGION
+  (reference: memberlist.go:17-34).
 - EtcdPool (cluster/etcd.py): real etcd v3 lease/watch registration over a
   wire-level gRPC client — no etcd3 package needed; pairs with the
   embeddable etcdlite server (cluster/etcdlite.py).

@@ -1,0 +1,844 @@
+"""MemberlistPool: hashicorp/memberlist-v0.2.0-wire-compatible discovery.
+
+The reference's memberlist-backed pool (reference: memberlist.go:17-106)
+is the one discovery option round-3 review recorded as genuinely absent:
+GossipPool (cluster/discovery.py) fills the ROLE but speaks its own
+wire format, so a gubernator_tpu node could not join an existing
+memberlist fleet.  This pool speaks the library's actual protocol
+(cluster/mlwire.py) and its SWIM state machine:
+
+- UDP failure detection: round-robin probe -> ack, indirect probes
+  through `indirect_checks` relays (with nacks), TCP fallback ping, then
+  a SUSPECT broadcast; suspicion expires into DEAD after
+  `suspicion_mult * log10(n+1) * probe_interval` seconds.
+- dissemination: alive/suspect/dead broadcasts piggyback on every UDP
+  send through a transmit-limited queue (`retransmit_mult * log10(n+1)`
+  sends per broadcast, newest-about-a-node invalidates queued older).
+- refutation: suspect/dead claims about ourselves bump our incarnation
+  and re-broadcast alive, exactly the SWIM liveness rule.
+- state sync: TCP push/pull of the full node table on join and every
+  `push_pull_interval` (both sides merge; streams may be LZW-wrapped).
+- metadata: Node.Meta carries the reference's gob-encoded
+  {DataCenter, GubernatorPort} (reference: memberlist.go:193-209), so
+  peers learn each other's *gubernator* endpoint through the gossip
+  fleet itself; `on_update` receives PeerInfo(address=ip:guber_port,
+  datacenter=dc) just like the reference's event handler
+  (reference: memberlist.go:119-149).
+
+Timing defaults mirror DefaultWANConfig, the config the reference picks
+(reference: memberlist.go:43); tests shrink them.  Not implemented (and
+refused loudly rather than mis-spoken): encrypted fleets (SecretKey —
+the reference never sets one) and user-level delegate messages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+import random
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import msgpack
+
+from gubernator_tpu.cluster import mlwire as wire
+from gubernator_tpu.cluster.discovery import Pool
+from gubernator_tpu.types import PeerInfo
+
+log = logging.getLogger("gubernator_tpu.memberlist")
+
+UpdateFunc = Callable[[List[PeerInfo]], None]
+
+_TICK = 0.05  # scheduler granularity; every interval is measured, not counted
+_UDP_BUDGET = 1400  # memberlist UDPBufferSize: max datagram it assembles
+
+
+class JoinError(RuntimeError):
+    """No seed node could be push/pull-synced."""
+
+
+@dataclasses.dataclass
+class NodeState:
+    name: str
+    addr: bytes  # 4 (IPv4) or 16 (IPv6) bytes, the alive.Addr payload
+    port: int
+    meta: bytes
+    incarnation: int
+    state: int  # wire.STATE_*
+    state_change: float = 0.0
+    suspicion_deadline: float = 0.0
+
+    def endpoint(self) -> Tuple[str, int]:
+        host = socket.inet_ntoa(self.addr) if len(self.addr) == 4 else \
+            socket.inet_ntop(socket.AF_INET6, self.addr)
+        return host, self.port
+
+
+class MemberlistPool(Pool):
+    def __init__(
+        self,
+        bind_address: str,
+        node_name: str,
+        on_update: UpdateFunc,
+        gubernator_port: int,
+        known_nodes: Sequence[str] = (),
+        datacenter: str = "",
+        advertise_address: str = "",
+        probe_interval: float = 5.0,
+        probe_timeout: float = 3.0,
+        gossip_interval: float = 0.5,
+        gossip_nodes: int = 4,
+        push_pull_interval: float = 60.0,
+        suspicion_mult: float = 6.0,
+        retransmit_mult: float = 4.0,
+        indirect_checks: int = 3,
+        join_required: bool = True,
+    ):
+        host, _, port = bind_address.rpartition(":")
+        self.bind = (host or "0.0.0.0", int(port))
+        self.name = node_name
+        self.on_update = on_update
+        self.datacenter = datacenter
+        self.probe_interval = probe_interval
+        self.probe_timeout = probe_timeout
+        self.gossip_interval = gossip_interval
+        self.gossip_nodes = gossip_nodes
+        self.push_pull_interval = push_pull_interval
+        self.suspicion_mult = suspicion_mult
+        self.retransmit_mult = retransmit_mult
+        self.indirect_checks = indirect_checks
+
+        self._lock = threading.RLock()
+        self._closed = threading.Event()
+        self._nodes: Dict[str, NodeState] = {}
+        self._incarnation = 1
+        self._seq = 0
+        # seqno -> (deadline, callback(payload) or None); fired on ack
+        self._acks: Dict[int, Tuple[float, Optional[Callable[[bytes], None]]]] = {}
+        # broadcast queue: node name -> [framed bytes, transmits so far]
+        self._bcast: Dict[str, List[Any]] = {}
+        self._probe_ring: List[str] = []
+        self._push_lock = threading.Lock()
+        self._last_pushed: Optional[List[PeerInfo]] = None
+        self._leaving = False
+
+        # --- sockets (UDP + TCP share the port, like memberlist)
+        self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._udp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._udp.bind(self.bind)
+        self._udp.settimeout(0.2)
+        self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._tcp.bind((self.bind[0], self._udp.getsockname()[1]))
+        self._tcp.listen(16)
+        self._tcp.settimeout(0.2)
+        self.bound_port = self._udp.getsockname()[1]
+
+        adv_host = advertise_address or self._advertise_ip()
+        self.advertise = (adv_host, self.bound_port)
+        self._addr_bytes = socket.inet_aton(adv_host)
+
+        meta = wire.gob_encode_metadata(datacenter, gubernator_port)
+        if len(meta) > 512:  # memberlist MetaMaxSize
+            raise ValueError("gob metadata over memberlist's 512-byte cap")
+        with self._lock:
+            self._nodes[self.name] = NodeState(
+                name=self.name, addr=self._addr_bytes, port=self.bound_port,
+                meta=meta, incarnation=self._incarnation,
+                state=wire.STATE_ALIVE, state_change=time.monotonic(),
+            )
+        self._queue_broadcast(self.name, self._alive_msg(self._nodes[self.name]))
+
+        self._threads = [
+            threading.Thread(target=self._udp_loop, daemon=True, name="ml-udp"),
+            threading.Thread(target=self._tcp_loop, daemon=True, name="ml-tcp"),
+            threading.Thread(target=self._sched_loop, daemon=True, name="ml-tick"),
+        ]
+        for t in self._threads:
+            t.start()
+
+        if known_nodes:
+            joined = self.join(known_nodes)
+            if joined == 0 and join_required:
+                self.close()
+                raise JoinError(f"could not join any of {list(known_nodes)}")
+        self._push_update()
+
+    # ------------------------------------------------------------- identity
+
+    def _advertise_ip(self) -> str:
+        ip = self.bind[0]
+        if ip not in ("0.0.0.0", ""):
+            return ip
+        try:  # routing trick: no packet is sent for a connected UDP socket
+            probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            probe.connect(("198.51.100.1", 9))
+            ip = probe.getsockname()[0]
+            probe.close()
+            return ip
+        except OSError:
+            return "127.0.0.1"
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq = (self._seq + 1) & 0xFFFFFFFF
+            return self._seq
+
+    def _alive_msg(self, n: NodeState) -> bytes:
+        return wire.encode_msg(wire.ALIVE, {
+            "Incarnation": n.incarnation, "Node": n.name, "Addr": n.addr,
+            "Port": n.port, "Meta": n.meta, "Vsn": wire.DEFAULT_VSN,
+        })
+
+    # ------------------------------------------------------------ broadcasts
+
+    def _queue_broadcast(self, about: str, framed: bytes) -> None:
+        with self._lock:
+            self._bcast[about] = [framed, 0]
+
+    def _transmit_limit(self) -> int:
+        with self._lock:
+            n = len(self._nodes)
+        return max(1, int(self.retransmit_mult * math.ceil(math.log10(n + 1))))
+
+    def _take_broadcasts(self, budget: int) -> List[bytes]:
+        """Pop up to `budget` bytes of queued broadcasts, fewest-transmits
+        first, charging each 2 bytes of compound overhead."""
+        limit = self._transmit_limit()
+        out: List[bytes] = []
+        with self._lock:
+            order = sorted(self._bcast.items(), key=lambda kv: kv[1][1])
+            for about, entry in order:
+                framed = entry[0]
+                if len(framed) + 2 > budget:
+                    continue
+                budget -= len(framed) + 2
+                out.append(framed)
+                entry[1] += 1
+                if entry[1] >= limit:
+                    del self._bcast[about]
+        return out
+
+    def _send_udp(self, dest: Tuple[str, int], *parts: bytes) -> None:
+        head = b"".join(parts)
+        piggyback = self._take_broadcasts(_UDP_BUDGET - len(head) - 7)
+        try:
+            self._udp.sendto(
+                wire.assemble_packet(list(parts) + piggyback), dest
+            )
+        except OSError:
+            pass
+
+    # ------------------------------------------------------------- UDP loop
+
+    def _udp_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                data, src = self._udp.recvfrom(wire.MAX_UDP_PACKET)
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                msgs = wire.ingest_packet(data)
+            except wire.WireError as exc:
+                log.debug("bad packet from %s: %s", src, exc)
+                continue
+            for t, body in msgs:
+                try:
+                    self._handle(t, body, src)
+                except (wire.WireError, ValueError, TypeError, KeyError,
+                        OverflowError) as exc:
+                    # a peer-controlled field of the wrong msgpack type
+                    # (int() on bytes, a non-addr Addr) must never kill
+                    # the receive thread
+                    log.debug("bad %d msg from %s: %s", t, src, exc)
+
+    def _handle(self, t: int, m: Dict[str, Any], src: Tuple[str, int]) -> None:
+        if t == wire.PING:
+            node = m.get("Node", "")
+            if node and node != self.name:
+                log.warning("ping for %r arrived at %r", node, self.name)
+                return
+            dest = self._reply_addr(m, src)
+            self._send_udp(dest, wire.encode_msg(
+                wire.ACK_RESP, {"SeqNo": m.get("SeqNo", 0), "Payload": b""}))
+        elif t == wire.INDIRECT_PING:
+            self._on_indirect_ping(m, src)
+        elif t == wire.ACK_RESP:
+            self._on_ack(m)
+        elif t == wire.NACK_RESP:
+            pass  # informational: the relay answered but the target did not
+        elif t == wire.SUSPECT:
+            self._on_suspect(int(m.get("Incarnation", 0)), m.get("Node", ""))
+        elif t == wire.ALIVE:
+            self._on_alive(m)
+        elif t == wire.DEAD:
+            self._on_dead(int(m.get("Incarnation", 0)), m.get("Node", ""),
+                          m.get("From", ""))
+        elif t in (wire.USER, wire.ERR):
+            pass
+        else:
+            log.debug("unhandled msg type %d", t)
+
+    @staticmethod
+    def _reply_addr(m: Dict[str, Any], src: Tuple[str, int]) -> Tuple[str, int]:
+        sa, sp = m.get("SourceAddr"), m.get("SourcePort")
+        if isinstance(sa, bytes) and len(sa) == 4 and sp:
+            return socket.inet_ntoa(sa), int(sp)
+        return src
+
+    def _on_ack(self, m: Dict[str, Any]) -> None:
+        seq = int(m.get("SeqNo", 0))
+        with self._lock:
+            entry = self._acks.pop(seq, None)
+        if entry and entry[1]:
+            payload = m.get("Payload", b"")
+            entry[1](payload if isinstance(payload, bytes) else b"")
+
+    def _on_indirect_ping(self, m: Dict[str, Any], src: Tuple[str, int]) -> None:
+        target_addr = m.get("Target", b"")
+        if not isinstance(target_addr, bytes) or len(target_addr) != 4:
+            return
+        dest = (socket.inet_ntoa(target_addr), int(m.get("Port", 0)))
+        requester = self._reply_addr(m, src)
+        orig_seq = int(m.get("SeqNo", 0))
+        want_nack = bool(m.get("Nack", False))
+        my_seq = self._next_seq()
+
+        def relay(_payload: bytes, _req=requester, _orig=orig_seq) -> None:
+            self._send_udp(_req, wire.encode_msg(
+                wire.ACK_RESP, {"SeqNo": _orig, "Payload": b""}))
+
+        deadline = time.monotonic() + self.probe_timeout
+        with self._lock:
+            self._acks[my_seq] = (deadline, relay)
+        if want_nack:
+            def nack_if_unanswered(_seq=my_seq, _req=requester, _orig=orig_seq):
+                with self._lock:
+                    missed = _seq in self._acks
+                if missed:
+                    self._send_udp(_req, wire.encode_msg(
+                        wire.NACK_RESP, {"SeqNo": _orig}))
+            threading.Timer(self.probe_timeout, nack_if_unanswered).start()
+        self._send_udp(dest, wire.encode_msg(wire.PING, {
+            "SeqNo": my_seq, "Node": m.get("Node", ""),
+            "SourceAddr": self._addr_bytes, "SourcePort": self.bound_port,
+            "SourceNode": self.name,
+        }))
+
+    # --------------------------------------------------------- state machine
+
+    def _refute(self, claimed_inc: int) -> None:
+        with self._lock:
+            self._incarnation = max(self._incarnation, claimed_inc) + 1
+            me = self._nodes[self.name]
+            me.incarnation = self._incarnation
+            me.state = wire.STATE_ALIVE
+            framed = self._alive_msg(me)
+        self._queue_broadcast(self.name, framed)
+
+    def _on_alive(self, m: Dict[str, Any]) -> None:
+        name = m.get("Node", "")
+        inc = int(m.get("Incarnation", 0))
+        addr, port = m.get("Addr", b""), int(m.get("Port", 0))
+        meta = m.get("Meta", b"") or b""
+        if not name or not isinstance(addr, bytes) or len(addr) not in (4, 16):
+            return
+        if name == self.name:
+            with self._lock:
+                me = self._nodes[self.name]
+                same = addr == me.addr and port == me.port and meta == me.meta
+            if inc >= me.incarnation and not same:
+                self._refute(inc)  # someone is gossiping a stale identity
+            return
+        changed = False
+        with self._lock:
+            cur = self._nodes.get(name)
+            if cur is None:
+                self._nodes[name] = NodeState(
+                    name=name, addr=addr, port=port, meta=bytes(meta),
+                    incarnation=inc, state=wire.STATE_ALIVE,
+                    state_change=time.monotonic(),
+                )
+                changed = True
+            elif inc > cur.incarnation:
+                cur.addr, cur.port, cur.meta = addr, port, bytes(meta)
+                cur.incarnation = inc
+                if cur.state != wire.STATE_ALIVE:
+                    cur.state = wire.STATE_ALIVE
+                    cur.state_change = time.monotonic()
+                changed = True
+        if changed:
+            self._queue_broadcast(name, wire.encode_msg(wire.ALIVE, m))
+            self._push_update()
+
+    def _on_suspect(self, inc: int, name: str) -> None:
+        if not name:
+            return
+        if name == self.name:
+            # staleness rule: a claim older than our incarnation is a
+            # replay of an already-refuted rumor — ignoring it (as the
+            # Go state machine does) stops incarnation churn
+            if inc >= self._incarnation:
+                self._refute(inc)
+            return
+        now = time.monotonic()
+        with self._lock:
+            cur = self._nodes.get(name)
+            if cur is None or inc < cur.incarnation or \
+                    cur.state != wire.STATE_ALIVE:
+                return
+            cur.state = wire.STATE_SUSPECT
+            cur.incarnation = inc
+            cur.state_change = now
+            n = len(self._nodes)
+            cur.suspicion_deadline = now + (
+                self.suspicion_mult
+                * max(1.0, math.log10(max(n, 1) + 1))
+                * self.probe_interval
+            )
+        self._queue_broadcast(name, wire.encode_msg(wire.SUSPECT, {
+            "Incarnation": inc, "Node": name, "From": self.name,
+        }))
+        self._push_update()
+
+    def _on_dead(self, inc: int, name: str, from_: str) -> None:
+        if not name:
+            return
+        if name == self.name:
+            if not self._leaving and inc >= self._incarnation:
+                self._refute(inc)
+            return
+        with self._lock:
+            cur = self._nodes.get(name)
+            if cur is None or inc < cur.incarnation or \
+                    cur.state == wire.STATE_DEAD:
+                return
+            cur.state = wire.STATE_DEAD
+            cur.incarnation = inc
+            cur.state_change = time.monotonic()
+        self._queue_broadcast(name, wire.encode_msg(wire.DEAD, {
+            "Incarnation": inc, "Node": name, "From": from_ or self.name,
+        }))
+        self._push_update()
+
+    # ------------------------------------------------------------ scheduler
+
+    def _sched_loop(self) -> None:
+        now = time.monotonic()
+        next_probe = now + self.probe_interval
+        next_gossip = now + self.gossip_interval
+        next_push_pull = now + self.push_pull_interval
+        while not self._closed.wait(_TICK):
+            now = time.monotonic()
+            self._expire_acks(now)
+            self._expire_suspicion(now)
+            if now >= next_gossip:
+                next_gossip = now + self.gossip_interval
+                self._gossip_tick()
+            if now >= next_probe:
+                next_probe = now + self.probe_interval
+                target = self._next_probe_target()
+                if target:
+                    threading.Thread(
+                        target=self._probe, args=(target,), daemon=True,
+                        name="ml-probe",
+                    ).start()
+            if now >= next_push_pull:
+                next_push_pull = now + self.push_pull_interval
+                peer = self._random_alive_endpoint()
+                if peer:
+                    threading.Thread(
+                        target=self._push_pull_safely, args=(peer,),
+                        daemon=True, name="ml-pushpull",
+                    ).start()
+
+    def _expire_acks(self, now: float) -> None:
+        with self._lock:
+            stale = [s for s, (dl, _) in self._acks.items() if now > dl]
+            for s in stale:
+                del self._acks[s]
+
+    def _expire_suspicion(self, now: float) -> None:
+        expired: List[NodeState] = []
+        with self._lock:
+            for n in self._nodes.values():
+                if n.state == wire.STATE_SUSPECT and \
+                        now >= n.suspicion_deadline:
+                    expired.append(n)
+        for n in expired:
+            self._on_dead(n.incarnation, n.name, self.name)
+
+    def _gossip_tick(self) -> None:
+        with self._lock:
+            if not self._bcast:
+                return
+            candidates = [
+                n for n in self._nodes.values()
+                if n.name != self.name and (
+                    n.state != wire.STATE_DEAD
+                    or time.monotonic() - n.state_change < 30.0
+                )
+            ]
+        random.shuffle(candidates)
+        for n in candidates[: self.gossip_nodes]:
+            parts = self._take_broadcasts(_UDP_BUDGET - 7)
+            if not parts:
+                return
+            try:
+                self._udp.sendto(wire.assemble_packet(parts), n.endpoint())
+            except OSError:
+                pass
+
+    # ---------------------------------------------------------------- probe
+
+    def _next_probe_target(self) -> Optional[NodeState]:
+        with self._lock:
+            while True:
+                if not self._probe_ring:
+                    self._probe_ring = [
+                        n for n in self._nodes if n != self.name
+                    ]
+                    random.shuffle(self._probe_ring)
+                    if not self._probe_ring:
+                        return None
+                name = self._probe_ring.pop()
+                node = self._nodes.get(name)
+                if node and node.state != wire.STATE_DEAD:
+                    return node
+                if not self._probe_ring:
+                    return None
+
+    def _ping_once(self, node: NodeState, timeout: float) -> bool:
+        seq = self._next_seq()
+        got = threading.Event()
+        with self._lock:
+            self._acks[seq] = (
+                time.monotonic() + timeout, lambda _p: got.set()
+            )
+        self._send_udp(node.endpoint(), wire.encode_msg(wire.PING, {
+            "SeqNo": seq, "Node": node.name,
+            "SourceAddr": self._addr_bytes, "SourcePort": self.bound_port,
+            "SourceNode": self.name,
+        }))
+        return got.wait(timeout)
+
+    def _probe(self, node: NodeState) -> None:
+        if self._ping_once(node, self.probe_timeout):
+            return
+        # indirect probes through up to `indirect_checks` alive relays
+        with self._lock:
+            relays = [
+                n for n in self._nodes.values()
+                if n.state == wire.STATE_ALIVE
+                and n.name not in (self.name, node.name)
+            ]
+        random.shuffle(relays)
+        got = threading.Event()
+        seq = self._next_seq()
+        with self._lock:
+            self._acks[seq] = (
+                time.monotonic() + self.probe_interval, lambda _p: got.set()
+            )
+        for relay in relays[: self.indirect_checks]:
+            self._send_udp(relay.endpoint(), wire.encode_msg(
+                wire.INDIRECT_PING, {
+                    "SeqNo": seq, "Target": node.addr, "Port": node.port,
+                    "Node": node.name, "Nack": True,
+                    "SourceAddr": self._addr_bytes,
+                    "SourcePort": self.bound_port, "SourceNode": self.name,
+                }))
+        # TCP fallback ping, the way memberlist covers UDP-hostile paths
+        tcp_ok = self._tcp_ping(node)
+        if got.wait(self.probe_timeout) or tcp_ok:
+            return
+        if self._closed.is_set():
+            return
+        self._on_suspect(node.incarnation, node.name)
+
+    def _tcp_ping(self, node: NodeState) -> bool:
+        seq = self._next_seq()
+        try:
+            with socket.create_connection(
+                node.endpoint(), timeout=self.probe_timeout
+            ) as conn:
+                conn.sendall(wire.encode_msg(wire.PING, {
+                    "SeqNo": seq, "Node": node.name,
+                    "SourceAddr": self._addr_bytes,
+                    "SourcePort": self.bound_port, "SourceNode": self.name,
+                }))
+                conn.settimeout(self.probe_timeout)
+                t, parsed = _read_stream_message(conn, self.probe_timeout)
+                if t != wire.ACK_RESP:
+                    return False
+                return int(parsed.get("SeqNo", -1)) == seq
+        except (OSError, wire.WireError, ValueError, TypeError):
+            return False
+
+    # ------------------------------------------------------------ push/pull
+
+    def _local_states(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [
+                {
+                    "Name": n.name, "Addr": n.addr, "Port": n.port,
+                    "Meta": n.meta, "Incarnation": n.incarnation,
+                    "State": n.state, "Vsn": wire.DEFAULT_VSN,
+                }
+                for n in self._nodes.values()
+            ]
+
+    def _merge_states(self, states: List[Dict[str, Any]]) -> None:
+        for s in states:
+            state = int(s.get("State", wire.STATE_ALIVE))
+            alive_shaped = {
+                "Incarnation": s.get("Incarnation", 0),
+                "Node": s.get("Name", ""), "Addr": s.get("Addr", b""),
+                "Port": s.get("Port", 0), "Meta": s.get("Meta", b""),
+                "Vsn": s.get("Vsn", wire.DEFAULT_VSN),
+            }
+            if state == wire.STATE_ALIVE:
+                self._on_alive(alive_shaped)
+            elif state == wire.STATE_SUSPECT:
+                self._on_alive(alive_shaped)
+                self._on_suspect(int(s.get("Incarnation", 0)),
+                                 str(s.get("Name", "")))
+            elif state == wire.STATE_DEAD:
+                # make the node known first so the death can be recorded
+                self._on_alive(alive_shaped)
+                self._on_dead(int(s.get("Incarnation", 0)),
+                              str(s.get("Name", "")), "")
+
+    def push_pull(self, host: str, port: int, join: bool = False) -> int:
+        """One TCP state exchange with host:port; returns nodes merged."""
+        with socket.create_connection((host, port), timeout=5.0) as conn:
+            conn.sendall(wire.encode_push_pull(self._local_states(), join))
+            t, parsed = _read_stream_message(conn, 5.0)
+        if t != wire.PUSH_PULL:
+            raise wire.WireError(f"push/pull reply was msg type {t}")
+        states, _join, _user = parsed
+        self._merge_states(states)
+        self._push_update()
+        return len(states)
+
+    def _push_pull_safely(self, peer: Tuple[str, int]) -> None:
+        try:
+            self.push_pull(peer[0], peer[1])
+        except (OSError, wire.WireError, ValueError, TypeError,
+                KeyError, OverflowError) as exc:
+            log.debug("push/pull with %s failed: %s", peer, exc)
+
+    def _random_alive_endpoint(self) -> Optional[Tuple[str, int]]:
+        with self._lock:
+            alive = [
+                n for n in self._nodes.values()
+                if n.name != self.name and n.state == wire.STATE_ALIVE
+            ]
+        return random.choice(alive).endpoint() if alive else None
+
+    def join(self, known_nodes: Sequence[str]) -> int:
+        """Push/pull every seed (host or host:port; bare hosts get our
+        bind port, reference: config.go:186-190).  Returns successes."""
+        ok = 0
+        for seed in known_nodes:
+            host, _, port = seed.rpartition(":") if ":" in seed else (seed, "", "")
+            try:
+                self.push_pull(host or seed, int(port or self.bound_port),
+                               join=True)
+                ok += 1
+            except (OSError, wire.WireError, ValueError, TypeError,
+                    KeyError, OverflowError) as exc:
+                log.warning("join %s failed: %s", seed, exc)
+        return ok
+
+    # ------------------------------------------------------------- TCP loop
+
+    def _tcp_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, _src = self._tcp.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True,
+                name="ml-conn",
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            with conn:
+                conn.settimeout(5.0)
+                t, parsed = _read_stream_message(conn, 5.0)
+                if t == wire.PUSH_PULL:
+                    states, _join, _user = parsed
+                    # reply first: the peer reads our state before merging
+                    conn.sendall(
+                        wire.encode_push_pull(self._local_states(), False))
+                    self._merge_states(states)
+                    self._push_update()
+                elif t == wire.PING:
+                    conn.sendall(wire.encode_msg(wire.ACK_RESP, {
+                        "SeqNo": parsed.get("SeqNo", 0), "Payload": b"",
+                    }))
+        except (OSError, wire.WireError, msgpack.OutOfData, ValueError,
+                TypeError, KeyError, OverflowError) as exc:
+            log.debug("stream conn failed: %s", exc)
+
+    # ------------------------------------------------------------ membership
+
+    def _push_update(self) -> None:
+        # _push_lock serializes compute -> compare -> callback across the
+        # rx/tick/push-pull threads; without it a stale peer list could be
+        # published LAST and stick until the next membership change
+        with self._push_lock:
+            peers: List[PeerInfo] = []
+            with self._lock:
+                for n in self._nodes.values():
+                    if n.state == wire.STATE_DEAD:
+                        continue
+                    try:
+                        dc, gport = wire.gob_decode_metadata(n.meta)
+                    except wire.WireError as exc:
+                        # same stance as the reference: a member with
+                        # unreadable metadata is logged and not routed to
+                        # (reference: memberlist.go:138-143)
+                        log.warning("bad metadata from %r: %s", n.name, exc)
+                        continue
+                    if not gport:
+                        continue
+                    peers.append(PeerInfo(
+                        address=f"{n.endpoint()[0]}:{gport}", datacenter=dc))
+            peers.sort(key=lambda p: p.address)
+            if peers == self._last_pushed:
+                return
+            self._last_pushed = peers
+            try:
+                self.on_update(list(peers))
+            except Exception:  # noqa: BLE001
+                log.exception("peer update callback failed")
+
+    def members(self) -> Dict[str, NodeState]:
+        with self._lock:
+            return {k: dataclasses.replace(v) for k, v in self._nodes.items()}
+
+    def leave(self, timeout: float = 1.0) -> None:
+        """Graceful exit: broadcast dead-about-self (Node == From means
+        intentional, reference semantics) and give gossip a moment."""
+        self._leaving = True
+        framed = wire.encode_msg(wire.DEAD, {
+            "Incarnation": self._incarnation, "Node": self.name,
+            "From": self.name,
+        })
+        self._queue_broadcast(self.name, framed)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                pending = self.name in self._bcast
+            if not pending:
+                break
+            self._gossip_tick()
+            time.sleep(min(0.05, self.gossip_interval))
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        if not self._leaving:
+            try:
+                self.leave(timeout=0.5)
+            except Exception:  # noqa: BLE001
+                pass
+        self._closed.set()
+        for sock in (self._udp, self._tcp):
+            try:
+                sock.close()
+            except OSError:
+                pass
+        for t in self._threads:
+            t.join(timeout=1.0)
+
+
+# ---------------------------------------------------------------- streams
+
+class _StreamBuf:
+    """Buffered socket reader over one persistent Unpacker: each object
+    is parsed exactly once and only NEW bytes are ever fed (linear in
+    stream size, even for a 4096-state push/pull)."""
+
+    def __init__(self, conn: socket.socket, deadline: float):
+        self.conn = conn
+        self.deadline = deadline
+        self.up = msgpack.Unpacker(
+            raw=True, strict_map_key=False, max_buffer_size=1 << 26)
+
+    def _fill(self) -> None:
+        if time.monotonic() > self.deadline:
+            raise wire.WireError("stream read timed out")
+        chunk = self.conn.recv(65536)
+        if not chunk:
+            raise wire.WireError("stream closed mid-message")
+        self.up.feed(chunk)
+
+    def next_obj(self) -> Any:
+        while True:
+            try:
+                return self.up.unpack()
+            except msgpack.OutOfData:
+                self._fill()
+
+    def read_exact(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            got = self.up.read_bytes(n - len(out))
+            if got:
+                out.extend(got)
+            else:
+                self._fill()
+        return bytes(out)
+
+
+def _read_stream_message(conn: socket.socket, timeout: float) -> Tuple[int, Any]:
+    """Read one framed message off a TCP stream -> (type, parsed).
+
+    parsed is (states, join, user_state) for PUSH_PULL and the body dict
+    for everything else.  Handles the compressMsg wrapping a
+    default-config Go sender applies to whole streams:
+    [0x09][msgpack compress{Algo,Buf}] where Buf decompresses to
+    [real type][real body]."""
+    r = _StreamBuf(conn, time.monotonic() + timeout)
+    first = r.read_exact(1)[0]
+    if first == wire.ENCRYPT:
+        raise wire.WireError("encrypted stream (no keyring configured)")
+    if first == wire.COMPRESS:
+        body = wire._norm(wire.COMPRESS, r.next_obj())
+        if body.get("Algo", 0) != 0:
+            raise wire.WireError("unknown stream compression algo")
+        raw = body.get("Buf", b"")
+        if not isinstance(raw, bytes) or not raw:
+            raise wire.WireError("empty compressed stream")
+        inner = wire.lzw_decompress(raw)
+        if not inner:
+            raise wire.WireError("empty stream message")
+        t = inner[0]
+        if t == wire.ENCRYPT:
+            raise wire.WireError("encrypted stream (no keyring configured)")
+        if t == wire.PUSH_PULL:
+            return t, wire.decode_push_pull(inner[1:])
+        return t, wire.decode_body(t, inner[1:])
+    if first == wire.PUSH_PULL:
+        header = wire._norm(wire.PUSH_PULL, r.next_obj())
+        n = int(header.get("Nodes", 0))
+        user_len = int(header.get("UserStateLen", 0))
+        if not 0 <= n <= 4096 or not 0 <= user_len <= (1 << 24):
+            raise wire.WireError("push/pull header out of range")
+        states = [wire._norm(wire.PUSH_PULL, r.next_obj()) for _ in range(n)]
+        user = r.read_exact(user_len) if user_len else b""
+        return first, (states, bool(header.get("Join", False)), user)
+    # fixed single-object messages (stream ping / ack / err)
+    return first, wire._norm(first, r.next_obj())

@@ -1,0 +1,575 @@
+"""hashicorp/memberlist v0.2.0 wire codec — pure functions, no sockets.
+
+The reference's MemberlistPool (reference: memberlist.go:36-78) delegates
+membership to github.com/hashicorp/memberlist v0.2.0 (reference:
+go.mod:9).  Interop therefore needs that library's exact wire format, NOT
+its Go API.  This module implements the format from the protocol's
+published structure so a gubernator_tpu node can join an existing
+memberlist fleet:
+
+- message framing: one type byte, then a go-msgpack (codec) body.
+  go-msgpack v0.5.3 (reference: go.sum:98) speaks the OLD msgpack spec:
+  structs are maps keyed by exported field name, strings AND []byte both
+  use the raw family (0xa0-0xbf/0xda/0xdb) — never bin8/str8.  msgpack-
+  python produces exactly that with use_bin_type=False, and raw=True on
+  decode keeps []byte fields (Addr, Meta, Vsn) byte-exact.
+- compound packets: [0x07][count u8][count × u16be lengths][parts].
+- CRC framing: [0x0c][crc32-ieee u32be][payload] (verified + stripped).
+- compression: compress{Algo: 0 (lzw), Buf} wrapping, where Buf is
+  compress/lzw LSB litWidth=8 — variable 9..12-bit codes, clear=256,
+  eof=257, "late" width change, clear-code reset at 4095 — implemented
+  here byte-compatibly (tests/test_memberlist.py pins golden vectors).
+- node metadata: the reference gob-encodes {DataCenter, GubernatorPort}
+  into Node.Meta (reference: memberlist.go:193-226); gob_encode_metadata/
+  gob_decode_metadata speak that stream (typedef message + value message,
+  validated against the gob wire spec's published struct example).
+
+Every decoder here is fed attacker-reachable bytes from UDP/TCP; all of
+them bound allocations and raise WireError (never segfault, never hang)
+on malformed input.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import msgpack
+
+# ---------------------------------------------------------------- messages
+
+PING = 0
+INDIRECT_PING = 1
+ACK_RESP = 2
+SUSPECT = 3
+ALIVE = 4
+DEAD = 5
+PUSH_PULL = 6
+COMPOUND = 7
+USER = 8
+COMPRESS = 9
+ENCRYPT = 10
+NACK_RESP = 11
+HAS_CRC = 12
+ERR = 13
+
+# node states carried in pushNodeState.State (memberlist v0.2.0)
+STATE_ALIVE = 0
+STATE_SUSPECT = 1
+STATE_DEAD = 2
+
+# alive.Vsn layout: [pmin, pmax, pcur, dmin, dmax, dcur]; defaults for a
+# config that sets none of the protocol knobs (the reference sets none).
+DEFAULT_VSN = bytes([1, 5, 2, 0, 0, 0])
+
+MAX_UDP_PACKET = 65536
+MAX_DECOMPRESSED = 1 << 22
+
+
+class WireError(ValueError):
+    """Malformed or unsupported memberlist wire bytes."""
+
+
+def pack(obj: Any) -> bytes:
+    """Old-spec msgpack bytes (what go-msgpack v0.5.3 decodes)."""
+    return msgpack.packb(obj, use_bin_type=False)
+
+
+# Fields whose values are UTF-8 text in the Go structs; everything else
+# raw stays bytes (Addr/Target/Meta/Vsn/Payload/Buf are []byte in Go).
+_TEXT_FIELDS = {"Node", "SourceNode", "From", "Name", "Error"}
+
+
+def _norm(t: int, obj: Any) -> Dict[str, Any]:
+    if not isinstance(obj, dict):
+        raise WireError(f"msg type {t}: body is not a struct map")
+    out: Dict[str, Any] = {}
+    for k, v in obj.items():
+        if isinstance(k, bytes):
+            k = k.decode("utf-8", errors="replace")
+        if not isinstance(k, str):
+            raise WireError(f"msg type {t}: non-string field key")
+        if k in _TEXT_FIELDS and isinstance(v, bytes):
+            v = v.decode("utf-8", errors="replace")
+        out[k] = v
+    return out
+
+
+def encode_msg(msg_type: int, body: Dict[str, Any]) -> bytes:
+    """[type byte][old-spec msgpack body] — the unit every framing wraps."""
+    return bytes([msg_type]) + pack(body)
+
+
+def decode_body(msg_type: int, body: bytes) -> Dict[str, Any]:
+    try:
+        obj = msgpack.unpackb(body, raw=True, strict_map_key=False)
+    except Exception as exc:  # noqa: BLE001 - any unpack failure is WireError
+        raise WireError(f"msgpack: {exc}") from exc
+    return _norm(msg_type, obj)
+
+
+# ---------------------------------------------------------------- compound
+
+def make_compound(parts: List[bytes]) -> bytes:
+    if not 0 < len(parts) <= 255:
+        raise WireError(f"compound of {len(parts)} parts")
+    out = [bytes([COMPOUND, len(parts)])]
+    for p in parts:
+        if len(p) > 0xFFFF:
+            raise WireError("compound part over 64KiB")
+        out.append(struct.pack(">H", len(p)))
+    out.extend(parts)
+    return b"".join(out)
+
+
+def split_compound(buf: bytes) -> List[bytes]:
+    if len(buf) < 1:
+        raise WireError("truncated compound")
+    n, off = buf[0], 1
+    if len(buf) < off + 2 * n:
+        raise WireError("truncated compound lengths")
+    lens = struct.unpack(f">{n}H", buf[off:off + 2 * n])
+    off += 2 * n
+    parts = []
+    for ln in lens:
+        if len(buf) < off + ln:
+            raise WireError("truncated compound part")
+        parts.append(buf[off:off + ln])
+        off += ln
+    return parts
+
+
+# ---------------------------------------------------------------- LZW (Go compress/lzw, LSB, litWidth=8)
+
+_CLEAR = 256
+_EOF = 257
+_MAX_CODE = (1 << 12) - 1
+
+
+def lzw_compress(data: bytes) -> bytes:
+    out = bytearray()
+    acc = nbits = 0
+    width = 9
+    hi = _EOF
+    overflow = 1 << 9
+    table: Dict[int, int] = {}
+
+    def emit(code: int) -> None:
+        nonlocal acc, nbits
+        acc |= code << nbits
+        nbits += width
+        while nbits >= 8:
+            out.append(acc & 0xFF)
+            acc >>= 8
+            nbits -= 8
+
+    def inc_hi() -> bool:
+        # Returns False when the table was reset (no new entry may be
+        # added this step) — Go's errOutOfCodes path.
+        nonlocal hi, width, overflow, table
+        hi += 1
+        if hi == overflow:
+            width += 1
+            overflow <<= 1
+        if hi == _MAX_CODE:
+            emit(_CLEAR)
+            width, hi, overflow = 9, _EOF, 1 << 9
+            table = {}
+            return False
+        return True
+
+    seq = -1
+    for b in data:
+        if seq < 0:
+            seq = b
+            continue
+        key = (seq << 8) | b
+        nxt = table.get(key)
+        if nxt is not None:
+            seq = nxt
+            continue
+        emit(seq)
+        if inc_hi():
+            table[key] = hi
+        seq = b
+    if seq >= 0:
+        emit(seq)
+        inc_hi()
+    emit(_EOF)
+    if nbits > 0:
+        out.append(acc & 0xFF)
+    return bytes(out)
+
+
+def lzw_decompress(data: bytes, max_out: int = MAX_DECOMPRESSED) -> bytes:
+    out = bytearray()
+    acc = nbits = 0
+    width = 9
+    hi = _EOF
+    overflow = 1 << 9
+    last = -1
+    # code -> (prefix code, suffix byte); literals implicit
+    prefix = {}
+    suffix = {}
+    pos = 0
+    n = len(data)
+    while True:
+        while nbits < width:
+            if pos >= n:
+                # Go returns io.ErrUnexpectedEOF here; trailing padding
+                # after the eof code never reaches this loop.
+                raise WireError("lzw: truncated stream")
+            acc |= data[pos] << nbits
+            pos += 1
+            nbits += 8
+        code = acc & ((1 << width) - 1)
+        acc >>= width
+        nbits -= width
+
+        if code < _CLEAR:
+            out.append(code)
+            if last >= 0:
+                prefix[hi] = last
+                suffix[hi] = code
+        elif code == _CLEAR:
+            width, hi, overflow, last = 9, _EOF, 1 << 9, -1
+            prefix.clear()
+            suffix.clear()
+            continue
+        elif code == _EOF:
+            return bytes(out)
+        elif code <= hi:
+            chunk = bytearray()
+            c = code
+            if code == hi and last >= 0:
+                # KwKwK: expands to last expansion + its first byte
+                c = last
+                while c >= _CLEAR:
+                    c = prefix[c]
+                chunk.append(c)
+                c = last
+            while c >= _CLEAR:
+                chunk.append(suffix[c])
+                c = prefix[c]
+            chunk.append(c)
+            chunk.reverse()
+            first = chunk[0]
+            out.extend(chunk)
+            if last >= 0:
+                prefix[hi] = last
+                suffix[hi] = first
+        else:
+            raise WireError("lzw: invalid code")
+        last = code
+        hi += 1
+        if hi >= overflow:
+            if width == 12:
+                # writer is obliged to send a clear before overflowing
+                last = -1
+                hi -= 1
+            else:
+                width += 1
+                overflow <<= 1
+        if len(out) > max_out:
+            raise WireError("lzw: output over limit")
+
+
+# ---------------------------------------------------------------- packet assembly / ingest
+
+def wrap_compress(payload: bytes) -> bytes:
+    """compress{Algo: lzw(0), Buf} framing — used only when smaller."""
+    return encode_msg(COMPRESS, {"Algo": 0, "Buf": lzw_compress(payload)})
+
+
+def wrap_crc(payload: bytes) -> bytes:
+    return bytes([HAS_CRC]) + struct.pack(">I", zlib.crc32(payload)) + payload
+
+
+def assemble_packet(
+    parts: List[bytes], compress: bool = True, crc: bool = True
+) -> bytes:
+    """One UDP datagram from framed messages, the sender-side pipeline:
+    compound (if >1) -> lzw (kept only if smaller, matching the Go
+    sender) -> crc (receivers with protocol max >= 5 verify it)."""
+    buf = parts[0] if len(parts) == 1 else make_compound(parts)
+    if compress:
+        comp = wrap_compress(buf)
+        if len(comp) < len(buf):
+            buf = comp
+    if crc:
+        buf = wrap_crc(buf)
+    return buf
+
+
+def ingest_packet(buf: bytes, depth: int = 0) -> List[Tuple[int, Dict[str, Any]]]:
+    """Decode one UDP datagram into [(msg_type, body), ...], unwrapping
+    crc / compress / compound recursively the way the Go receiver does."""
+    if depth > 4:
+        raise WireError("packet nesting too deep")
+    if not buf:
+        return []
+    t = buf[0]
+    if t == HAS_CRC:
+        if len(buf) < 5:
+            raise WireError("truncated crc header")
+        want = struct.unpack(">I", buf[1:5])[0]
+        if zlib.crc32(buf[5:]) != want:
+            raise WireError("crc mismatch")
+        return ingest_packet(buf[5:], depth + 1)
+    if t == COMPRESS:
+        body = decode_body(t, buf[1:])
+        if body.get("Algo", 0) != 0:
+            raise WireError(f"unknown compression algo {body.get('Algo')}")
+        raw = body.get("Buf", b"")
+        if not isinstance(raw, bytes):
+            raise WireError("compress.Buf is not bytes")
+        return ingest_packet(lzw_decompress(raw), depth + 1)
+    if t == COMPOUND:
+        msgs: List[Tuple[int, Dict[str, Any]]] = []
+        for part in split_compound(buf[1:]):
+            msgs.extend(ingest_packet(part, depth + 1))
+        return msgs
+    if t == ENCRYPT:
+        raise WireError("encrypted packet (no keyring configured)")
+    return [(t, decode_body(t, buf[1:]))]
+
+
+# ---------------------------------------------------------------- push/pull stream bodies
+
+def encode_push_pull(
+    states: List[Dict[str, Any]], join: bool, user_state: bytes = b""
+) -> bytes:
+    """[pushPullMsg][header][N node states][user state] — the TCP state
+    sync body both sides exchange (join=True on the joining side)."""
+    out = [bytes([PUSH_PULL])]
+    out.append(pack({
+        "Nodes": len(states), "UserStateLen": len(user_state), "Join": join,
+    }))
+    for s in states:
+        out.append(pack(s))
+    out.append(user_state)
+    return b"".join(out)
+
+
+def decode_push_pull(body: bytes) -> Tuple[List[Dict[str, Any]], bool, bytes]:
+    """Parse everything after the pushPullMsg type byte."""
+    up = msgpack.Unpacker(raw=True, strict_map_key=False,
+                          max_buffer_size=1 << 26)
+    up.feed(body)
+    try:
+        header = _norm(PUSH_PULL, up.unpack())
+        n = int(header.get("Nodes", 0))
+        user_len = int(header.get("UserStateLen", 0))
+        if not 0 <= n <= 4096 or not 0 <= user_len <= (1 << 24):
+            raise WireError("push/pull header out of range")
+        states = [_norm(PUSH_PULL, up.unpack()) for _ in range(n)]
+        user = up.read_bytes(user_len) if user_len else b""
+    except WireError:
+        raise
+    except Exception as exc:  # noqa: BLE001
+        raise WireError(f"push/pull: {exc}") from exc
+    if len(user) != user_len:
+        raise WireError("truncated user state")
+    return states, bool(header.get("Join", False)), bytes(user)
+
+
+# ---------------------------------------------------------------- gob metadata
+#
+# encoding/gob stream for the single struct the reference stores in
+# Node.Meta (reference: memberlist.go:193-209):
+#
+#   type memberlistMetadata struct { DataCenter string; GubernatorPort int }
+#
+# Stream = [typedef message for user type 65][value message].  Each
+# message is uint(length) + payload; a typedef payload is int(-65) + the
+# wireType descriptor; a value payload is int(+65) + the struct fields as
+# (field delta, value) pairs with zero fields omitted and a 0 terminator.
+
+_GOB_TSTRING = 6
+_GOB_TINT = 2
+_GOB_USER_ID = 65
+
+
+def _gob_uint(n: int) -> bytes:
+    if n < 0:
+        raise WireError("gob uint < 0")
+    if n < 128:
+        return bytes([n])
+    raw = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([256 - len(raw)]) + raw
+
+
+def _gob_int(i: int) -> bytes:
+    u = (i << 1) if i >= 0 else (((-i) << 1) - 1)
+    return _gob_uint(u)
+
+
+def _gob_string(s: str) -> bytes:
+    raw = s.encode("utf-8")
+    return _gob_uint(len(raw)) + raw
+
+
+def _gob_field_type(name: str, type_id: int) -> bytes:
+    # fieldType{Name(0), Id(1)} + terminator
+    return b"\x01" + _gob_string(name) + b"\x01" + _gob_int(type_id) + b"\x00"
+
+
+def _gob_message(payload: bytes) -> bytes:
+    return _gob_uint(len(payload)) + payload
+
+
+def gob_encode_metadata(datacenter: str, gubernator_port: int) -> bytes:
+    # typedef: wireType{StructT(2): StructType{CommonType{Name, Id},
+    #                                          Field: []fieldType}}
+    struct_t = (
+        b"\x01"  # StructType field 0: CommonType
+        + b"\x01" + _gob_string("memberlistMetadata")
+        + b"\x01" + _gob_int(_GOB_USER_ID)
+        + b"\x00"
+        + b"\x01"  # StructType field 1: Field slice
+        + _gob_uint(2)
+        + _gob_field_type("DataCenter", _GOB_TSTRING)
+        + _gob_field_type("GubernatorPort", _GOB_TINT)
+        + b"\x00"  # end StructType
+    )
+    typedef = _gob_int(-_GOB_USER_ID) + b"\x03" + struct_t + b"\x00"
+
+    fields = b""
+    delta = 1
+    if datacenter:
+        fields += bytes([delta]) + _gob_string(datacenter)
+        delta = 1
+    else:
+        delta = 2
+    if gubernator_port:
+        fields += bytes([delta]) + _gob_int(gubernator_port)
+    value = _gob_int(_GOB_USER_ID) + fields + b"\x00"
+    return _gob_message(typedef) + _gob_message(value)
+
+
+class _GobReader:
+    def __init__(self, buf: bytes):
+        self.buf = buf
+        self.pos = 0
+
+    def uint(self) -> int:
+        if self.pos >= len(self.buf):
+            raise WireError("gob: truncated uint")
+        b = self.buf[self.pos]
+        self.pos += 1
+        if b < 128:
+            return b
+        n = 256 - b
+        if n > 8 or self.pos + n > len(self.buf):
+            raise WireError("gob: bad uint length")
+        v = int.from_bytes(self.buf[self.pos:self.pos + n], "big")
+        self.pos += n
+        return v
+
+    def int_(self) -> int:
+        u = self.uint()
+        return -( (u + 1) >> 1) if (u & 1) else (u >> 1)
+
+    def string(self) -> str:
+        n = self.uint()
+        if n > 1 << 16 or self.pos + n > len(self.buf):
+            raise WireError("gob: bad string length")
+        s = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return s.decode("utf-8", errors="replace")
+
+
+def _gob_parse_typedef(r: _GobReader) -> Dict[int, Tuple[str, int]]:
+    """Parse a wireType struct -> {field number: (name, type id)}."""
+    fields: Dict[int, Tuple[str, int]] = {}
+    wt_field = -1
+    while True:
+        delta = r.uint()
+        if delta == 0:
+            break
+        wt_field += delta
+        if wt_field != 2:  # only StructT is expected / supported
+            raise WireError(f"gob: unsupported wireType field {wt_field}")
+        st_field = -1
+        while True:
+            d = r.uint()
+            if d == 0:
+                break
+            st_field += d
+            if st_field == 0:  # CommonType {Name, Id}
+                ct_field = -1
+                while True:
+                    dd = r.uint()
+                    if dd == 0:
+                        break
+                    ct_field += dd
+                    if ct_field == 0:
+                        r.string()
+                    elif ct_field == 1:
+                        r.int_()
+                    else:
+                        raise WireError("gob: bad CommonType")
+            elif st_field == 1:  # Field []fieldType
+                count = r.uint()
+                if count > 256:
+                    raise WireError("gob: too many fields")
+                for i in range(count):
+                    name, tid = "", 0
+                    ft_field = -1
+                    while True:
+                        dd = r.uint()
+                        if dd == 0:
+                            break
+                        ft_field += dd
+                        if ft_field == 0:
+                            name = r.string()
+                        elif ft_field == 1:
+                            tid = r.int_()
+                        else:
+                            raise WireError("gob: bad fieldType")
+                    fields[i] = (name, tid)
+            else:
+                raise WireError("gob: bad StructType")
+    return fields
+
+
+def gob_decode_metadata(buf: bytes) -> Tuple[str, int]:
+    """Tolerant decode of the reference's gob metadata -> (datacenter,
+    gubernator_port).  Raises WireError on anything else."""
+    fields: Dict[int, Tuple[str, int]] = {}
+    r = _GobReader(buf)
+    for _ in range(8):  # bounded number of messages
+        if r.pos >= len(r.buf):
+            break
+        length = r.uint()
+        end = r.pos + length
+        if length > len(r.buf) - r.pos:
+            raise WireError("gob: truncated message")
+        type_id = r.int_()
+        if type_id < 0:
+            fields = _gob_parse_typedef(r)
+            if r.pos != end:
+                raise WireError("gob: typedef trailing bytes")
+            continue
+        # value message: struct fields by (delta, typed value)
+        dc, port = "", 0
+        fnum = -1
+        while True:
+            delta = r.uint()
+            if delta == 0:
+                break
+            fnum += delta
+            name, tid = fields.get(fnum, ("", 0))
+            if name == "DataCenter" or (not fields and fnum == 0):
+                dc = r.string()
+            elif name == "GubernatorPort" or (not fields and fnum == 1):
+                port = r.int_()
+            elif tid == _GOB_TSTRING:
+                r.string()
+            elif tid == _GOB_TINT:
+                r.int_()
+            else:
+                raise WireError(f"gob: unknown field {fnum}")
+        return dc, port
+    raise WireError("gob: no value message")

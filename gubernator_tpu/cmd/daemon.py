@@ -134,6 +134,29 @@ def build_pool(conf: DaemonConfig, instance: Instance):
             # GUBER_MEMBERLIST_ADVERTISE_PORT completes a bare address
             # (reference: config.go:126-127)
             bind = f"{bind}:{conf.gossip_advertise_port}"
+        if conf.memberlist_compat:
+            # the default: the hashicorp/memberlist v0.2.0 wire protocol,
+            # joinable by/of reference fleets (reference: memberlist.go)
+            import socket as _socket
+
+            from gubernator_tpu.cluster.memberlist import MemberlistPool
+
+            # a port-less advertise address falls back to the gRPC bind
+            # port (which always has one — default 0.0.0.0:81)
+            grpc_addr = conf.advertise_address or conf.grpc_address
+            try:
+                guber_port = int(grpc_addr.rsplit(":", 1)[-1])
+            except ValueError:
+                guber_port = int(conf.grpc_address.rsplit(":", 1)[-1])
+            return MemberlistPool(
+                bind_address=bind,
+                node_name=conf.memberlist_node_name
+                or _socket.gethostname(),
+                on_update=on_update,
+                gubernator_port=guber_port,
+                known_nodes=conf.gossip_known_nodes,
+                datacenter=conf.data_center,
+            )
         return discovery.GossipPool(
             bind_address=bind,
             grpc_address=conf.advertise_address or conf.grpc_address,

@@ -101,6 +101,12 @@ class DaemonConfig:
     gossip_bind: str = ""
     gossip_advertise_port: int = 7946
     gossip_known_nodes: List[str] = dataclasses.field(default_factory=list)
+    # GUBER_MEMBERLIST_* speaks the hashicorp/memberlist v0.2.0 wire
+    # protocol by default (cluster/memberlist.py) so a node can join a
+    # reference fleet; =0 selects the leaner gubernator_tpu-only
+    # GossipPool (same role, own wire format).
+    memberlist_compat: bool = True
+    memberlist_node_name: str = ""  # default: hostname
     etcd_endpoints: List[str] = dataclasses.field(default_factory=list)
     etcd_advertise_address: str = ""  # defaults to advertise_address
     etcd_key_prefix: str = ""  # "" -> the pool's /gubernator/peers/ default
@@ -199,6 +205,8 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         gossip_bind=_env_str("GUBER_MEMBERLIST_ADVERTISE_ADDRESS"),
         gossip_advertise_port=_env_int("GUBER_MEMBERLIST_ADVERTISE_PORT", 7946),
         gossip_known_nodes=_env_slice("GUBER_MEMBERLIST_KNOWN_NODES"),
+        memberlist_compat=_env_str("GUBER_MEMBERLIST_COMPAT", "1") != "0",
+        memberlist_node_name=_env_str("GUBER_MEMBERLIST_NODE_NAME"),
         etcd_endpoints=_env_slice("GUBER_ETCD_ENDPOINTS"),
         etcd_advertise_address=_env_str("GUBER_ETCD_ADVERTISE_ADDRESS"),
         etcd_key_prefix=_env_str("GUBER_ETCD_KEY_PREFIX"),
