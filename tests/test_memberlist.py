@@ -163,6 +163,75 @@ class TestWireCodec:
         assert got[0]["Meta"] == meta
 
 
+class TestDecoderFuzz:
+    """Every decoder fed from the network must terminate with WireError
+    or a parsed value on ARBITRARY bytes — never hang, crash, or leak an
+    unexpected exception type (the -race/-fuzz hygiene the reference
+    gets from Go's type system, SURVEY.md section 5.2)."""
+
+    def test_ingest_packet_random(self):
+        rng = random.Random(11)
+        for i in range(400):
+            n = rng.randrange(0, 600)
+            buf = bytes(rng.randrange(256) for _ in range(n))
+            try:
+                wire.ingest_packet(buf)
+            except wire.WireError:
+                pass
+
+    def test_ingest_packet_mutated_valid(self):
+        rng = random.Random(12)
+        meta = wire.gob_encode_metadata("dc", 81)
+        alive = wire.encode_msg(wire.ALIVE, {
+            "Incarnation": 1, "Node": "node-x", "Addr": b"\x7f\x00\x00\x01",
+            "Port": 7946, "Meta": meta, "Vsn": wire.DEFAULT_VSN})
+        ping = wire.encode_msg(wire.PING, {"SeqNo": 5, "Node": "n"})
+        pkt = wire.assemble_packet([ping, alive])
+        for _ in range(400):
+            mutated = bytearray(pkt)
+            for _ in range(rng.randrange(1, 6)):
+                mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+            try:
+                wire.ingest_packet(bytes(mutated))
+            except wire.WireError:
+                pass
+
+    def test_push_pull_body_random(self):
+        rng = random.Random(13)
+        for _ in range(200):
+            buf = bytes(rng.randrange(256)
+                        for _ in range(rng.randrange(0, 300)))
+            try:
+                wire.decode_push_pull(buf)
+            except wire.WireError:
+                pass
+
+    def test_gob_mutated_valid(self):
+        rng = random.Random(14)
+        good = wire.gob_encode_metadata("us-west-2", 9081)
+        for _ in range(400):
+            mutated = bytearray(good)
+            for _ in range(rng.randrange(1, 4)):
+                mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+            try:
+                wire.gob_decode_metadata(bytes(mutated))
+            except wire.WireError:
+                pass
+
+    def test_lzw_mutated_valid(self):
+        rng = random.Random(15)
+        data = bytes(rng.randrange(8) for _ in range(4000))
+        packed = bytearray(wire.lzw_compress(data))
+        for _ in range(200):
+            mutated = bytearray(packed)
+            for _ in range(rng.randrange(1, 4)):
+                mutated[rng.randrange(len(mutated))] = rng.randrange(256)
+            try:
+                wire.lzw_decompress(bytes(mutated), max_out=1 << 20)
+            except wire.WireError:
+                pass
+
+
 # ------------------------------------------------------------------- pool
 
 
