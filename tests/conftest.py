@@ -119,6 +119,19 @@ def http_metric(http_port, name):
     return 0.0
 
 
+def await_cond(cond, timeout, every=0.5):
+    """Poll `cond()` until truthy or `timeout` seconds elapse (shared by
+    the multi-node e2e suites)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(every)
+    return False
+
+
 def wait_http_metric(http_port, name, want, deadline_s,
                      cmp=lambda v, w: v >= w):
     import time

@@ -43,12 +43,9 @@ def _pool(name, on_update=lambda ps: None, seeds=(), port=1050, **kw):
 
 
 def _await(cond, timeout=15.0, every=0.05):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if cond():
-            return True
-        time.sleep(every)
-    return False
+    from conftest import await_cond
+
+    return await_cond(cond, timeout, every)
 
 
 # ------------------------------------------------------------------ codec

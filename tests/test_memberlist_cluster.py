@@ -1,0 +1,122 @@
+"""Rolling-restart e2e: a daemon fleet whose ONLY membership source is
+the memberlist-wire gossip pool.
+
+The reference's deployment story is exactly this shape — daemons find
+each other through hashicorp/memberlist and re-shard on membership
+change (reference: memberlist.go:17-34, config.go:180-198).  This test
+runs three REAL daemons (subprocesses, CPU JAX, native tiers active),
+kills one mid-traffic, and restarts it:
+
+- convergence: all three health-check at peerCount=3 purely via gossip;
+- failure: the survivors drop to peerCount=2 (SWIM suspect -> dead) and
+  keep serving;
+- rejoin: the restarted daemon pushes/pulls back in, peerCount returns
+  to 3 everywhere, and traffic through the rejoined node sees the same
+  buckets (ownership re-settles).
+"""
+
+import json
+import signal
+import urllib.request
+
+from conftest import await_cond as _await
+from conftest import free_port, spawn_daemon, stop_daemon
+
+
+def _health(port, timeout=2.0):
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/v1/HealthCheck", timeout=timeout
+        ) as r:
+            return json.load(r)
+    except Exception:  # noqa: BLE001 - polling helper
+        return None
+
+
+def _get(port, key, timeout=30.0):
+    body = json.dumps({"requests": [{
+        "name": "ml-e2e", "uniqueKey": key, "hits": "1",
+        "limit": "100", "duration": "60000",
+    }]}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/GetRateLimits", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.load(r)["responses"][0]
+
+
+def test_memberlist_fleet_rolling_restart(tmp_path):
+    names = ("fd1", "fd2", "fd3")
+    grpc = {n: free_port() for n in names}
+    http = {n: free_port() for n in names}
+    gossip = {n: free_port() for n in names}
+
+    def env_for(name, seeds):
+        e = {
+            "JAX_PLATFORMS": "cpu",
+            "GUBER_GRPC_ADDRESS": f"127.0.0.1:{grpc[name]}",
+            "GUBER_HTTP_ADDRESS": f"127.0.0.1:{http[name]}",
+            "GUBER_ADVERTISE_ADDRESS": f"127.0.0.1:{grpc[name]}",
+            "GUBER_MEMBERLIST_ADVERTISE_ADDRESS":
+                f"127.0.0.1:{gossip[name]}",
+            "GUBER_MEMBERLIST_NODE_NAME": name,
+            "GUBER_CACHE_SIZE": "4096",
+            "GUBER_MIN_BATCH_WIDTH": "64",
+            "GUBER_MAX_BATCH_WIDTH": "128",  # 2 warmup buckets: fast boot
+        }
+        if seeds:
+            e["GUBER_MEMBERLIST_KNOWN_NODES"] = ",".join(seeds)
+        return e
+
+    seed = [f"127.0.0.1:{gossip['fd1']}"]
+    procs = {}
+    try:
+        procs["fd1"] = spawn_daemon(
+            env_for("fd1", ()), stderr_path=tmp_path / "fd1.log")
+        procs["fd2"] = spawn_daemon(
+            env_for("fd2", seed), stderr_path=tmp_path / "fd2.log")
+        procs["fd3"] = spawn_daemon(
+            env_for("fd3", seed), stderr_path=tmp_path / "fd3.log")
+
+        def peer_counts():
+            return [
+                (h or {}).get("peerCount", 0)
+                for h in (_health(http[n]) for n in names)
+            ]
+
+        def log_tails():
+            return {
+                f.name: f.read_text()[-1500:]
+                for f in sorted(tmp_path.glob("*.log"))
+            }
+
+        assert _await(lambda: peer_counts() == [3, 3, 3], 90), (
+            peer_counts(), log_tails())
+
+        # one shared bucket no matter the entry node
+        assert int(_get(http["fd1"], "rk").get("remaining")) == 99
+        assert int(_get(http["fd2"], "rk").get("remaining")) == 98
+        assert int(_get(http["fd3"], "rk").get("remaining")) == 97
+
+        # hard-kill fd3: SWIM demotes it; survivors keep serving
+        procs["fd3"].send_signal(signal.SIGKILL)
+        procs.pop("fd3").wait(timeout=10)
+        assert _await(lambda: peer_counts()[:2] == [2, 2], 90), peer_counts()
+        assert int(_get(http["fd1"], "rk2").get("remaining")) == 99
+        assert int(_get(http["fd2"], "rk2").get("remaining")) == 98
+
+        # restart fd3 on the SAME ports: rejoin via push/pull + gossip
+        procs["fd3"] = spawn_daemon(
+            env_for("fd3", seed), stderr_path=tmp_path / "fd3b.log")
+        assert _await(lambda: peer_counts() == [3, 3, 3], 90), (
+            peer_counts(), log_tails())
+        # the rejoined node is in ONE consistent ring: a fresh key
+        # decided through all three entry nodes hits one owner bucket
+        # (keys whose ownership moved to fd3 reset — the reference loses
+        # bucket state on membership change the same way, cache.go)
+        assert int(_get(http["fd3"], "rk3").get("remaining")) == 99
+        assert int(_get(http["fd1"], "rk3").get("remaining")) == 98
+        assert int(_get(http["fd2"], "rk3").get("remaining")) == 97
+    finally:
+        for p in procs.values():
+            stop_daemon(p)
