@@ -492,6 +492,27 @@ class TestMemberlistPool:
         finally:
             p1.close()
 
+    def test_daemon_build_pool_compat_off(self):
+        """GUBER_MEMBERLIST_COMPAT=0 selects the lean GossipPool through
+        the same env surface."""
+        from gubernator_tpu.cluster.discovery import GossipPool
+        from gubernator_tpu.cmd.daemon import build_pool
+        from gubernator_tpu.cmd.envconf import DaemonConfig
+
+        class _Inst:
+            def set_peers(self, peers):
+                pass
+
+        conf = DaemonConfig(
+            grpc_address="127.0.0.1:6201", gossip_bind="127.0.0.1:0",
+            memberlist_compat=False,
+        )
+        pool = build_pool(conf, _Inst())
+        try:
+            assert isinstance(pool, GossipPool)
+        finally:
+            pool.close()
+
     def test_lossy_network_no_false_expiry(self):
         """30% UDP loss: indirect probes + TCP fallback must keep all
         members alive (the SWIM property the round-3 verdict asked the
