@@ -79,10 +79,11 @@ def pytest_unconfigure(config):
         import warnings
 
         with warnings.catch_warnings():
-            # the multi-threaded-fork DeprecationWarning would print
-            # AFTER the suite summary and become the run's last line;
-            # the child only sleeps and kills, which fork-safety allows
-            warnings.simplefilter("ignore", DeprecationWarning)
+            # CPython's DeprecationWarning and JAX's at-fork
+            # RuntimeWarning would print AFTER the suite summary and
+            # become the run's last line; the child only sleeps and
+            # kills, which fork-safety allows
+            warnings.simplefilter("ignore")
             pid = os.fork()
     except OSError:
         return
