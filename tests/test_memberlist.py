@@ -492,6 +492,39 @@ class TestMemberlistPool:
         finally:
             p1.close()
 
+    def test_eight_node_convergence_and_leave_cascade(self):
+        """Scale check: 8 pools converge through one seed (O(log n)
+        gossip dissemination + join push/pull), then a cascade of
+        graceful leaves shrinks every survivor's view correctly."""
+        updates = {}
+
+        def mk(name):
+            def cb(peers):
+                updates[name] = len(peers)
+            return cb
+
+        pools = [_pool("m0", mk("m0"), port=2000)]
+        seed = [f"127.0.0.1:{pools[0].bound_port}"]
+        try:
+            for i in range(1, 8):
+                pools.append(_pool(f"m{i}", mk(f"m{i}"), seeds=seed,
+                                   port=2000 + i))
+            assert _await(
+                lambda: all(updates.get(f"m{i}") == 8 for i in range(8)),
+                timeout=30.0), updates
+            # leave three nodes back-to-back; the remaining five must
+            # each converge to exactly 5 members
+            for _ in range(3):
+                p = pools.pop()
+                p.leave()
+                p.close()
+            assert _await(
+                lambda: all(updates.get(f"m{i}") == 5 for i in range(5)),
+                timeout=30.0), updates
+        finally:
+            for p in pools:
+                p.close()
+
     def test_daemon_build_pool_compat_off(self):
         """GUBER_MEMBERLIST_COMPAT=0 selects the lean GossipPool through
         the same env surface."""
