@@ -41,6 +41,10 @@ from gubernator_tpu.ops.decide import (
     I64,
     TableState,
     compact_window,
+    decide_packed_lean,
+    decide_scan_packed_lean,
+    lean_capacity_ok,
+    lean_window,
     decide_packed,
     decide_packed_compact,
     decide_scan_packed,
@@ -105,6 +109,18 @@ def _jit_decide_packed_compact(donate: bool):
 @_functools.lru_cache(maxsize=None)
 def _jit_decide_scan_compact(donate: bool):
     return jax.jit(decide_scan_packed_compact,
+                   donate_argnums=(0,) if donate else ())
+
+
+@_functools.lru_cache(maxsize=None)
+def _jit_decide_packed_lean(donate: bool):
+    return jax.jit(decide_packed_lean,
+                   donate_argnums=(0,) if donate else ())
+
+
+@_functools.lru_cache(maxsize=None)
+def _jit_decide_scan_lean(donate: bool):
+    return jax.jit(decide_scan_packed_lean,
                    donate_argnums=(0,) if donate else ())
 
 
@@ -200,6 +216,10 @@ class Engine:
         self._decide_scan = _jit_decide_scan(donate)
         self._decide_packed_compact = _jit_decide_packed_compact(donate)
         self._decide_scan_compact = _jit_decide_scan_compact(donate)
+        self._decide_packed_lean = _jit_decide_packed_lean(donate)
+        self._decide_scan_lean = _jit_decide_scan_lean(donate)
+        # lean staging needs every slot to fit the 24-bit lane field
+        self._lean_ok = lean_capacity_ok(capacity)
         self._inject = _jit_inject(donate)
         self._gather = _jit_gather()
         # Staging wire-format policy: "auto" (default) ships each window in
@@ -243,9 +263,13 @@ class Engine:
                 packed = np.zeros((9, width), np.int64)
                 packed[0, :] = -1  # all padding lanes
                 self.state, resp = self._decide_packed(self.state, packed, 0)
-                if both:  # auto mode serves from either wire format
+                if both:  # auto mode serves from any eligible wire format
                     self.state, resp = self._decide_packed_compact(
                         self.state, compact_window(packed), 0)
+                    if self._lean_ok:
+                        ln = lean_window(packed, self.capacity)
+                        self.state, resp = self._decide_packed_lean(
+                            self.state, ln[0], jnp.asarray(ln[1]), 0)
             # every scan-path shape: depths 2..=_MAX_SCAN at min_width (the
             # fast path dispatches nothing else — see _split_scannable)
             k = 2
@@ -256,6 +280,10 @@ class Engine:
                 if both:
                     self.state, resp = self._decide_scan_compact(
                         self.state, compact_window(stacked), 0)
+                    if self._lean_ok:
+                        ln = lean_window(stacked, self.capacity)
+                        self.state, resp = self._decide_scan_lean(
+                            self.state, ln[0], jnp.asarray(ln[1]), 0)
                 k *= 2
             # serving-path auxiliary jits: the lone-miss mirror seed's
             # 1-slot gather and the mirror-flush inject at its common
@@ -277,9 +305,17 @@ class Engine:
     # (VERDICT r3 item 1: auto-selected by eligibility).
 
     def _dispatch_staged(self, packed: np.ndarray, now_ms):
-        """Dispatch one wide-format i64[9, W] window, shipping it compact
-        when eligible. Returns an opaque handle for _fetch_staged."""
+        """Dispatch one wide-format i64[9, W] window, shipping it lean
+        (4 B/lane — the hits==1, few-configs serving shape) when eligible,
+        compact (20 B/lane) otherwise, wide as the last resort. Returns an
+        opaque handle for _fetch_staged."""
         if self._staging != "wide":
+            if self._lean_ok:
+                ln = lean_window(packed, self.capacity)
+                if ln is not None:
+                    self.state, out = self._decide_packed_lean(
+                        self.state, ln[0], jnp.asarray(ln[1]), now_ms)
+                    return out, now_ms
             c = compact_window(packed)
             if c is not None:
                 self.state, out = self._decide_packed_compact(
@@ -290,8 +326,15 @@ class Engine:
 
     def _dispatch_scan_staged(self, stacked: np.ndarray, now_ms):
         """decide_scan dispatch of a wide i64[K, 9, W] stack, shipped
-        compact when eligible. Handle contract matches _dispatch_staged."""
+        lean/compact when eligible. Handle contract matches
+        _dispatch_staged."""
         if self._staging != "wide":
+            if self._lean_ok:
+                ln = lean_window(stacked, self.capacity)
+                if ln is not None:
+                    self.state, out = self._decide_scan_lean(
+                        self.state, ln[0], jnp.asarray(ln[1]), now_ms)
+                    return out, now_ms
             c = compact_window(stacked)
             if c is not None:
                 self.state, out = self._decide_scan_compact(

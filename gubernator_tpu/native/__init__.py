@@ -146,6 +146,15 @@ def load_library() -> ctypes.CDLL:
             c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
             c.c_void_p,
         ]
+        lib.keydir_lean_max_cfg.restype = c.c_int64
+        lib.keydir_lean_max_cfg.argtypes = []
+        lib.keydir_lean_hash_slots.restype = c.c_int64
+        lib.keydir_lean_hash_slots.argtypes = []
+        # same 21-slot layout as keydir_prep_pack_interned (iw is i32[width],
+        # cfg i64[128][4], cfg_hash i32[512]) — see that annotation
+        lib.keydir_prep_pack_lean.restype = c.c_int32
+        lib.keydir_prep_pack_lean.argtypes = \
+            list(lib.keydir_prep_pack_interned.argtypes)
         _LIB = lib
         return lib
 
@@ -347,6 +356,35 @@ class InternPrepState:
         return int(self._n_cfg[0])
 
 
+def _prep_pack_cfg(fn, width: int, directory: "NativeKeyDirectory", n: int,
+                   keys, key_off, name_len, hits, limit, duration,
+                   algorithm, behavior, slow_mask: int, iw: np.ndarray,
+                   state):
+    """Shared driver for the two config-interning preps (interned / lean):
+    identical buffer setup, ctypes call shape, and (n0, lane_item,
+    leftover, inject) return contract — only the C entry point, staging
+    width, and state type differ."""
+    lane_item = np.empty(width, np.int32)
+    leftover = np.empty(n, np.int32)
+    n_left = np.zeros(1, np.int32)
+    inject = np.empty((n, 8), np.int64)
+    n_inj = np.zeros(1, np.int32)
+    n0 = fn(
+        directory._kd, n, keys,
+        key_off.ctypes.data, name_len.ctypes.data, hits.ctypes.data,
+        limit.ctypes.data, duration.ctypes.data, algorithm.ctypes.data,
+        behavior.ctypes.data, slow_mask, iw.ctypes.data, width,
+        state.cfg.ctypes.data, state._n_cfg.ctypes.data,
+        state._hash.ctypes.data,
+        lane_item.ctypes.data, leftover.ctypes.data, n_left.ctypes.data,
+        inject.ctypes.data, n_inj.ctypes.data,
+    )
+    if n0 < 0:
+        return n0, None, None, inject[:int(n_inj[0])]
+    return (n0, lane_item[:n0], leftover[:int(n_left[0])],
+            inject[:int(n_inj[0])])
+
+
 def prep_pack_interned(directory: "NativeKeyDirectory", n: int,
                        keys, key_off, name_len, hits, limit, duration,
                        algorithm, behavior, slow_mask: int,
@@ -361,26 +399,57 @@ def prep_pack_interned(directory: "NativeKeyDirectory", n: int,
 
     Returns (n0, lane_item, leftover, inject) like prep_pack_columnar."""
     lib = load_library()
-    width = iw.shape[1]
-    lane_item = np.empty(width, np.int32)
-    leftover = np.empty(n, np.int32)
-    n_left = np.zeros(1, np.int32)
-    inject = np.empty((n, 8), np.int64)
-    n_inj = np.zeros(1, np.int32)
-    n0 = lib.keydir_prep_pack_interned(
-        directory._kd, n, keys,
-        key_off.ctypes.data, name_len.ctypes.data, hits.ctypes.data,
-        limit.ctypes.data, duration.ctypes.data, algorithm.ctypes.data,
-        behavior.ctypes.data, slow_mask, iw.ctypes.data, width,
-        state.cfg.ctypes.data, state._n_cfg.ctypes.data,
-        state._hash.ctypes.data,
-        lane_item.ctypes.data, leftover.ctypes.data, n_left.ctypes.data,
-        inject.ctypes.data, n_inj.ctypes.data,
-    )
-    if n0 < 0:
-        return n0, None, None, inject[:int(n_inj[0])]
-    return (n0, lane_item[:n0], leftover[:int(n_left[0])],
-            inject[:int(n_inj[0])])
+    return _prep_pack_cfg(
+        lib.keydir_prep_pack_interned, iw.shape[1], directory, n, keys,
+        key_off, name_len, hits, limit, duration, algorithm, behavior,
+        slow_mask, iw, state)
+
+
+# keydir_prep_pack_lean: a looked-up slot exceeded the 24-bit lane field —
+# the caller's capacity gate (ops/decide.py lean_capacity_ok) was skipped
+PREP_SLOT_WIDE = -4
+
+
+class LeanPrepState:
+    """Caller-owned persistent state for the lean columnar prep: the
+    i64[128, 4] (limit, duration, algorithm, behavior) config table the
+    device receives, its fill count, and the C-side find-or-insert map
+    (i32[512] of id+1). One instance per serving loop / engine; ships cfg
+    to the device whenever n_cfg grows."""
+
+    def __init__(self):
+        lib = load_library()  # sizes come from the C compile-time constants
+        max_cfg = lib.keydir_lean_max_cfg()
+        slots = lib.keydir_lean_hash_slots()
+        self.cfg = np.zeros((max_cfg, 4), np.int64)
+        self._n_cfg = np.zeros(1, np.int32)
+        self._hash = np.zeros(slots, np.int32)
+
+    @property
+    def n_cfg(self) -> int:
+        return int(self._n_cfg[0])
+
+
+def prep_pack_lean(directory: "NativeKeyDirectory", n: int,
+                   keys, key_off, name_len, hits, limit, duration,
+                   algorithm, behavior, slow_mask: int,
+                   iw: np.ndarray, state: LeanPrepState):
+    """Columnar one-pass prep emitting the LEAN staging format
+    (ops/decide.py decide_packed_lean): `iw` is i32[width] — ONE word per
+    lane, 4 bytes/decision on the wire (no pre-zeroing needed — every lane
+    is written), `state` persists the config table across windows. Lanes
+    the lean format cannot carry (hits != 1, out-of-range values,
+    slow-mask behaviors) demote to `leftover`; >128 distinct configs
+    returns PREP_CFG_OVERFLOW with directory and config state untouched.
+    The caller must hold the capacity gate: directory capacity <= 0xFFFFFF
+    (lean_capacity_ok) — PREP_SLOT_WIDE flags a breach.
+
+    Returns (n0, lane_item, leftover, inject) like prep_pack_columnar."""
+    lib = load_library()
+    return _prep_pack_cfg(
+        lib.keydir_prep_pack_lean, iw.shape[0], directory, n, keys,
+        key_off, name_len, hits, limit, duration, algorithm, behavior,
+        slow_mask, iw, state)
 
 
 def prep_route_columnar(directories, n: int, keys, key_off, name_len,

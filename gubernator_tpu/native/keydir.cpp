@@ -1009,6 +1009,182 @@ int32_t keydir_prep_pack_interned(
 
 namespace {
 
+// Lean-lane config interning: the table absorbs the full
+// (limit, duration, algorithm, behavior) tuple so the wire carries only a
+// 7-bit id (ops/decide.py "lean": 128 tuples, i64[128][4] rows). The hash
+// map stores id + 1 per slot (0 = empty) and compares the full tuple
+// against the cfg row on probe — open addressing with the table itself as
+// the key store, so no packing of the 69-bit tuple into one word.
+constexpr int64_t LEAN_HASH_SLOTS = 512;  // 4x LEAN_MAX_CFG fill
+constexpr int64_t LEAN_MAX_CFG = 128;     // ops/decide.py LEAN_MAX_CFG
+constexpr int32_t LEAN_SLOT_MASK = (1 << 24) - 1;
+constexpr int32_t LEAN_FRESH_SHIFT = 24;
+constexpr int32_t LEAN_CFG_SHIFT = 25;
+
+inline uint64_t lean_cfg_hash(int64_t limit, int64_t duration, int64_t algo,
+                              int64_t behavior) {
+    return intern_hash(
+        static_cast<uint64_t>((limit << 31) | duration) ^
+        (static_cast<uint64_t>(algo | (behavior << 1)) << 57));
+}
+
+inline int64_t lean_cfg_id(int64_t limit, int64_t duration, int64_t algo,
+                           int64_t behavior, int64_t* cfg, int32_t* n_cfg,
+                           int32_t* cfg_hash) {
+    uint64_t h = lean_cfg_hash(limit, duration, algo, behavior);
+    for (;;) {
+        int32_t* slot = cfg_hash + (h & (LEAN_HASH_SLOTS - 1));
+        const int32_t v = *slot;
+        if (v == 0) {
+            if (*n_cfg >= LEAN_MAX_CFG) return -1;
+            const int64_t id = (*n_cfg)++;
+            *slot = static_cast<int32_t>(id) + 1;
+            cfg[4 * id] = limit;
+            cfg[4 * id + 1] = duration;
+            cfg[4 * id + 2] = algo;
+            cfg[4 * id + 3] = behavior;
+            return id;
+        }
+        const int64_t id = v - 1;
+        if (cfg[4 * id] == limit && cfg[4 * id + 1] == duration &&
+            cfg[4 * id + 2] == algo && cfg[4 * id + 3] == behavior) {
+            return id;
+        }
+        ++h;
+    }
+}
+
+}  // namespace
+
+int64_t keydir_lean_max_cfg() { return LEAN_MAX_CFG; }
+int64_t keydir_lean_hash_slots() { return LEAN_HASH_SLOTS; }
+
+// Lean columnar prep: keydir_prep_pack_interned's contract, but the
+// staging output is the LEAN wire format (ops/decide.py "lean"):
+// iw i32[width] — ONE word per lane: [23:0] slot (0xFFFFFF = padding) |
+// [24] fresh | [31:25] config id — 4 bytes/decision on the wire, hits = 1
+// implied, with (limit, duration, algorithm, behavior) interned into the
+// caller-owned i64[128][4] cfg table (cfg_hash here is i32[512] of id+1,
+// caller-zeroed, persists across calls).
+//
+// Lanes the lean format cannot carry — hits != 1, limit/duration outside
+// [0, 2^31), behavior past the 6-bit field, gregorian via slow_mask —
+// demote to `leftover` like slow-mask lanes. The caller must ensure the
+// directory capacity fits 24 bits (ops/decide.py lean_capacity_ok);
+// a slot at/past the 0xFFFFFF sentinel returns PREP_SLOT_WIDE (-4) after
+// the lookup (defensive — unreachable when the capacity gate holds).
+// Returns n0 >= 0, PREP_FALLBACK, PREP_OVERCOMMIT, PREP_CFG_OVERFLOW (-3,
+// config state rolled back to entry — caller re-preps interned/wide), or
+// PREP_SLOT_WIDE (-4).
+int32_t keydir_prep_pack_lean(
+    void* kd, int32_t n, const char* keys, const int32_t* key_off,
+    const int32_t* name_len, const int64_t* hits, const int64_t* limit,
+    const int64_t* duration, const int32_t* algorithm,
+    const int32_t* behavior, int64_t slow_mask, int32_t* iw, int32_t width,
+    int64_t* cfg, int32_t* n_cfg, int32_t* cfg_hash, int32_t* lane_item,
+    int32_t* leftover, int32_t* n_leftover_out, int64_t* inject,
+    int32_t* n_inject) {
+    if (n <= 0 || n > width) return -1;
+
+    const int32_t n_cfg_entry = *n_cfg;
+    std::string arena;
+    std::vector<int64_t> offsets;
+    std::vector<int32_t> lanes;
+    std::vector<int32_t> word;  // lane word sans fresh bit
+    std::unordered_set<std::string> seen;
+    seen.reserve(n);
+    offsets.reserve(n + 1);
+    offsets.push_back(0);
+    lanes.reserve(n);
+    word.reserve(n);
+    arena.reserve(static_cast<size_t>(key_off[n] - key_off[0]) + n);
+    std::string key;
+    int32_t n_left = 0;
+    bool overflow = false;
+    for (int32_t i = 0; i < n; ++i) {
+        const int32_t lo = key_off[i], hi = key_off[i + 1];
+        const int32_t nl = name_len[i], ul = hi - lo - nl;
+        const bool keyok = nl > 0 && ul > 0 &&
+                           key_bytes_ok(keys + lo, nl) &&
+                           key_bytes_ok(keys + lo + nl, ul);
+        bool ok = keyok && (behavior[i] & slow_mask) == 0 && hits[i] == 1 &&
+                  limit[i] >= 0 && limit[i] <= INTERN_I32_MAX &&
+                  duration[i] >= 0 && duration[i] <= INTERN_I32_MAX &&
+                  (behavior[i] & ~0x3F) == 0 && (algorithm[i] & ~1) == 0;
+        if (keyok) {
+            key.assign(keys + lo, nl);
+            key.push_back('_');
+            key.append(keys + lo + nl, ul);
+            if (ok) {
+                ok = seen.insert(key).second;
+            } else {
+                seen.insert(key);  // later occurrences also demote
+            }
+        }
+        if (ok) {
+            const int64_t id =
+                lean_cfg_id(limit[i], duration[i], algorithm[i],
+                            behavior[i], cfg, n_cfg, cfg_hash);
+            if (id < 0) {
+                overflow = true;
+                break;
+            }
+            word.push_back(static_cast<int32_t>(id << LEAN_CFG_SHIFT));
+            arena += key;
+            offsets.push_back(static_cast<int64_t>(arena.size()));
+            lanes.push_back(i);
+        } else {
+            leftover[n_left++] = i;
+        }
+    }
+    if (overflow) {
+        // roll the config state back to entry; the hash map rebuilds from
+        // the surviving table (rare: once per deployment config churn)
+        *n_cfg = n_cfg_entry;
+        std::memset(cfg_hash, 0,
+                    static_cast<size_t>(LEAN_HASH_SLOTS) * sizeof(int32_t));
+        for (int64_t id = 0; id < n_cfg_entry; ++id) {
+            uint64_t h = lean_cfg_hash(cfg[4 * id], cfg[4 * id + 1],
+                                       cfg[4 * id + 2], cfg[4 * id + 3]);
+            for (;;) {
+                int32_t* slot = cfg_hash + (h & (LEAN_HASH_SLOTS - 1));
+                if (*slot == 0) {
+                    *slot = static_cast<int32_t>(id) + 1;
+                    break;
+                }
+                ++h;
+            }
+        }
+        return -3;
+    }
+    *n_leftover_out = n_left;
+    const int32_t n0 = static_cast<int32_t>(lanes.size());
+    if (n0 == 0) {
+        for (int32_t i = 0; i < width; ++i) iw[i] = LEAN_SLOT_MASK;
+        return 0;
+    }
+
+    std::vector<int32_t> slots(n0);
+    std::vector<uint8_t> fresh(n0);
+    const int64_t done = static_cast<KeyDir*>(kd)->lookup_batch(
+        arena.data(), offsets.data(), n0, slots.data(), fresh.data(),
+        inject, n_inject);
+    if (done != n0) return -2;
+
+    for (int32_t i = 0; i < n0; ++i) {
+        if (slots[i] >= LEAN_SLOT_MASK) return -4;  // capacity gate breach
+        iw[i] = slots[i] | word[i] |
+                (fresh[i] ? (1 << LEAN_FRESH_SHIFT) : 0);
+    }
+    for (int32_t i = n0; i < width; ++i) iw[i] = LEAN_SLOT_MASK;
+    std::memcpy(lane_item, lanes.data(),
+                static_cast<size_t>(n0) * sizeof(int32_t));
+    return n0;
+}
+
+
+namespace {
+
 // Owner-routed lane accumulator + drain shared by the two sharded preps:
 // per-owner directory lookup and the owner-major staging emit (the decide
 // staging row-order contract — slot / 5 request cols / gregorian zeros /

@@ -635,6 +635,129 @@ class InternCache:
         return _emit_interned(packed, inv)
 
 
+# ------------------------------------------------------------------ lean
+# The dominant serving shape — hits == 1 (one decision per request), a
+# handful of limit configs, no gregorian — needs even less than interned's
+# 8 B/decision: ONE i32 word per lane. The config table absorbs algorithm
+# and behavior alongside (limit, duration), hits = 1 is implied, and the
+# slot rides in the low 24 bits (table <= 2^24 - 1 slots; the 10M-key
+# north-star uses 10,000,001 < 16,777,215). 4 B up + 8 B back (the serving
+# loop's two-row response) = 12 B/decision round trip vs interned's 16 —
+# the wire lever DESIGN.md "Next wire lever" specs for link-bound rigs.
+#
+# lane word layout (i32; bit 31 participates in the config id, so the
+# word may be negative — every decode masks):
+#   [23:0]  slot        (all-ones 0xFFFFFF = padding sentinel)
+#   [24]    fresh
+#   [31:25] config id   (<= 128 distinct (limit, duration, algo,
+#                        behavior) tuples per deployment epoch)
+
+LEAN_MAX_CFG = 128
+_LEAN_SLOT_MASK = (1 << 24) - 1
+_LEAN_PAD = _LEAN_SLOT_MASK  # slot sentinel: capacity must stay below it
+_LEAN_FRESH_SHIFT = 24
+_LEAN_CFG_SHIFT = 25
+
+
+def lean_capacity_ok(capacity: int) -> bool:
+    """Slots must fit the 24-bit lane field with 0xFFFFFF reserved for
+    padding — a deployment-time property, checked once per engine."""
+    return capacity <= _LEAN_SLOT_MASK
+
+
+def decide_packed_lean(
+    state: TableState, packed: jax.Array, cfg: jax.Array, now_ms: jax.Array
+) -> Tuple[TableState, jax.Array]:
+    """decide() over one lean i32[B] lane word per request + i64[128, 4]
+    config table of (limit, duration, algorithm, behavior) rows. hits = 1
+    implied. Bit-identical to decide_packed on any window lean_window()
+    accepts (TestLeanStaging differential). Returns the compact i32[4, B]
+    response rows."""
+    lane = packed
+    slot24 = lane & _LEAN_SLOT_MASK
+    slot = jnp.where(slot24 == _LEAN_PAD, jnp.asarray(-1, I32), slot24)
+    cfgid = (lane >> _LEAN_CFG_SHIFT) & (LEAN_MAX_CFG - 1)
+    zero64 = jnp.zeros(lane.shape[-1], I64)
+    reqs = ReqBatch(
+        slot=slot,
+        hits=jnp.ones(lane.shape[-1], I64),
+        limit=cfg[cfgid, 0],
+        duration=cfg[cfgid, 1],
+        algorithm=cfg[cfgid, 2].astype(I32),
+        behavior=cfg[cfgid, 3].astype(I32),
+        greg_expire=zero64,
+        greg_interval=zero64,
+        fresh=((lane >> _LEAN_FRESH_SHIFT) & 1) != 0,
+    )
+    new_state, resp = decide(state, reqs, now_ms)
+    return new_state, _compact_response(resp, now_ms)
+
+
+def decide_scan_packed_lean(
+    state: TableState, packed_k: jax.Array, cfg: jax.Array, now_ms: jax.Array
+) -> Tuple[TableState, jax.Array]:
+    """K lean windows in one dispatch: i32[K, B] + one shared i64[128, 4]
+    config table -> i32[K, 4, B], window k+1 observing window k's writes
+    (see decide_scan_packed)."""
+
+    def body(st, pk):
+        st2, out = decide_packed_lean(st, pk, cfg, now_ms)
+        return st2, out
+
+    return jax.lax.scan(body, state, packed_k)
+
+
+def lean_window(packed, capacity: int):
+    """Wide i64[9, W] (or [K, 9, W]) staging -> (lean i32[W] / [K, W] lane
+    words, i64[LEAN_MAX_CFG, 4] config table), or None when any non-padding
+    lane is ineligible: hits != 1, gregorian, limit/duration outside
+    [0, 2^31), behavior past 6 bits, algorithm past 1 bit, slot too wide
+    for 24 bits, or > LEAN_MAX_CFG distinct (limit, duration, algorithm,
+    behavior) tuples. Padding lanes emit the 0xFFFFFF sentinel and occupy
+    no config row."""
+    import numpy as np
+
+    if not lean_capacity_ok(capacity):
+        return None
+    slot = packed[..., 0, :]
+    live = slot >= 0
+    if (slot >= _LEAN_PAD).any():
+        return None
+    hits = packed[..., 1, :]
+    limit = packed[..., 2, :]
+    dur = packed[..., 3, :]
+    algo = packed[..., 4, :]
+    beh = packed[..., 5, :]
+    bad = (
+        (hits != 1)
+        | (limit < 0) | (limit > _I32_MAX)
+        | (dur < 0) | (dur > _I32_MAX)
+        | ((algo & ~1) != 0)
+        | ((beh & ~_META_BEHAVIOR_MASK) != 0)
+        | ((beh & int(Behavior.DURATION_IS_GREGORIAN)) != 0)
+    )
+    if bool((bad & live).any()):
+        return None
+    tup = np.stack([limit[live], dur[live], algo[live], beh[live]], axis=-1)
+    uniq, inv = np.unique(tup, axis=0, return_inverse=True)
+    if uniq.shape[0] > LEAN_MAX_CFG:
+        return None
+    cfg = np.zeros((LEAN_MAX_CFG, 4), np.int64)
+    cfg[: uniq.shape[0]] = uniq
+    lanes = np.full(slot.shape, _LEAN_PAD, np.int64)
+    # astype before shifting: numpy 1.x value-based casting would promote
+    # the bool to a small int dtype and overflow the 24-bit shift
+    lanes[live] = (
+        slot[live]
+        | ((packed[..., 8, :][live] != 0).astype(np.int64)
+           << _LEAN_FRESH_SHIFT)
+        | (inv.reshape(-1).astype(np.int64) << _LEAN_CFG_SHIFT)
+    )
+    # bit 31 of the cfgid field lands in the i32 sign bit — wrap the bit
+    # pattern through uint32 (every reader masks, so negatives are fine)
+    return lanes.astype(np.uint32).view(np.int32), cfg
+
+
 def pack_window(items, slots, fresh, width: int, out=None):
     """Host-side packer for decide_packed: i64[9, width] from one window.
 

@@ -430,3 +430,155 @@ class TestInternedPrep:
             c2["name_len"], c2["hits"], c2["limit"], c2["duration"],
             c2["algorithm"], c2["behavior"], SLOW, iw, istate)
         assert n0 == 10 and istate.n_cfg == 10
+
+
+class TestLeanPrep:
+    """The lean C prep (keydir_prep_pack_lean) + lean kernel must be
+    bit-exact with the request-object path: hits==1 lanes decide through
+    the 4-byte wire format, everything else (hits != 1, huge limits,
+    gregorian, invalid keys, duplicates) demotes to leftovers, and config
+    overflow rolls back cleanly."""
+
+    @staticmethod
+    def _run_lean(eng, lstate, reqs, now_ms):
+        import jax
+
+        from gubernator_tpu import native
+        from gubernator_tpu.ops.decide import (
+            decide_packed_lean,
+            widen_compact_out,
+        )
+
+        c = cols_from(reqs)
+        n = c["n"]
+        st = np.zeros(n, np.int32)
+        li = np.zeros(n, np.int64)
+        re = np.zeros(n, np.int64)
+        rs = np.zeros(n, np.int64)
+        width = max(16, 1 << (n - 1).bit_length())
+        iw = np.empty(width, np.int32)
+        n0, lane, left, inj = native.prep_pack_lean(
+            eng.directory, n, c["keys"], c["key_off"], c["name_len"],
+            c["hits"], c["limit"], c["duration"], c["algorithm"],
+            c["behavior"], SLOW, iw, lstate)
+        assert n0 >= 0
+        eng._apply_inject_rows(inj)
+        if n0:
+            eng.state, out = jax.jit(decide_packed_lean)(
+                eng.state, iw, lstate.cfg, now_ms)
+            rows = widen_compact_out(out, now_ms)
+            st[lane] = rows[0, :n0]
+            li[lane] = rows[1, :n0]
+            re[lane] = rows[2, :n0]
+            rs[lane] = rows[3, :n0]
+        for i in left.tolist():
+            r = eng.get_rate_limits([reqs[i]], now_ms=now_ms)[0]
+            st[i], li[i], re[i], rs[i] = (r.status, r.limit, r.remaining,
+                                          r.reset_time)
+        return st, li, re, rs
+
+    def test_random_workload_bit_exact(self, engines):
+        from gubernator_tpu.native import LeanPrepState
+
+        a, b = engines
+        lstate = LeanPrepState()
+        rng = np.random.default_rng(31)
+        for it in range(20):
+            n = int(rng.integers(1, 120))
+            reqs = []
+            for _ in range(n):
+                beh = 0
+                if rng.random() < 0.1:
+                    beh |= int(Behavior.RESET_REMAINING)
+                if rng.random() < 0.05:
+                    beh |= int(Behavior.DURATION_IS_GREGORIAN)
+                # mostly the lean shape (hits=1); some peeks and multi-hit
+                # lanes that must demote to the leftover path
+                hits = 1 if rng.random() < 0.8 else int(rng.integers(0, 5))
+                limit = 25 if rng.random() < 0.9 else (1 << 40)
+                reqs.append(RateLimitReq(
+                    name="lp", unique_key=f"k{rng.integers(0, 40)}",
+                    hits=hits, limit=limit, duration=60_000,
+                    algorithm=(Algorithm.TOKEN_BUCKET if rng.random() < .7
+                               else Algorithm.LEAKY_BUCKET),
+                    behavior=beh))
+            now = NOW + it * 500
+            want = a.get_rate_limits(reqs, now_ms=now)
+            st, li, re, rs = self._run_lean(b, lstate, reqs, now)
+            for i, w in enumerate(want):
+                got = (st[i], li[i], re[i], rs[i])
+                assert got == (w.status, w.limit, w.remaining,
+                               w.reset_time), (it, i, reqs[i], got, w)
+
+    def test_overflow_falls_back(self):
+        from gubernator_tpu import native
+        from gubernator_tpu.native import LeanPrepState
+
+        eng = Engine(capacity=2048, min_width=16, max_width=1024)
+        lstate = LeanPrepState()
+        reqs = [RateLimitReq(name="lf", unique_key=f"k{i}", hits=1,
+                             limit=100 + i, duration=60_000)
+                for i in range(200)]  # 200 distinct configs > 128
+        c = cols_from(reqs)
+        iw = np.empty(256, np.int32)
+        n0, lane, left, inj = native.prep_pack_lean(
+            eng.directory, c["n"], c["keys"], c["key_off"], c["name_len"],
+            c["hits"], c["limit"], c["duration"], c["algorithm"],
+            c["behavior"], SLOW, iw, lstate)
+        assert n0 == native.PREP_CFG_OVERFLOW
+        assert lstate.n_cfg == 0  # rolled back
+        # the same window re-preps fine through the wide columnar path
+        st, li, re, rs = run_columnar(eng, reqs, NOW)
+        assert (st == 0).all() and (re == np.arange(200) + 99).all()
+        # and the lean path still serves smaller windows afterwards
+        small = reqs[:10]
+        c2 = cols_from(small)
+        n0, lane, left, inj = native.prep_pack_lean(
+            eng.directory, c2["n"], c2["keys"], c2["key_off"],
+            c2["name_len"], c2["hits"], c2["limit"], c2["duration"],
+            c2["algorithm"], c2["behavior"], SLOW, iw, lstate)
+        assert n0 == 10 and lstate.n_cfg == 10
+
+    def test_lean_matches_interned_lanes(self):
+        """On a hits==1 window the lean and interned preps must agree on
+        lane order, demotions, and decisions — only the wire width
+        differs (4 vs 8 bytes/lane)."""
+        import jax
+
+        from gubernator_tpu import native
+        from gubernator_tpu.native import InternPrepState, LeanPrepState
+        from gubernator_tpu.ops.decide import (
+            decide_packed_interned,
+            decide_packed_lean,
+        )
+
+        ea = Engine(capacity=4096, min_width=16, max_width=1024)
+        eb = Engine(capacity=4096, min_width=16, max_width=1024)
+        lstate, istate = LeanPrepState(), InternPrepState()
+        rng = np.random.default_rng(7)
+        for it in range(6):
+            reqs = [RateLimitReq(
+                name="li", unique_key=f"k{rng.integers(0, 200)}", hits=1,
+                limit=int(rng.choice([10, 100, 1000])), duration=60_000)
+                for _ in range(64)]
+            c = cols_from(reqs)
+            iw_l = np.empty(64, np.int32)
+            iw_i = np.empty((2, 64), np.int32)
+            n0, lane_l, left_l, _ = native.prep_pack_lean(
+                ea.directory, c["n"], c["keys"], c["key_off"],
+                c["name_len"], c["hits"], c["limit"], c["duration"],
+                c["algorithm"], c["behavior"], SLOW, iw_l, lstate)
+            n1, lane_i, left_i, _ = native.prep_pack_interned(
+                eb.directory, c["n"], c["keys"], c["key_off"],
+                c["name_len"], c["hits"], c["limit"], c["duration"],
+                c["algorithm"], c["behavior"], SLOW, iw_i, istate)
+            assert n0 == n1
+            np.testing.assert_array_equal(lane_l, lane_i)
+            np.testing.assert_array_equal(left_l, left_i)
+            now = NOW + it
+            ea.state, out_l = jax.jit(decide_packed_lean)(
+                ea.state, iw_l, lstate.cfg, now)
+            eb.state, out_i = jax.jit(decide_packed_interned)(
+                eb.state, iw_i, istate.cfg, now)
+            np.testing.assert_array_equal(np.asarray(out_l),
+                                          np.asarray(out_i))

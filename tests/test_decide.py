@@ -754,3 +754,176 @@ class TestInternedStaging:
         assert cache.intern(many) is None
         assert cache.n_cfg == n0  # rejected atomically
         assert cache.intern(base) is not None  # still serving
+
+
+class TestLeanStaging:
+    """The 4-byte lean lane (i32[B] + i64[128, 4] config table, hits = 1
+    implied — DESIGN.md "Next wire lever") must be bit-identical to the
+    wide i64 format on every window it accepts, and must refuse windows it
+    cannot represent (hits != 1, > 128 distinct configs, gregorian, values
+    outside i32, capacity past 24 bits)."""
+
+    @staticmethod
+    def _rand_wide_lean(rng, r, C, B, now, behaviors):
+        """TestCompactStaging._rand_wide with every live lane at hits=1
+        (the lean format's defining constraint)."""
+        p = TestCompactStaging._rand_wide(rng, r, C, B, now, behaviors)
+        p[1, p[0] >= 0] = 1
+        return p
+
+    @pytest.mark.parametrize("seed", range(4))
+    def test_differential_vs_wide(self, seed):
+        from gubernator_tpu.ops.decide import (
+            decide_packed,
+            decide_packed_lean,
+            lean_window,
+            widen_compact_out,
+        )
+
+        r = random.Random(seed)
+        rng = np.random.RandomState(seed)
+        C, B, now = 256, 32, 1_700_000_000_000
+        behaviors = [0, int(Behavior.RESET_REMAINING),
+                     int(Behavior.NO_BATCHING)]
+        wide_step = jax.jit(decide_packed)
+        lean_step = jax.jit(decide_packed_lean)
+        st_w, st_l = make_table(C), make_table(C)
+        for i in range(12):
+            wide = self._rand_wide_lean(rng, r, C, B, now + i * 1000,
+                                        behaviors)
+            got = lean_window(wide, C)
+            assert got is not None
+            lanes, cfg = got
+            assert lanes.dtype == np.int32 and lanes.shape == (B,)
+            assert cfg.shape == (128, 4)
+            st_w, out_w = wide_step(st_w, wide, now + i * 1000)
+            st_l, out_l = lean_step(st_l, lanes, cfg, now + i * 1000)
+            np.testing.assert_array_equal(
+                np.asarray(out_w),
+                widen_compact_out(out_l, now + i * 1000))
+        np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_l))
+
+    def test_scan_differential_vs_wide(self):
+        from gubernator_tpu.ops.decide import (
+            decide_scan_packed,
+            decide_scan_packed_lean,
+            lean_window,
+            widen_compact_out,
+        )
+
+        r = random.Random(13)
+        rng = np.random.RandomState(13)
+        C, K, B, now = 256, 6, 16, 1_700_000_000_000
+        wide = np.stack([
+            self._rand_wide_lean(rng, r, C, B, now, [0])
+            for _ in range(K)])
+        got = lean_window(wide, C)
+        assert got is not None
+        lanes, cfg = got
+        assert lanes.shape == (K, B)
+        st_w, out_w = jax.jit(decide_scan_packed)(make_table(C), wide, now)
+        st_l, out_l = jax.jit(decide_scan_packed_lean)(
+            make_table(C), lanes, cfg, now)
+        np.testing.assert_array_equal(
+            np.asarray(out_w), widen_compact_out(out_l, now))
+        np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_l))
+
+    def test_sign_bit_config_ids(self):
+        """cfgid >= 64 sets i32 bit 31 — the lane word goes NEGATIVE on
+        the wire and must still decode bit-exact (every reader masks)."""
+        from gubernator_tpu.ops.decide import (
+            LEAN_MAX_CFG,
+            decide_packed,
+            decide_packed_lean,
+            lean_window,
+            widen_compact_out,
+        )
+
+        now = 1_700_000_000_000
+        C, B = 1 << 20, LEAN_MAX_CFG
+        p = np.zeros((9, B), np.int64)
+        p[0] = np.arange(B) + (C - B - 1)  # slots near the capacity edge
+        p[1] = 1
+        p[2] = np.arange(B) + 1  # exactly 128 distinct configs
+        p[3] = 60_000
+        lanes, cfg = lean_window(p, C)
+        assert (lanes < 0).any()
+        st_w, out_w = jax.jit(decide_packed)(make_table(C), p, now)
+        st_l, out_l = jax.jit(decide_packed_lean)(
+            make_table(C), lanes, cfg, now)
+        np.testing.assert_array_equal(
+            np.asarray(out_w), widen_compact_out(out_l, now))
+        np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_l))
+
+    def test_rejects_what_it_cannot_represent(self):
+        from gubernator_tpu.ops.decide import LEAN_MAX_CFG, lean_window
+
+        C = 1 << 20
+        base = np.zeros((9, 4), np.int64)
+        base[0] = [0, 1, 2, -1]
+        base[1, :3] = 1
+        base[2:4, :] = 1
+        assert lean_window(base, C) is not None
+        multi = base.copy()
+        multi[1, 1] = 2  # hits != 1 cannot ride (hits is implied)
+        assert lean_window(multi, C) is None
+        peek = base.copy()
+        peek[1, 0] = 0  # ... including hits=0 peeks
+        assert lean_window(peek, C) is None
+        too_big = base.copy()
+        too_big[2, 1] = 2**31  # limit exceeds i32
+        assert lean_window(too_big, C) is None
+        greg = base.copy()
+        greg[5, 2] = int(Behavior.DURATION_IS_GREGORIAN)
+        assert lean_window(greg, C) is None
+        # capacity gate: slots must fit 24 bits with 0xFFFFFF reserved
+        assert lean_window(base, 1 << 24) is None
+        assert lean_window(base, (1 << 24) - 1) is not None
+        # config-count boundary: 129 distinct tuples refused, 128 accepted
+        many = np.zeros((9, LEAN_MAX_CFG + 1), np.int64)
+        many[0] = np.arange(LEAN_MAX_CFG + 1)
+        many[1] = 1
+        many[2] = np.arange(LEAN_MAX_CFG + 1) + 1
+        many[3] = 1000
+        assert lean_window(many, C) is None
+        many[2, LEAN_MAX_CFG] = many[2, 0]
+        got = lean_window(many, C)
+        assert got is not None
+        lanes, cfg = got
+        cfgids = (lanes.astype(np.int64) >> 25) & 0x7F
+        np.testing.assert_array_equal(cfg[cfgids, 0], many[2])
+        np.testing.assert_array_equal(cfg[cfgids, 1], many[3])
+        # algorithm/behavior fold into the config tuple, not the lane word
+        ab = base.copy()
+        ab[4, :3] = [0, 1, 0]
+        ab[5, :3] = [0, 0, int(Behavior.RESET_REMAINING)]
+        lanes, cfg = lean_window(ab, C)
+        cfgids = (lanes.astype(np.int64) >> 25) & 0x7F
+        np.testing.assert_array_equal(cfg[cfgids[:3], 2], ab[4, :3])
+        np.testing.assert_array_equal(cfg[cfgids[:3], 3], ab[5, :3])
+
+    def test_fresh_and_padding(self):
+        """The fresh bit survives the lane word; padding lanes ride the
+        0xFFFFFF sentinel and never touch the table."""
+        from gubernator_tpu.ops.decide import (
+            decide_packed,
+            decide_packed_lean,
+            lean_window,
+            widen_compact_out,
+        )
+
+        now = 1_700_000_000_000
+        st_w, st_l = make_table(16), make_table(16)
+        mk = np.zeros((9, 4), np.int64)
+        mk[0] = [3, 5, -1, -1]
+        mk[1, :2] = 1
+        mk[2, :2] = 10
+        mk[3, :2] = 60_000
+        mk[8, :2] = [1, 0]
+        lanes, cfg = lean_window(mk, 16)
+        assert (np.asarray(lanes[2:]) & 0xFFFFFF == 0xFFFFFF).all()
+        st_w, out_w = jax.jit(decide_packed)(st_w, mk, now)
+        st_l, out_l = jax.jit(decide_packed_lean)(st_l, lanes, cfg, now)
+        np.testing.assert_array_equal(
+            np.asarray(out_w), widen_compact_out(out_l, now))
+        np.testing.assert_array_equal(np.asarray(st_w), np.asarray(st_l))
