@@ -97,6 +97,8 @@ class MemberlistPool(Pool):
         retransmit_mult: float = 4.0,
         indirect_checks: int = 3,
         join_required: bool = True,
+        secret_key: bytes = b"",
+        secret_keys: Sequence[bytes] = (),
     ):
         host, _, port = bind_address.rpartition(":")
         self.bind = (host or "0.0.0.0", int(port))
@@ -111,6 +113,17 @@ class MemberlistPool(Pool):
         self.suspicion_mult = suspicion_mult
         self.retransmit_mult = retransmit_mult
         self.indirect_checks = indirect_checks
+        # AES-GCM packet encryption, as hashicorp/memberlist's SecretKey/
+        # Keyring: `secret_key` is the primary (encrypt) key, `secret_keys`
+        # additional decrypt-only keys for rotation. An encrypted fleet
+        # refuses plaintext both ways (GossipVerify{In,Out}going defaults).
+        ring = [k for k in [secret_key, *secret_keys] if k]
+        for k in ring:
+            if len(k) not in (16, 24, 32):
+                raise ValueError(
+                    "memberlist secret keys must be 16/24/32 bytes")
+        self._keyring: Optional[List[bytes]] = ring or None
+        self._primary_key: Optional[bytes] = ring[0] if ring else None
 
         self._lock = threading.RLock()
         self._closed = threading.Event()
@@ -121,6 +134,10 @@ class MemberlistPool(Pool):
         self._acks: Dict[int, Tuple[float, Optional[Callable[[bytes], None]]]] = {}
         # broadcast queue: node name -> [framed bytes, transmits so far]
         self._bcast: Dict[str, List[Any]] = {}
+        # pending indirect-ping nack timers: cancelled on close so a
+        # dying pool neither delays interpreter exit nor fires a nack
+        # after its sockets are gone
+        self._nack_timers: List[threading.Timer] = []
         self._probe_ring: List[str] = []
         self._push_lock = threading.Lock()
         self._last_pushed: Optional[List[PeerInfo]] = None
@@ -140,7 +157,10 @@ class MemberlistPool(Pool):
 
         adv_host = advertise_address or self._advertise_ip()
         self.advertise = (adv_host, self.bound_port)
-        self._addr_bytes = socket.inet_aton(adv_host)
+        try:
+            self._addr_bytes = socket.inet_pton(socket.AF_INET, adv_host)
+        except OSError:  # IPv6 advertise hosts ride the 16-byte form
+            self._addr_bytes = socket.inet_pton(socket.AF_INET6, adv_host)
 
         meta = wire.gob_encode_metadata(datacenter, gubernator_port)
         if len(meta) > 512:  # memberlist MetaMaxSize
@@ -225,13 +245,23 @@ class MemberlistPool(Pool):
 
     def _send_udp(self, dest: Tuple[str, int], *parts: bytes) -> None:
         head = b"".join(parts)
-        piggyback = self._take_broadcasts(_UDP_BUDGET - len(head) - 7)
+        overhead = 7 if self._primary_key is None else \
+            7 + wire.encrypted_length(wire.ENC_V1, 0)
+        piggyback = self._take_broadcasts(_UDP_BUDGET - len(head) - overhead)
         try:
             self._udp.sendto(
-                wire.assemble_packet(list(parts) + piggyback), dest
+                wire.assemble_packet(list(parts) + piggyback,
+                                     key=self._primary_key), dest
             )
         except OSError:
             pass
+
+    def _stream_out(self, payload: bytes) -> bytes:
+        """Frame one outbound TCP stream body: encryptMsg-wrapped on an
+        encrypted fleet, plaintext otherwise."""
+        if self._primary_key is None:
+            return payload
+        return wire.encrypt_stream_frame(self._primary_key, payload)
 
     # ------------------------------------------------------------- UDP loop
 
@@ -244,7 +274,7 @@ class MemberlistPool(Pool):
             except OSError:
                 return
             try:
-                msgs = wire.ingest_packet(data)
+                msgs = wire.ingest_packet(data, keyring=self._keyring)
             except wire.WireError as exc:
                 log.debug("bad packet from %s: %s", src, exc)
                 continue
@@ -286,10 +316,22 @@ class MemberlistPool(Pool):
             log.debug("unhandled msg type %d", t)
 
     @staticmethod
-    def _reply_addr(m: Dict[str, Any], src: Tuple[str, int]) -> Tuple[str, int]:
-        sa, sp = m.get("SourceAddr"), m.get("SourcePort")
-        if isinstance(sa, bytes) and len(sa) == 4 and sp:
-            return socket.inet_ntoa(sa), int(sp)
+    def _ntop(addr: Any) -> Optional[str]:
+        """4- or 16-byte wire address -> presentation form (None when
+        neither) — IPv6 members carry 16-byte Addr/SourceAddr/Target."""
+        if isinstance(addr, bytes):
+            if len(addr) == 4:
+                return socket.inet_ntoa(addr)
+            if len(addr) == 16:
+                return socket.inet_ntop(socket.AF_INET6, addr)
+        return None
+
+    @classmethod
+    def _reply_addr(cls, m: Dict[str, Any], src: Tuple[str, int]) -> Tuple[str, int]:
+        host = cls._ntop(m.get("SourceAddr"))
+        sp = m.get("SourcePort")
+        if host and sp:
+            return host, int(sp)
         return src
 
     def _on_ack(self, m: Dict[str, Any]) -> None:
@@ -301,10 +343,10 @@ class MemberlistPool(Pool):
             entry[1](payload if isinstance(payload, bytes) else b"")
 
     def _on_indirect_ping(self, m: Dict[str, Any], src: Tuple[str, int]) -> None:
-        target_addr = m.get("Target", b"")
-        if not isinstance(target_addr, bytes) or len(target_addr) != 4:
+        target_host = self._ntop(m.get("Target", b""))
+        if target_host is None:
             return
-        dest = (socket.inet_ntoa(target_addr), int(m.get("Port", 0)))
+        dest = (target_host, int(m.get("Port", 0)))
         requester = self._reply_addr(m, src)
         orig_seq = int(m.get("SeqNo", 0))
         want_nack = bool(m.get("Nack", False))
@@ -324,7 +366,13 @@ class MemberlistPool(Pool):
                 if missed:
                     self._send_udp(_req, wire.encode_msg(
                         wire.NACK_RESP, {"SeqNo": _orig}))
-            threading.Timer(self.probe_timeout, nack_if_unanswered).start()
+            timer = threading.Timer(self.probe_timeout, nack_if_unanswered)
+            timer.daemon = True
+            with self._lock:
+                self._nack_timers = [
+                    t for t in self._nack_timers if t.is_alive()]
+                self._nack_timers.append(timer)
+            timer.start()
         self._send_udp(dest, wire.encode_msg(wire.PING, {
             "SeqNo": my_seq, "Node": m.get("Node", ""),
             "SourceAddr": self._addr_bytes, "SourcePort": self.bound_port,
@@ -350,10 +398,11 @@ class MemberlistPool(Pool):
         if not name or not isinstance(addr, bytes) or len(addr) not in (4, 16):
             return
         if name == self.name:
-            with self._lock:
-                me = self._nodes[self.name]
+            with self._lock:  # compare under the lock: a concurrent
+                me = self._nodes[self.name]  # _refute must not race the
                 same = addr == me.addr and port == me.port and meta == me.meta
-            if inc >= me.incarnation and not same:
+                stale = inc < me.incarnation  # incarnation read
+            if not stale and not same:
                 self._refute(inc)  # someone is gossiping a stale identity
             return
         changed = False
@@ -383,8 +432,12 @@ class MemberlistPool(Pool):
         if name == self.name:
             # staleness rule: a claim older than our incarnation is a
             # replay of an already-refuted rumor — ignoring it (as the
-            # Go state machine does) stops incarnation churn
-            if inc >= self._incarnation:
+            # Go state machine does) stops incarnation churn. Read the
+            # incarnation under the lock so a concurrent _refute cannot
+            # race the comparison.
+            with self._lock:
+                stale = inc < self._incarnation
+            if not stale:
                 self._refute(inc)
             return
         now = time.monotonic()
@@ -397,9 +450,12 @@ class MemberlistPool(Pool):
             cur.incarnation = inc
             cur.state_change = now
             n = len(self._nodes)
+            # ceil like hashicorp/memberlist's suspicionTimeout — the raw
+            # log would shorten the window up to ~40% at 10-99 nodes and
+            # over-declare DEAD under packet loss
             cur.suspicion_deadline = now + (
                 self.suspicion_mult
-                * max(1.0, math.log10(max(n, 1) + 1))
+                * max(1.0, math.ceil(math.log10(max(n, 1) + 1)))
                 * self.probe_interval
             )
         self._queue_broadcast(name, wire.encode_msg(wire.SUSPECT, {
@@ -567,13 +623,14 @@ class MemberlistPool(Pool):
             with socket.create_connection(
                 node.endpoint(), timeout=self.probe_timeout
             ) as conn:
-                conn.sendall(wire.encode_msg(wire.PING, {
+                conn.sendall(self._stream_out(wire.encode_msg(wire.PING, {
                     "SeqNo": seq, "Node": node.name,
                     "SourceAddr": self._addr_bytes,
                     "SourcePort": self.bound_port, "SourceNode": self.name,
-                }))
+                })))
                 conn.settimeout(self.probe_timeout)
-                t, parsed = _read_stream_message(conn, self.probe_timeout)
+                t, parsed = _read_stream_message(conn, self.probe_timeout,
+                                                 keyring=self._keyring)
                 if t != wire.ACK_RESP:
                     return False
                 return int(parsed.get("SeqNo", -1)) == seq
@@ -617,8 +674,10 @@ class MemberlistPool(Pool):
     def push_pull(self, host: str, port: int, join: bool = False) -> int:
         """One TCP state exchange with host:port; returns nodes merged."""
         with socket.create_connection((host, port), timeout=5.0) as conn:
-            conn.sendall(wire.encode_push_pull(self._local_states(), join))
-            t, parsed = _read_stream_message(conn, 5.0)
+            conn.sendall(self._stream_out(
+                wire.encode_push_pull(self._local_states(), join)))
+            t, parsed = _read_stream_message(conn, 5.0,
+                                             keyring=self._keyring)
         if t != wire.PUSH_PULL:
             raise wire.WireError(f"push/pull reply was msg type {t}")
         states, _join, _user = parsed
@@ -675,18 +734,20 @@ class MemberlistPool(Pool):
         try:
             with conn:
                 conn.settimeout(5.0)
-                t, parsed = _read_stream_message(conn, 5.0)
+                t, parsed = _read_stream_message(conn, 5.0,
+                                                 keyring=self._keyring)
                 if t == wire.PUSH_PULL:
                     states, _join, _user = parsed
                     # reply first: the peer reads our state before merging
-                    conn.sendall(
-                        wire.encode_push_pull(self._local_states(), False))
+                    conn.sendall(self._stream_out(
+                        wire.encode_push_pull(self._local_states(), False)))
                     self._merge_states(states)
                     self._push_update()
                 elif t == wire.PING:
-                    conn.sendall(wire.encode_msg(wire.ACK_RESP, {
-                        "SeqNo": parsed.get("SeqNo", 0), "Payload": b"",
-                    }))
+                    conn.sendall(self._stream_out(wire.encode_msg(
+                        wire.ACK_RESP, {
+                            "SeqNo": parsed.get("SeqNo", 0), "Payload": b"",
+                        })))
         except (OSError, wire.WireError, msgpack.OutOfData, ValueError,
                 TypeError, KeyError, OverflowError) as exc:
             log.debug("stream conn failed: %s", exc)
@@ -755,6 +816,10 @@ class MemberlistPool(Pool):
             except Exception:  # noqa: BLE001
                 pass
         self._closed.set()
+        with self._lock:
+            timers, self._nack_timers = self._nack_timers, []
+        for timer in timers:  # pending nacks must not outlive the sockets
+            timer.cancel()
         for sock in (self._udp, self._tcp):
             try:
                 sock.close()
@@ -803,18 +868,60 @@ class _StreamBuf:
         return bytes(out)
 
 
-def _read_stream_message(conn: socket.socket, timeout: float) -> Tuple[int, Any]:
+_MAX_STREAM_ENC = 1 << 25  # like memberlist's maxPushStateBytes bound
+
+
+def _parse_stream_bytes(data: bytes, depth: int = 0) -> Tuple[int, Any]:
+    """Parse one fully-buffered stream message (the decrypted form) ->
+    (type, parsed); same contract as _read_stream_message."""
+    if not data:
+        raise wire.WireError("empty stream message")
+    if depth > 2:
+        raise wire.WireError("stream nesting too deep")
+    t = data[0]
+    if t == wire.COMPRESS:
+        body = wire.decode_body(t, data[1:])
+        if body.get("Algo", 0) != 0:
+            raise wire.WireError("unknown stream compression algo")
+        raw = body.get("Buf", b"")
+        if not isinstance(raw, bytes) or not raw:
+            raise wire.WireError("empty compressed stream")
+        return _parse_stream_bytes(wire.lzw_decompress(raw), depth + 1)
+    if t == wire.ENCRYPT:
+        raise wire.WireError("nested encrypted stream")
+    if t == wire.PUSH_PULL:
+        return t, wire.decode_push_pull(data[1:])
+    return t, wire.decode_body(t, data[1:])
+
+
+def _read_stream_message(
+    conn: socket.socket, timeout: float,
+    keyring: Optional[List[bytes]] = None,
+) -> Tuple[int, Any]:
     """Read one framed message off a TCP stream -> (type, parsed).
 
     parsed is (states, join, user_state) for PUSH_PULL and the body dict
     for everything else.  Handles the compressMsg wrapping a
     default-config Go sender applies to whole streams:
     [0x09][msgpack compress{Algo,Buf}] where Buf decompresses to
-    [real type][real body]."""
+    [real type][real body], and — under a keyring — the encryptMsg
+    stream frame [0x0a][u32 length][vsn|nonce|ct] whose 5-byte header is
+    the GCM AAD (security.go decryptRemoteState). An encrypted fleet
+    refuses plaintext streams (GossipVerifyIncoming's default)."""
     r = _StreamBuf(conn, time.monotonic() + timeout)
     first = r.read_exact(1)[0]
     if first == wire.ENCRYPT:
-        raise wire.WireError("encrypted stream (no keyring configured)")
+        if not keyring:
+            raise wire.WireError("encrypted stream (no keyring configured)")
+        size_bytes = r.read_exact(4)
+        n = struct.unpack(">I", size_bytes)[0]
+        if not 0 < n <= _MAX_STREAM_ENC:
+            raise wire.WireError("encrypted stream length out of range")
+        aad = bytes([wire.ENCRYPT]) + size_bytes
+        plain = wire.decrypt_payload(keyring, r.read_exact(n), aad=aad)
+        return _parse_stream_bytes(plain)
+    if keyring:
+        raise wire.WireError("plaintext stream on an encrypted fleet")
     if first == wire.COMPRESS:
         body = wire._norm(wire.COMPRESS, r.next_obj())
         if body.get("Algo", 0) != 0:
@@ -822,15 +929,7 @@ def _read_stream_message(conn: socket.socket, timeout: float) -> Tuple[int, Any]
         raw = body.get("Buf", b"")
         if not isinstance(raw, bytes) or not raw:
             raise wire.WireError("empty compressed stream")
-        inner = wire.lzw_decompress(raw)
-        if not inner:
-            raise wire.WireError("empty stream message")
-        t = inner[0]
-        if t == wire.ENCRYPT:
-            raise wire.WireError("encrypted stream (no keyring configured)")
-        if t == wire.PUSH_PULL:
-            return t, wire.decode_push_pull(inner[1:])
-        return t, wire.decode_body(t, inner[1:])
+        return _parse_stream_bytes(wire.lzw_decompress(raw))
     if first == wire.PUSH_PULL:
         header = wire._norm(wire.PUSH_PULL, r.next_obj())
         n = int(header.get("Nodes", 0))

@@ -275,6 +275,101 @@ def lzw_decompress(data: bytes, max_out: int = MAX_DECOMPRESSED) -> bytes:
             raise WireError("lzw: output over limit")
 
 
+# ---------------------------------------------------------------- encryption
+# hashicorp/memberlist packet encryption (security.go): AES-GCM under a
+# keyring, payload = [version byte][12-byte nonce][ciphertext || 16-byte
+# tag]. Version 0 PKCS7-pads the plaintext to the AES block; version 1
+# (what protocol >= 2 speaks — our DEFAULT_VSN advertises protocol 2+)
+# sends it raw. On UDP the whole assembled packet is encrypted as the
+# OUTERMOST layer (AAD empty, v0.2.0 predates packet labels); on TCP the
+# stream body rides an [encryptMsg][u32 length][payload] frame whose
+# 5-byte header is the GCM AAD (security.go encryptLocalState /
+# decryptRemoteState).
+
+NONCE_SIZE = 12
+TAG_SIZE = 16
+_AES_BLOCK = 16
+ENC_V0 = 0
+ENC_V1 = 1
+
+
+def _aesgcm(key: bytes):
+    if len(key) not in (16, 24, 32):
+        raise WireError(
+            f"memberlist SecretKey must be 16, 24 or 32 bytes (AES-128/"
+            f"192/256), got {len(key)}")
+    try:
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    except ImportError as exc:  # pragma: no cover - baked into the image
+        raise WireError(f"AES-GCM unavailable: {exc}") from exc
+    return AESGCM(key)
+
+
+def encrypted_length(vsn: int, msg_len: int) -> int:
+    """Size of encrypt_payload's output for a plaintext of msg_len."""
+    if vsn == ENC_V0:  # PKCS7 always pads 1..16 bytes
+        pad = _AES_BLOCK - (msg_len % _AES_BLOCK)
+        return 1 + NONCE_SIZE + msg_len + pad + TAG_SIZE
+    return 1 + NONCE_SIZE + msg_len + TAG_SIZE
+
+
+def encrypt_payload(key: bytes, plaintext: bytes, aad: bytes = b"",
+                    vsn: int = ENC_V1, _nonce: Optional[bytes] = None
+                    ) -> bytes:
+    """[vsn][nonce][GCM ct||tag] with the keyring's primary key.
+    `_nonce` pins the nonce for golden-vector tests ONLY."""
+    if vsn not in (ENC_V0, ENC_V1):
+        raise WireError(f"unsupported encryption version {vsn}")
+    import os as _os
+    nonce = _os.urandom(NONCE_SIZE) if _nonce is None else _nonce
+    if len(nonce) != NONCE_SIZE:
+        raise WireError("bad nonce size")
+    if vsn == ENC_V0:
+        pad = _AES_BLOCK - (len(plaintext) % _AES_BLOCK)
+        plaintext = plaintext + bytes([pad]) * pad
+    ct = _aesgcm(key).encrypt(nonce, plaintext, aad or None)
+    return bytes([vsn]) + nonce + ct
+
+
+def decrypt_payload(keys: List[bytes], payload: bytes,
+                    aad: bytes = b"") -> bytes:
+    """Try every keyring key (newest-first, like memberlist's keyring)
+    against one [vsn][nonce][ct||tag] payload."""
+    if len(payload) < 1 + NONCE_SIZE + TAG_SIZE:
+        raise WireError("encrypted payload truncated")
+    vsn = payload[0]
+    if vsn not in (ENC_V0, ENC_V1):
+        raise WireError(f"unsupported encryption version {vsn}")
+    nonce = payload[1:1 + NONCE_SIZE]
+    ct = payload[1 + NONCE_SIZE:]
+    from cryptography.exceptions import InvalidTag
+    for key in keys:
+        try:
+            plain = _aesgcm(key).decrypt(nonce, ct, aad or None)
+            break
+        except InvalidTag:
+            continue
+    else:
+        raise WireError("no keyring key decrypts this payload")
+    if vsn == ENC_V0:
+        if not plain:
+            raise WireError("empty padded plaintext")
+        pad = plain[-1]
+        if not 1 <= pad <= _AES_BLOCK or len(plain) < pad:
+            raise WireError("bad PKCS7 padding")
+        plain = plain[:-pad]
+    return plain
+
+
+def encrypt_stream_frame(key: bytes, body: bytes, vsn: int = ENC_V1
+                         ) -> bytes:
+    """TCP framing: [encryptMsg][u32 BE encrypted-length][payload], the
+    5-byte header doubling as GCM AAD (security.go encryptLocalState)."""
+    header = bytes([ENCRYPT]) + struct.pack(
+        ">I", encrypted_length(vsn, len(body)))
+    return header + encrypt_payload(key, body, aad=header, vsn=vsn)
+
+
 # ---------------------------------------------------------------- packet assembly / ingest
 
 def wrap_compress(payload: bytes) -> bytes:
@@ -287,11 +382,13 @@ def wrap_crc(payload: bytes) -> bytes:
 
 
 def assemble_packet(
-    parts: List[bytes], compress: bool = True, crc: bool = True
+    parts: List[bytes], compress: bool = True, crc: bool = True,
+    key: Optional[bytes] = None
 ) -> bytes:
     """One UDP datagram from framed messages, the sender-side pipeline:
     compound (if >1) -> lzw (kept only if smaller, matching the Go
-    sender) -> crc (receivers with protocol max >= 5 verify it)."""
+    sender) -> crc (receivers with protocol max >= 5 verify it) ->
+    AES-GCM under `key` as the OUTERMOST layer (rawSendMsgPacket order)."""
     buf = parts[0] if len(parts) == 1 else make_compound(parts)
     if compress:
         comp = wrap_compress(buf)
@@ -299,16 +396,35 @@ def assemble_packet(
             buf = comp
     if crc:
         buf = wrap_crc(buf)
+    if key is not None:
+        buf = encrypt_payload(key, buf)
     return buf
 
 
-def ingest_packet(buf: bytes, depth: int = 0) -> List[Tuple[int, Dict[str, Any]]]:
+def ingest_packet(
+    buf: bytes, depth: int = 0, budget: Optional[List[int]] = None,
+    keyring: Optional[List[bytes]] = None
+) -> List[Tuple[int, Dict[str, Any]]]:
     """Decode one UDP datagram into [(msg_type, body), ...], unwrapping
-    crc / compress / compound recursively the way the Go receiver does."""
+    crc / compress / compound recursively the way the Go receiver does.
+    A `keyring` decrypts the whole datagram FIRST (encryption is the
+    outermost layer; an encrypted fleet rejects plaintext, matching
+    GossipVerifyIncoming's default).
+
+    `budget` is a shared one-element mutable cell of decompressed bytes
+    remaining for the WHOLE datagram: without it, a compound of 255
+    compress parts could turn one 64 KB datagram into ~1 GB of
+    sequential LZW work and stall the single receive thread."""
     if depth > 4:
         raise WireError("packet nesting too deep")
     if not buf:
         return []
+    if depth == 0 and keyring:
+        buf = decrypt_payload(keyring, buf)
+        if not buf:
+            return []
+    if budget is None:
+        budget = [MAX_DECOMPRESSED]
     t = buf[0]
     if t == HAS_CRC:
         if len(buf) < 5:
@@ -316,7 +432,7 @@ def ingest_packet(buf: bytes, depth: int = 0) -> List[Tuple[int, Dict[str, Any]]
         want = struct.unpack(">I", buf[1:5])[0]
         if zlib.crc32(buf[5:]) != want:
             raise WireError("crc mismatch")
-        return ingest_packet(buf[5:], depth + 1)
+        return ingest_packet(buf[5:], depth + 1, budget)
     if t == COMPRESS:
         body = decode_body(t, buf[1:])
         if body.get("Algo", 0) != 0:
@@ -324,11 +440,15 @@ def ingest_packet(buf: bytes, depth: int = 0) -> List[Tuple[int, Dict[str, Any]]
         raw = body.get("Buf", b"")
         if not isinstance(raw, bytes):
             raise WireError("compress.Buf is not bytes")
-        return ingest_packet(lzw_decompress(raw), depth + 1)
+        if budget[0] <= 0:
+            raise WireError("datagram decompression budget exhausted")
+        out = lzw_decompress(raw, max_out=budget[0])
+        budget[0] -= len(out)
+        return ingest_packet(out, depth + 1, budget)
     if t == COMPOUND:
         msgs: List[Tuple[int, Dict[str, Any]]] = []
         for part in split_compound(buf[1:]):
-            msgs.extend(ingest_packet(part, depth + 1))
+            msgs.extend(ingest_packet(part, depth + 1, budget))
         return msgs
     if t == ENCRYPT:
         raise WireError("encrypted packet (no keyring configured)")

@@ -148,6 +148,10 @@ def build_pool(conf: DaemonConfig, instance: Instance):
                 guber_port = int(grpc_addr.rsplit(":", 1)[-1])
             except ValueError:
                 guber_port = int(conf.grpc_address.rsplit(":", 1)[-1])
+            import base64 as _b64
+
+            ring = [_b64.b64decode(k)
+                    for k in conf.memberlist_secret_keys]
             return MemberlistPool(
                 bind_address=bind,
                 node_name=conf.memberlist_node_name
@@ -156,6 +160,8 @@ def build_pool(conf: DaemonConfig, instance: Instance):
                 gubernator_port=guber_port,
                 known_nodes=conf.gossip_known_nodes,
                 datacenter=conf.data_center,
+                secret_key=ring[0] if ring else b"",
+                secret_keys=ring[1:],
             )
         return discovery.GossipPool(
             bind_address=bind,

@@ -107,6 +107,10 @@ class DaemonConfig:
     # GossipPool (same role, own wire format).
     memberlist_compat: bool = True
     memberlist_node_name: str = ""  # default: hostname
+    # base64 AES key(s) for memberlist packet encryption (16/24/32 bytes
+    # decoded), primary first — hashicorp SecretKey/Keyring semantics
+    memberlist_secret_keys: List[str] = dataclasses.field(
+        default_factory=list)
     etcd_endpoints: List[str] = dataclasses.field(default_factory=list)
     etcd_advertise_address: str = ""  # defaults to advertise_address
     etcd_key_prefix: str = ""  # "" -> the pool's /gubernator/peers/ default
@@ -207,6 +211,7 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         gossip_known_nodes=_env_slice("GUBER_MEMBERLIST_KNOWN_NODES"),
         memberlist_compat=_env_str("GUBER_MEMBERLIST_COMPAT", "1") != "0",
         memberlist_node_name=_env_str("GUBER_MEMBERLIST_NODE_NAME"),
+        memberlist_secret_keys=_env_slice("GUBER_MEMBERLIST_SECRET_KEYS"),
         etcd_endpoints=_env_slice("GUBER_ETCD_ENDPOINTS"),
         etcd_advertise_address=_env_str("GUBER_ETCD_ADVERTISE_ADDRESS"),
         etcd_key_prefix=_env_str("GUBER_ETCD_KEY_PREFIX"),

@@ -461,6 +461,96 @@ class TestDegradation:
         assert s.queue_hit(_greq("st", 1))
         s.close()
 
+    def test_repeated_flap_conserves_hits(self):
+        """Property: across REPEATED stall/resume cycles with live GLOBAL
+        traffic, every issued hit lands in the owner's authoritative
+        bucket exactly once — whether it rode the collective, was
+        requeued by the stall watchdog, or fell back to gRPC while the
+        fabric was down (VERDICT r4 item 10). No loss, no double-count,
+        admitted never exceeds the limit."""
+
+        class GatedFabric(FakeFabric):
+            """FakeFabric whose exchange can be frozen (a dead peer mid-
+            collective): every entering host blocks until the gate opens."""
+
+            def __init__(self, k, capacity):
+                super().__init__(k, capacity)
+                self.gate = threading.Event()
+                self.gate.set()
+
+            def exchange(self, idx, delta, claim, state):
+                assert self.gate.wait(60)
+                return super().exchange(idx, delta, claim, state)
+
+        cluster = LocalCluster().start(2)
+        fabric = GatedFabric(2, 64)
+        syncs = []
+        try:
+            for i, ci in enumerate(cluster.instances):
+                s = CollectiveGlobalSync(
+                    ci.instance, fabric.endpoints[i], interval_s=3600,
+                    stall_timeout_s=0.05)
+                ci.instance.attach_collective(s)
+                ci.instance.global_manager._hits._wait_s = 3600
+                ci.instance.global_manager._broadcasts._wait_s = 3600
+                syncs.append(s)
+            owner, non, key = _owner_nonowner(cluster)
+            limit = 100
+
+            r = non.instance.get_rate_limits([_greq(key, hits=5, limit=limit)])[0]
+            assert r.status == Status.UNDER_LIMIT
+            issued = 5
+            for _ in range(3):  # claim -> peek -> broadcast: cache primed
+                lockstep(syncs)
+            assert len(non.instance._global_cache) == 1
+
+            for flap in range(4):
+                # live traffic on a healthy fabric
+                non.instance.get_rate_limits([_greq(key, hits=2, limit=limit)])
+                issued += 2
+                # the fabric dies mid-exchange: both hosts' ticks block
+                fabric.gate.clear()
+                ts = [threading.Thread(target=s.tick) for s in syncs]
+                for t in ts:
+                    t.start()
+                deadline = time.time() + 5
+                while any(s._tick_started is None for s in syncs) \
+                        and time.time() < deadline:
+                    time.sleep(0.005)
+                # traffic while blocked-but-not-stalled: accepted pending
+                non.instance.get_rate_limits([_greq(key, hits=3, limit=limit)])
+                issued += 3
+                time.sleep(0.08)  # cross the stall timeout
+                for s in syncs:  # the watchdog requeues pending -> gRPC
+                    assert s.health_error() and "stalled" in s.health_error()
+                # traffic while stalled: intake refused -> gRPC fallback
+                non.instance.get_rate_limits([_greq(key, hits=4, limit=limit)])
+                issued += 4
+                # the fabric comes back; the blocked exchange completes
+                fabric.gate.set()
+                for t in ts:
+                    t.join(timeout=30)
+                assert not any(t.is_alive() for t in ts), \
+                    f"flap {flap}: tick wedged"
+                lockstep(syncs)  # clean tick: intake resumes, drains
+                assert all(s.health_error() is None for s in syncs)
+
+            for _ in range(2):  # drain any still-pending collective hits
+                lockstep(syncs)
+            for ci in cluster.instances:  # flush the frozen gRPC pipelines
+                ci.instance.global_manager.flush()
+
+            peek = owner.instance.get_rate_limits(
+                [_greq(key, hits=0, limit=limit)])[0]
+            assert issued <= limit  # the property's precondition
+            assert peek.remaining == limit - issued, (
+                f"conservation broke: issued {issued}, authoritative "
+                f"remaining {peek.remaining} (want {limit - issued})")
+        finally:
+            for s in syncs:
+                s.close()
+            cluster.stop()
+
     def test_stall_watchdog_surfaces_in_health(self, duo):
         cluster, syncs = duo
         s = syncs[0]
