@@ -224,7 +224,7 @@ def main() -> None:
                 np.zeros(BATCH_WIDTH, np.int32),
                 np.zeros(BATCH_WIDTH, np.int32)))
         K_SERVE = 128
-        N_BUF = 5  # buffer ring; up to 3 cycles stay in flight (auto-tuned)
+        N_BUF = 6  # buffer ring; up to 4 cycles stay in flight (auto-tuned)
         lanes = [[None] * K_SERVE for _ in range(N_BUF)]
         iws = [np.empty((K_SERVE, BATCH_WIDTH), np.int32)
                for _ in range(N_BUF)]
@@ -361,7 +361,7 @@ def main() -> None:
         # pipelines hide more link jitter until queueing stops paying
         depth_probe = {}
         w_base = 2 * K_SERVE
-        for depth in (2, 3):
+        for depth in (2, 3, 4):
             t0 = time.perf_counter()
             run(4, w_base, depth=depth)
             depth_probe[depth] = (time.perf_counter() - t0) / 4

@@ -100,12 +100,21 @@ def build_backend(conf: DaemonConfig):
 
 
 def _make_loader(conf: DaemonConfig):
-    """Durable bucket snapshots via GUBER_SNAPSHOT_PATH (both backends)."""
+    """Durable bucket snapshots via GUBER_SNAPSHOT_PATH (both backends).
+
+    Binary slab format by default (10×+ faster at production scale;
+    restore time is boot time after a crash) — a legacy JSONL file at the
+    path still restores (auto-detected) and is migrated binary on the
+    next save. GUBER_SNAPSHOT_FORMAT=jsonl pins the text format."""
     if not conf.snapshot_path:
         return None
-    from gubernator_tpu.store import FileLoader
+    if conf.snapshot_format == "jsonl":
+        from gubernator_tpu.store import FileLoader
 
-    return FileLoader(conf.snapshot_path)
+        return FileLoader(conf.snapshot_path)
+    from gubernator_tpu.store import BinarySnapshotLoader
+
+    return BinarySnapshotLoader(conf.snapshot_path)
 
 
 def build_pool(conf: DaemonConfig, instance: Instance):

@@ -145,6 +145,7 @@ class DaemonConfig:
     # durable bucket snapshot: load at boot, save at shutdown (FileLoader;
     # the reference leaves persistence to the user, README.md:159-175)
     snapshot_path: str = ""
+    snapshot_format: str = "binary"  # or "jsonl" (legacy text format)
     # device-level tracing (no reference analogue): live profiler server
     # port, and a dir for a capture spanning the daemon's lifetime
     profile_port: int = 0
@@ -237,6 +238,7 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         min_batch_width=_env_int("GUBER_MIN_BATCH_WIDTH", 64),
         max_batch_width=_env_int("GUBER_MAX_BATCH_WIDTH", 8192),
         snapshot_path=_env_str("GUBER_SNAPSHOT_PATH"),
+        snapshot_format=_env_str("GUBER_SNAPSHOT_FORMAT", "binary"),
         profile_port=_env_int("GUBER_PROFILE_PORT", 0),
         profile_dir=_env_str("GUBER_PROFILE_DIR"),
         collectives=_env_str("GUBER_COLLECTIVES", "psum"),

@@ -237,7 +237,10 @@ class Engine:
                 " (compact cannot be pinned — ineligible windows need the"
                 " wide format)")
         if loader is not None:
-            self.load_snapshot(loader.load())
+            if hasattr(loader, "load_slabs"):
+                self.load_snapshot_slabs(loader.load_slabs())
+            else:
+                self.load_snapshot(loader.load())
 
     # ------------------------------------------------------------------ API
 
@@ -640,21 +643,71 @@ class Engine:
                 n += len(chunk)
         return n
 
+    def load_snapshot_slabs(self, slabs) -> int:
+        """Binary restore: consume (key_blob, key_offsets i64[m+1],
+        rows i64[m, 7]) chunks — snapshot_slabs' shape — with no per-row
+        host objects. Same locking contract as load_snapshot (the lock is
+        taken per inject chunk, never while pulling the source)."""
+        lookup_raw = getattr(self.directory, "lookup_raw", None)
+        n = 0
+        for blob, off, rows in slabs:
+            off = np.asarray(off, np.int64)
+            rows = np.asarray(rows, np.int64)
+            m = len(off) - 1
+            for s in range(0, m, self.max_width):
+                e = min(s + self.max_width, m)
+                cnt = e - s
+                r = rows[s:e]
+                with self._lock:
+                    if lookup_raw is not None:
+                        sub = bytes(blob[off[s]:off[e]])
+                        slots, _fresh, _inj = lookup_raw(
+                            sub, off[s:e + 1] - off[s])
+                        slots = slots.astype(np.int64)
+                    else:
+                        keys = [blob[off[i]:off[i + 1]].decode("utf-8")
+                                for i in range(s, e)]
+                        got, _ = self.directory.lookup(keys)
+                        slots = np.asarray(got, np.int64)
+                    w = _bucket_width(cnt, self.min_width, self.max_width)
+                    pad = w - cnt
+
+                    def col(c, dtype):
+                        return jnp.asarray(
+                            np.pad(c, (0, pad)).astype(dtype))
+
+                    self.state = self._inject(
+                        self.state,
+                        jnp.asarray(np.pad(slots, (0, pad),
+                                           constant_values=-1), I32),
+                        col(r[:, 0], np.int32), col(r[:, 1], np.int64),
+                        col(r[:, 2], np.int64), col(r[:, 3], np.int64),
+                        col(r[:, 4], np.int64), col(r[:, 5], np.int64),
+                        col(r[:, 6], np.int32),
+                    )
+                    n += cnt
+        return n
+
     # ~16 MB of rows per device->host slab: the streamed snapshot's peak
     # host footprint per step, and one compiled slice program total
     _SNAPSHOT_SLAB_ROWS = 1 << 18
 
-    def snapshot_stream(self, include_expired: bool = False):
-        """Stream live rows (reference: gubernator.go:86-105 Close/save).
+    def snapshot_slabs(self, include_expired: bool = False):
+        """Stream live rows as binary SLABS (reference: gubernator.go:86-105
+        Close/save): yields (key_blob: bytes, key_offsets: i64[m+1],
+        rows: i64[m, 7]) chunks with NO per-row host objects — the 10×
+        lever over JSONL at production scale (VERDICT r4 item 5). Row
+        field order matches BucketSnapshot: algo, limit, remaining,
+        duration, stamp, expire_at, status.
 
         The naive dump at production scale is ruinous twice over: one
         gather dispatch per 8192-key chunk (1,200+ launches at 10M keys)
         and a fully-materialized list of 10M dataclasses (gigabytes of
         host objects). This generator fetches the table in fixed-shape
         row SLABS (one compiled dynamic-slice program, ~16 MB per fetch),
-        filters each slab vectorized in numpy, and yields only the live
-        rows' snapshots — peak extra host memory is one slab plus its
-        live subset, regardless of table size. Rows stream in slot order.
+        filters each slab vectorized in numpy, and emits only the live
+        rows — peak extra host memory is one slab plus its live subset,
+        regardless of table size. Rows stream in slot order.
 
         Locking: the engine lock is taken PER SLAB, never across a yield
         (a suspended or leaked generator must not wedge the engine — the
@@ -662,8 +715,8 @@ class Engine:
         quiesced engine (shutdown, the normal snapshot moment) the cut is
         exact; under live traffic each slab is internally consistent and
         an entry whose slot was recycled between the directory walk and
-        its slab is re-validated and skipped rather than attributed to
-        the wrong key."""
+        its slab is re-validated (one batch peek per slab) and skipped
+        rather than attributed to the wrong key."""
         now = millisecond_now()
         with self._lock:
             if hasattr(self.directory, "mirror_flush"):
@@ -674,19 +727,46 @@ class Engine:
                     if not len(inj):
                         break
                     self._apply_inject_rows(inj)
-            entries = self.directory.items()
-        if not entries:
+            if hasattr(self.directory, "items_raw"):
+                blob, off, slots32 = self.directory.items_raw()
+            else:  # python-twin directory: build the arena once
+                entries = self.directory.items()
+                keys_b = [k.encode("utf-8") for k, _ in entries]
+                blob = b"".join(keys_b)
+                off = np.zeros(len(keys_b) + 1, np.int64)
+                if keys_b:
+                    np.cumsum([len(b) for b in keys_b], out=off[1:])
+                slots32 = np.fromiter((s for _, s in entries), np.int32,
+                                      count=len(entries))
+        n = len(slots32)
+        if n == 0:
             return
-        keys = [k for k, _ in entries]
-        slots = np.fromiter((s for _, s in entries), np.int64,
-                            count=len(entries))
+        off = np.asarray(off, np.int64)
+        lens = off[1:] - off[:-1]
+        slots = slots32.astype(np.int64)
         order = np.argsort(slots, kind="stable")
-        slots = slots[order]
+        slots_sorted = slots[order]
         S = min(self._SNAPSHOT_SLAB_ROWS, self.capacity)
         slab_fn = _jit_slab(S)
-        check_slot = getattr(self.directory, "peek_slot", None)
+        batch_peek = getattr(self.directory, "peek_slots_raw", None)
+        peek_one = getattr(self.directory, "peek_slot", None)
+        blob_arr = np.frombuffer(blob, np.uint8)
+
+        def gather_keys(sel):
+            """Vectorized sub-arena build: the selected keys' bytes and
+            offsets without a python loop over 256K slices."""
+            ln = lens[sel]
+            sub_off = np.zeros(sel.size + 1, np.int64)
+            np.cumsum(ln, out=sub_off[1:])
+            total = int(sub_off[-1])
+            # absolute byte positions: each key's start repeated over its
+            # length, plus the within-key offset
+            pos = np.repeat(off[sel] - sub_off[:-1], ln) + \
+                np.arange(total, dtype=np.int64)
+            return blob_arr[pos].tobytes(), sub_off
+
         for a in range(0, self.capacity, S):
-            lo, hi = np.searchsorted(slots, (a, a + S))
+            lo, hi = np.searchsorted(slots_sorted, (a, a + S))
             if lo == hi:
                 continue  # no directory entries in this row range
             # dynamic_slice CLAMPS an out-of-range start: fetch the
@@ -695,20 +775,45 @@ class Engine:
             cs = min(a, self.capacity - S)
             with self._lock:
                 slab = np.asarray(slab_fn(self.state, cs))
-            ent_slots = slots[lo:hi]
+            idx = order[lo:hi]  # original entry index, slot order
+            ent_slots = slots_sorted[lo:hi]
             rows = slab[ent_slots - cs]  # [n, 8] in slot order
             live = rows[:, 0] >= 0  # algo < 0 marks a vacant row
             if not include_expired:
                 live &= rows[:, 5] >= now
-            for j in np.flatnonzero(live):
-                key = keys[order[lo + j]]
-                if check_slot is not None and \
-                        check_slot(key) != int(ent_slots[j]):
-                    continue  # slot recycled mid-dump: not this key's row
+            sel = idx[live]
+            if sel.size == 0:
+                continue
+            ent_sel = ent_slots[live].astype(np.int32)
+            sub_blob, sub_off = gather_keys(sel)
+            # slot recycled mid-dump: not this key's row anymore
+            if batch_peek is not None:
+                okm = batch_peek(sub_blob, sub_off) == ent_sel
+            elif peek_one is not None:
+                okm = np.fromiter(
+                    (peek_one(sub_blob[sub_off[k]:sub_off[k + 1]]
+                              .decode("utf-8")) == int(s)
+                     for k, s in enumerate(ent_sel)), bool, count=sel.size)
+            else:
+                okm = np.ones(sel.size, bool)
+            rows_live = rows[live]
+            if not okm.all():
+                keep = np.flatnonzero(okm)
+                sub_blob, sub_off = gather_keys(sel[keep])
+                rows_live = rows_live[keep]
+            yield sub_blob, sub_off, np.ascontiguousarray(rows_live[:, :7])
+
+    def snapshot_stream(self, include_expired: bool = False):
+        """Stream live rows as BucketSnapshots — the object-level view of
+        snapshot_slabs (same walk, same ordering, same consistency
+        contract); slab-level consumers (the binary Loader) should use
+        snapshot_slabs directly and skip 10M dataclass constructions."""
+        for blob, off, rows in self.snapshot_slabs(include_expired):
+            for j in range(len(off) - 1):
                 r = rows[j]
                 yield BucketSnapshot(
-                    key=key, algo=int(r[0]),
-                    limit=int(r[1]), remaining=int(r[2]),
+                    key=blob[off[j]:off[j + 1]].decode("utf-8"),
+                    algo=int(r[0]), limit=int(r[1]), remaining=int(r[2]),
                     duration=int(r[3]), stamp=int(r[4]),
                     expire_at=int(r[5]), status=int(r[6]))
 
@@ -719,9 +824,14 @@ class Engine:
 
     def close(self) -> None:
         """Persist via the Loader, mirroring daemon shutdown
-        (reference: gubernator.go:86-105)."""
+        (reference: gubernator.go:86-105). A slab-capable Loader gets the
+        binary stream (no per-row objects); plain Loaders keep the
+        BucketSnapshot SPI."""
         if self.loader is not None:
-            self.loader.save(self.snapshot_stream())
+            if hasattr(self.loader, "save_slabs"):
+                self.loader.save_slabs(self.snapshot_slabs())
+            else:
+                self.loader.save(self.snapshot_stream())
 
     # ------------------------------------------------------------- internals
 

@@ -524,6 +524,20 @@ int32_t keydir_peek(void* kd, const char* key, int32_t len) {
     return static_cast<KeyDir*>(kd)->peek(key, len);
 }
 
+// Batch peek for the streamed binary snapshot: one GIL-free pass verifies
+// a whole slab's slot attributions (keydir_peek per row would pay 10M
+// ctypes crossings at production scale). Never touches LRU order.
+int64_t keydir_peek_batch(void* kd, const char* keys, const int64_t* offsets,
+                          int64_t n, int32_t* slots_out) {
+    KeyDir* d = static_cast<KeyDir*>(kd);
+    for (int64_t i = 0; i < n; ++i) {
+        slots_out[i] = d->peek(
+            keys + offsets[i],
+            static_cast<int32_t>(offsets[i + 1] - offsets[i]));
+    }
+    return n;
+}
+
 int64_t keydir_dump(void* kd, char* key_buf, int64_t buf_cap, int64_t* offsets,
                     int32_t* slots, int64_t max_items) {
     return static_cast<KeyDir*>(kd)->dump(key_buf, buf_cap, offsets, slots,

@@ -34,8 +34,10 @@ def rss_mb() -> float:
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--keys", type=int, default=10_000_000)
-    ap.add_argument("--path", default="/tmp/guber_snapshot_bench.jsonl")
+    ap.add_argument("--path", default="/tmp/guber_snapshot_bench.snap")
     ap.add_argument("--platform", default="cpu", choices=["cpu", "default"])
+    ap.add_argument("--format", default="binary",
+                    choices=["binary", "jsonl"])
     args = ap.parse_args()
 
     if args.platform == "cpu":
@@ -44,7 +46,11 @@ def main() -> int:
         jax.config.update("jax_platforms", "cpu")
 
     from gubernator_tpu.models.engine import Engine
-    from gubernator_tpu.store import BucketSnapshot, FileLoader
+    from gubernator_tpu.store import (
+        BinarySnapshotLoader,
+        BucketSnapshot,
+        FileLoader,
+    )
 
     N = args.keys
     NOW = 4_000_000_000_000  # far future: nothing expires mid-bench
@@ -56,7 +62,8 @@ def main() -> int:
                 duration=3_600_000, stamp=NOW - 1000, expire_at=NOW,
                 status=0)
 
-    out = {"bench": "snapshot_10m", "keys": N, "rss0_mb": round(rss_mb(), 1)}
+    out = {"bench": "snapshot_10m", "keys": N, "format": args.format,
+           "rss0_mb": round(rss_mb(), 1)}
 
     eng = Engine(capacity=N, min_width=64, max_width=8192)
     t0 = time.perf_counter()
@@ -64,9 +71,14 @@ def main() -> int:
     out["seed_s"] = round(time.perf_counter() - t0, 2)
     assert n == N
 
-    loader = FileLoader(args.path)
-    t0 = time.perf_counter()
-    loader.save(eng.snapshot_stream())
+    if args.format == "binary":
+        loader = BinarySnapshotLoader(args.path)
+        t0 = time.perf_counter()
+        loader.save_slabs(eng.snapshot_slabs())
+    else:
+        loader = FileLoader(args.path)
+        t0 = time.perf_counter()
+        loader.save(eng.snapshot_stream())
     out["save_s"] = round(time.perf_counter() - t0, 2)
     out["file_mb"] = round(os.path.getsize(args.path) / 1e6, 1)
     out["rss_after_save_mb"] = round(rss_mb(), 1)
@@ -74,7 +86,10 @@ def main() -> int:
 
     eng2 = Engine(capacity=N, min_width=64, max_width=8192)
     t0 = time.perf_counter()
-    n2 = eng2.load_snapshot(loader.load())
+    if args.format == "binary":
+        n2 = eng2.load_snapshot_slabs(loader.load_slabs())
+    else:
+        n2 = eng2.load_snapshot(loader.load())
     out["restore_s"] = round(time.perf_counter() - t0, 2)
     assert n2 == N, (n2, N)
 

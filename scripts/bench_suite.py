@@ -692,6 +692,58 @@ def main(argv=None) -> int:
             finally:
                 svc.close()
 
+        def bench_grpc_herd_fairness():
+            # VERDICT r4 item 8: are the ~50 ms grpcio herd p99s a server
+            # fairness problem or client-library queuing? On this 1-core
+            # rig the herd processes cannot be pinned off the server's
+            # core, so the discriminating experiment runs a LEAN probe
+            # client (native LinkClient, ~10 µs client cost) through the
+            # SAME server at low offered load DURING the grpcio herd:
+            # an unfair/slow server would collapse the probe's p99 along
+            # with the herd's; a fair server serving self-queued grpcio
+            # clients keeps the probe fast while grpcio reports ~50 ms.
+            import threading as _t
+
+            from gubernator_tpu.service.peerlink import (
+                METHOD_GET_RATE_LIMITS,
+                PeerLinkClient,
+            )
+
+            ci = rng.choice(cluster.instances)
+            svc = _start_grpc_front(ci)
+            probe_lat = []
+            stop = _t.Event()
+
+            def prober():
+                cli = PeerLinkClient(f"127.0.0.1:{svc.port}")
+                try:
+                    r = [req("fair_probe", "probe_key", limit=1 << 30,
+                             duration=3_600_000)]
+                    cli.call(METHOD_GET_RATE_LIMITS, r, 30.0)  # warm
+                    while not stop.is_set():
+                        t0 = time.perf_counter()
+                        cli.call(METHOD_GET_RATE_LIMITS, r, 30.0)
+                        probe_lat.append((time.perf_counter() - t0) * 1e3)
+                        stop.wait(0.005)  # ~low offered load
+                finally:
+                    cli.close()
+
+            th = _t.Thread(target=prober, daemon=True)
+            th.start()
+            try:
+                out = run_herd_mp(f"127.0.0.1:{svc.grpc_port}",
+                                  args.seconds)
+            finally:
+                stop.set()
+                th.join(timeout=10)
+                svc.close()
+            lat = sorted(probe_lat)
+            out["probe_rpcs"] = len(lat)
+            out["probe_p50_ms"] = round(_percentile(lat, 0.50), 3)
+            out["probe_p99_ms"] = round(_percentile(lat, 0.99), 3)
+            out["client"] = "4-proc grpcio herd + concurrent lean probe"
+            return out
+
         def bench_multi_region():
             return run_serial(
                 lambda: client.get_rate_limits(
@@ -803,6 +855,7 @@ def main(argv=None) -> int:
             "grpc_native_wire_rps": bench_grpc_native_wire_rps,
             "grpc_native_herd_mp": bench_grpc_native_herd_mp,
             "grpc_native_routed_herd_mp": bench_grpc_native_routed_herd_mp,
+            "grpc_herd_fairness": bench_grpc_herd_fairness,
             "leaky_bucket": bench_leaky_bucket,
             "global_mode": bench_global_mode,
             "gregorian": bench_gregorian,

@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Memberlist live-interop proof (VERDICT r4 item 6): a reference Go
+# gubernator and a gubernator_tpu node must discover each other over the
+# real hashicorp/memberlist wire and route a GLOBAL limit across the
+# implementation boundary.
+#
+# Usage:  GUBER_REFERENCE_PATH=/path/to/mailgun-gubernator \
+#           ./scripts/interop/run_interop.sh
+#
+# Requires Docker + docker compose and network egress to build the two
+# images. Exits 0 on proof, non-zero with a diagnostic otherwise.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+: "${GUBER_REFERENCE_PATH:?set GUBER_REFERENCE_PATH to the reference Go checkout}"
+
+cleanup() { docker compose down -v --remove-orphans >/dev/null 2>&1 || true; }
+trap cleanup EXIT
+
+echo "== building images"
+docker compose build
+
+echo "== starting the mixed fleet"
+docker compose up -d
+
+REF=http://127.0.0.1:8180
+TPU=http://127.0.0.1:8280
+
+peers() {  # $1 = base url -> peer count from the health check
+  curl -sf "$1/v1/HealthCheck" | python3 -c \
+    'import json,sys; d=json.load(sys.stdin); print(d.get("peerCount", d.get("peer_count", 0)))' \
+    2>/dev/null || echo 0
+}
+
+echo "== waiting for mutual discovery (both health checks at 2 peers)"
+for i in $(seq 1 60); do
+  R=$(peers "$REF"); T=$(peers "$TPU")
+  [ "$R" = 2 ] && [ "$T" = 2 ] && break
+  sleep 2
+done
+R=$(peers "$REF"); T=$(peers "$TPU")
+if [ "$R" != 2 ] || [ "$T" != 2 ]; then
+  echo "FAIL: discovery incomplete (reference sees $R peers, tpu sees $T)"
+  docker compose logs --tail 50
+  exit 1
+fi
+echo "ok: each side lists the other as a peer"
+
+echo "== driving a GLOBAL limit across the boundary"
+BODY='{"requests":[{"name":"interop","uniqueKey":"k1","hits":"1","limit":"10","duration":"60000","behavior":2}]}'
+for i in $(seq 1 6); do
+  curl -sf -X POST "$TPU/v1/GetRateLimits" \
+    -H 'Content-Type: application/json' -d "$BODY" >/dev/null
+done
+sleep 3  # let the async GLOBAL pipeline broadcast
+PEEK='{"requests":[{"name":"interop","uniqueKey":"k1","hits":"0","limit":"10","duration":"60000","behavior":2}]}'
+REMAIN=$(curl -sf -X POST "$REF/v1/GetRateLimits" \
+  -H 'Content-Type: application/json' -d "$PEEK" | python3 -c \
+  'import json,sys; print(json.load(sys.stdin)["responses"][0]["remaining"])')
+if [ "$REMAIN" -ge 10 ]; then
+  echo "FAIL: reference never saw the tpu node's GLOBAL hits (remaining=$REMAIN)"
+  exit 1
+fi
+echo "ok: GLOBAL hits from the tpu node visible at the reference node (remaining=$REMAIN)"
+echo "PASS: memberlist wire interop + cross-impl GLOBAL"

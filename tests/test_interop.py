@@ -1,0 +1,53 @@
+"""Gated live-interop test (VERDICT r4 item 6).
+
+The memberlist wire tier (cluster/mlwire.py, cluster/memberlist.py) is
+golden-vector- and fuzz-tested, but those vectors are self-derived: the
+residual risk that they encode a shared misreading of the Go protocol can
+only be closed by exchanging packets with a REAL hashicorp/memberlist
+process. That needs Docker + egress, which this build environment does
+not have — so the harness (scripts/interop/) ships runnable and this
+test runs it only where an operator opts in:
+
+    GUBER_INTEROP_DOCKER=1 \
+    GUBER_REFERENCE_PATH=/path/to/mailgun-gubernator \
+        python -m pytest tests/test_interop.py -v
+
+Skipped (not failed) everywhere else, so CI stays green without Docker.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+HARNESS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts", "interop", "run_interop.sh")
+
+
+@pytest.mark.skipif(
+    os.environ.get("GUBER_INTEROP_DOCKER") != "1",
+    reason="live Docker interop opt-in (set GUBER_INTEROP_DOCKER=1 and "
+           "GUBER_REFERENCE_PATH; needs Docker + network egress)")
+def test_memberlist_live_interop_with_reference():
+    assert os.environ.get("GUBER_REFERENCE_PATH"), \
+        "GUBER_REFERENCE_PATH must point at the reference Go checkout"
+    proc = subprocess.run(
+        ["bash", HARNESS], capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, \
+        f"interop harness failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "PASS: memberlist wire interop" in proc.stdout
+
+
+def test_harness_files_present_and_wired():
+    """The harness itself must stay shippable: compose file + script
+    exist, the script is executable-shaped, and the compose file names
+    both sides of the fleet."""
+    assert os.path.exists(HARNESS)
+    with open(HARNESS) as f:
+        body = f.read()
+    assert "GUBER_REFERENCE_PATH" in body and "GetRateLimits" in body
+    compose = os.path.join(os.path.dirname(HARNESS), "docker-compose.yaml")
+    with open(compose) as f:
+        comp = f.read()
+    assert "reference:" in comp and "tpu:" in comp
+    assert "GUBER_MEMBERLIST_KNOWN_NODES" in comp
