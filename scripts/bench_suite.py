@@ -714,6 +714,11 @@ def main(argv=None) -> int:
             probe_lat = []
             stop = _t.Event()
 
+            def probe_once(cli, r, sink):
+                t0 = time.perf_counter()
+                cli.call(METHOD_GET_RATE_LIMITS, r, 30.0)
+                sink.append((time.perf_counter() - t0) * 1e3)
+
             def prober():
                 cli = PeerLinkClient(f"127.0.0.1:{svc.port}")
                 try:
@@ -721,12 +726,25 @@ def main(argv=None) -> int:
                              duration=3_600_000)]
                     cli.call(METHOD_GET_RATE_LIMITS, r, 30.0)  # warm
                     while not stop.is_set():
-                        t0 = time.perf_counter()
-                        cli.call(METHOD_GET_RATE_LIMITS, r, 30.0)
-                        probe_lat.append((time.perf_counter() - t0) * 1e3)
-                        stop.wait(0.005)  # ~low offered load
+                        probe_once(cli, r, probe_lat)
+                        stop.wait(0.005)  # low offered load
                 finally:
                     cli.close()
+
+            # baseline: the same probe ALONE (no herd) — the un-contended
+            # floor the mixed-load numbers are read against
+            base_lat = []
+            cli0 = PeerLinkClient(f"127.0.0.1:{svc.port}")
+            try:
+                r0 = [req("fair_probe", "probe_key", limit=1 << 30,
+                          duration=3_600_000)]
+                cli0.call(METHOD_GET_RATE_LIMITS, r0, 30.0)
+                t_end = time.perf_counter() + min(2.0, args.seconds)
+                while time.perf_counter() < t_end:
+                    probe_once(cli0, r0, base_lat)
+                    time.sleep(0.005)
+            finally:
+                cli0.close()
 
             th = _t.Thread(target=prober, daemon=True)
             th.start()
@@ -738,9 +756,14 @@ def main(argv=None) -> int:
                 th.join(timeout=10)
                 svc.close()
             lat = sorted(probe_lat)
+            base = sorted(base_lat)
             out["probe_rpcs"] = len(lat)
-            out["probe_p50_ms"] = round(_percentile(lat, 0.50), 3)
-            out["probe_p99_ms"] = round(_percentile(lat, 0.99), 3)
+            out["probe_alone_p50_ms"] = round(_percentile(base, 0.50), 3)
+            out["probe_alone_p99_ms"] = round(_percentile(base, 0.99), 3)
+            out["probe_during_herd_p50_ms"] = round(
+                _percentile(lat, 0.50), 3)
+            out["probe_during_herd_p99_ms"] = round(
+                _percentile(lat, 0.99), 3)
             out["client"] = "4-proc grpcio herd + concurrent lean probe"
             return out
 

@@ -241,6 +241,94 @@ _KEYDIR_STRESS = textwrap.dedent("""
 """)
 
 
+_GRPC_FRONT_FUZZ = textwrap.dedent("""
+    import ctypes, random, socket, struct, sys, threading
+    lib = ctypes.CDLL(sys.argv[1])
+    c = ctypes
+    lib.pls_start.restype = c.c_void_p
+    lib.pls_start.argtypes = [c.c_int, c.POINTER(c.c_int)]
+    lib.pls_stop.argtypes = [c.c_void_p]
+    lib.pls_free.argtypes = [c.c_void_p]
+    lib.pls_start_grpc.restype = c.c_int
+    lib.pls_start_grpc.argtypes = [c.c_void_p, c.c_int, c.c_char_p]
+
+    port = c.c_int(0)
+    h = lib.pls_start(0, c.byref(port))
+    assert h
+    gp = lib.pls_start_grpc(h, 0, b"")
+    assert gp > 0
+
+    PRE = b"PRI * HTTP/2.0\\r\\n\\r\\nSM\\r\\n\\r\\n"
+    def fr(t, flags, sid, payload=b""):
+        return (struct.pack(">I", len(payload))[1:] + bytes([t, flags])
+                + struct.pack(">I", sid) + payload)
+    def lit(n, v):
+        return bytes([0, len(n)]) + n + bytes([len(v)]) + v
+    HDRS = (lit(b":method", b"POST") + lit(b":scheme", b"http")
+            + lit(b":path", b"/pb.gubernator.V1/HealthCheck")
+            + lit(b":authority", b"t")
+            + lit(b"content-type", b"application/grpc"))
+    VALID = (PRE + fr(4, 0, 0) + fr(1, 0x4, 1, HDRS)
+             + fr(0, 0x1, 1, b"\\x00" + struct.pack(">I", 0)))
+    BOMBS = [b"\\x80", b"\\x3f" + b"\\xff" * 12,
+             b"\\x00\\x85garb\\xff\\x85" + b"\\xff" * 5,
+             b"\\xff\\xff\\xff\\xff\\xff\\x7f"]
+
+    def fuzzer(seed):
+        # malformed H2/HPACK under TSan: IO thread parses while other
+        # connections churn — the race surface the single-threaded fuzz
+        # campaign (test_grpc_front) cannot see
+        rng = random.Random(seed)
+        for i in range(250):
+            try:
+                s = socket.create_connection(("127.0.0.1", gp), timeout=5)
+                kind = i % 3
+                if kind == 0:
+                    s.sendall(PRE + rng.randbytes(rng.randrange(1, 200)))
+                elif kind == 1:
+                    m = bytearray(VALID)
+                    for _ in range(rng.randrange(1, 5)):
+                        m[rng.randrange(len(PRE), len(m))] = rng.randrange(256)
+                    s.sendall(bytes(m))
+                else:
+                    s.sendall(PRE + fr(4, 0, 0)
+                              + fr(1, 0x4, 1, BOMBS[i % len(BOMBS)]))
+                if i % 7 == 0:
+                    s.settimeout(0.05)
+                    try:
+                        s.recv(4096)
+                    except OSError:
+                        pass
+                s.close()
+            except OSError:
+                pass
+
+    def health(n):
+        # concurrent VALID HealthChecks (C-cached) race the fuzzers'
+        # connection churn through the same epoll loop
+        for i in range(n):
+            try:
+                s = socket.create_connection(("127.0.0.1", gp), timeout=5)
+                s.sendall(VALID)
+                s.settimeout(0.5)
+                try:
+                    s.recv(8192)
+                except OSError:
+                    pass
+                s.close()
+            except OSError:
+                pass
+
+    ts = [threading.Thread(target=fuzzer, args=(t,)) for t in range(4)]
+    ts += [threading.Thread(target=health, args=(150,))]
+    [t.start() for t in ts]
+    [t.join(timeout=240) for t in ts]
+    lib.pls_stop(h)
+    lib.pls_free(h)
+    print("GRPC_FRONT_FUZZ_OK")
+""")
+
+
 @pytest.mark.skipif(LIBTSAN is None, reason="libtsan not installed")
 @pytest.mark.parametrize("name,src,prefix,extra,script,sentinel", [
     ("peerlink", "peerlink.cpp", "_tsan_peerlink_", (),
@@ -248,6 +336,8 @@ _KEYDIR_STRESS = textwrap.dedent("""
     ("keydir", "keydir.cpp", "_tsan_keydir_",
      ("-I" + __import__("sysconfig").get_paths()["include"],),
      _KEYDIR_STRESS, "KEYDIR_STRESS_OK"),
+    ("grpc_front", "peerlink.cpp", "_tsan_peerlink_", (),
+     _GRPC_FRONT_FUZZ, "GRPC_FRONT_FUZZ_OK"),
 ])
 def test_tsan_clean(tmp_path, name, src, prefix, extra, script, sentinel):
     lib = _tsan_lib(src, prefix, extra)
