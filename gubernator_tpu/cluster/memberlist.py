@@ -143,14 +143,26 @@ class MemberlistPool(Pool):
         self._last_pushed: Optional[List[PeerInfo]] = None
         self._leaving = False
 
-        # --- sockets (UDP + TCP share the port, like memberlist)
-        self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
-        self._udp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._udp.bind(self.bind)
-        self._udp.settimeout(0.2)
-        self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        self._tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        self._tcp.bind((self.bind[0], self._udp.getsockname()[1]))
+        # --- sockets (UDP + TCP share the port, like memberlist). With
+        # an ephemeral bind (port 0) the kernel picks the UDP port first
+        # and the matching TCP port may already belong to someone else —
+        # retry with a fresh ephemeral pick instead of failing the pool.
+        for attempt in range(16):
+            self._udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            self._udp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._udp.bind(self.bind)
+            self._udp.settimeout(0.2)
+            self._tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            try:
+                self._tcp.bind((self.bind[0], self._udp.getsockname()[1]))
+            except OSError:
+                self._udp.close()
+                self._tcp.close()
+                if self.bind[1] != 0 or attempt == 15:
+                    raise  # a FIXED port in use is the operator's error
+                continue
+            break
         self._tcp.listen(16)
         self._tcp.settimeout(0.2)
         self.bound_port = self._udp.getsockname()[1]
