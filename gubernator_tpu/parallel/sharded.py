@@ -52,7 +52,12 @@ from gubernator_tpu.ops.decide import (
     ROW_STATUS,
     TableState,
     decide_packed,
+    decide_packed_lean,
     decide_scan_packed,
+    decide_scan_packed_lean,
+    lean_capacity_ok,
+    lean_window,
+    widen_compact_out,
     pack_window,
 )
 from gubernator_tpu.parallel.global_sync import (
@@ -143,6 +148,64 @@ def make_decide_sharded_scan(plan: MeshPlan, donate: bool = False):
         _step, mesh=plan.mesh,
         in_specs=(spec_state, spec_io, P()),
         out_specs=(spec_state, spec_io),
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def make_decide_sharded_lean(plan: MeshPlan, donate: bool = False):
+    """Lean-lane variant of make_decide_sharded (r5): fn(state [R,S,C,8],
+    lanes i32[R,S,W], cfg i64[128,4], now) -> (state, out i32[R,S,4,W]).
+
+    The staging buffer drops from 72 B to 4 B per lane — on a multi-chip
+    host the host->device transfer is the window's dominant byte cost,
+    and the lean lane cuts it 18x for the dominant serving shape
+    (hits=1, few configs; ops/decide.py "lean"). Slots are shard-LOCAL
+    (each chip's lane slice indexes its own table shard, same as the
+    wide path); the config table is fleet-global and replicated."""
+    spec_state = P(REGION_AXIS, SHARD_AXIS, None, None)
+    spec_lanes = P(REGION_AXIS, SHARD_AXIS, None)
+    spec_out = P(REGION_AXIS, SHARD_AXIS, None, None)
+
+    def _step(state: TableState, lanes: jax.Array, cfg: jax.Array,
+              now: jax.Array):
+        local_state = state.reshape(state.shape[-2:])
+        new_state, out = decide_packed_lean(
+            local_state, lanes.reshape(lanes.shape[-1:]), cfg, now)
+        return (
+            new_state.reshape((1, 1) + new_state.shape),
+            out.reshape(1, 1, *out.shape),
+        )
+
+    mapped = jax.shard_map(
+        _step, mesh=plan.mesh,
+        in_specs=(spec_state, spec_lanes, P(), P()),
+        out_specs=(spec_state, spec_out),
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def make_decide_sharded_scan_lean(plan: MeshPlan, donate: bool = False):
+    """Scan-coalesced lean variant: fn(state, lanes i32[R,S,K,W], cfg,
+    now) -> (state, out i32[R,S,K,4,W]) — K lean windows per shard in one
+    dispatch (see make_decide_sharded_scan for the rounds ordering)."""
+    spec_state = P(REGION_AXIS, SHARD_AXIS, None, None)
+    spec_lanes = P(REGION_AXIS, SHARD_AXIS, None, None)
+    spec_out = P(REGION_AXIS, SHARD_AXIS, None, None, None)
+
+    def _step(state: TableState, lanes_k: jax.Array, cfg: jax.Array,
+              now: jax.Array):
+        local_state = state.reshape(state.shape[-2:])
+        new_state, out = decide_scan_packed_lean(
+            local_state, lanes_k.reshape(lanes_k.shape[-2:]), cfg, now)
+        return (
+            new_state.reshape((1, 1) + new_state.shape),
+            out.reshape(1, 1, *out.shape),
+        )
+
+    mapped = jax.shard_map(
+        _step, mesh=plan.mesh,
+        in_specs=(spec_state, spec_lanes, P(), P()),
+        out_specs=(spec_state, spec_out),
     )
     return jax.jit(mapped, donate_argnums=(0,) if donate else ())
 
@@ -243,6 +306,20 @@ class ShardedEngine:
         self.state = make_sharded_table(self.plan)
         self._decide = make_decide_sharded(self.plan, donate=donate)
         self._decide_scan = make_decide_sharded_scan(self.plan, donate=donate)
+        self._decide_lean = make_decide_sharded_lean(self.plan,
+                                                     donate=donate)
+        self._decide_scan_lean = make_decide_sharded_scan_lean(
+            self.plan, donate=donate)
+        # staging policy, same contract as models/engine.py: auto ships
+        # eligible windows on the 4 B/lane lean wire; wide pins i64[9]
+        import os as _os
+
+        self._staging = _os.environ.get("GUBER_STAGING", "auto")
+        if self._staging not in ("auto", "wide"):
+            raise ValueError(
+                f"GUBER_STAGING={self._staging!r}: must be 'auto' or"
+                " 'wide'")
+        self._lean_ok = lean_capacity_ok(capacity_per_shard)
         self._sync = make_global_sync(self.plan, donate=donate,
                                       collectives=collectives)
         self.store = store
@@ -301,6 +378,7 @@ class ShardedEngine:
             "global_mirror_answers": 0,
             "global_evictions": 0,
             "global_registry_fallbacks": 0,
+            "lean_windows": 0,  # windows shipped on the 4 B/lane wire
         }
         # per-stage wall clocks, same contract as models/engine.py
         # EngineStats (exposed as engine_stage_seconds_total in /metrics)
@@ -327,15 +405,26 @@ class ShardedEngine:
         widths.append(self.max_width)
         resp = None
         with self._lock:
+            lean_warm = self._staging != "wide" and self._lean_ok
             for width in widths:
                 packed = np.zeros((R, S, 9, width), np.int64)
                 packed[:, :, 0, :] = -1
                 self.state, resp = self._decide(self.state, packed, 0)
+                if lean_warm:  # auto mode serves either wire format
+                    ln = lean_window(packed, self.plan.capacity_per_shard)
+                    self.state, resp = self._decide_lean(
+                        self.state, jnp.asarray(ln[0]),
+                        jnp.asarray(ln[1]), 0)
             k = 2
             while k <= self._MAX_SCAN:
                 packed = np.zeros((R, S, k, 9, self.min_width), np.int64)
                 packed[:, :, :, 0, :] = -1
                 self.state, resp = self._decide_scan(self.state, packed, 0)
+                if lean_warm:
+                    ln = lean_window(packed, self.plan.capacity_per_shard)
+                    self.state, resp = self._decide_scan_lean(
+                        self.state, jnp.asarray(ln[0]),
+                        jnp.asarray(ln[1]), 0)
                 k *= 2
             if self.store is not None:
                 # the Store path adds two gathers + an inject per window
@@ -492,7 +581,7 @@ class ShardedEngine:
                 out, placed = self._pack_and_decide(
                     cols, lane_item, owner_count, now_ms, t1)
                 t3 = time.perf_counter_ns()
-                out = np.asarray(out)  # readback sync
+                out = self._fetch_mesh(out)  # readback sync
                 t4 = time.perf_counter_ns()
                 self.stats["device_ns"] += t4 - t3
                 self._demux(out, placed, responses)
@@ -557,9 +646,10 @@ class ShardedEngine:
         """Pack owner-major staging cols into the [R,S,9,w] mesh buffer
         and dispatch one shard_map'ped window — the ONE copy of the mesh
         packing contract, shared by the object and columnar fast paths.
-        Returns (out_device, placed) with placed rows (r, s, None, lanes).
-        Caller holds the lock; `t1` is the pack-start clock; pack/rounds/
-        dispatch stats recorded here, readback+demux by the caller."""
+        Returns (_dispatch_mesh handle, placed) with placed rows
+        (r, s, None, lanes); readback via _fetch_mesh. Caller holds the
+        lock; `t1` is the pack-start clock; pack/rounds/dispatch stats
+        recorded here, readback+demux by the caller."""
         R, S = self.plan.n_regions, self.plan.n_shards
         counts = owner_count.tolist()
         w = bucket_width(max(counts), self.min_width, self.max_width)
@@ -578,9 +668,9 @@ class ShardedEngine:
         t2 = time.perf_counter_ns()
         self.stats["pack_ns"] += t2 - t1
         self.stats["rounds"] += 1
-        self.state, out = self._decide(self.state, packed, now_ms)
+        handle = self._dispatch_mesh(packed, now_ms)
         self.stats["device_ns"] += time.perf_counter_ns() - t2
-        return out, placed
+        return handle, placed
 
     def complete_columnar(self, handle, out_status, out_limit,
                           out_remaining, out_reset) -> np.ndarray:
@@ -590,7 +680,7 @@ class ShardedEngine:
         out, placed, leftover, n0 = handle
         if n0:
             t0 = time.perf_counter_ns()
-            rows = np.asarray(out)  # device sync for THIS window
+            rows = self._fetch_mesh(out)  # device sync for THIS window
             t1 = time.perf_counter_ns()
             over = 0
             for r_, s_, _k, lanes in placed:
@@ -943,8 +1033,7 @@ class ShardedEngine:
                                  pre=window_pre(lanes))
 
             t = time.perf_counter_ns()
-            self.state, out = self._decide_scan(self.state, packed, now_ms)
-            out = np.asarray(out)
+            out = self._fetch_mesh(self._dispatch_mesh_scan(packed, now_ms))
             t2 = time.perf_counter_ns()
             self.stats["device_ns"] += t2 - t
             self._demux(out, placed, responses)
@@ -953,6 +1042,50 @@ class ShardedEngine:
         if store_ctx is not None:
             per_owner, slotmat = store_ctx
             self._store_write_through_mesh(per_owner, slotmat, now_ms)
+
+    # -------------------------------------------------- staging dispatch
+    # Every mesh window funnels through these helpers so the wide/lean
+    # wire-format switch lives in one place (models/engine.py has the
+    # single-chip twin). The handle defers the device sync: the columnar
+    # path reads it back in complete_columnar, everyone else via
+    # _fetch_mesh immediately.
+
+    def _dispatch_mesh(self, packed: np.ndarray, now_ms):
+        """One wide i64[R,S,9,w] window, shipped on the 4 B/lane lean
+        wire when eligible. Returns an opaque handle for _fetch_mesh."""
+        if self._staging != "wide" and self._lean_ok:
+            ln = lean_window(packed, self.plan.capacity_per_shard)
+            if ln is not None:
+                self.stats["lean_windows"] += 1
+                self.state, out = self._decide_lean(
+                    self.state, jnp.asarray(ln[0]), jnp.asarray(ln[1]),
+                    now_ms)
+                return out, now_ms
+        self.state, out = self._decide(self.state, packed, now_ms)
+        return out, None
+
+    def _dispatch_mesh_scan(self, stacked: np.ndarray, now_ms):
+        """decide_scan dispatch of a wide i64[R,S,K,9,w] stack, shipped
+        lean when eligible. Handle contract matches _dispatch_mesh."""
+        if self._staging != "wide" and self._lean_ok:
+            ln = lean_window(stacked, self.plan.capacity_per_shard)
+            if ln is not None:
+                self.stats["lean_windows"] += 1
+                self.state, out = self._decide_scan_lean(
+                    self.state, jnp.asarray(ln[0]), jnp.asarray(ln[1]),
+                    now_ms)
+                return out, now_ms
+        self.state, out = self._decide_scan(self.state, stacked, now_ms)
+        return out, None
+
+    @staticmethod
+    def _fetch_mesh(handle) -> np.ndarray:
+        """Block on a dispatched mesh window and return the wide i64
+        response rows regardless of which wire format carried it."""
+        out, lean_now = handle
+        if lean_now is not None:
+            return widen_compact_out(np.asarray(out), lean_now)
+        return np.asarray(out)
 
     def _apply_round(self, round_work: List[WorkItem], now_ms, responses,
                      pre=None, lanes=None) -> None:
@@ -976,8 +1109,7 @@ class ShardedEngine:
         self._pack_lanes(lanes, w, packed, placed, None, pre=pre)
 
         t = time.perf_counter_ns()
-        self.state, out = self._decide(self.state, packed, now_ms)
-        out = np.asarray(out)
+        out = self._fetch_mesh(self._dispatch_mesh(packed, now_ms))
         t2 = time.perf_counter_ns()
         self.stats["device_ns"] += t2 - t
         self._demux(out, placed, responses)
@@ -1085,8 +1217,7 @@ class ShardedEngine:
                for owner, _r, _s, _items, _keys, slots, fresh in per_owner}
         self._pack_lanes(lanes, w, packed, placed, None, pre=pre)
         t2 = time.perf_counter_ns()
-        self.state, out = self._decide(self.state, packed, now_ms)
-        out = np.asarray(out)
+        out = self._fetch_mesh(self._dispatch_mesh(packed, now_ms))
         t3 = time.perf_counter_ns()
         self.stats["device_ns"] += t3 - t2
         self._demux(out, placed, responses)

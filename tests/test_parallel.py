@@ -86,6 +86,56 @@ def test_differential_vs_single_engine():
             ), f"divergence at step {step}"
 
 
+def test_lean_mesh_wire_engages_and_stays_bit_exact():
+    """The 4 B/lane lean wire (r5) must carry the dominant serving shape
+    over the mesh — hits=1, few configs — and produce byte-identical
+    decisions to the single-table engine; mixed windows (peeks,
+    multi-hit) must fall back to the wide wire, also bit-exact."""
+    rng = random.Random(9)
+    single = Engine(capacity=4096)
+    sharded = ShardedEngine(n_shards=4, n_regions=2, capacity_per_shard=1024)
+    assert sharded.stats["lean_windows"] == 0
+    keys = [f"lk{i}" for i in range(60)]
+    # phase 1: pure serving shape -> every mesh window rides lean
+    for step in range(8):
+        now = NOW + step * 500
+        batch = [_req(rng.choice(keys), hits=1,
+                      limit=rng.choice([5, 10, 20]))
+                 for _ in range(rng.randint(4, 24))]
+        a = single.get_rate_limits(batch, now_ms=now)
+        b = sharded.get_rate_limits(batch, now_ms=now)
+        assert [(r.status, r.limit, r.remaining, r.reset_time)
+                for r in a] == \
+            [(r.status, r.limit, r.remaining, r.reset_time) for r in b]
+    lean_after_phase1 = sharded.stats["lean_windows"]
+    assert lean_after_phase1 > 0, "lean wire never engaged"
+    # phase 2: ineligible lanes (hits=0 peeks, hits=3) -> wide fallback,
+    # still bit-exact, and the lean counter only moves for eligible
+    # windows
+    for step in range(6):
+        now = NOW + 10_000 + step * 500
+        batch = [_req(rng.choice(keys), hits=rng.choice([0, 3]),
+                      limit=10) for _ in range(rng.randint(4, 16))]
+        a = single.get_rate_limits(batch, now_ms=now)
+        b = sharded.get_rate_limits(batch, now_ms=now)
+        assert [(r.status, r.remaining) for r in a] == \
+            [(r.status, r.remaining) for r in b]
+    # a >128-distinct-config window cannot ride the 7-bit config id
+    wide_cfg = [_req(f"cfg{i}", hits=1, limit=1000 + i) for i in range(140)]
+    a = single.get_rate_limits(wide_cfg, now_ms=NOW + 50_000)
+    b = sharded.get_rate_limits(wide_cfg, now_ms=NOW + 50_000)
+    assert [(r.status, r.remaining) for r in a] == \
+        [(r.status, r.remaining) for r in b]
+
+
+def test_lean_mesh_wide_pin(monkeypatch):
+    """GUBER_STAGING=wide pins the wide wire on the mesh engine too."""
+    monkeypatch.setenv("GUBER_STAGING", "wide")
+    sharded = ShardedEngine(n_shards=2, capacity_per_shard=512)
+    sharded.get_rate_limits([_req("wp", hits=1)], now_ms=NOW)
+    assert sharded.stats["lean_windows"] == 0
+
+
 def test_duplicate_keys_in_batch(eng8):
     """Same-key requests in one batch observe each other (round splitting)."""
     reqs = [_req("dup", hits=3), _req("dup", hits=3), _req("dup", hits=3)]
