@@ -224,7 +224,7 @@ def main() -> None:
                 np.zeros(BATCH_WIDTH, np.int32),
                 np.zeros(BATCH_WIDTH, np.int32)))
         K_SERVE = 128
-        N_BUF = 6  # buffer ring; up to 4 cycles stay in flight (auto-tuned)
+        N_BUF = 8  # buffer ring; up to 6 cycles stay in flight (auto-tuned)
         lanes = [[None] * K_SERVE for _ in range(N_BUF)]
         iws = [np.empty((K_SERVE, BATCH_WIDTH), np.int32)
                for _ in range(N_BUF)]
@@ -357,15 +357,19 @@ def main() -> None:
 
         run(2, 0)  # warm + compile
         # auto-tune cycles-in-flight (VERDICT r4 item 2): probe each depth
-        # with a short run and serve the segments at the fastest — deeper
-        # pipelines hide more link jitter until queueing stops paying
+        # with a run long enough that (a) the queue actually FILLS (a
+        # probe shorter than ~2x the depth never engages backpressure and
+        # measures nothing) and (b) fill/tail amortize enough for a
+        # RELATIVE comparison — deeper pipelines hide more link jitter
+        # until queueing stops paying
         depth_probe = {}
         w_base = 2 * K_SERVE
-        for depth in (2, 3, 4):
+        PROBE_CYCLES = 12
+        for depth in (3, 6):
             t0 = time.perf_counter()
-            run(4, w_base, depth=depth)
-            depth_probe[depth] = (time.perf_counter() - t0) / 4
-            w_base += 4 * K_SERVE
+            run(PROBE_CYCLES, w_base, depth=depth)
+            depth_probe[depth] = (time.perf_counter() - t0) / PROBE_CYCLES
+            w_base += PROBE_CYCLES * K_SERVE
         depth = min(depth_probe, key=depth_probe.get)
         per_cycle = max(depth_probe[depth], 1e-6)
         # enough cycles that pipeline fill + the serial drain tail (~1.5

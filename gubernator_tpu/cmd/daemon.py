@@ -108,6 +108,10 @@ def _make_loader(conf: DaemonConfig):
     next save. GUBER_SNAPSHOT_FORMAT=jsonl pins the text format."""
     if not conf.snapshot_path:
         return None
+    if conf.snapshot_format not in ("binary", "jsonl"):
+        raise ValueError(
+            f"GUBER_SNAPSHOT_FORMAT={conf.snapshot_format!r}: must be"
+            " 'binary' or 'jsonl'")
     if conf.snapshot_format == "jsonl":
         from gubernator_tpu.store import FileLoader
 

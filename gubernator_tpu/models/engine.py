@@ -45,6 +45,7 @@ from gubernator_tpu.ops.decide import (
     decide_scan_packed_lean,
     lean_capacity_ok,
     lean_window,
+    staging_policy,
     decide_packed,
     decide_packed_compact,
     decide_scan_packed,
@@ -222,20 +223,11 @@ class Engine:
         self._lean_ok = lean_capacity_ok(capacity)
         self._inject = _jit_inject(donate)
         self._gather = _jit_gather()
-        # Staging wire-format policy: "auto" (default) ships each window in
-        # the compact i32[5, W] format whenever it is eligible (no gregorian
-        # lanes, values < 2^31) — 20+16 B/decision on the wire instead of
-        # 72+32 — and falls back to the wide i64[9, W] contract otherwise;
-        # GUBER_STAGING=wide pins the wide format (e.g. to rule the switch
-        # out while debugging). The two kernels are held bit-identical by
-        # TestCompactStaging's differential.
-        import os as _os
-        self._staging = _os.environ.get("GUBER_STAGING", "auto")
-        if self._staging not in ("auto", "wide"):
-            raise ValueError(
-                f"GUBER_STAGING={self._staging!r}: must be 'auto' or 'wide'"
-                " (compact cannot be pinned — ineligible windows need the"
-                " wide format)")
+        # Staging wire-format policy: "auto" (default) ships each window
+        # on the leanest eligible wire — lean i32[W] (4 B/lane), compact
+        # i32[5, W] (20 B/lane), wide i64[9, W] as the last resort — all
+        # held bit-identical by TestLeanStaging/TestCompactStaging.
+        self._staging = staging_policy()
         if loader is not None:
             if hasattr(loader, "load_slabs"):
                 self.load_snapshot_slabs(loader.load_slabs())

@@ -659,6 +659,22 @@ _LEAN_FRESH_SHIFT = 24
 _LEAN_CFG_SHIFT = 25
 
 
+def staging_policy() -> str:
+    """GUBER_STAGING resolution, shared by the single-chip and mesh
+    engines (one parse, one error message): 'auto' ships each window on
+    the leanest eligible wire format, 'wide' pins the i64[9] contract
+    (e.g. to rule the switch out while debugging)."""
+    import os
+
+    s = os.environ.get("GUBER_STAGING", "auto")
+    if s not in ("auto", "wide"):
+        raise ValueError(
+            f"GUBER_STAGING={s!r}: must be 'auto' or 'wide'"
+            " (lean/compact cannot be pinned — ineligible windows need"
+            " the wide format)")
+    return s
+
+
 def lean_capacity_ok(capacity: int) -> bool:
     """Slots must fit the 24-bit lane field with 0xFFFFFF reserved for
     padding — a deployment-time property, checked once per engine."""

@@ -57,6 +57,7 @@ from gubernator_tpu.ops.decide import (
     decide_scan_packed_lean,
     lean_capacity_ok,
     lean_window,
+    staging_policy,
     widen_compact_out,
     pack_window,
 )
@@ -312,13 +313,7 @@ class ShardedEngine:
             self.plan, donate=donate)
         # staging policy, same contract as models/engine.py: auto ships
         # eligible windows on the 4 B/lane lean wire; wide pins i64[9]
-        import os as _os
-
-        self._staging = _os.environ.get("GUBER_STAGING", "auto")
-        if self._staging not in ("auto", "wide"):
-            raise ValueError(
-                f"GUBER_STAGING={self._staging!r}: must be 'auto' or"
-                " 'wide'")
+        self._staging = staging_policy()
         self._lean_ok = lean_capacity_ok(capacity_per_shard)
         self._sync = make_global_sync(self.plan, donate=donate,
                                       collectives=collectives)

@@ -222,7 +222,16 @@ class BinarySnapshotLoader(Loader):
                 m = len(off) - 1
                 if m == 0:
                     continue
-                lens = (off[1:] - off[:-1]).astype(np.uint32)
+                raw_lens = off[1:] - off[:-1]
+                # loud save-time rejection of an inconsistent slab — a
+                # silent write here is data loss discovered only at the
+                # NEXT boot, after the live table is gone
+                if int(off[0]) != 0 or int(off[-1]) != len(blob) or \
+                        bool((raw_lens < 0).any()):
+                    raise ValueError(
+                        f"slab offsets inconsistent: span [{int(off[0])},"
+                        f" {int(off[-1])}] over a {len(blob)}-byte blob")
+                lens = raw_lens.astype(np.uint32)
                 rows = np.ascontiguousarray(np.asarray(rows, np.int64))
                 if rows.shape != (m, _SLAB_FIELDS):
                     raise ValueError(
@@ -298,24 +307,8 @@ class BinarySnapshotLoader(Loader):
 
     def _jsonl_slabs(self, chunk_rows: int = 8192):
         """Re-chunk a legacy JSONL snapshot into slab tuples."""
-        import numpy as np
-
-        it = iter(FileLoader(self.path).load())
-        while True:
-            batch = []
-            for snap in it:
-                batch.append(snap)
-                if len(batch) >= chunk_rows:
-                    break
-            if not batch:
-                return
-            keys_b = [s.key.encode("utf-8") for s in batch]
-            off = np.zeros(len(batch) + 1, np.int64)
-            np.cumsum([len(b) for b in keys_b], out=off[1:])
-            rows = np.array(
-                [[s.algo, s.limit, s.remaining, s.duration, s.stamp,
-                  s.expire_at, s.status] for s in batch], np.int64)
-            yield b"".join(keys_b), off, rows
+        return _snapshots_to_slabs(FileLoader(self.path).load(),
+                                   chunk_rows)
 
     # ------------------------------------------------------ Loader SPI
 
@@ -338,24 +331,29 @@ class BinarySnapshotLoader(Loader):
         return rows()
 
     def save(self, items: Iterable[BucketSnapshot]) -> None:
-        import numpy as np
+        self.save_slabs(_snapshots_to_slabs(items))
 
-        def slabs():
-            it = iter(items)
-            while True:
-                batch = []
-                for snap in it:
-                    batch.append(snap)
-                    if len(batch) >= 8192:
-                        break
-                if not batch:
-                    return
-                keys_b = [s.key.encode("utf-8") for s in batch]
-                off = np.zeros(len(batch) + 1, np.int64)
-                np.cumsum([len(b) for b in keys_b], out=off[1:])
-                rows = np.array(
-                    [[s.algo, s.limit, s.remaining, s.duration, s.stamp,
-                      s.expire_at, s.status] for s in batch], np.int64)
-                yield b"".join(keys_b), off, rows
 
-        self.save_slabs(slabs())
+def _snapshots_to_slabs(items: Iterable[BucketSnapshot],
+                        chunk_rows: int = 8192):
+    """BucketSnapshot stream -> (key_blob, offsets, rows) slab chunks —
+    the ONE batch-to-slab conversion, shared by BinarySnapshotLoader's
+    SPI save() and its JSONL import path."""
+    import numpy as np
+
+    it = iter(items)
+    while True:
+        batch = []
+        for snap in it:
+            batch.append(snap)
+            if len(batch) >= chunk_rows:
+                break
+        if not batch:
+            return
+        keys_b = [s.key.encode("utf-8") for s in batch]
+        off = np.zeros(len(batch) + 1, np.int64)
+        np.cumsum([len(b) for b in keys_b], out=off[1:])
+        rows = np.array(
+            [[s.algo, s.limit, s.remaining, s.duration, s.stamp,
+              s.expire_at, s.status] for s in batch], np.int64)
+        yield b"".join(keys_b), off, rows
