@@ -162,6 +162,47 @@ def test_memberlist_advertise_port(monkeypatch):
     assert conf.gossip_advertise_port == 7777
 
 
+def test_memberlist_secret_keys_build_the_keyring(monkeypatch):
+    """GUBER_MEMBERLIST_SECRET_KEYS (base64, primary first) must reach
+    the pool as a decoded keyring; bad base64 or a wrong-length key must
+    fail the boot loudly, not produce a silently-plaintext fleet."""
+    import base64
+
+    import pytest as _pytest
+
+    from gubernator_tpu.cmd.daemon import build_pool
+    from gubernator_tpu.cmd.envconf import config_from_env
+
+    primary = base64.b64encode(b"p" * 32).decode()
+    old = base64.b64encode(b"o" * 16).decode()
+    monkeypatch.setenv("GUBER_MEMBERLIST_ADVERTISE_ADDRESS", "127.0.0.1")
+    monkeypatch.setenv("GUBER_MEMBERLIST_ADVERTISE_PORT", "0")
+    monkeypatch.setenv("GUBER_MEMBERLIST_SECRET_KEYS",
+                       f"{primary},{old}")
+    conf = config_from_env([])
+    assert conf.memberlist_secret_keys == [primary, old]
+
+    class _Inst:
+        advertise_address = "127.0.0.1:9081"
+
+        def set_peers(self, peers):
+            pass
+
+    pool = build_pool(conf, _Inst())
+    try:
+        assert pool is not None
+        assert pool._keyring == [b"p" * 32, b"o" * 16]
+        assert pool._primary_key == b"p" * 32
+    finally:
+        pool.close()
+
+    # a wrong-length key must refuse the boot
+    monkeypatch.setenv("GUBER_MEMBERLIST_SECRET_KEYS",
+                       base64.b64encode(b"short").decode())
+    with _pytest.raises(ValueError):
+        build_pool(config_from_env([]), _Inst())
+
+
 def test_skip_verify_false_is_false(monkeypatch):
     """GUBER_ETCD_TLS_SKIP_VERIFY=false must not enable pinning (the
     reference treats any non-empty value as true, config.go:254 — we parse
