@@ -781,6 +781,61 @@ int32_t keydir_prep_pack_fast(void* kd, PyObject* items, int64_t* packed,
 // Returns n0 lanes packed into `packed` (zeroed i64[9, width], decide
 // staging rows), PREP_FALLBACK (n<=0 or n>width, nothing mutated), or
 // PREP_OVERCOMMIT.
+namespace {
+
+// Open-addressing set of 64-bit key fingerprints for the columnar preps'
+// in-window duplicate detection — an unordered_set<std::string> costs an
+// allocation + copy + compare per key (~40% of the per-item budget);
+// fnv1a64 of name + '_' + unique_key replaces it. A 64-bit collision
+// merely DEMOTES the later lane to the request-object pipeline
+// (unnecessary but correct — the same thing a real duplicate does), at
+// probability ~n^2/2^65 per window (~1e-12 at 8192 wide).
+struct FpSet {
+    std::vector<uint64_t> slots;  // 0 = empty (fp 0 remapped to 1)
+    uint64_t mask;
+
+    explicit FpSet(int32_t n) {
+        size_t cap = 64;
+        while (cap < static_cast<size_t>(n) * 2) cap <<= 1;
+        slots.assign(cap, 0);
+        mask = cap - 1;
+    }
+
+    // returns true when newly inserted (first occurrence)
+    bool insert(uint64_t fp) {
+        if (fp == 0) fp = 1;
+        uint64_t h = fp;
+        for (;;) {
+            uint64_t& s = slots[h & mask];
+            if (s == fp) return false;
+            if (s == 0) {
+                s = fp;
+                return true;
+            }
+            ++h;
+        }
+    }
+};
+
+inline uint64_t fnv1a64(uint64_t h, const char* p, int32_t len) {
+    for (int32_t i = 0; i < len; ++i) {
+        h ^= static_cast<unsigned char>(p[i]);
+        h *= 0x100000001b3ULL;
+    }
+    return h;
+}
+constexpr uint64_t FNV64_SEED = 0xcbf29ce484222325ULL;
+
+// One window lane's joined-key fingerprint (name + '_' + unique_key).
+inline uint64_t lane_fp(const char* keys, int32_t lo, int32_t nl,
+                        int32_t ul) {
+    uint64_t fp = fnv1a64(FNV64_SEED, keys + lo, nl);
+    fp = fnv1a64(fp, "_", 1);
+    return fnv1a64(fp, keys + lo + nl, ul);
+}
+
+}  // namespace
+
 int32_t keydir_prep_pack_columnar(
     void* kd, int32_t n, const char* keys, const int32_t* key_off,
     const int32_t* name_len, const int64_t* hits, const int64_t* limit,
@@ -794,13 +849,11 @@ int32_t keydir_prep_pack_columnar(
     std::vector<int64_t> offsets;
     std::vector<int32_t> lanes;
     std::vector<int64_t> col(5 * static_cast<size_t>(n));
-    std::unordered_set<std::string> seen;  // same per-key order rule as
-    seen.reserve(n);                       // keydir_prep_pack_fast
+    FpSet seen(n);  // same per-key order rule as keydir_prep_pack_fast
     offsets.reserve(n + 1);
     offsets.push_back(0);
     lanes.reserve(n);
     arena.reserve(static_cast<size_t>(key_off[n] - key_off[0]) + n);
-    std::string key;
     int32_t n_left = 0;
     for (int32_t i = 0; i < n; ++i) {
         const int32_t lo = key_off[i], hi = key_off[i + 1];
@@ -811,18 +864,12 @@ int32_t keydir_prep_pack_columnar(
         bool ok = nl > 0 && ul > 0 && (behavior[i] & slow_mask) == 0 &&
                   key_bytes_ok(keys + lo, nl) &&
                   key_bytes_ok(keys + lo + nl, ul);
-        if (ok) {
-            key.assign(keys + lo, nl);
-            key.push_back('_');
-            key.append(keys + lo + nl, ul);
-            ok = seen.insert(key).second;
-        } else if (nl > 0 && ul > 0) {
-            // slow-mask lane: its key still enters `seen` so any LATER
-            // occurrence of the same key also demotes (per-key order)
-            key.assign(keys + lo, nl);
-            key.push_back('_');
-            key.append(keys + lo + nl, ul);
-            seen.insert(key);
+        if (nl > 0 && ul > 0) {
+            // every well-formed key enters `seen` (even slow-mask lanes)
+            // so any LATER occurrence of the same key also demotes
+            // (per-key order)
+            const bool first = seen.insert(lane_fp(keys, lo, nl, ul));
+            ok = ok && first;
         }
         if (ok) {
             const size_t lane = lanes.size();
@@ -831,7 +878,9 @@ int32_t keydir_prep_pack_columnar(
             col[2 * n + lane] = duration[i];
             col[3 * n + lane] = algorithm[i];
             col[4 * n + lane] = behavior[i];
-            arena += key;
+            arena.append(keys + lo, nl);
+            arena.push_back('_');
+            arena.append(keys + lo + nl, ul);
             offsets.push_back(static_cast<int64_t>(arena.size()));
             lanes.push_back(i);
         } else {
@@ -945,14 +994,12 @@ int32_t keydir_prep_pack_interned(
     std::vector<int64_t> offsets;
     std::vector<int32_t> lanes;
     std::vector<int32_t> meta;  // meta word sans fresh bit
-    std::unordered_set<std::string> seen;
-    seen.reserve(n);
+    FpSet seen(n);  // fingerprint dedup: no per-key string allocation
     offsets.reserve(n + 1);
     offsets.push_back(0);
     lanes.reserve(n);
     meta.reserve(n);
     arena.reserve(static_cast<size_t>(key_off[n] - key_off[0]) + n);
-    std::string key;
     int32_t n_left = 0;
     bool overflow = false;
     for (int32_t i = 0; i < n; ++i) {
@@ -967,14 +1014,8 @@ int32_t keydir_prep_pack_interned(
                   duration[i] >= 0 && duration[i] <= INTERN_I32_MAX &&
                   (behavior[i] & ~0x3F) == 0 && (algorithm[i] & ~1) == 0;
         if (keyok) {
-            key.assign(keys + lo, nl);
-            key.push_back('_');
-            key.append(keys + lo + nl, ul);
-            if (ok) {
-                ok = seen.insert(key).second;
-            } else {
-                seen.insert(key);  // later occurrences also demote
-            }
+            const bool first = seen.insert(lane_fp(keys, lo, nl, ul));
+            ok = ok && first;  // later occurrences also demote
         }
         if (ok) {
             const int64_t pair = (limit[i] << 31) | duration[i];
@@ -987,7 +1028,9 @@ int32_t keydir_prep_pack_interned(
                 hits[i] | (static_cast<int64_t>(algorithm[i] & 1) << 15) |
                 (static_cast<int64_t>(behavior[i] & 0x3F) << 16) |
                 (id << 23)));
-            arena += key;
+            arena.append(keys + lo, nl);
+            arena.push_back('_');
+            arena.append(keys + lo + nl, ul);
             offsets.push_back(static_cast<int64_t>(arena.size()));
             lanes.push_back(i);
         } else {
@@ -1060,50 +1103,6 @@ constexpr int64_t LEAN_MAX_CFG = 128;     // ops/decide.py LEAN_MAX_CFG
 constexpr int32_t LEAN_SLOT_MASK = (1 << 24) - 1;
 constexpr int32_t LEAN_FRESH_SHIFT = 24;
 constexpr int32_t LEAN_CFG_SHIFT = 25;
-
-// Open-addressing set of 64-bit key fingerprints for the lean prep's
-// in-window duplicate detection: the interned/columnar preps dedup with
-// an unordered_set<std::string> (alloc + copy + compare per key — ~40%
-// of their per-item budget); the lean hot path dedups on fnv1a64 of
-// name + '_' + unique_key instead. A 64-bit collision merely DEMOTES
-// the later lane to the request-object pipeline (unnecessary but
-// correct — the same thing a real duplicate does), at probability
-// ~n^2/2^65 per window (~1e-12 at 8192 wide).
-struct FpSet {
-    std::vector<uint64_t> slots;  // 0 = empty (fp 0 remapped to 1)
-    uint64_t mask;
-
-    explicit FpSet(int32_t n) {
-        size_t cap = 64;
-        while (cap < static_cast<size_t>(n) * 2) cap <<= 1;
-        slots.assign(cap, 0);
-        mask = cap - 1;
-    }
-
-    // returns true when newly inserted (first occurrence)
-    bool insert(uint64_t fp) {
-        if (fp == 0) fp = 1;
-        uint64_t h = fp;
-        for (;;) {
-            uint64_t& s = slots[h & mask];
-            if (s == fp) return false;
-            if (s == 0) {
-                s = fp;
-                return true;
-            }
-            ++h;
-        }
-    }
-};
-
-inline uint64_t fnv1a64(uint64_t h, const char* p, int32_t len) {
-    for (int32_t i = 0; i < len; ++i) {
-        h ^= static_cast<unsigned char>(p[i]);
-        h *= 0x100000001b3ULL;
-    }
-    return h;
-}
-constexpr uint64_t FNV64_SEED = 0xcbf29ce484222325ULL;
 
 inline uint64_t lean_cfg_hash(int64_t limit, int64_t duration, int64_t algo,
                               int64_t behavior) {
@@ -1194,10 +1193,7 @@ int32_t keydir_prep_pack_lean(
                   duration[i] >= 0 && duration[i] <= INTERN_I32_MAX &&
                   (behavior[i] & ~0x3F) == 0 && (algorithm[i] & ~1) == 0;
         if (keyok) {
-            uint64_t fp = fnv1a64(FNV64_SEED, keys + lo, nl);
-            fp = fnv1a64(fp, "_", 1);
-            fp = fnv1a64(fp, keys + lo + nl, ul);
-            const bool first = seen.insert(fp);
+            const bool first = seen.insert(lane_fp(keys, lo, nl, ul));
             ok = ok && first;  // later occurrences (or a fp collision,
             // ~1e-12/window) demote to the request-object pipeline
         }
