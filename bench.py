@@ -77,6 +77,80 @@ def _init_watchdog(seconds: float = 180.0):
     return t
 
 
+def phase_breakdown() -> dict:
+    """Phase split of the FULL serving stack, measured by the tracing tier
+    itself (obs/trace.py): a 2-instance loopback cluster forwards singles
+    non-owner -> owner at sample rate 1.0 and the recorded spans give each
+    phase's latency — ingress (whole request at the non-owner), peer.hop
+    (forward RPC incl. the micro-batch window), owner.apply, combiner.wait
+    and kernel.dispatch (owner side). This is the combiner/kernel/peer-hop
+    split the BENCH_*.json trajectory tracks per PR; absolute numbers are
+    rig-dependent (loopback gRPC + this platform's dispatch latency), the
+    RATIOS are the regression signal."""
+    import numpy as np
+
+    from gubernator_tpu.models.engine import Engine
+    from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
+    from gubernator_tpu.service.convert import req_to_pb
+    from gubernator_tpu.service.grpc_api import close_channels, dial_v1
+    from gubernator_tpu.service.instance import Instance
+    from gubernator_tpu.service.pb import gubernator_pb2 as pb
+    from gubernator_tpu.service.server import make_server
+    from gubernator_tpu.obs.trace import Tracer
+    from gubernator_tpu.types import PeerInfo, RateLimitReq
+
+    N_REQ = 40
+    nodes = []
+    try:
+        behaviors = BehaviorConfig(batch_wait_s=0.001, peer_link_offset=0)
+        for _ in range(2):
+            # one width bucket, no warmup: the handful of inline compiles
+            # land on the first requests and fall out of the p50s
+            eng = Engine(capacity=1024, min_width=64, max_width=64)
+            inst = Instance(
+                InstanceConfig(behaviors=behaviors, backend=eng,
+                               tracer=Tracer(sample=1.0)),
+                advertise_address="pending")
+            server, port = make_server(inst, "127.0.0.1:0")
+            inst.advertise_address = f"127.0.0.1:{port}"
+            server.start()
+            nodes.append((inst, server))
+        infos = [PeerInfo(address=i.advertise_address) for i, _ in nodes]
+        for inst, _ in nodes:
+            inst.set_peers(infos)
+
+        # send from whichever node does NOT own the key, forcing the hop
+        key = "bk0"
+        owner_addr = nodes[0][0].get_peer(
+            RateLimitReq(name="ph", unique_key=key).hash_key()).info.address
+        non_owner = next(inst for inst, _ in nodes
+                         if inst.advertise_address != owner_addr)
+        stub = dial_v1(non_owner.advertise_address)
+        msg = pb.GetRateLimitsReq(requests=[req_to_pb(RateLimitReq(
+            name="ph", unique_key=key, hits=1, limit=1 << 20,
+            duration=3_600_000))])
+        for _ in range(N_REQ):
+            stub.GetRateLimits(msg, timeout=30)
+        phases: dict = {}
+        for inst, _ in nodes:
+            for spans in inst.tracer.traces().values():
+                for s in spans:
+                    phases.setdefault(s["name"], []).append(s["duration_ms"])
+        return {
+            name: {
+                "p50_ms": round(float(np.percentile(v, 50)), 4),
+                "p99_ms": round(float(np.percentile(v, 99)), 4),
+                "n": len(v),
+            }
+            for name, v in sorted(phases.items())
+        }
+    finally:
+        for inst, server in nodes:
+            server.stop(grace=0.2)
+            close_channels(inst.advertise_address)
+            inst.close()
+
+
 def main() -> None:
     watchdog = _init_watchdog()
     import jax
@@ -458,12 +532,19 @@ def main() -> None:
             },
         }
 
+    # trace-derived serving-stack phase split (never fails the bench)
+    try:
+        phases = phase_breakdown()
+    except Exception as e:  # noqa: BLE001
+        phases = {"error": str(e)}
+
     print(
         json.dumps(
             {
                 "metric": METRIC,
                 "value": round(decisions_per_sec, 1),
                 **serving_row,
+                "phase_breakdown_ms": phases,
                 "unit": UNIT,
                 "vs_baseline": round(decisions_per_sec / REFERENCE_BASELINE_RPS, 2),
                 "batch_width": BATCH_WIDTH,

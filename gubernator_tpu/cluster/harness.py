@@ -115,6 +115,7 @@ class LocalCluster:
         backend = Engine(capacity=capacity, min_width=32, max_width=256)
         backend.warmup()  # compile all width buckets before serving
         metrics = Metrics()
+        backend.metrics = metrics  # engine phase histograms, as the daemon
         inst = Instance(
             InstanceConfig(
                 behaviors=test_behaviors(),

@@ -254,6 +254,16 @@ def main(argv=None) -> int:
 
     advertise = conf.advertise_address or conf.grpc_address
     metrics = Metrics()
+    # engine phase histograms (device dispatch, window lanes) feed the
+    # same per-daemon registry the RPC tiers use
+    backend.metrics = metrics
+    from gubernator_tpu.obs.trace import Tracer
+
+    tracer = Tracer(sample=conf.trace_sample, slow_ms=conf.slow_request_ms,
+                    service=advertise)
+    if conf.trace_sample > 0:
+        log.info("request tracing on: sample=%.3g slow_request_ms=%.0f",
+                 conf.trace_sample, conf.slow_request_ms)
     instance = Instance(
         InstanceConfig(
             behaviors=conf.behaviors,
@@ -261,6 +271,7 @@ def main(argv=None) -> int:
             backend=backend,
             local_picker=build_picker(conf),
             metrics=metrics,
+            tracer=tracer,
         ),
         advertise_address=advertise,
     )
@@ -350,9 +361,11 @@ def main(argv=None) -> int:
                 log.warning("peerlink disabled: %s (peer calls ride gRPC)",
                             e)
 
-    gateway = HttpGateway(instance, conf.http_address, metrics=metrics)
+    gateway = HttpGateway(instance, conf.http_address, metrics=metrics,
+                          debug_endpoints=conf.debug_endpoints)
     gateway.start()
-    log.info("HTTP gateway on %s", conf.http_address)
+    log.info("HTTP gateway on %s (debug endpoints %s)", conf.http_address,
+             "on" if conf.debug_endpoints else "off")
 
     pool = build_pool(conf, instance)
 

@@ -71,6 +71,11 @@ def _env_slice(name: str) -> List[str]:
     return [s.strip() for s in v.split(",") if s.strip()] if v else []
 
 
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "")
+    return float(v) if v else default
+
+
 def _env_bool(name: str) -> bool:
     """Go strconv.ParseBool semantics for security-relevant flags: 'false'
     must mean false. (The reference treats ANY non-empty
@@ -150,6 +155,14 @@ class DaemonConfig:
     # port, and a dir for a capture spanning the daemon's lifetime
     profile_port: int = 0
     profile_dir: str = ""
+    # request tracing + introspection (obs/; no reference analogue):
+    # trace_sample 0.0 disables tracing entirely (hard no-op hot path);
+    # slow_request_ms logs a structured JSON event for any traced root
+    # request slower than the threshold (0 disables);
+    # debug_endpoints gates /v1/debug/vars and /v1/debug/traces
+    trace_sample: float = 0.0
+    slow_request_ms: float = 0.0
+    debug_endpoints: bool = True
     # GLOBAL-sync collective implementation for the sharded backend:
     # "psum" (XLA, default) or "ring" (Pallas ICI ring — TPU-compiled only,
     # single-region meshes; see ops/ring.py)
@@ -241,6 +254,9 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         snapshot_format=_env_str("GUBER_SNAPSHOT_FORMAT", "binary"),
         profile_port=_env_int("GUBER_PROFILE_PORT", 0),
         profile_dir=_env_str("GUBER_PROFILE_DIR"),
+        trace_sample=_env_float("GUBER_TRACE_SAMPLE", 0.0),
+        slow_request_ms=_env_float("GUBER_SLOW_REQUEST_MS", 0.0),
+        debug_endpoints=_env_str("GUBER_DEBUG_ENDPOINTS", "1") != "0",
         collectives=_env_str("GUBER_COLLECTIVES", "psum"),
         coordinator_address=_env_str("GUBER_COORDINATOR_ADDRESS"),
         num_hosts=_env_int("GUBER_NUM_HOSTS", 1),
@@ -257,6 +273,10 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         raise ValueError(
             f"'GUBER_COLLECTIVES={conf.collectives}' is invalid; "
             "choices are ['psum', 'ring']")
+    if not 0.0 <= conf.trace_sample <= 1.0:
+        raise ValueError(
+            f"'GUBER_TRACE_SAMPLE={conf.trace_sample}' is invalid; "
+            "must be a fraction in [0, 1]")
     return conf
 
 

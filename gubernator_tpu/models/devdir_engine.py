@@ -27,6 +27,7 @@ import functools as _functools
 from typing import List, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from gubernator_tpu.models.engine import Engine, _bucket_width
@@ -103,6 +104,12 @@ class DevDirEngine(Engine):
             self._fingerprints = lambda keys: np.fromiter(
                 (key_fingerprint(k) for k in keys), np.int64,
                 count=len(keys))
+
+    def key_count(self) -> int:
+        """Occupied device-directory slots (nonzero fingerprints). One
+        device reduction — scrape-path only, never the serving path."""
+        with self._lock:
+            return int(jnp.count_nonzero(self.fps))
 
     # directory-dependent surfaces are honestly unsupported
     def snapshot(self, include_expired: bool = False):

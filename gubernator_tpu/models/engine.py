@@ -50,6 +50,7 @@ from gubernator_tpu.ops.decide import (
     decide_packed_compact,
     decide_scan_packed,
     decide_scan_packed_compact,
+    kernel_telemetry,
     make_table,
     pack_window,
     pad_to_drop,
@@ -208,6 +209,10 @@ class Engine:
         # one kernel round must never need more distinct slots than exist
         self.max_width = min(max_width, capacity)
         self.stats = EngineStats()
+        # daemon-registry histograms (service/metrics.py); attached by the
+        # daemon/harness after construction, None keeps every observation
+        # site a no-op
+        self.metrics = None
         self._lock = threading.Lock()
         if donate is None:
             from gubernator_tpu.utils.platform import donation_supported
@@ -304,18 +309,22 @@ class Engine:
         (4 B/lane — the hits==1, few-configs serving shape) when eligible,
         compact (20 B/lane) otherwise, wide as the last resort. Returns an
         opaque handle for _fetch_staged."""
+        w = packed.shape[1]
         if self._staging != "wide":
             if self._lean_ok:
                 ln = lean_window(packed, self.capacity)
                 if ln is not None:
+                    kernel_telemetry.note("packed_lean", w)
                     self.state, out = self._decide_packed_lean(
                         self.state, ln[0], jnp.asarray(ln[1]), now_ms)
                     return out, now_ms
             c = compact_window(packed)
             if c is not None:
+                kernel_telemetry.note("packed_compact", w)
                 self.state, out = self._decide_packed_compact(
                     self.state, c, now_ms)
                 return out, now_ms
+        kernel_telemetry.note("packed_wide", w)
         self.state, out = self._decide_packed(self.state, packed, now_ms)
         return out, None
 
@@ -323,20 +332,38 @@ class Engine:
         """decide_scan dispatch of a wide i64[K, 9, W] stack, shipped
         lean/compact when eligible. Handle contract matches
         _dispatch_staged."""
+        k, w = stacked.shape[0], stacked.shape[2]
         if self._staging != "wide":
             if self._lean_ok:
                 ln = lean_window(stacked, self.capacity)
                 if ln is not None:
+                    kernel_telemetry.note("scan_lean", w, depth=k)
                     self.state, out = self._decide_scan_lean(
                         self.state, ln[0], jnp.asarray(ln[1]), now_ms)
                     return out, now_ms
             c = compact_window(stacked)
             if c is not None:
+                kernel_telemetry.note("scan_compact", w, depth=k)
                 self.state, out = self._decide_scan_compact(
                     self.state, c, now_ms)
                 return out, now_ms
+        kernel_telemetry.note("scan_wide", w, depth=k)
         self.state, out = self._decide_scan(self.state, stacked, now_ms)
         return out, None
+
+    def _obs_device(self, ns: int, lanes: int) -> None:
+        """Feed one window's device dispatch+readback wall time and live
+        lane count into the daemon-registry histograms (no-op until a
+        Metrics is attached)."""
+        m = self.metrics
+        if m is not None:
+            m.engine_device_dispatch_ms.observe(ns / 1e6)
+            m.engine_window_lanes.observe(lanes)
+
+    def key_count(self) -> int:
+        """Live key-table occupancy (the cache_size /
+        engine_key_table_size gauge source)."""
+        return len(self.directory)
 
     @staticmethod
     def _fetch_staged(handle) -> np.ndarray:
@@ -429,6 +456,7 @@ class Engine:
                     self._dispatch_staged(packed, now_ms))
                 t2 = time.perf_counter_ns()
                 stage["device"] += t2 - t1
+                self._obs_device(t2 - t1, n0)
                 status, limit, remaining, reset = out[:, :n0].tolist()
                 over = 0
                 for j, i in enumerate(lane_item.tolist()):
@@ -520,6 +548,7 @@ class Engine:
             out_reset[lane_item] = rows[3, :n0]
             over = int(np.count_nonzero(rows[0, :n0] == 1))
             t2 = time.perf_counter_ns()
+            self._obs_device(t1 - t0, n0)
             with self._lock:  # concurrent completers: counters stay exact
                 self.stats.over_limit += over
                 self.stats.stage_ns["device"] += t1 - t0
@@ -944,6 +973,7 @@ class Engine:
                 self._dispatch_scan_staged(stacked, now_ms))
             t2 = time.perf_counter_ns()
             stage["device"] += t2 - t
+            self._obs_device(t2 - t, sum(len(w) for w in group))
             for gi, wk in enumerate(group):
                 n = len(wk)
                 status, limit, remaining, reset = out[gi, :, :n].tolist()
@@ -995,6 +1025,7 @@ class Engine:
         out = self._fetch_staged(self._dispatch_staged(packed, now_ms))
         t3 = time.perf_counter_ns()
         stage["device"] += t3 - t2
+        self._obs_device(t3 - t2, n)
 
         # one C-level tolist beats four per-element int() casts per lane
         status, limit, remaining, reset = out[:, :n].tolist()

@@ -1,0 +1,14 @@
+"""Observability: request tracing, runtime introspection, phase telemetry.
+
+The reference's only latency observability is per-RPC histograms
+(prometheus.go:51-64); none of the stages this port added — the combiner
+batch window, the native peerlink hop, the device kernel dispatch, the
+host-tier GLOBAL pipelines — existed there to observe. This package gives
+those stages first-class visibility:
+
+- obs/trace.py: a lightweight span tracer with W3C trace-context
+  propagation, so one request's non-owner -> owner hop chain reconstructs
+  end to end across daemons;
+- obs/introspect.py: the /v1/debug/vars snapshot (engine occupancy,
+  combiner/GLOBAL pipeline state, peer rings, kernel dispatch mix).
+"""

@@ -1,0 +1,93 @@
+"""Runtime introspection: the /v1/debug/vars snapshot.
+
+One JSON document answering "what is this daemon doing right now" — the
+expvar-style counterpart to /metrics (which carries the same families as
+time series). Everything here is a read of live objects; nothing is
+sampled or buffered, so the snapshot is as fresh as the calling request.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _backend_vars(backend) -> dict:
+    out: dict = {"type": type(backend).__name__}
+    stats = getattr(backend, "stats", None)
+    if stats is not None:
+        out["stats"] = stats.as_dict() if hasattr(stats, "as_dict") \
+            else dict(stats)
+    for attr in ("capacity", "min_width", "max_width"):
+        v = getattr(backend, attr, None)
+        if isinstance(v, int):
+            out[attr] = v
+    occ = key_table_size(backend)
+    if occ is not None:
+        out["key_table_size"] = occ
+    reg = getattr(backend, "global_registry_size", None)
+    if callable(reg):
+        out["global_registry_size"] = int(reg())
+    return out
+
+
+def key_table_size(backend) -> Optional[int]:
+    """Live key-table occupancy: distinct keys currently holding a table
+    slot. None when the backend has no countable directory (the devdir
+    engine keeps keys on-chip as fingerprints only)."""
+    count = getattr(backend, "key_count", None)
+    if callable(count):
+        try:
+            return int(count())
+        except Exception:  # noqa: BLE001 — introspection must not raise
+            return None
+    return None
+
+
+def debug_vars(instance) -> dict:
+    """Snapshot one Instance's pipeline state. Sections appear only when
+    the corresponding subsystem is wired, so the schema is
+    subset-stable across backend/deployment shapes."""
+    from gubernator_tpu.ops.decide import kernel_telemetry
+
+    out: dict = {
+        "advertise_address": instance.advertise_address,
+        "engine": _backend_vars(instance.backend),
+        "combiner": dict(instance.combiner.stats),
+        "kernel": kernel_telemetry.snapshot(),
+    }
+
+    gm = getattr(instance, "global_manager", None)
+    if gm is not None:
+        hits_depth, bcast_depth = gm.depths()
+        out["global"] = {
+            **gm.stats,
+            "hits_queue_depth": hits_depth,
+            "broadcast_queue_depth": bcast_depth,
+            "cache_items": len(instance._global_cache),  # noqa: SLF001
+        }
+
+    with instance._peer_lock:  # noqa: SLF001 — the read the ring exposes
+        out["peers"] = {
+            "local": [
+                {"address": p.info.address, "datacenter": p.info.datacenter,
+                 "is_owner": p.info.is_owner}
+                for p in instance.local_picker.peers()
+            ],
+            "region": [
+                {"address": p.info.address, "datacenter": p.info.datacenter}
+                for p in instance.region_picker.peers()
+            ],
+        }
+
+    tracer = getattr(instance, "tracer", None)
+    if tracer is not None:
+        out["trace"] = {"sample": tracer.sample, "slow_ms": tracer.slow_ms,
+                        **tracer.stats}
+
+    cg = getattr(instance, "collective_global", None)
+    if cg is not None:
+        out["collective_global"] = dict(cg.stats)
+    mr = getattr(instance, "multiregion_manager", None)
+    if mr is not None and getattr(mr, "stats", None):
+        out["multiregion"] = dict(mr.stats)
+    return out

@@ -34,12 +34,55 @@ Almost all real windows are round-1-only.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Sequence, Tuple
+import threading
+from typing import Dict, NamedTuple, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from gubernator_tpu.types import Algorithm, Behavior, Status
+
+
+class KernelTelemetry:
+    """Process-wide kernel dispatch accounting.
+
+    The engines report every device launch here — which kernel (wide /
+    compact / lean, per-window / scan), at which width, at which scan
+    depth — so an operator can see the compiled-program mix actually
+    serving traffic (each distinct shape is one XLA program; an unexpected
+    width churn here means warmup() and live traffic disagree). Totals are
+    process-wide: in-process cluster harnesses share one registry, exactly
+    like the shared jit caches they mirror. Exported in /v1/debug/vars
+    ("kernel") and as engine_kernel_dispatch_total{kernel,width}."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counts: Dict[Tuple[str, int], int] = {}
+        self._lanes = 0
+
+    def note(self, kernel: str, width: int, depth: int = 1,
+             lanes: int = 0) -> None:
+        """One dispatch of `kernel` at staging width `width` retiring
+        `depth` windows (scan kernels) and `lanes` live lanes."""
+        with self._lock:
+            key = (kernel, width)
+            self._counts[key] = self._counts.get(key, 0) + depth
+            self._lanes += lanes
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "windows": {f"{k}@{w}": n
+                            for (k, w), n in sorted(self._counts.items())},
+                "lanes_total": self._lanes,
+            }
+
+    def counts(self) -> Dict[Tuple[str, int], int]:
+        with self._lock:
+            return dict(self._counts)
+
+
+kernel_telemetry = KernelTelemetry()
 
 I32 = jnp.int32
 I64 = jnp.int64

@@ -866,6 +866,11 @@ class ShardedEngine:
     def global_registry_size(self) -> int:
         return len(self._globals)
 
+    def key_count(self) -> int:
+        """Live key occupancy across every shard directory (the
+        cache_size / engine_key_table_size gauge source)."""
+        return sum(len(d) for d in self.directories)
+
     # Same fast-path bounds as models/engine.py: scan groups are capped at 32
     # windows of exactly min_width lanes, so warmup() can pre-compile every
     # shape this path dispatches, and the capacity guard keeps a group's

@@ -14,15 +14,23 @@ thundering herd aggregates into dispatch-sized windows automatically.
 Per-key sequential semantics are preserved by the engine's collision-free
 rounds (models/prep.py): duplicate keys across merged callers land in
 separate rounds of the same launch.
+
+Observability: every submission's enqueue->launch wait and every window's
+occupancy feed the daemon registry's combiner_* histograms (docs/
+observability.md); a traced submission (obs/trace.py) additionally gets
+`combiner.wait` and `kernel.dispatch` phase spans — the two intervals a
+slow p99 most needs split apart.
 """
 
 from __future__ import annotations
 
 import logging
 import threading
+import time
 from concurrent.futures import Future
 from typing import List, Optional, Sequence
 
+from gubernator_tpu.obs import trace
 from gubernator_tpu.types import RateLimitReq, RateLimitResp
 
 log = logging.getLogger("gubernator_tpu.combiner")
@@ -31,15 +39,33 @@ log = logging.getLogger("gubernator_tpu.combiner")
 class BackendCombiner:
     """Merges concurrent get_rate_limits calls into single backend batches."""
 
-    def __init__(self, backend, name: str = "backend-combiner"):
+    def __init__(self, backend, name: str = "backend-combiner",
+                 metrics=None, tracer=None):
         self.backend = backend
+        self._metrics = metrics
+        self._tracer = tracer
         self._cond = threading.Condition()
-        self._pending: List[tuple] = []  # (reqs, now_ms, future)
+        # pending entry: (reqs, now_ms, future, enqueue time_ns, span|None)
+        self._pending: List[tuple] = []
         self._closed = False
-        # windows actually merged >1 submission (observability)
-        self.stats = {"submissions": 0, "windows": 0, "merged_windows": 0}
+        # Counter state lives in the daemon's Prometheus registry when one
+        # is attached (combiner_* families); these ints are the always-on
+        # dict view the in-process harnesses and tests read.
+        self._submissions = 0
+        self._windows = 0
+        self._merged_windows = 0
         self._thread = threading.Thread(target=self._run, name=name, daemon=True)
         self._thread.start()
+
+    @property
+    def stats(self) -> dict:
+        """Dict view of the combiner counters (windows actually merged >1
+        submission under "merged_windows")."""
+        return {
+            "submissions": self._submissions,
+            "windows": self._windows,
+            "merged_windows": self._merged_windows,
+        }
 
     def submit(
         self, reqs: Sequence[RateLimitReq], now_ms: Optional[int] = None
@@ -47,13 +73,18 @@ class BackendCombiner:
         """Block until this submission's responses are ready."""
         if not reqs:
             return []
+        span = trace.current()  # None on every untraced request
         fut: "Future[List[RateLimitResp]]" = Future()
         with self._cond:
             if self._closed:
                 raise RuntimeError("combiner is closed")
-            self._pending.append((list(reqs), now_ms, fut))
-            self.stats["submissions"] += 1
+            self._pending.append(
+                (list(reqs), now_ms, fut, time.time_ns(), span))
+            self._submissions += 1
             self._cond.notify()
+        m = self._metrics
+        if m is not None:
+            m.combiner_submissions.inc()
         return fut.result()
 
     def close(self, timeout_s: float = 30.0) -> None:
@@ -73,7 +104,8 @@ class BackendCombiner:
             )
         with self._cond:
             orphans, self._pending = self._pending, []
-        for _, _, fut in orphans:
+        for entry in orphans:
+            fut = entry[2]
             if not fut.done():
                 fut.set_exception(
                     RuntimeError("combiner closed before dispatch")
@@ -93,7 +125,8 @@ class BackendCombiner:
                 self._execute(batch)
             except BaseException as e:  # noqa: BLE001 — never die silently
                 log.exception("combiner window failed")
-                for _, _, fut in batch:
+                for entry in batch:
+                    fut = entry[2]
                     if not fut.done():
                         fut.set_exception(
                             RuntimeError(f"combiner window failed: {e!r}")
@@ -106,17 +139,33 @@ class BackendCombiner:
         groups: dict = {}
         for entry in batch:
             groups.setdefault(entry[1], []).append(entry)
+        m = self._metrics
+        tracer = self._tracer
         for now_ms, entries in groups.items():
-            self.stats["windows"] += 1
-            if len(entries) > 1:
-                self.stats["merged_windows"] += 1
+            self._windows += 1
+            merged = len(entries) > 1
+            if merged:
+                self._merged_windows += 1
+            t_launch = time.time_ns()
             flat: List[RateLimitReq] = []
             spans = []
-            for reqs, _, fut in entries:
+            for reqs, _, fut, t_enq, req_span in entries:
                 spans.append((len(flat), len(reqs), fut))
                 flat.extend(reqs)
+                if m is not None:
+                    m.combiner_wait_ms.observe((t_launch - t_enq) / 1e6)
+                if req_span is not None and tracer is not None:
+                    tracer.record_span(
+                        "combiner.wait", req_span, t_enq, t_launch,
+                        {"merged_submissions": len(entries)})
+            if m is not None:
+                m.combiner_windows.inc()
+                m.combiner_window_items.observe(len(flat))
+                if merged:
+                    m.combiner_merged_windows.inc()
             try:
                 resps = self.backend.get_rate_limits(flat, now_ms=now_ms)
+                self._record_dispatch(entries, t_launch, len(flat))
                 if resps is None or len(resps) != len(flat):
                     raise RuntimeError(
                         f"backend returned "
@@ -129,3 +178,19 @@ class BackendCombiner:
                 for _, _, fut in spans:
                     if not fut.done():
                         fut.set_exception(e)
+
+    def _record_dispatch(self, entries, t_launch: int, n_items: int) -> None:
+        """`kernel.dispatch` spans for the traced submissions of a window:
+        the backend call IS the device launch + readback they shared."""
+        tracer = self._tracer
+        if tracer is None:
+            return
+        t_done = 0
+        for entry in entries:
+            req_span = entry[4]
+            if req_span is None:
+                continue
+            if not t_done:
+                t_done = time.time_ns()
+            tracer.record_span("kernel.dispatch", req_span, t_launch,
+                               t_done, {"window_items": n_items})

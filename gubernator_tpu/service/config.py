@@ -53,6 +53,9 @@ class InstanceConfig:
     # service.metrics.Metrics; optional — managers observe their histograms
     # through it when present (reference: global.go:45-51,155,238)
     metrics: Optional[object] = None
+    # obs.trace.Tracer; optional — the Instance builds a disabled one
+    # (sample 0, zero hot-path cost) when omitted
+    tracer: Optional[object] = None
 
     def validate(self) -> None:
         if self.behaviors.batch_limit > MAX_BATCH_SIZE:

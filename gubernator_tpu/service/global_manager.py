@@ -79,6 +79,11 @@ class _Pipeline:
         if n == 1 or n >= self._limit:
             self._wake.set()
 
+    def depth(self) -> int:
+        """Keys currently queued and not yet flushed (scrape-time gauge)."""
+        with self._lock:
+            return len(self._pending)
+
     def _drain(self) -> Dict[str, RateLimitReq]:
         with self._lock:
             out, self._pending = self._pending, {}
@@ -154,6 +159,11 @@ class GlobalManager:
         """Owner: broadcast this key's state on the next window
         (reference: global.go:67-69)."""
         self._broadcasts.queue(req, aggregate_hits=False)
+
+    def depths(self) -> tuple:
+        """(hit queue depth, broadcast queue depth) — the backlog a scrape
+        sees between flush windows (global_queue_depth{pipeline=...})."""
+        return self._hits.depth(), self._broadcasts.depth()
 
     def flush(self) -> None:
         self._hits.flush_now()

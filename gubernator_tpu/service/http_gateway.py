@@ -6,6 +6,13 @@ gubernator.pb.gw.go:33-77), plus GET /metrics for prometheus
 (reference: cmd/gubernator/main.go:127-144). Implemented natively on the
 stdlib threading HTTP server — no gRPC hop in between: the gateway calls the
 Instance directly.
+
+Debug endpoints (GUBER_DEBUG_ENDPOINTS; the TPU-native counterpart of the
+reference daemon's expvar/pprof handlers):
+
+- GET /v1/debug/vars    — live pipeline snapshot (obs/introspect.py)
+- GET /v1/debug/traces  — recent-trace ring buffer, grouped by trace id
+  (?id=<trace_id> filters to one trace)
 """
 
 from __future__ import annotations
@@ -15,9 +22,12 @@ import logging
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlparse
 
 from google.protobuf import json_format
 
+from gubernator_tpu.obs import trace
+from gubernator_tpu.obs.introspect import debug_vars
 from gubernator_tpu.service.convert import (
     health_to_pb,
     req_from_pb,
@@ -31,17 +41,19 @@ log = logging.getLogger("gubernator_tpu.gateway")
 
 
 class HttpGateway:
-    """Serves /v1/GetRateLimits, /v1/HealthCheck and /metrics."""
+    """Serves /v1/GetRateLimits, /v1/HealthCheck, /metrics and /v1/debug/*."""
 
     def __init__(
         self,
         instance: Instance,
         address: str = "127.0.0.1:9080",
         metrics: Optional[Metrics] = None,
+        debug_endpoints: bool = True,
     ):
         host, _, port = address.rpartition(":")
         self.instance = instance
         self.metrics = metrics
+        self.debug_endpoints = debug_endpoints
         gateway = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -81,8 +93,30 @@ class HttpGateway:
                             gateway.metrics.render(gateway.instance),
                             ctype=CONTENT_TYPE_LATEST,
                         )
+                elif self.path.startswith("/v1/debug/"):
+                    self._debug()
                 else:
                     self._reply_error(404, "not found")
+
+            def _debug(self):
+                if not gateway.debug_endpoints:
+                    self._reply_error(404, "debug endpoints disabled")
+                    return
+                url = urlparse(self.path)
+                try:
+                    if url.path == "/v1/debug/vars":
+                        body = debug_vars(gateway.instance)
+                    elif url.path == "/v1/debug/traces":
+                        q = parse_qs(url.query)
+                        body = {"traces": gateway.instance.tracer.traces(
+                            q.get("id", [""])[0])}
+                    else:
+                        self._reply_error(404, "not found")
+                        return
+                except Exception as e:  # noqa: BLE001 — introspection must
+                    self._reply_error(500, str(e))  # never crash the gateway
+                    return
+                self._reply(200, json.dumps(body, default=str).encode())
 
             def do_POST(self):
                 if self.path != "/v1/GetRateLimits":
@@ -95,6 +129,11 @@ class HttpGateway:
                 except json_format.ParseError as e:
                     self._reply_error(400, f"invalid request: {e}")
                     return
+                tracer = gateway.instance.tracer
+                span = tracer.maybe_trace(
+                    "ingress", self.headers.get("traceparent")) \
+                    if tracer.active else None
+                token = trace.use(span) if span is not None else None
                 try:
                     resps = gateway.instance.get_rate_limits(
                         [req_from_pb(m) for m in msg.requests]
@@ -102,6 +141,12 @@ class HttpGateway:
                 except ApiError as e:
                     self._reply_error(400, e.message)
                     return
+                finally:
+                    if span is not None:
+                        span.set("requests", len(msg.requests))
+                        span.set("transport", "http")
+                        trace.reset(token)
+                        tracer.finish(span)
                 self._reply_json(
                     200, pb.GetRateLimitsResp(responses=resps_to_pb_list(resps))
                 )

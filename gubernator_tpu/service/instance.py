@@ -19,6 +19,7 @@ import dataclasses
 
 import logging
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
@@ -27,6 +28,8 @@ from gubernator_tpu.cluster.pickers import (
     RegionPicker,
     ReplicatedConsistentHashPicker,
 )
+from gubernator_tpu.obs import trace
+from gubernator_tpu.obs.trace import Tracer
 from gubernator_tpu.service.combiner import BackendCombiner
 from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
 from gubernator_tpu.service.global_manager import GlobalManager
@@ -89,9 +92,13 @@ class Instance:
 
             conf.backend = Engine()
         self.backend = conf.backend
+        # always present; sample 0 (the default) keeps every trace site a
+        # guarded no-op — daemons wire GUBER_TRACE_SAMPLE through here
+        self.tracer = conf.tracer or Tracer()
         # concurrent callers merge into single kernel launches; while one
         # launch is in flight the next window pools up (service/combiner.py)
-        self.combiner = BackendCombiner(self.backend)
+        self.combiner = BackendCombiner(
+            self.backend, metrics=conf.metrics, tracer=self.tracer)
 
         self.local_picker = conf.local_picker or ReplicatedConsistentHashPicker()
         # The cross-region picker must route exactly like the DESTINATION
@@ -198,6 +205,10 @@ class Instance:
         responses: List[Optional[RateLimitResp]] = [None] * len(requests)
         local: List[int] = []
         remote: Dict[str, tuple] = {}  # owner addr -> (peer, [batch indices])
+        # one ContextVar read per call — the entire routing-path cost of
+        # tracing when off; the active span (if any) is handed explicitly
+        # to the forward pool (contexts do not cross its threads)
+        span = trace.current()
 
         for i, req in enumerate(requests):
             if not req.unique_key:
@@ -234,10 +245,11 @@ class Instance:
             if len(idxs) == 1:
                 req = requests[idxs[0]]
                 futures.append((idxs, self._forward_pool.submit(
-                    self._forward_as_list, req, req.hash_key())))
+                    self._forward_as_list, req, req.hash_key(), span)))
             else:
                 futures.append((idxs, self._forward_pool.submit(
-                    self._forward_group, peer, [requests[i] for i in idxs])))
+                    self._forward_group, peer,
+                    [requests[i] for i in idxs], span)))
 
         if local:
             batch = [requests[i] for i in local]
@@ -449,7 +461,8 @@ class Instance:
 
     # ------------------------------------------------------------ internals
 
-    def _forward(self, req: RateLimitReq, key: str) -> RateLimitResp:
+    def _forward(self, req: RateLimitReq, key: str,
+                 span=None) -> RateLimitResp:
         """Relay to the owning peer, re-picking up to 5 times while peers
         shut down (reference: gubernator.go:149-157,186-205)."""
         last_err = ""
@@ -461,10 +474,20 @@ class Instance:
                     error=f"while finding peer that owns rate limit '{key}' - '{e}'"
                 )
             if peer.info.is_owner:  # membership changed under us
-                return self.apply_owner_batch([req])[0]
+                token = trace.use(span) if span is not None else None
+                try:
+                    return self.apply_owner_batch([req])[0]
+                finally:
+                    if token is not None:
+                        trace.reset(token)
+            t0 = time.time_ns() if span is not None else 0
             try:
-                resp = peer.get_peer_rate_limit(req)
+                resp = peer.get_peer_rate_limit(req, trace_span=span)
                 resp.metadata["owner"] = peer.info.address
+                if span is not None:
+                    self.tracer.record_span(
+                        "peer.hop", span, t0, time.time_ns(),
+                        {"peer": peer.info.address})
                 return resp
             except PeerNotReadyError as e:
                 last_err = str(e)
@@ -478,11 +501,12 @@ class Instance:
             f"'{key}' - '{last_err}'"
         )
 
-    def _forward_as_list(self, req: RateLimitReq, key: str) -> List[RateLimitResp]:
-        return [self._forward(req, key)]
+    def _forward_as_list(self, req: RateLimitReq, key: str,
+                         span=None) -> List[RateLimitResp]:
+        return [self._forward(req, key, span)]
 
     def _forward_group(
-        self, peer: PeerClient, reqs: List[RateLimitReq]
+        self, peer: PeerClient, reqs: List[RateLimitReq], span=None
     ) -> List[RateLimitResp]:
         """Forward several same-owner requests as ONE ordered batch.
 
@@ -501,10 +525,11 @@ class Instance:
         safe and fails fast; any OTHER error may mean the owner already
         applied the batch, so re-sending would double-count hits — those
         surface as error responses, exactly like the per-request path."""
+        t0 = time.time_ns() if span is not None else 0
         try:
-            resps = peer.get_peer_rate_limits(reqs)
+            resps = peer.get_peer_rate_limits(reqs, trace_span=span)
         except PeerNotReadyError:
-            return [self._forward(r, r.hash_key()) for r in reqs]
+            return [self._forward(r, r.hash_key(), span) for r in reqs]
         except Exception as e:  # noqa: BLE001
             return [RateLimitResp(
                 error=f"while fetching rate limit '{r.hash_key()}' "
@@ -515,6 +540,10 @@ class Instance:
                 error=f"peer returned {len(resps)} responses for "
                       f"{len(reqs)} requests")
                 for _ in reqs]
+        if span is not None:
+            self.tracer.record_span(
+                "peer.hop", span, t0, time.time_ns(),
+                {"peer": peer.info.address, "requests": len(reqs)})
         for r in resps:
             r.metadata["owner"] = peer.info.address
         return resps

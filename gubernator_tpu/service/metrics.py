@@ -58,6 +58,99 @@ class Metrics:
             "The duration of GLOBAL broadcasts to peers in seconds.",
             registry=self.registry,
         )
+        # combiner batch window (service/combiner.py — live counters, the
+        # combiner increments these directly; no mirroring)
+        self.combiner_submissions = Counter(
+            "combiner_submissions_total",
+            "Caller submissions into the flat-combining batch window.",
+            registry=self.registry,
+        )
+        self.combiner_windows = Counter(
+            "combiner_windows_total",
+            "Batch windows executed against the device backend.",
+            registry=self.registry,
+        )
+        self.combiner_merged_windows = Counter(
+            "combiner_merged_windows_total",
+            "Windows that merged more than one submission.",
+            registry=self.registry,
+        )
+        self.combiner_wait_ms = Histogram(
+            "combiner_wait_milliseconds",
+            "Per-submission enqueue->launch wait inside the combiner.",
+            registry=self.registry,
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100),
+        )
+        self.combiner_window_items = Histogram(
+            "combiner_window_items",
+            "Requests per executed combiner window (batch occupancy).",
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
+        )
+        # engine hot-path phase instrumentation (models/engine.py — live)
+        self.engine_device_dispatch_ms = Histogram(
+            "engine_device_dispatch_milliseconds",
+            "Per-window device kernel dispatch + readback wall time.",
+            registry=self.registry,
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 500),
+        )
+        self.engine_window_lanes = Histogram(
+            "engine_window_lanes",
+            "Live lanes per dispatched kernel window.",
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096, 8192),
+        )
+        self.engine_kernel_dispatches = Counter(
+            "engine_kernel_dispatch_total",
+            "Device kernel windows by kernel variant and staging width "
+            "(process-wide: in-process clusters share the jit caches and "
+            "this registry with them).",
+            ["kernel", "width"], registry=self.registry,
+        )
+        self.engine_key_table_size = Gauge(
+            "engine_key_table_size",
+            "Distinct keys currently holding a device table slot.",
+            registry=self.registry,
+        )
+        # the non-owner GLOBAL broadcast mirror (cache_size itself now
+        # reports the engine key table — the authoritative cache here)
+        self.global_cache_size = Gauge(
+            "global_cache_size",
+            "Non-owner GLOBAL statuses cached from owner broadcasts.",
+            registry=self.registry,
+        )
+        # host-tier GLOBAL pipelines (service/global_manager.py)
+        self.global_queue_depth = Gauge(
+            "global_queue_depth",
+            "Keys pending in the GLOBAL pipelines at scrape time.",
+            ["pipeline"], registry=self.registry,
+        )
+        self.global_manager = {
+            name: Counter(
+                f"global_{name}_total", help_, registry=self.registry)
+            for name, help_ in (
+                ("hits_sent", "Aggregated GLOBAL hits relayed to owners."),
+                ("broadcasts_sent",
+                 "GLOBAL broadcast pushes delivered to peers."),
+                ("broadcast_errors", "Failed GLOBAL broadcast pushes."),
+            )
+        }
+        # native peerlink transport (service/peerlink.py)
+        self.peerlink = {
+            name: Counter(
+                f"peerlink_{name}_total", help_, registry=self.registry)
+            for name, help_ in (
+                ("batches", "Aggregated pulls served by the link workers."),
+                ("requests", "Requests carried by those pulls."),
+                ("errors", "Worker batch/send failures."),
+            )
+        }
+        self.peerlink_stage_ms = Histogram(
+            "peerlink_stage_milliseconds",
+            "Peerlink worker phases per pull: decode+handle, send.",
+            ["stage"], registry=self.registry,
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 100),
+        )
         # TPU-native engine metrics (no reference analogue)
         self.engine_decisions = Counter(
             "engine_decisions_total",
@@ -157,6 +250,11 @@ class Metrics:
         (RPCs answered entirely in C never reach the Python counters)."""
         self._native_front_hits = hits_fn
 
+    def set_peerlink_stats(self, stats_fn) -> None:
+        """Register a PeerLinkService's stats-dict supplier so the link's
+        batch/request/error totals export as peerlink_* families."""
+        self._peerlink_stats = stats_fn
+
     def observe_instance(self, instance) -> None:
         """Refresh gauges from live objects before exposition."""
         hits_fn = getattr(self, "_native_front_hits", None)
@@ -199,6 +297,33 @@ class Metrics:
         registry_size = getattr(instance.backend, "global_registry_size", None)
         if callable(registry_size):
             self.engine_global_registry_size.set(registry_size())
+        # kernel dispatch mix (ops/decide.py kernel_telemetry)
+        from gubernator_tpu.ops.decide import kernel_telemetry
+
+        for (kernel, width), n in kernel_telemetry.counts().items():
+            self._set_counter(
+                self.engine_kernel_dispatches.labels(
+                    kernel=kernel, width=str(width)), n)
+        # live key-table occupancy: the engine directory IS the cache here,
+        # so cache_size (reference: cache.go:87-95) reports it
+        from gubernator_tpu.obs.introspect import key_table_size
+
+        occupancy = key_table_size(instance.backend)
+        if occupancy is not None:
+            self.engine_key_table_size.set(occupancy)
+            self.cache_size.set(occupancy)
+        gm = getattr(instance, "global_manager", None)
+        if gm is not None:
+            hits_depth, bcast_depth = gm.depths()
+            self.global_queue_depth.labels(pipeline="hits").set(hits_depth)
+            self.global_queue_depth.labels(
+                pipeline="broadcast").set(bcast_depth)
+            for name, counter in self.global_manager.items():
+                self._set_counter(counter, gm.stats.get(name, 0))
+        link = getattr(self, "_peerlink_stats", None)
+        if link is not None:
+            for name, counter in self.peerlink.items():
+                self._set_counter(counter, link().get(name, 0))
         collective = getattr(instance, "collective_global", None)
         if collective is not None:
             for name, counter in self.cross_host.items():
@@ -211,7 +336,9 @@ class Metrics:
                 self._set_counter(counter, mr.stats.get(name, 0))
         cache = getattr(instance, "_global_cache", None)
         if cache is not None:
-            self.cache_size.set(len(cache))
+            self.global_cache_size.set(len(cache))
+            if occupancy is None:  # no countable engine directory: keep
+                self.cache_size.set(len(cache))  # the legacy LRU reading
 
     @staticmethod
     def _set_counter(counter, value: float) -> None:

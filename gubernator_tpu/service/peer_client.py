@@ -149,7 +149,7 @@ class PeerClient:
                 break
             if item is None:
                 continue
-            _, fut = item
+            fut = item[1]
             if not fut.done():
                 fut.set_exception(PeerNotReadyError(self.info.address))
         if self._link is not None:
@@ -159,11 +159,12 @@ class PeerClient:
 
     # ------------------------------------------------------------------ API
 
-    def get_peer_rate_limit(self, req: RateLimitReq) -> RateLimitResp:
+    def get_peer_rate_limit(self, req: RateLimitReq,
+                            trace_span=None) -> RateLimitResp:
         """Forward one request to this peer, batching unless NO_BATCHING
         (reference: peer_client.go:127-140)."""
         if has_behavior(req.behavior, Behavior.NO_BATCHING):
-            resps = self.get_peer_rate_limits([req])
+            resps = self.get_peer_rate_limits([req], trace_span=trace_span)
             return resps[0]
         self._connect()
         fut: "Future[RateLimitResp]" = Future()
@@ -174,7 +175,7 @@ class PeerClient:
         with self._lock:
             if self._closing:
                 raise PeerNotReadyError(self.info.address)
-            self._queue.put((req, fut))
+            self._queue.put((req, fut, trace_span))
         try:
             return fut.result(timeout=self.conf.batch_timeout_s)
         except _FutureTimeout:
@@ -183,6 +184,7 @@ class PeerClient:
 
     def get_peer_rate_limits(
         self, reqs: Sequence[RateLimitReq], wait_for_ready: bool = False,
+        trace_span=None,
     ) -> List[RateLimitResp]:
         """One peer call carrying the whole batch: the native link when the
         peer answers it (~4-5x cheaper than Python gRPC), else gRPC.
@@ -192,17 +194,31 @@ class PeerClient:
         failure handling DROPS the payload (multi-region replication:
         delivery-uncertain errors cannot be retried without double
         counting). Routed request traffic keeps fail-fast so owner-down
-        fallbacks stay prompt."""
+        fallbacks stay prompt.
+
+        `trace_span` (obs/trace.py) propagates W3C trace context to the
+        owner: gRPC carries it as `traceparent` metadata, peerlink as a
+        reserved carrier item in a TRACED frame — the owner's spans then
+        share this request's trace id."""
         link = self._peer_link()
         if link is not None:
             from gubernator_tpu.service.peerlink import (
                 METHOD_GET_PEER_RATE_LIMITS,
+                MAX_FRAME_ITEMS,
+                METHOD_TRACED,
                 PeerLinkError,
                 PeerLinkTimeout,
                 PeerLinkUnencodable,
+                trace_carrier,
             )
 
             try:
+                if trace_span is not None and len(reqs) < MAX_FRAME_ITEMS:
+                    resps = link.call(
+                        METHOD_GET_PEER_RATE_LIMITS | METHOD_TRACED,
+                        [trace_carrier(trace_span)] + list(reqs),
+                        self.conf.batch_timeout_s)
+                    return resps[1:]  # drop the carrier's placeholder
                 return link.call(METHOD_GET_PEER_RATE_LIMITS, list(reqs),
                                  self.conf.batch_timeout_s)
             except PeerLinkUnencodable:
@@ -222,10 +238,15 @@ class PeerClient:
                 self._drop_link()
         stub = self._connect()
         msg = peers_pb.GetPeerRateLimitsReq(requests=[req_to_pb(r) for r in reqs])
+        metadata = None
+        if trace_span is not None:
+            from gubernator_tpu.obs.trace import format_traceparent
+
+            metadata = (("traceparent", format_traceparent(trace_span)),)
         try:
             out = stub.GetPeerRateLimits(
                 msg, timeout=self.conf.batch_timeout_s,
-                wait_for_ready=wait_for_ready)
+                wait_for_ready=wait_for_ready, metadata=metadata)
         except grpc.RpcError as e:
             self._record_err(str(e.code()))
             raise
@@ -295,17 +316,21 @@ class PeerClient:
 
     def _send_batch(self, batch) -> None:
         """Send one batch, demuxing responses by index
-        (reference: peer_client.go:287-319)."""
+        (reference: peer_client.go:287-319). One RPC carries one trace
+        context: the first traced entry's (a merged batch IS one shared
+        hop — co-batched traces share its owner-side spans)."""
+        span = next((s for _, _, s in batch if s is not None), None)
         try:
-            resps = self.get_peer_rate_limits([req for req, _ in batch])
+            resps = self.get_peer_rate_limits(
+                [req for req, _, _ in batch], trace_span=span)
             if len(resps) != len(batch):
                 raise RuntimeError(
                     f"server responded with incorrect rate limit list size: "
                     f"{len(resps)} != {len(batch)}"
                 )
-            for (_, fut), resp in zip(batch, resps):
+            for (_, fut, _), resp in zip(batch, resps):
                 fut.set_result(resp)
         except Exception as e:  # noqa: BLE001 — every waiter must wake
-            for _, fut in batch:
+            for _, fut, _ in batch:
                 if not fut.done():
                     fut.set_exception(e)

@@ -45,6 +45,23 @@ log = logging.getLogger("gubernator_tpu.peerlink")
 
 METHOD_GET_RATE_LIMITS = 0
 METHOD_GET_PEER_RATE_LIMITS = 1
+# Method-byte flag: the frame's FIRST item is a trace-context carrier (its
+# unique_key field holds the W3C traceparent; its response lane is a zero
+# placeholder). The reserved high bits of the method byte are the frame
+# format's only spare field, so trace context rides there without touching
+# the C++ parser: flagged methods never match the IO-thread fast paths
+# (they check method == 0/1 exactly) and reach the Python workers with the
+# flag intact.
+METHOD_TRACED = 0x80
+TRACE_CARRIER_NAME = "tp"
+
+
+def trace_carrier(span) -> RateLimitReq:
+    """The reserved item 0 of a TRACED frame (see METHOD_TRACED)."""
+    from gubernator_tpu.obs.trace import format_traceparent
+
+    return RateLimitReq(name=TRACE_CARRIER_NAME,
+                        unique_key=format_traceparent(span))
 
 
 # Columnar wire layout (see native/peerlink.cpp): fields ride as arrays,
@@ -373,6 +390,9 @@ class PeerLinkService:
             self.grpc_port = gp
         self.instance = instance
         self.stats = {"batches": 0, "requests": 0, "errors": 0}
+        if metrics is not None and hasattr(metrics, "set_peerlink_stats"):
+            # exports batches/requests/errors as peerlink_* families
+            metrics.set_peerlink_stats(lambda: self.stats)
         self._public_fast = False  # method-0 owner paths (standalone only)
         # native lone-request fast path: 1-item peer-hop frames decide in
         # the C++ IO thread against the engine's directory row mirrors
@@ -594,9 +614,14 @@ class PeerLinkService:
                 meta_buf = b""
                 b["meta_off"][:got + 1] = 0
             try:
+                t_send = time.perf_counter()
                 self._lib.pls_send_responses(
                     self._handle, got, *resp_ptrs, err_buf, meta_ptr,
                     meta_buf)
+                if self._metrics is not None:
+                    self._metrics.peerlink_stage_ms.labels(
+                        stage="send").observe(
+                            (time.perf_counter() - t_send) * 1e3)
             except Exception:  # noqa: BLE001
                 log.exception("peerlink send_responses failed")
                 self.stats["errors"] += 1
@@ -636,7 +661,7 @@ class PeerLinkService:
             # their method name).
             rids = b["rid"][:got]
             conns = b["conn"][:got]
-            meth = b["method"][:got]
+            meth = b["method"][:got] & ~METHOD_TRACED  # count by base method
             starts = np.ones(got, bool)
             starts[1:] = ((rids[1:] != rids[:-1])
                           | (conns[1:] != conns[:-1]))
@@ -665,9 +690,14 @@ class PeerLinkService:
             columnar_ok = eng is not None and (
                 m == METHOD_GET_PEER_RATE_LIMITS
                 or (m == METHOD_GET_RATE_LIMITS and self._public_fast))
-            if not (columnar_ok
-                    and self._columnar_chunk(m, eng, j, k, b, errs,
-                                             metas)):
+            if m & METHOD_TRACED:
+                # sampled frames: decode the carrier, record owner-side
+                # spans, ride the combiner (the traced window's wait is
+                # part of the phase picture)
+                self._traced_chunk(m, j, k, b, errs, metas)
+            elif not (columnar_ok
+                      and self._columnar_chunk(m, eng, j, k, b, errs,
+                                               metas)):
                 self._object_chunk(m, j, k, b, errs, metas)
             j = k
 
@@ -706,6 +736,11 @@ class PeerLinkService:
             # Python and carry no histogram sample — documented limit
             ms = (time.perf_counter() - t_batch0) * 1e3
             n0, n1 = getattr(self, "_frames_in_batch", (0, 0))
+            try:
+                self._metrics.peerlink_stage_ms.labels(
+                    stage="handle").observe(ms)
+            except Exception:  # noqa: BLE001
+                pass
             try:
                 if n0:
                     self._metrics.grpc_request_duration.labels(
@@ -808,10 +843,68 @@ class PeerLinkService:
         if metas is not None and resp.metadata:
             metas.append((i, _encode_pb_metadata(resp.metadata)))
 
-    def _object_chunk(self, m: int, j: int, k: int, b: dict,
+    def _traced_chunk(self, m: int, j: int, k: int, b: dict,
                       errs: list, metas: list) -> None:
+        """A run of TRACED items: split at frame boundaries (rid/conn
+        change — the aggregated pull may have merged several traced
+        frames) and handle each with its own trace context."""
+        rid, conn = b["rid"], b["conn"]
+        i = j
+        while i < k:
+            e = i + 1
+            while e < k and rid[e] == rid[i] and conn[e] == conn[i]:
+                e += 1
+            # the carrier is item 0 OF ITS FRAME; a frame continued from a
+            # previous (batch-cap-split) chunk carries no new context
+            frame_start = i == 0 or rid[i] != rid[i - 1] \
+                or conn[i] != conn[i - 1]
+            self._traced_frame(m & ~METHOD_TRACED, i, e, b, errs, metas,
+                               frame_start)
+            i = e
+
+    def _traced_frame(self, base: int, i: int, e: int, b: dict, errs: list,
+                      metas: list, frame_start: bool) -> None:
+        from gubernator_tpu.obs import trace
+
+        span = None
+        start = i
+        if frame_start:
+            # decode the reserved carrier item's traceparent
+            lo, hi = int(b["key_off"][i]), int(b["key_off"][i + 1])
+            split = lo + int(b["name_len"][i])
+            tracer = getattr(self.instance, "tracer", None)
+            if tracer is not None:
+                try:
+                    span = tracer.continue_trace(
+                        "owner.apply", b["keys"][split:hi].decode())
+                except UnicodeDecodeError:
+                    span = None
+            if span is not None:
+                span.set("transport", "peerlink")
+            self._fill_one(b, i, RateLimitResp(), errs, metas)
+            start = i + 1
+        if start >= e:
+            return
+        token = trace.use(span)
+        try:
+            # via the combiner (direct=False): a traced window's
+            # enqueue->launch wait is exactly the phase a sampled request
+            # exists to measure
+            self._object_chunk(base, start, e, b, errs, metas,
+                               direct=span is None)
+        finally:
+            trace.reset(token)
+            if span is not None:
+                self.instance.tracer.finish(span)
+
+    def _object_chunk(self, m: int, j: int, k: int, b: dict,
+                      errs: list, metas: list,
+                      direct: bool = True) -> None:
         """The request-object path (non-peer-hop methods, or no columnar
-        backend): decode -> one handler call -> fill."""
+        backend): decode -> one handler call -> fill. `direct=False`
+        routes peer-hop chunks through the combiner instead of
+        apply_owner_batch_direct (traced frames: the batch-window wait is
+        part of the measured phases)."""
         koff = b["key_off"][j:k + 1].tolist()
         nlen = b["name_len"][j:k].tolist()
         hits = b["hits"][j:k].tolist()
@@ -839,11 +932,14 @@ class PeerLinkService:
         try:
             if not good:
                 handled = []
-            elif m == METHOD_GET_PEER_RATE_LIMITS:
+            elif m == METHOD_GET_PEER_RATE_LIMITS and direct:
                 # this worker's pull IS the batch window: go straight to
                 # the backend (owner semantics preserved; combiner hop
                 # saved — see Instance.apply_owner_batch_direct)
                 handled = self.instance.apply_owner_batch_direct(
+                    good, from_peer_rpc=True)
+            elif m == METHOD_GET_PEER_RATE_LIMITS:
+                handled = self.instance.apply_owner_batch(
                     good, from_peer_rpc=True)
             else:
                 handled = self.instance.get_rate_limits(good)

@@ -13,6 +13,7 @@ from typing import Optional
 
 import grpc
 
+from gubernator_tpu.obs import trace
 from gubernator_tpu.service.convert import (
     health_to_pb,
     req_from_pb,
@@ -34,6 +35,18 @@ _CODES = {
 }
 
 
+def _incoming_traceparent(instance, context) -> str:
+    """The request's traceparent header, scanned only when the daemon's
+    tracer is on (sample rate 0 never touches the metadata)."""
+    if not instance.tracer.active:
+        return ""
+    try:
+        return trace.traceparent_from_metadata(
+            context.invocation_metadata()) or ""
+    except Exception:  # noqa: BLE001 — raw-punt contexts carry no metadata
+        return ""
+
+
 class V1Servicer:
     """Public API endpoints (reference: proto/gubernator.proto:27-45)."""
 
@@ -41,12 +54,24 @@ class V1Servicer:
         self.instance = instance
 
     def GetRateLimits(self, request, context):
+        # ingress root span: continues a sampled remote trace or samples a
+        # new one; None (the common case) costs nothing further
+        span = self.instance.tracer.maybe_trace(
+            "ingress", _incoming_traceparent(self.instance, context)) \
+            if self.instance.tracer.active else None
+        token = trace.use(span) if span is not None else None
         try:
             resps = self.instance.get_rate_limits(
                 [req_from_pb(m) for m in request.requests]
             )
         except ApiError as e:
             context.abort(_CODES.get(e.code, grpc.StatusCode.UNKNOWN), e.message)
+        finally:
+            if span is not None:
+                span.set("requests", len(request.requests))
+                span.set("transport", "grpc")
+                trace.reset(token)
+                self.instance.tracer.finish(span)
         return pb.GetRateLimitsResp(responses=resps_to_pb_list(resps))
 
     def HealthCheck(self, request, context):
@@ -60,12 +85,24 @@ class PeersV1Servicer:
         self.instance = instance
 
     def GetPeerRateLimits(self, request, context):
+        # owner-side span: recorded ONLY when the forwarding peer sent
+        # sampled trace context (internal surfaces never originate traces)
+        span = self.instance.tracer.continue_trace(
+            "owner.apply", _incoming_traceparent(self.instance, context)) \
+            if self.instance.tracer.active else None
+        if span is not None:
+            span.set("transport", "grpc")
+        token = trace.use(span) if span is not None else None
         try:
             resps = self.instance.get_peer_rate_limits(
                 [req_from_pb(m) for m in request.requests]
             )
         except ApiError as e:
             context.abort(_CODES.get(e.code, grpc.StatusCode.UNKNOWN), e.message)
+        finally:
+            if span is not None:
+                trace.reset(token)
+                self.instance.tracer.finish(span)
         return peers_pb.GetPeerRateLimitsResp(rate_limits=resps_to_pb_list(resps))
 
     def UpdatePeerGlobals(self, request, context):

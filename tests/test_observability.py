@@ -1,0 +1,351 @@
+"""End-to-end observability: trace propagation across a 2-peer cluster
+(gRPC forward and peerlink fast path), phase metrics exposition, and the
+/v1/debug/* introspection endpoints."""
+
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from gubernator_tpu.cluster.harness import LocalCluster, wire_peerlink
+from gubernator_tpu.models.engine import Engine
+from gubernator_tpu.obs import trace
+from gubernator_tpu.obs.introspect import debug_vars
+from gubernator_tpu.obs.trace import (
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
+from gubernator_tpu.service.combiner import BackendCombiner
+from gubernator_tpu.service.convert import req_to_pb
+from gubernator_tpu.service.grpc_api import dial_v1
+from gubernator_tpu.service.http_gateway import HttpGateway
+from gubernator_tpu.service.metrics import Metrics
+from gubernator_tpu.service.pb import gubernator_pb2 as pb
+from gubernator_tpu.types import RateLimitReq
+
+
+def _req(key, name="obs", hits=1, limit=1000, duration=60_000):
+    return RateLimitReq(
+        name=name, unique_key=key, hits=hits, limit=limit, duration=duration
+    )
+
+
+CLIENT_TP = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+CLIENT_TID = "ab" * 16
+
+
+class TestTraceparent:
+    def test_roundtrip(self):
+        t = Tracer(sample=1.0)
+        span = t.maybe_trace("ingress")
+        tid, sid, sampled = parse_traceparent(format_traceparent(span))
+        assert (tid, sid, sampled) == (span.trace_id, span.span_id, True)
+
+    def test_continues_remote_trace(self):
+        t = Tracer(sample=1.0)
+        span = t.maybe_trace("ingress", CLIENT_TP)
+        assert span.trace_id == CLIENT_TID
+        assert span.parent_id == "cd" * 8
+
+    @pytest.mark.parametrize("bad", [
+        "", "garbage", "00-short-cd-01", "zz-" + "ab" * 16 + "-" + "cd" * 8 + "-01",
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",  # all-zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span id
+    ])
+    def test_malformed_rejected(self, bad):
+        assert parse_traceparent(bad) is None
+
+    def test_unsampled_remote_not_continued(self):
+        unsampled = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-00"
+        t = Tracer(sample=1.0)
+        span = t.maybe_trace("ingress", unsampled)
+        # a fresh trace is sampled instead (local rate 1.0), not continued
+        assert span is not None and span.trace_id != CLIENT_TID
+        assert t.continue_trace("owner.apply", unsampled) is None
+
+    def test_sample_zero_is_off(self):
+        t = Tracer(sample=0.0)
+        assert not t.active
+        assert t.maybe_trace("ingress") is None
+        # even a sampled remote context is dropped when tracing is off
+        assert t.maybe_trace("ingress", CLIENT_TP) is None
+
+    def test_slow_request_log(self, caplog):
+        t = Tracer(sample=1.0, slow_ms=0.0001, service="svc")
+        span = t.maybe_trace("ingress")
+        t.record_span("combiner.wait", span, span.start_ns,
+                      span.start_ns + 1000)
+        with caplog.at_level(logging.WARNING, logger="gubernator_tpu.slow"):
+            t.finish(span)
+        events = [json.loads(r.message) for r in caplog.records
+                  if "slow_request" in r.message]
+        assert events and events[0]["trace_id"] == span.trace_id
+        assert any(s["name"] == "combiner.wait" for s in events[0]["spans"])
+
+
+class TestCombinerMetrics:
+    def test_prometheus_counters_and_dict_view(self):
+        eng = Engine(capacity=256, min_width=8, max_width=64)
+        m = Metrics()
+        c = BackendCombiner(eng, metrics=m)
+        try:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(max_workers=8) as pool:
+                futs = [pool.submit(c.submit, [_req(f"cm{i}")], 1_000)
+                        for i in range(8)]
+                for f in futs:
+                    f.result()
+            # dict view kept for tests/harnesses
+            assert c.stats["submissions"] == 8
+            assert c.stats["windows"] >= 1
+            text = m.render().decode()
+            assert "combiner_submissions_total 8.0" in text
+            assert "combiner_wait_milliseconds_count 8.0" in text
+            assert "combiner_window_items_count" in text
+        finally:
+            c.close()
+
+    def test_traced_submission_records_phases(self):
+        eng = Engine(capacity=256, min_width=8, max_width=64)
+        t = Tracer(sample=1.0)
+        c = BackendCombiner(eng, tracer=t)
+        try:
+            root = t.maybe_trace("ingress")
+            token = trace.use(root)
+            try:
+                c.submit([_req("tr")], 1_000)
+            finally:
+                trace.reset(token)
+            t.finish(root)
+            names = [s["name"] for s in t.traces(root.trace_id)[root.trace_id]]
+            assert "combiner.wait" in names
+            assert "kernel.dispatch" in names
+        finally:
+            c.close()
+
+
+def _tracing_cluster(n=2):
+    cluster = LocalCluster().start(n)
+    for ci in cluster.instances:
+        ci.instance.tracer.sample = 1.0  # same object the combiner holds
+    return cluster
+
+
+def _split_owner(cluster):
+    """(non_owner_ci, owner_ci, key) with the two instances distinct."""
+    for i in range(64):
+        key = f"route{i}"
+        owner = cluster.owner_of(_req(key).hash_key())
+        for ci in cluster.instances:
+            if ci is not owner:
+                return ci, owner, key
+    raise AssertionError("no key split the 2-node ring")
+
+
+def _span_names(tracer, tid):
+    return {s["name"] for s in tracer.traces(tid).get(tid, [])}
+
+
+class TestClusterTracing:
+    def test_grpc_forward_joins_one_trace(self):
+        cluster = _tracing_cluster(2)
+        try:
+            non_owner, owner, key = _split_owner(cluster)
+            stub = dial_v1(non_owner.address)
+            resp = stub.GetRateLimits(
+                pb.GetRateLimitsReq(requests=[req_to_pb(_req(key))]),
+                metadata=(("traceparent", CLIENT_TP),), timeout=10)
+            assert resp.responses[0].limit == 1000
+            ingress_names = _span_names(non_owner.instance.tracer, CLIENT_TID)
+            owner_names = _span_names(owner.instance.tracer, CLIENT_TID)
+            assert {"ingress", "peer.hop"} <= ingress_names
+            assert {"owner.apply", "combiner.wait",
+                    "kernel.dispatch"} <= owner_names
+        finally:
+            cluster.stop()
+
+    def test_peerlink_forward_one_trace_via_debug_endpoint(self):
+        """Acceptance: one request forwarded non-owner -> owner over
+        peerlink yields one trace with >= 4 phase spans, reconstructed
+        from the daemons' /v1/debug/traces endpoints."""
+        cluster = _tracing_cluster(2)
+        links, gateways = [], []
+        try:
+            links = wire_peerlink(cluster)
+            if not links:
+                pytest.skip("no free peerlink port offset on this host")
+            for ci in cluster.instances:
+                gw = HttpGateway(ci.instance, "127.0.0.1:0")
+                gw.start()
+                gateways.append(gw)
+            non_owner, owner, key = _split_owner(cluster)
+            gw_by_inst = dict(zip([ci.instance for ci in cluster.instances],
+                                  gateways))
+            body = json.dumps({"requests": [
+                {"name": "obs", "uniqueKey": key, "hits": 1,
+                 "limit": 1000, "duration": 60000}]}).encode()
+            req = urllib.request.Request(
+                f"http://{gw_by_inst[non_owner.instance].address}"
+                "/v1/GetRateLimits",
+                data=body, headers={"Content-Type": "application/json",
+                                    "traceparent": CLIENT_TP})
+            out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+            assert out["responses"][0]["limit"] == "1000"
+
+            spans = []
+            for gw in gateways:
+                dump = json.loads(urllib.request.urlopen(
+                    f"http://{gw.address}/v1/debug/traces?id={CLIENT_TID}",
+                    timeout=10).read())
+                spans.extend(dump["traces"].get(CLIENT_TID, []))
+            names = {s["name"] for s in spans}
+            assert len(spans) >= 4
+            assert {"ingress", "peer.hop", "combiner.wait",
+                    "kernel.dispatch"} <= names
+            # the owner hop really rode the native link, not the gRPC tier
+            owner_apply = [s for s in spans if s["name"] == "owner.apply"]
+            assert owner_apply and \
+                owner_apply[0]["attrs"]["transport"] == "peerlink"
+        finally:
+            for gw in gateways:
+                gw.close()
+            for svc in links:
+                svc.close()
+            cluster.stop()
+
+    def test_untraced_requests_record_nothing(self):
+        cluster = LocalCluster().start(2)  # sample stays 0.0
+        try:
+            non_owner, owner, key = _split_owner(cluster)
+            stub = dial_v1(non_owner.address)
+            stub.GetRateLimits(
+                pb.GetRateLimitsReq(requests=[req_to_pb(_req(key))]),
+                metadata=(("traceparent", CLIENT_TP),), timeout=10)
+            assert non_owner.instance.tracer.traces() == {}
+            assert owner.instance.tracer.traces() == {}
+        finally:
+            cluster.stop()
+
+
+class TestMetricsExposition:
+    def test_new_families_exposed_after_traffic(self):
+        cluster = LocalCluster().start(2)
+        try:
+            ci = cluster.instances[0]
+            ci.instance.get_rate_limits(
+                [_req(f"mx{i}") for i in range(10)])
+            text = ci.metrics.render(ci.instance).decode()
+            for family in (
+                "combiner_submissions_total",
+                "combiner_windows_total",
+                "combiner_merged_windows_total",
+                "combiner_wait_milliseconds_bucket",
+                "combiner_window_items_bucket",
+                "engine_device_dispatch_milliseconds_bucket",
+                "engine_window_lanes_bucket",
+                "engine_kernel_dispatch_total",
+                "engine_key_table_size",
+                "global_queue_depth",
+                "global_cache_size",
+                "global_hits_sent_total",
+                "global_broadcasts_sent_total",
+                "peerlink_stage_milliseconds",
+            ):
+                assert family in text, family
+            # cache_size now reports live key-table occupancy
+            line = next(ln for ln in text.splitlines()
+                        if ln.startswith("cache_size "))
+            assert float(line.split()[1]) >= 1.0
+        finally:
+            cluster.stop()
+
+
+class TestDebugVars:
+    def test_schema_over_http(self):
+        cluster = LocalCluster().start(1)
+        gw = None
+        try:
+            ci = cluster.instances[0]
+            ci.instance.get_rate_limits([_req("dv1"), _req("dv2")])
+            gw = HttpGateway(ci.instance, "127.0.0.1:0", metrics=ci.metrics)
+            gw.start()
+            out = json.loads(urllib.request.urlopen(
+                f"http://{gw.address}/v1/debug/vars", timeout=10).read())
+            for section in ("engine", "combiner", "global", "peers",
+                            "kernel", "trace"):
+                assert section in out, section
+            assert out["engine"]["key_table_size"] >= 2
+            assert out["engine"]["stats"]["requests"] >= 2
+            assert out["combiner"]["submissions"] >= 1
+            assert "hits_queue_depth" in out["global"]
+            assert out["peers"]["local"][0]["address"] == ci.address
+            assert out["trace"]["sample"] == 0.0
+            assert any("@" in k for k in out["kernel"]["windows"])
+        finally:
+            if gw is not None:
+                gw.close()
+            cluster.stop()
+
+    def test_disabled_endpoints_404(self):
+        cluster = LocalCluster().start(1)
+        gw = None
+        try:
+            gw = HttpGateway(cluster.instances[0].instance, "127.0.0.1:0",
+                             debug_endpoints=False)
+            gw.start()
+            with pytest.raises(urllib.error.HTTPError) as err:
+                urllib.request.urlopen(
+                    f"http://{gw.address}/v1/debug/vars", timeout=10)
+            assert err.value.code == 404
+        finally:
+            if gw is not None:
+                gw.close()
+            cluster.stop()
+
+    def test_debug_vars_without_http(self):
+        eng = Engine(capacity=128, min_width=8, max_width=32)
+        from gubernator_tpu.service.config import InstanceConfig
+        from gubernator_tpu.service.instance import Instance
+
+        inst = Instance(InstanceConfig(backend=eng), advertise_address="a:1")
+        try:
+            inst.get_rate_limits([_req("raw")])
+            out = debug_vars(inst)
+            assert out["engine"]["type"] == "Engine"
+            assert out["engine"]["capacity"] == 128
+        finally:
+            inst.close()
+
+
+class TestEnvKnobs:
+    def test_observability_env(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv("GUBER_TRACE_SAMPLE", "0.25")
+        monkeypatch.setenv("GUBER_SLOW_REQUEST_MS", "150")
+        monkeypatch.setenv("GUBER_DEBUG_ENDPOINTS", "0")
+        conf = config_from_env([])
+        assert conf.trace_sample == 0.25
+        assert conf.slow_request_ms == 150.0
+        assert conf.debug_endpoints is False
+
+    def test_defaults(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        for var in ("GUBER_TRACE_SAMPLE", "GUBER_SLOW_REQUEST_MS",
+                    "GUBER_DEBUG_ENDPOINTS"):
+            monkeypatch.delenv(var, raising=False)
+        conf = config_from_env([])
+        assert conf.trace_sample == 0.0
+        assert conf.slow_request_ms == 0.0
+        assert conf.debug_endpoints is True
+
+    def test_bad_sample_rejected(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv("GUBER_TRACE_SAMPLE", "1.5")
+        with pytest.raises(ValueError, match="GUBER_TRACE_SAMPLE"):
+            config_from_env([])
