@@ -462,12 +462,13 @@ class MemberlistPool(Pool):
             cur.incarnation = inc
             cur.state_change = now
             n = len(self._nodes)
-            # ceil like hashicorp/memberlist's suspicionTimeout — the raw
-            # log would shorten the window up to ~40% at 10-99 nodes and
-            # over-declare DEAD under packet loss
+            # fractional nodeScale, exactly hashicorp/memberlist's
+            # suspicionTimeout (state.go): max(1, log10(max(1, n))) — the
+            # earlier ceil(log10(n+1)) overshot the reference's window up
+            # to ~2x at small clusters while claiming parity
             cur.suspicion_deadline = now + (
                 self.suspicion_mult
-                * max(1.0, math.ceil(math.log10(max(n, 1) + 1)))
+                * max(1.0, math.log10(max(n, 1)))
                 * self.probe_interval
             )
         self._queue_broadcast(name, wire.encode_msg(wire.SUSPECT, {

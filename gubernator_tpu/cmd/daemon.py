@@ -176,6 +176,14 @@ def build_pool(conf: DaemonConfig, instance: Instance):
                 secret_key=ring[0] if ring else b"",
                 secret_keys=ring[1:],
             )
+        if conf.memberlist_secret_keys:
+            # the operator asked for encrypted gossip; silently dropping
+            # the keyring would ship cleartext membership traffic
+            raise ValueError(
+                "GUBER_MEMBERLIST_SECRET_KEYS is set but "
+                "GUBER_MEMBERLIST_COMPAT=0 selects GossipPool, which "
+                "cannot encrypt; unset the keys or use the "
+                "memberlist-compatible pool (GUBER_MEMBERLIST_COMPAT=1)")
         return discovery.GossipPool(
             bind_address=bind,
             grpc_address=conf.advertise_address or conf.grpc_address,
@@ -272,9 +280,20 @@ def main(argv=None) -> int:
             local_picker=build_picker(conf),
             metrics=metrics,
             tracer=tracer,
+            pipeline_depth=conf.pipeline_depth or None,  # 0 -> env/auto
+            pipeline_scan=conf.pipeline_scan,
         ),
         advertise_address=advertise,
     )
+    if instance.combiner.pipelined:
+        # compile the burst scan shapes up front (a cold compile inside a
+        # live window stalls it for the whole compile), then resolve an
+        # 'auto' depth against the live link with no-op windows
+        if hasattr(backend, "warmup_pipeline"):
+            backend.warmup_pipeline(max_group=conf.pipeline_scan)
+        depth = instance.combiner.autotune()
+        log.info("pipelined serving loop on: depth=%d scan<=%d",
+                 depth, conf.pipeline_scan)
     if multi_host:
         # cross-host GLOBAL aggregation rides the device fabric: one
         # lockstep collective per tick replaces the per-peer gRPC pipelines

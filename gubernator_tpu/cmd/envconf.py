@@ -76,6 +76,20 @@ def _env_float(name: str, default: float) -> float:
     return float(v) if v else default
 
 
+def _env_pipeline_depth() -> int:
+    """GUBER_PIPELINE_DEPTH: 'auto' (default) -> 0, else a non-negative
+    int (1 pins the serial lock-step combiner path)."""
+    v = os.environ.get("GUBER_PIPELINE_DEPTH", "").strip().lower()
+    if v in ("", "auto"):
+        return 0
+    depth = int(v)
+    if depth < 0:
+        raise ValueError(
+            f"'GUBER_PIPELINE_DEPTH={v}' is invalid; must be 'auto' or a "
+            "non-negative integer")
+    return depth
+
+
 def _env_bool(name: str) -> bool:
     """Go strconv.ParseBool semantics for security-relevant flags: 'false'
     must mean false. (The reference treats ANY non-empty
@@ -147,6 +161,12 @@ class DaemonConfig:
     device_directory: bool = False  # on-chip key directory (engine only)
     min_batch_width: int = 64
     max_batch_width: int = 8192
+    # depth-N pipelined serving loop (service/combiner.py): cycles in
+    # flight between kernel launch and readback. 0 = auto (boot-time 3/6
+    # probe against the live link); 1 pins the serial lock-step path.
+    # pipeline_scan caps the windows coalesced into one scan-group launch.
+    pipeline_depth: int = 0
+    pipeline_scan: int = 8
     # durable bucket snapshot: load at boot, save at shutdown (FileLoader;
     # the reference leaves persistence to the user, README.md:159-175)
     snapshot_path: str = ""
@@ -250,6 +270,8 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         device_directory=_env_bool("GUBER_DEVICE_DIRECTORY"),
         min_batch_width=_env_int("GUBER_MIN_BATCH_WIDTH", 64),
         max_batch_width=_env_int("GUBER_MAX_BATCH_WIDTH", 8192),
+        pipeline_depth=_env_pipeline_depth(),
+        pipeline_scan=_env_int("GUBER_PIPELINE_SCAN", 8),
         snapshot_path=_env_str("GUBER_SNAPSHOT_PATH"),
         snapshot_format=_env_str("GUBER_SNAPSHOT_FORMAT", "binary"),
         profile_port=_env_int("GUBER_PROFILE_PORT", 0),
@@ -273,6 +295,10 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         raise ValueError(
             f"'GUBER_COLLECTIVES={conf.collectives}' is invalid; "
             "choices are ['psum', 'ring']")
+    if conf.pipeline_scan < 1:
+        raise ValueError(
+            f"'GUBER_PIPELINE_SCAN={conf.pipeline_scan}' is invalid; "
+            "must be >= 1")
     if not 0.0 <= conf.trace_sample <= 1.0:
         raise ValueError(
             f"'GUBER_TRACE_SAMPLE={conf.trace_sample}' is invalid; "

@@ -476,6 +476,239 @@ class Engine:
                 responses[i] = resp
         return responses  # type: ignore[return-value]
 
+    # ----------------------------------------------------- pipelined serving
+    # The launch/collect split of the request-object path: the combiner
+    # (service/combiner.py) keeps up to GUBER_PIPELINE_DEPTH window groups
+    # in flight — launch N+1 is admitted while window N's readback is still
+    # crossing the link. Per-key sequential semantics survive because (a)
+    # launches are serialized under the engine lock, so host prep order ==
+    # dispatch order, and (b) the device state chain (each launch consumes
+    # the previous launch's table) orders the windows' effects on device —
+    # the same argument submit_columnar already rides. Leftover lanes
+    # (duplicate occurrences, gregorian, invalid) are retired AT LAUNCH,
+    # between this group's dispatch and any later launch, so a key's later
+    # arrivals can never overtake its packed first occurrence
+    # (tests/test_pipeline.py proves this with a duplicate-key hammer
+    # differential against the serial path).
+
+    def supports_pipeline(self) -> bool:
+        """True when the non-blocking launch/collect split is available:
+        native one-pass prep and no Store hooks (a Store needs synchronous
+        host calls around every window)."""
+        return self._prep_fast is not None and self.store is None
+
+    def launch_windows(self, windows, now_ms: Optional[int] = None,
+                       staging=None):
+        """Dispatch 1..K request-object windows as ONE device launch
+        (K > 1 rides the scan kernel) without blocking on the readback.
+
+        `windows` is a list of request lists, each 0 < len <= max_width;
+        `staging`, when given, is a dict the engine parks reusable staging
+        buffers in (keyed by shape) — the combiner hands each pipeline
+        slot its own dict so a buffer is never rewritten while its launch
+        may still be reading it. Returns an opaque handle for
+        collect_windows, or None when the pipelined path cannot take the
+        group at all (nothing mutated, nothing dispatched)."""
+        if not self.supports_pipeline():
+            return None
+        k_req = len(windows)
+        if not 0 < k_req <= self._MAX_SCAN:
+            return None
+        if any(not 0 < len(wk) <= self.max_width for wk in windows):
+            return None
+        if now_ms is None:
+            now_ms = millisecond_now()
+        w = max(_bucket_width(len(wk), self.min_width, self.max_width)
+                for wk in windows)
+        kb = _bucket_pow2(k_req) if k_req > 1 else 1
+        shape = (kb, 9, w)
+        buf = None if staging is None else staging.get(shape)
+        if buf is None:
+            buf = np.zeros(shape, np.int64)
+            if staging is not None:
+                staging[shape] = buf
+        else:
+            buf.fill(0)  # the prep contract: zeroed staging rows
+        # Segmented group launch. A window whose prep yields LEFTOVERS
+        # (duplicate occurrences, gregorian, invalid) CUTS the group: the
+        # segment so far dispatches and its tails retire before any later
+        # window preps — the ISSUE's pipeline-barrier rule. Otherwise a
+        # key pending in window k's tail could be overtaken by its next
+        # arrival packed into window k+1 of the same launch, breaking the
+        # per-key submission order the serial combiner guarantees. The
+        # common serving shape (distinct keys, hits=1) never cuts: one
+        # scan dispatch for the whole group.
+        meta: List[Optional[tuple]] = [None] * k_req
+        tails: List[Optional[list]] = [None] * k_req
+        segments = []  # (staged, k_start, m, scanned) in launch order
+        k = 0
+        while k < k_req:
+            seg_start = k
+            with self._lock:
+                t0 = time.perf_counter_ns()  # excludes the lock wait
+                total = 0
+                rounds = 0
+                cut = False
+                while k < k_req and not cut:
+                    wk = windows[k]
+                    n0, lane_item, leftover, inject = self._prep_fast(
+                        self.directory, wk, buf[k], _GREG_MASK)
+                    if n0 == PREP_OVERCOMMIT:
+                        self._apply_inject_rows(inject)
+                        raise RuntimeError(
+                            f"key directory over-committed: "
+                            f">{self.capacity} distinct keys in one lookup")
+                    if n0 < 0:
+                        # defensive — the size preconditions above rule
+                        # this out; nothing was committed for THIS window,
+                        # so it retires whole through the python tail
+                        buf[k][0, :] = -1
+                        meta[k] = (0, None,
+                                   np.arange(len(wk), dtype=np.int32))
+                        k += 1
+                        cut = True
+                        break
+                    self._apply_inject_rows(inject)
+                    if n0 == 0:
+                        buf[k][0, :] = -1  # prep leaves slot row zeroed
+                    meta[k] = (n0, lane_item, leftover)
+                    total += n0
+                    rounds += 1 if n0 else 0
+                    k += 1
+                    cut = len(leftover) > 0
+                m = k - seg_start
+                t1 = time.perf_counter_ns()
+                self.stats.stage_ns["prep"] += t1 - t0
+                self.stats.requests += total
+                self.stats.batches += m
+                self.stats.rounds += rounds
+                if m == 1:
+                    staged = self._dispatch_staged(buf[seg_start], now_ms)
+                    scanned = False
+                else:
+                    kb2 = _bucket_pow2(m)
+                    if seg_start == 0 and k == k_req and kb2 == kb:
+                        # the whole group in one segment: dispatch the
+                        # staging stack itself, marking the pow2 pads
+                        stack = buf
+                        for kk in range(k_req, kb):
+                            stack[kk][0, :] = -1
+                    elif kb2 == m:
+                        stack = buf[seg_start:k]  # contiguous prefix run
+                    else:  # rare (a cut left a non-pow2 run): copy-pad
+                        stack = np.zeros((kb2, 9, w), np.int64)
+                        stack[:m] = buf[seg_start:k]
+                        stack[m:, 0, :] = -1
+                    staged = self._dispatch_scan_staged(stack, now_ms)
+                    scanned = True
+                self.stats.stage_ns["device"] += time.perf_counter_ns() - t1
+            segments.append((staged, seg_start, m, scanned))
+            # Leftover tails retire NOW — after this segment's dispatch,
+            # before any later window preps — preserving per-key
+            # submission order exactly as the serial path does.
+            # _slow_window blocks on its own readback; rare path.
+            for kk in range(seg_start, k):
+                leftover = meta[kk][2]
+                if leftover is not None and len(leftover):
+                    idxs = leftover.tolist()
+                    tails[kk] = self._slow_window(
+                        [windows[kk][i] for i in idxs], now_ms,
+                        count_batch=False)
+        return (segments, windows, meta, tails)
+
+    def collect_windows(self, handle):
+        """Block on a launched group's readbacks (in dispatch order) and
+        demux: returns one response list per window, in launch order. Runs
+        outside the engine lock — dispatch order is already fixed — so
+        later launches proceed while this readback drains."""
+        segments, windows, meta, tails = handle
+        results: List[Optional[list]] = [None] * len(windows)
+        over = 0
+        lanes = 0
+        t_fetch = 0
+        t0 = time.perf_counter_ns()
+        for staged, seg_start, m, scanned in segments:
+            tf = time.perf_counter_ns()
+            out = self._fetch_staged(staged)  # device sync, this segment
+            t_fetch += time.perf_counter_ns() - tf
+            for k in range(seg_start, seg_start + m):
+                wk = windows[k]
+                n0, lane_item, leftover = meta[k]
+                responses: List[Optional[RateLimitResp]] = [None] * len(wk)
+                if n0:
+                    rows = out[k - seg_start] if scanned else out
+                    status, limit, remaining, reset = rows[:, :n0].tolist()
+                    over += status.count(1)
+                    if n0 == len(wk):
+                        # nothing was skipped, so lanes are in request
+                        # order — build the list directly (the common
+                        # serving shape; ~2x less python per decision
+                        # than the scatter loop)
+                        responses = [
+                            RateLimitResp(st, li, re_, rs)
+                            for st, li, re_, rs in zip(
+                                status, limit, remaining, reset)
+                        ]
+                    else:
+                        for j, i in enumerate(lane_item.tolist()):
+                            responses[i] = RateLimitResp(
+                                status[j], limit[j], remaining[j], reset[j])
+                    lanes += n0
+                tail = tails[k]
+                if tail is not None:
+                    for i, resp in zip(leftover.tolist(), tail):
+                        responses[i] = resp
+                results[k] = responses
+        t2 = time.perf_counter_ns()
+        self._obs_device(t_fetch, lanes)
+        with self._lock:  # concurrent completers: counters stay exact
+            self.stats.over_limit += over
+            self.stats.stage_ns["device"] += t_fetch
+            self.stats.stage_ns["demux"] += t2 - t0 - t_fetch
+        return results
+
+    def launch_noop(self, width: Optional[int] = None):
+        """Dispatch one all-padding window (every lane drops — the table
+        is untouched) and return its handle: the combiner's depth
+        auto-probe times these to pick cycles-in-flight without mutating
+        state."""
+        w = width or self.min_width
+        packed = np.zeros((9, w), np.int64)
+        packed[0, :] = -1
+        with self._lock:
+            return self._dispatch_staged(packed, 0)
+
+    def collect_noop(self, handle) -> None:
+        """Block on a launch_noop readback."""
+        self._fetch_staged(handle)
+
+    def warmup_pipeline(self, max_group: int = 8) -> None:
+        """Compile the group-launch scan shapes (pow2 depths <= max_group
+        at max_width) the pipelined combiner dispatches under bursts.
+        Separate from warmup() so the extra boot cost is opt-in (daemons
+        with pipelining on); a cold compile of a scan shape inside a live
+        window would stall that window for the whole compile."""
+        if not self.supports_pipeline():
+            return
+        both = self._staging != "wide"
+        resp = None
+        with self._lock:
+            k = 2
+            while k <= min(max_group, self._MAX_SCAN):
+                stacked = np.zeros((k, 9, self.max_width), np.int64)
+                stacked[:, 0, :] = -1
+                self.state, resp = self._decide_scan(self.state, stacked, 0)
+                if both:
+                    self.state, resp = self._decide_scan_compact(
+                        self.state, compact_window(stacked), 0)
+                    if self._lean_ok:
+                        ln = lean_window(stacked, self.capacity)
+                        self.state, resp = self._decide_scan_lean(
+                            self.state, ln[0], jnp.asarray(ln[1]), 0)
+                k *= 2
+            if resp is not None:
+                jax.block_until_ready(resp)
+
     # ------------------------------------------------------- columnar path
 
     def supports_columnar(self) -> bool:

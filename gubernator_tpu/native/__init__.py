@@ -409,8 +409,10 @@ def prep_pack_interned(directory: "NativeKeyDirectory", n: int,
         slow_mask, iw, state)
 
 
-# keydir_prep_pack_lean: a looked-up slot exceeded the 24-bit lane field —
-# the caller's capacity gate (ops/decide.py lean_capacity_ok) was skipped
+# keydir_prep_pack_lean: the directory's capacity exceeds the 24-bit lane
+# field — the caller's capacity gate (ops/decide.py lean_capacity_ok) was
+# skipped. Checked at entry, BEFORE the lookup commits inserts/LRU/inject
+# rows: the directory and config state are untouched on this return
 PREP_SLOT_WIDE = -4
 
 
@@ -446,7 +448,8 @@ def prep_pack_lean(directory: "NativeKeyDirectory", n: int,
     slow-mask behaviors) demote to `leftover`; >128 distinct configs
     returns PREP_CFG_OVERFLOW with directory and config state untouched.
     The caller must hold the capacity gate: directory capacity <= 0xFFFFFF
-    (lean_capacity_ok) — PREP_SLOT_WIDE flags a breach.
+    (lean_capacity_ok) — PREP_SLOT_WIDE flags a breach, detected at entry
+    with the directory untouched.
 
     Returns (n0, lane_item, leftover, inject) like prep_pack_columnar."""
     lib = load_library()

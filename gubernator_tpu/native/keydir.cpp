@@ -357,6 +357,7 @@ class KeyDir {
         return capacity_ - static_cast<int64_t>(free_.size());
     }
     int64_t evictions() const { return evictions_; }
+    int64_t capacity() const { return capacity_; }
 
   private:
     void diag_abort(const char* where) const {
@@ -1152,10 +1153,10 @@ int64_t keydir_lean_hash_slots() { return LEAN_HASH_SLOTS; }
 //
 // Lanes the lean format cannot carry — hits != 1, limit/duration outside
 // [0, 2^31), behavior past the 6-bit field, gregorian via slow_mask —
-// demote to `leftover` like slow-mask lanes. The caller must ensure the
-// directory capacity fits 24 bits (ops/decide.py lean_capacity_ok);
-// a slot at/past the 0xFFFFFF sentinel returns PREP_SLOT_WIDE (-4) after
-// the lookup (defensive — unreachable when the capacity gate holds).
+// demote to `leftover` like slow-mask lanes. A directory whose capacity
+// exceeds the 24-bit lane field (ops/decide.py lean_capacity_ok) returns
+// PREP_SLOT_WIDE (-4) at ENTRY, before any lookup commits inserts/LRU
+// motion/inject rows — callers re-prep interned/compact/wide.
 // Returns n0 >= 0, PREP_FALLBACK, PREP_OVERCOMMIT, PREP_CFG_OVERFLOW (-3,
 // config state rolled back to entry — caller re-preps interned/wide), or
 // PREP_SLOT_WIDE (-4).
@@ -1168,6 +1169,13 @@ int32_t keydir_prep_pack_lean(
     int32_t* leftover, int32_t* n_leftover_out, int64_t* inject,
     int32_t* n_inject) {
     if (n <= 0 || n > width) return -1;
+    // Capacity gate BEFORE any work commits: a directory wider than the
+    // 24-bit lane field can hand out unencodable slots, and detecting
+    // that only after lookup_batch has committed inserts/LRU motion/
+    // inject rows would leave the caller holding side effects it cannot
+    // express (the old post-lookup -4). Slots are always < capacity, so
+    // capacity <= LEAN_SLOT_MASK makes the late check unreachable.
+    if (static_cast<KeyDir*>(kd)->capacity() > LEAN_SLOT_MASK) return -4;
 
     const int32_t n_cfg_entry = *n_cfg;
     std::string arena;
@@ -1250,7 +1258,12 @@ int32_t keydir_prep_pack_lean(
     if (done != n0) return -2;
 
     for (int32_t i = 0; i < n0; ++i) {
-        if (slots[i] >= LEAN_SLOT_MASK) return -4;  // capacity gate breach
+        // unreachable: the entry gate bounds capacity (and so every slot)
+        // below LEAN_SLOT_MASK. Kept as a cheap invariant check; if it
+        // ever fired, the lookup above already committed inserts/LRU
+        // motion, and the caller MUST still apply the returned inject
+        // rows (the ctypes wrapper hands them back on every n0 < 0).
+        if (slots[i] >= LEAN_SLOT_MASK) return -4;
         iw[i] = slots[i] | word[i] |
                 (fresh[i] ? (1 << LEAN_FRESH_SHIFT) : 0);
     }

@@ -31,7 +31,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from gubernator_tpu.ops.decide import I32, ReqBatch, TableState, decide
-from gubernator_tpu.parallel.mesh import MeshPlan, REGION_AXIS, SHARD_AXIS
+from gubernator_tpu.parallel.mesh import (
+    MeshPlan, REGION_AXIS, SHARD_AXIS, shard_map as _shard_map)
 
 
 class GlobalMirror(NamedTuple):
@@ -154,7 +155,7 @@ def make_global_sync(plan: MeshPlan, donate: bool = False,
         new_state = new_local.reshape((1, 1) + new_local.shape)
         return new_state, mirror, jnp.zeros_like(delta)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map()(
         _step,
         mesh=plan.mesh,
         in_specs=(state_spec, delta_spec, rep, rep),

@@ -42,6 +42,24 @@ REGION_AXIS = "region"
 SHARD_AXIS = "shard"
 
 
+def shard_map():
+    """jax.shard_map across jax versions: top-level since 0.6 (kwarg
+    `check_vma`), under jax.experimental.shard_map before that (kwarg
+    `check_rep`) — the mesh tier is otherwise version-portable, so
+    resolve the symbol and the kwarg rename in one place."""
+    fn = getattr(jax, "shard_map", None)
+    if fn is not None:
+        return fn
+    from jax.experimental.shard_map import shard_map as sm
+
+    def compat(f, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return sm(f, **kwargs)
+
+    return compat
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshPlan:
     """A mesh plus the table geometry sharded over it."""

@@ -72,6 +72,7 @@ from gubernator_tpu.parallel.mesh import (
     MeshPlan,
     make_mesh,
     make_sharded_table,
+    shard_map as _shard_map,
     shard_of_key,
 )
 from gubernator_tpu.types import (
@@ -113,7 +114,7 @@ def make_decide_sharded(plan: MeshPlan, donate: bool = False):
             out.reshape(1, 1, *out.shape),
         )
 
-    mapped = jax.shard_map(
+    mapped = _shard_map()(
         _step, mesh=plan.mesh,
         in_specs=(spec_state, spec_io, P()),
         out_specs=(spec_state, spec_io),
@@ -145,7 +146,7 @@ def make_decide_sharded_scan(plan: MeshPlan, donate: bool = False):
             out.reshape(1, 1, *out.shape),
         )
 
-    mapped = jax.shard_map(
+    mapped = _shard_map()(
         _step, mesh=plan.mesh,
         in_specs=(spec_state, spec_io, P()),
         out_specs=(spec_state, spec_io),
@@ -177,7 +178,7 @@ def make_decide_sharded_lean(plan: MeshPlan, donate: bool = False):
             out.reshape(1, 1, *out.shape),
         )
 
-    mapped = jax.shard_map(
+    mapped = _shard_map()(
         _step, mesh=plan.mesh,
         in_specs=(spec_state, spec_lanes, P(), P()),
         out_specs=(spec_state, spec_out),
@@ -203,7 +204,7 @@ def make_decide_sharded_scan_lean(plan: MeshPlan, donate: bool = False):
             out.reshape(1, 1, *out.shape),
         )
 
-    mapped = jax.shard_map(
+    mapped = _shard_map()(
         _step, mesh=plan.mesh,
         in_specs=(spec_state, spec_lanes, P(), P()),
         out_specs=(spec_state, spec_out),
@@ -231,7 +232,7 @@ def make_gather_sharded(plan: MeshPlan):
         rows = local[g][:, :7].T
         return rows.reshape(1, 1, *rows.shape)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map()(
         _step, mesh=plan.mesh,
         in_specs=(spec_state, spec_slot), out_specs=spec_out,
     )
@@ -259,7 +260,7 @@ def make_inject_sharded(plan: MeshPlan, donate: bool = False):
         new = local.at[s].set(w8, mode="drop")
         return new.reshape((1, 1) + new.shape)
 
-    mapped = jax.shard_map(
+    mapped = _shard_map()(
         _step, mesh=plan.mesh,
         in_specs=(spec_state, spec_slot, spec_rows), out_specs=spec_state,
     )
@@ -694,6 +695,105 @@ class ShardedEngine:
                 self.stats["device_ns"] += t1 - t0
                 self.stats["demux_ns"] += t2 - t1
         return leftover
+
+    # ----------------------------------------------------- pipelined serving
+    # Launch/collect split for the combiner's depth-N pipeline
+    # (models/engine.py has the single-chip twin and the ordering
+    # argument). Mesh groups launch one shard_map window per member —
+    # still zero readbacks between launches, so depth cycles overlap.
+
+    def supports_pipeline(self) -> bool:
+        """True when the non-blocking launch/collect split is available
+        (native routing prep, no Store hooks)."""
+        return self._prep_fast is not None and self.store is None
+
+    def launch_windows(self, windows, now_ms: Optional[int] = None,
+                       staging=None):
+        """Dispatch 1..K request-object windows without blocking on any
+        readback (one mesh launch per window, state-chained). Returns an
+        opaque handle for collect_windows, or None when the pipelined
+        path cannot take the group at all (nothing mutated)."""
+        if not self.supports_pipeline():
+            return None
+        if not windows or any(not 0 < len(wk) <= self.max_width
+                              for wk in windows):
+            return None
+        if now_ms is None:
+            now_ms = millisecond_now()
+        meta = []
+        tails = []
+        for wk in windows:
+            with self._lock:
+                t0 = time.perf_counter_ns()
+                n0, cols, lane_item, owner_count, leftover = self._prep_fast(
+                    self.directories, wk, _SLOW_MASK)
+                if n0 == PREP_OVERCOMMIT:
+                    self._raise_overcommit()
+                if n0 < 0:
+                    # defensive: nothing committed for THIS window — it
+                    # retires whole through the python tail below
+                    n0, out, placed = 0, None, []
+                    leftover = np.arange(len(wk), dtype=np.int32)
+                else:
+                    t1 = time.perf_counter_ns()
+                    self.stats["prep_ns"] += t1 - t0
+                    self.stats["requests"] += n0
+                    self.stats["batches"] += 1
+                    out, placed = (None, [])
+                    if n0:
+                        out, placed = self._pack_and_decide(
+                            cols, lane_item, owner_count, now_ms, t1)
+                meta.append((n0, out, placed, leftover))
+            # Leftover tails retire NOW — after this window's dispatch,
+            # BEFORE the next window preps — so a key pending in the tail
+            # is never overtaken by its next arrival (per-key submission
+            # order; models/engine.py has the full argument). Blocks on
+            # its own readback; rare path.
+            if leftover is not None and len(leftover):
+                idxs = leftover.tolist()
+                tails.append(self._slow_window(
+                    [wk[i] for i in idxs], now_ms, count_batch=False))
+            else:
+                tails.append(None)
+        return (windows, meta, tails)
+
+    def collect_windows(self, handle):
+        """Block on a launched group's readbacks (in launch order) and
+        demux: one response list per window. Runs outside the engine lock
+        except for the demux counter updates."""
+        windows, meta, tails = handle
+        results = []
+        for k, wk in enumerate(windows):
+            n0, out, placed, leftover = meta[k]
+            responses: List[Optional[RateLimitResp]] = [None] * len(wk)
+            if n0:
+                t0 = time.perf_counter_ns()
+                rows = self._fetch_mesh(out)  # device sync, THIS window
+                t1 = time.perf_counter_ns()
+                with self._lock:  # _demux mutates the stats counters
+                    self.stats["device_ns"] += t1 - t0
+                    self._demux(rows, placed, responses)
+                    self.stats["demux_ns"] += time.perf_counter_ns() - t1
+            tail = tails[k]
+            if tail is not None:
+                for i, resp in zip(leftover.tolist(), tail):
+                    responses[i] = resp
+            results.append(responses)
+        return results
+
+    def launch_noop(self, width: Optional[int] = None):
+        """All-padding mesh window dispatch (mutates nothing) for the
+        combiner's depth auto-probe."""
+        R, S = self.plan.n_regions, self.plan.n_shards
+        w = width or self.min_width
+        packed = np.zeros((R, S, 9, w), np.int64)
+        packed[:, :, 0, :] = -1
+        with self._lock:
+            return self._dispatch_mesh(packed, 0)
+
+    def collect_noop(self, handle) -> None:
+        """Block on a launch_noop readback."""
+        self._fetch_mesh(handle)
 
     def _slow_window(self, requests, now_ms,
                      count_batch: bool = True) -> List[RateLimitResp]:

@@ -56,6 +56,13 @@ class InstanceConfig:
     # obs.trace.Tracer; optional — the Instance builds a disabled one
     # (sample 0, zero hot-path cost) when omitted
     tracer: Optional[object] = None
+    # depth-N pipelined serving loop (service/combiner.py): cycles in
+    # flight between launch and readback. None reads GUBER_PIPELINE_DEPTH
+    # ('auto' probes; 1 pins the serial lock-step path); pipeline_scan is
+    # the max windows coalesced into one scan-group launch
+    # (GUBER_PIPELINE_SCAN).
+    pipeline_depth: Optional[int] = None
+    pipeline_scan: Optional[int] = None
 
     def validate(self) -> None:
         if self.behaviors.batch_limit > MAX_BATCH_SIZE:

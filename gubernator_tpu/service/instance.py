@@ -95,10 +95,12 @@ class Instance:
         # always present; sample 0 (the default) keeps every trace site a
         # guarded no-op — daemons wire GUBER_TRACE_SAMPLE through here
         self.tracer = conf.tracer or Tracer()
-        # concurrent callers merge into single kernel launches; while one
-        # launch is in flight the next window pools up (service/combiner.py)
+        # concurrent callers merge into pipelined kernel launches: up to
+        # GUBER_PIPELINE_DEPTH window groups ride the link/device while
+        # further windows pool up and pack (service/combiner.py)
         self.combiner = BackendCombiner(
-            self.backend, metrics=conf.metrics, tracer=self.tracer)
+            self.backend, metrics=conf.metrics, tracer=self.tracer,
+            depth=conf.pipeline_depth, scan=conf.pipeline_scan)
 
         self.local_picker = conf.local_picker or ReplicatedConsistentHashPicker()
         # The cross-region picker must route exactly like the DESTINATION

@@ -87,6 +87,41 @@ class Metrics:
             registry=self.registry,
             buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 4096),
         )
+        # depth-N pipelined serving loop (service/combiner.py — live)
+        self.combiner_pipeline_depth = Gauge(
+            "combiner_pipeline_depth",
+            "Configured cycles-in-flight bound of the pipelined combiner "
+            "(1 = serial lock-step).",
+            registry=self.registry,
+        )
+        self.combiner_pipeline_inflight = Gauge(
+            "combiner_pipeline_inflight",
+            "Launches currently in flight between dispatch and readback.",
+            registry=self.registry,
+        )
+        self.combiner_pipeline_occupancy = Histogram(
+            "combiner_pipeline_occupancy",
+            "In-flight launches observed at each pipeline launch.",
+            registry=self.registry,
+            buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16),
+        )
+        self.combiner_fill_stalls = Counter(
+            "combiner_fill_stalls_total",
+            "Launches that blocked on the in-flight backpressure cap.",
+            registry=self.registry,
+        )
+        self.combiner_pipelined_windows = Counter(
+            "combiner_pipelined_windows_total",
+            "Windows launched through the depth-N pipeline (vs the serial "
+            "lock-step path).",
+            registry=self.registry,
+        )
+        self.combiner_group_windows = Histogram(
+            "combiner_group_windows",
+            "Windows coalesced into one scan-group device launch.",
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32),
+        )
         # engine hot-path phase instrumentation (models/engine.py — live)
         self.engine_device_dispatch_ms = Histogram(
             "engine_device_dispatch_milliseconds",

@@ -265,6 +265,7 @@ class BinarySnapshotLoader(Loader):
                                 "skipping restore", self.path, ver)
                     return
                 terminated = False
+                n_restored = 0  # rows handed to the caller so far
                 while True:
                     hdr = f.read(12)
                     if len(hdr) < 12:
@@ -285,8 +286,8 @@ class BinarySnapshotLoader(Loader):
                     if (len(lens_b) < 4 * m or len(blob) < blob_len
                             or len(rows_b) < 8 * m * _SLAB_FIELDS):
                         log.warning("snapshot %s: truncated chunk — "
-                                    "keeping %s rows restored so far",
-                                    self.path, "earlier")
+                                    "keeping %d rows restored so far",
+                                    self.path, n_restored)
                         return
                     lens = np.frombuffer(lens_b, np.uint32)
                     if int(lens.sum()) != blob_len:
@@ -297,6 +298,7 @@ class BinarySnapshotLoader(Loader):
                     np.cumsum(lens, out=off[1:])
                     rows = np.frombuffer(rows_b, np.int64).reshape(
                         m, _SLAB_FIELDS)
+                    n_restored += m
                     yield blob, off, rows
                 if not terminated:
                     log.warning("snapshot %s: missing terminator "

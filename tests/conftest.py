@@ -41,6 +41,13 @@ _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 _session_exit = {}
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from the tier-1 run (-m 'not slow') — heavyweight "
+        "allocations or long soaks")
+
+
 def pytest_sessionfinish(session, exitstatus):
     _session_exit["code"] = int(exitstatus)
 

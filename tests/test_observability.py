@@ -234,7 +234,12 @@ class TestMetricsExposition:
     def test_new_families_exposed_after_traffic(self):
         cluster = LocalCluster().start(2)
         try:
-            ci = cluster.instances[0]
+            # drive traffic through (and scrape) the node that OWNS the
+            # keys: the fnv1 ring clusters the "mx{i}" family onto one
+            # arc (PARITY #15), so which node owns them is a product of
+            # the dynamic ports — scraping instances[0] blindly made the
+            # cache_size assertion a coin flip
+            ci = cluster.owner_of(_req("mx0").hash_key())
             ci.instance.get_rate_limits(
                 [_req(f"mx{i}") for i in range(10)])
             text = ci.metrics.render(ci.instance).decode()

@@ -1,0 +1,398 @@
+"""Depth-N pipelined serving loop (service/combiner.py launch/collect).
+
+The correctness bar from the pipelining change: per-key sequential
+semantics must SURVIVE cycles-in-flight — proven here with bit-equality
+differentials against the serial (depth-1) combiner under duplicate-key
+hammers and mixed traffic, plus backpressure and drain behavior.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu import native
+from gubernator_tpu.models.engine import Engine
+from gubernator_tpu.ops.decide import lean_capacity_ok
+from gubernator_tpu.service.combiner import BackendCombiner
+from gubernator_tpu.types import Behavior, RateLimitReq, RateLimitResp
+
+NOW = 1_700_000_000_000
+
+
+def _req(key, hits=1, limit=1000, duration=60_000, behavior=0):
+    return RateLimitReq(name="pl", unique_key=key, hits=hits, limit=limit,
+                        duration=duration, behavior=int(behavior))
+
+
+def _engine():
+    eng = Engine(capacity=256, min_width=8, max_width=16)
+    if not eng.supports_pipeline():
+        pytest.skip("native prep unavailable")
+    return eng
+
+
+def _drive(combiner, subs, shared_now=False):
+    """Single async submitter: submission order is the per-key order both
+    combiners must honor; returns every response field for bit-compare.
+    `shared_now` pins one timestamp for ALL submissions, so the combiner
+    merges them into multi-window groups (the cross-window hazard)."""
+    futs = [combiner.submit_async(s, NOW if shared_now else NOW + i)
+            for i, s in enumerate(subs)]
+    return [
+        [(r.status, r.limit, r.remaining, r.reset_time, r.error)
+         for r in f.result(timeout=60)]
+        for f in futs
+    ]
+
+
+def _differential(subs, depth=4, scan=4, shared_now=False):
+    serial = BackendCombiner(_engine(), depth=1)
+    try:
+        want = _drive(serial, subs, shared_now)
+    finally:
+        serial.close()
+    piped = BackendCombiner(_engine(), depth=depth, scan=scan)
+    try:
+        assert piped.pipelined
+        got = _drive(piped, subs, shared_now)
+        stats = piped.stats
+    finally:
+        piped.close()
+    assert got == want
+    return stats
+
+
+class TestPipelinedDifferential:
+    def test_duplicate_key_hammer_bit_equal(self):
+        """The acceptance bar: depth>1 output is bit-identical to the
+        serial combiner when every submission hammers the same key —
+        per-key sequential semantics proven, not assumed."""
+        subs = [[_req("hot", hits=1 + (i % 3), limit=10_000)]
+                for i in range(120)]
+        stats = _differential(subs, depth=4)
+        assert stats["pipelined_windows"] > 0
+
+    def test_mixed_traffic_bit_equal(self):
+        """Duplicates within AND across submissions, gregorian lanes
+        (leftover tails), invalid lanes, and an oversized submission
+        (serial fallback mid-stream) — still bit-identical."""
+        rng = np.random.RandomState(7)
+        subs = []
+        for i in range(60):
+            reqs = []
+            for _ in range(int(rng.randint(1, 10))):
+                kind = rng.rand()
+                if kind < 0.06:
+                    reqs.append(_req("", hits=1))  # invalid -> error lane
+                elif kind < 0.18:
+                    reqs.append(_req(f"g{int(rng.randint(3))}",
+                                     duration=int(rng.randint(2)),
+                                     behavior=Behavior.DURATION_IS_GREGORIAN))
+                else:
+                    reqs.append(_req(f"h{int(rng.randint(8))}", limit=500,
+                                     hits=int(rng.randint(3))))
+            subs.append(reqs)
+        # oversized submissions (> max_width=16): the pipelined combiner
+        # must hand them to the serial path without breaking key order
+        subs[20] = [_req(f"h{j % 8}", limit=500) for j in range(40)]
+        subs[40] = [_req("hot", limit=500) for _ in range(40)]
+        _differential(subs, depth=4)
+
+    def test_duplicates_within_submission_leftover_tails(self):
+        """In-window duplicates retire through the leftover tail AT LAUNCH
+        — a later submission of the same key never overtakes them."""
+        subs = []
+        for i in range(40):
+            subs.append([_req("dup", limit=10_000)] * 3)
+            subs.append([_req("dup", limit=10_000)])
+        _differential(subs, depth=3, scan=2)
+
+    def test_cross_window_collisions_in_one_group_bit_equal(self):
+        """The hardest ordering case: one timestamp group packs MANY
+        submissions into a multi-window scan launch, with a key's
+        duplicate pending in window k's leftover tail while the same key
+        arrives in window k+1 — the leftover must cut the group (pipeline
+        barrier) or the later arrival overtakes it."""
+        rng = np.random.RandomState(11)
+        subs = []
+        for i in range(50):
+            n = int(rng.randint(1, 8))
+            reqs = [_req(f"x{int(rng.randint(4))}", limit=10_000)
+                    for _ in range(n)]
+            if rng.rand() < 0.4:  # in-submission duplicate -> leftover
+                reqs.append(reqs[0])
+            subs.append(reqs)
+        _differential(subs, depth=4, scan=8, shared_now=True)
+
+    def test_mid_group_cut_never_dispatches_unprepped_windows(self):
+        """A cut at window m where pow2(m) == pow2(K) must NOT dispatch
+        the whole staging stack — the not-yet-prepped windows' zeroed
+        staging rows are live slot-0 lanes (slot 0 = the first key
+        inserted), which would corrupt that key's row. Deterministic
+        shape: 8 full-width windows (one per submission), the 5th
+        carrying a duplicate so the group cuts at m=5, pow2(5)=pow2(8)."""
+        eng = _engine()  # max_width 16
+        windows = [[_req(f"s{i}_{j}", limit=100) for j in range(16)]
+                   for i in range(8)]
+        windows[4][15] = _req("s4_0", limit=100)  # in-window dup -> cut
+        h = eng.launch_windows(windows, now_ms=NOW)
+        assert h is not None
+        got = eng.collect_windows(h)
+        assert [r.remaining for r in got[0]] == [99] * 16
+        assert [r.remaining for r in got[4]] == [99] * 15 + [98]
+        # slot 0 ("s0_0") must still hold exactly one hit of state: a
+        # second touch sees 98, not a zeroed/corrupted row
+        after = eng.get_rate_limits([_req("s0_0", limit=100)], now_ms=NOW)
+        assert after[0].remaining == 98
+        assert after[0].limit == 100
+
+    def test_concurrent_hammer_exact_hits(self):
+        """Real concurrency on the pipelined combiner: every hit lands
+        exactly once (remaining values are a permutation of the exact
+        sequential states)."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        c = BackendCombiner(_engine(), depth=4)
+        try:
+            assert c.pipelined
+            with ThreadPoolExecutor(max_workers=16) as pool:
+                futs = [
+                    pool.submit(c.submit, [_req("shared", limit=1000)], NOW)
+                    for _ in range(16)
+                ]
+                remainings = sorted(f.result()[0].remaining for f in futs)
+            assert remainings == list(range(984, 1000))
+        finally:
+            c.close()
+
+
+class TestShardedPipeline:
+    def test_mesh_launch_collect_bit_equal(self):
+        """The mesh engine's launch/collect split agrees bit-for-bit with
+        its own synchronous path under duplicates + leftovers."""
+        from gubernator_tpu.parallel.sharded import ShardedEngine
+
+        piped = ShardedEngine(n_shards=4, capacity_per_shard=512,
+                              min_width=8, max_width=16)
+        serial = ShardedEngine(n_shards=4, capacity_per_shard=512,
+                               min_width=8, max_width=16)
+        if not piped.supports_pipeline():
+            pytest.skip("native routing prep unavailable")
+        rng = np.random.RandomState(5)
+        for step in range(10):
+            wins = [
+                [_req(f"m{int(rng.randint(10))}", limit=100)
+                 for _ in range(int(rng.randint(1, 12)))]
+                for _ in range(2)
+            ]
+            h = piped.launch_windows(wins, now_ms=NOW + step)
+            assert h is not None
+            got = piped.collect_windows(h)
+            want = [serial.get_rate_limits(w, now_ms=NOW + step)
+                    for w in wins]
+            assert got == want
+        piped.collect_noop(piped.launch_noop())
+
+    def test_combiner_pipelines_mesh_backend(self):
+        from gubernator_tpu.parallel.sharded import ShardedEngine
+
+        eng = ShardedEngine(n_shards=4, capacity_per_shard=512,
+                            min_width=8, max_width=16)
+        if not eng.supports_pipeline():
+            pytest.skip("native routing prep unavailable")
+        c = BackendCombiner(eng, depth=3)
+        try:
+            assert c.pipelined
+            subs = [[_req(f"s{i % 6}", limit=10_000)] for i in range(40)]
+            out = _drive(c, subs)
+            assert all(len(o) == 1 and o[0][0] == 0 for o in out)
+            assert c.stats["pipelined_windows"] > 0
+        finally:
+            c.close()
+
+
+class _BlockingPipeBackend:
+    """launch/collect backend whose readbacks block until released —
+    drives the combiner's backpressure and drain paths."""
+
+    max_width = 64
+
+    def __init__(self):
+        self.release = threading.Event()
+        self.launched = 0
+        self.collected = 0
+        self.max_uncollected = 0
+        self._lock = threading.Lock()
+
+    def supports_pipeline(self):
+        return True
+
+    def launch_windows(self, windows, now_ms=None, staging=None):
+        with self._lock:
+            self.launched += len(windows)
+            self.max_uncollected = max(
+                self.max_uncollected, self.launched - self.collected)
+        return [list(w) for w in windows]
+
+    def collect_windows(self, handle):
+        self.release.wait(10)
+        with self._lock:
+            self.collected += len(handle)
+        return [
+            [RateLimitResp(limit=r.limit, remaining=r.limit - r.hits)
+             for r in w]
+            for w in handle
+        ]
+
+    def get_rate_limits(self, reqs, now_ms=None):
+        return [RateLimitResp(limit=r.limit, remaining=r.limit - r.hits)
+                for r in reqs]
+
+
+class TestBackpressure:
+    def test_inflight_capped_at_depth(self):
+        """A stalled link must NOT let launches run away: at most depth
+        launches queue (plus the one in the drainer's hands) and the pack
+        stage stalls — degrading to lock-step, not unbounded memory."""
+        be = _BlockingPipeBackend()
+        depth = 2
+        c = BackendCombiner(be, depth=depth, scan=1)
+        try:
+            assert c.pipelined
+            futs = [c.submit_async([_req(f"b{i}")], NOW + i)
+                    for i in range(depth + 6)]
+            deadline = time.monotonic() + 5
+            while (c.stats["fill_stalls"] < 1
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)  # let the worker fill the pipeline + stall
+            assert c.stats["fill_stalls"] >= 1
+            assert be.max_uncollected <= depth
+            assert be.launched <= depth  # the pack stage really stalled
+            be.release.set()
+            for f in futs:
+                assert f.result(timeout=10)[0].remaining == 999
+            assert be.max_uncollected <= depth
+        finally:
+            be.release.set()
+            c.close()
+
+    def test_close_drains_inflight_windows(self):
+        """close() resolves every accepted submission: queued, in flight,
+        and still-pending ones all complete (no orphan errors)."""
+        be = _BlockingPipeBackend()
+        c = BackendCombiner(be, depth=2, scan=1)
+        futs = [c.submit_async([_req(f"d{i}")], NOW + i) for i in range(8)]
+        time.sleep(0.05)  # some launched, some queued, some pending
+        be.release.set()
+        c.close(timeout_s=10)
+        for f in futs:
+            assert f.result(timeout=1)[0].remaining == 999
+
+    def test_depth_one_stays_serial(self):
+        """depth=1 pins the lock-step path even on a pipeline-capable
+        backend (the differential baseline must be the old behavior)."""
+        c = BackendCombiner(_engine(), depth=1)
+        try:
+            assert not c.pipelined
+            assert c.submit([_req("s")], NOW)[0].remaining == 999
+            assert c.stats["pipelined_windows"] == 0
+        finally:
+            c.close()
+
+
+class TestLeanCapacityCliff:
+    """An engine built past 2^24 - 1 slots cannot ship the 4 B/lane lean
+    wire (the 24-bit slot field); it must serve correctly via the
+    interned/compact fallback, and the C lean prep must refuse the
+    directory BEFORE committing any lookup side effects."""
+
+    def test_capacity_gate_boundary(self):
+        assert lean_capacity_ok((1 << 24) - 1)
+        assert not lean_capacity_ok(1 << 24)
+
+    def test_past_cliff_serves_bit_identical_via_fallback(self):
+        lean_eng = _engine()
+        cliff = _engine()
+        cliff._lean_ok = False  # exactly what the capacity gate sets past
+        # 2^24 - 1 slots (a real 16M-slot table is the slow test below)
+        rng = np.random.RandomState(3)
+        for step in range(20):
+            batch = [
+                _req(f"c{int(rng.randint(12))}", limit=100,
+                     hits=int(rng.randint(3)))
+                for _ in range(int(rng.randint(1, 14)))
+            ]
+            a = lean_eng.get_rate_limits(batch, now_ms=NOW + step)
+            b = cliff.get_rate_limits(batch, now_ms=NOW + step)
+            assert a == b
+
+    def test_prep_slot_wide_entry_gate_commits_nothing(self):
+        """keydir_prep_pack_lean on an over-wide directory returns
+        PREP_SLOT_WIDE at entry — no inserts, no LRU motion, no inject
+        rows, config state untouched (the old late check fired only AFTER
+        lookup_batch had committed all three)."""
+        if not native.available():
+            pytest.skip("native keydir unavailable")
+        d = native.NativeKeyDirectory(1 << 24)  # one past the last lean slot
+        state = native.LeanPrepState()
+        iw = np.zeros(8, np.int32)
+        keys = b"pl_k1"
+        off = np.array([0, len(keys)], np.int32)
+        n0, lane, left, inj = native.prep_pack_lean(
+            d, 1, keys, off, np.array([2], np.int32),
+            np.ones(1, np.int64), np.full(1, 100, np.int64),
+            np.full(1, 60_000, np.int64), np.zeros(1, np.int32),
+            np.zeros(1, np.int32), 0, iw, state)
+        assert n0 == native.PREP_SLOT_WIDE
+        assert len(d) == 0  # nothing committed
+        assert state.n_cfg == 0
+        assert len(inj) == 0
+
+    @pytest.mark.slow
+    def test_real_cliff_engine_serves_correctly(self):
+        """A real 2^24-slot engine (1 GB table) serves correct decisions
+        through the compact fallback."""
+        eng = Engine(capacity=1 << 24, min_width=8, max_width=8)
+        assert not eng._lean_ok
+        out = eng.get_rate_limits(
+            [_req("big0"), _req("big1")], now_ms=NOW)
+        assert [r.remaining for r in out] == [999, 999]
+        out = eng.get_rate_limits([_req("big0")], now_ms=NOW)
+        assert out[0].remaining == 998
+
+
+class TestPipelineObservability:
+    def test_stats_expose_pipeline_state(self):
+        c = BackendCombiner(_engine(), depth=3, scan=2)
+        try:
+            c.submit([_req("o1"), _req("o2")], NOW)
+            s = c.stats
+            assert s["pipeline_depth"] == 3
+            assert s["pipelined_windows"] >= 1
+            assert s["group_launches"] >= 1
+            assert "fill_stalls" in s and "pipeline_inflight" in s
+        finally:
+            c.close()
+
+    def test_autotune_requires_auto_depth(self):
+        """A pinned depth is never overridden by the probe."""
+        c = BackendCombiner(_engine(), depth=2)
+        try:
+            assert c.autotune() == 2
+        finally:
+            c.close()
+
+    def test_autotune_resolves_auto_depth(self):
+        eng = _engine()
+        c = BackendCombiner(eng, depth="auto")
+        try:
+            d = c.autotune(depths=(2, 3), probe_windows=4)
+            assert d in (2, 3)
+            assert c.depth == d
+            # probe used no-op windows only: the table is untouched
+            assert eng.key_count() == 0
+            assert c.submit([_req("after")], NOW)[0].remaining == 999
+        finally:
+            c.close()
