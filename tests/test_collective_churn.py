@@ -32,6 +32,8 @@ import threading
 import time
 import urllib.request
 
+import pytest
+
 from conftest import (
     free_port,
     http_metric as _metric,
@@ -45,6 +47,8 @@ N = 4
 GLOBAL = 2  # Behavior.GLOBAL wire value
 
 
+@pytest.mark.slow  # ~80 s four-daemon churn stress: over the tier-1
+# wall budget now that the mesh tier runs for real
 def test_four_host_collective_churn(tmp_path):
     from gubernator_tpu.service.grpc_api import dial_v1
     from gubernator_tpu.service.pb import gubernator_pb2 as pb
