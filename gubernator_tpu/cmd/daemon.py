@@ -285,15 +285,25 @@ def main(argv=None) -> int:
         ),
         advertise_address=advertise,
     )
-    if instance.combiner.pipelined:
+    columnar_pipe = (conf.columnar_pipeline and conf.pipeline_depth != 1
+                     and getattr(backend, "supports_columnar",
+                                 lambda: False)())
+    if instance.combiner.pipelined or columnar_pipe:
         # compile the burst scan shapes up front (a cold compile inside a
-        # live window stalls it for the whole compile), then resolve an
-        # 'auto' depth against the live link with no-op windows
+        # live window stalls it for the whole compile) — the object and
+        # columnar pipelines dispatch the same scan-group shapes
         if hasattr(backend, "warmup_pipeline"):
             backend.warmup_pipeline(max_group=conf.pipeline_scan)
+    if instance.combiner.pipelined:
+        # resolve an 'auto' depth against the live link with no-op
+        # windows; depth 1 in the probe set auto-degrades to lock-step
         depth = instance.combiner.autotune()
         log.info("pipelined serving loop on: depth=%d scan<=%d",
                  depth, conf.pipeline_scan)
+    # the columnar wire path rides the combiner's RESOLVED depth (the
+    # autotune winner), so both protocols share one pipelining decision;
+    # GUBER_COLUMNAR_PIPELINE=0 pins just the wire path lock-step
+    columnar_depth = instance.combiner.depth if columnar_pipe else 1
     if multi_host:
         # cross-host GLOBAL aggregation rides the device fabric: one
         # lockstep collective per tick replaces the per-peer gRPC pipelines
@@ -346,7 +356,9 @@ def main(argv=None) -> int:
                 instance,
                 port=conf_grpc_port + conf.behaviors.peer_link_offset,
                 grpc_port=conf_grpc_port, grpc_host=conf_grpc_host,
-                metrics=metrics)
+                metrics=metrics, pipeline_depth=columnar_depth,
+                pipeline_scan=conf.pipeline_scan,
+                columnar_pipeline=conf.columnar_pipeline)
             port = conf_grpc_port
             metrics.set_native_front(peerlink.native_hits)
             log.info("native gRPC front on :%d (peerlink on %d, "
@@ -374,7 +386,11 @@ def main(argv=None) -> int:
 
             link_port = port + conf.behaviors.peer_link_offset
             try:
-                peerlink = PeerLinkService(instance, port=link_port)
+                peerlink = PeerLinkService(
+                    instance, port=link_port, metrics=metrics,
+                    pipeline_depth=columnar_depth,
+                    pipeline_scan=conf.pipeline_scan,
+                    columnar_pipeline=conf.columnar_pipeline)
                 log.info("peerlink serving on port %d", peerlink.port)
             except (PeerLinkError, RuntimeError) as e:
                 log.warning("peerlink disabled: %s (peer calls ride gRPC)",

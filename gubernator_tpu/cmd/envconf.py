@@ -167,6 +167,11 @@ class DaemonConfig:
     # pipeline_scan caps the windows coalesced into one scan-group launch.
     pipeline_depth: int = 0
     pipeline_scan: int = 8
+    # depth-N pipelined COLUMNAR wire path (service/peerlink.py): the
+    # zero-object owner path shares GUBER_PIPELINE_DEPTH/SCAN with the
+    # combiner; this flag is its own escape hatch back to lock-step
+    # submit/complete (the object path keeps pipelining)
+    columnar_pipeline: bool = True
     # durable bucket snapshot: load at boot, save at shutdown (FileLoader;
     # the reference leaves persistence to the user, README.md:159-175)
     snapshot_path: str = ""
@@ -272,6 +277,7 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         max_batch_width=_env_int("GUBER_MAX_BATCH_WIDTH", 8192),
         pipeline_depth=_env_pipeline_depth(),
         pipeline_scan=_env_int("GUBER_PIPELINE_SCAN", 8),
+        columnar_pipeline=_env_str("GUBER_COLUMNAR_PIPELINE", "1") != "0",
         snapshot_path=_env_str("GUBER_SNAPSHOT_PATH"),
         snapshot_format=_env_str("GUBER_SNAPSHOT_FORMAT", "binary"),
         profile_port=_env_int("GUBER_PROFILE_PORT", 0),

@@ -788,6 +788,160 @@ class Engine:
                 self.stats.stage_ns["demux"] += t2 - t1
         return leftover
 
+    # ------------------------------------------- pipelined columnar serving
+    # The launch/collect split of the COLUMNAR path: the zero-object twin
+    # of launch_windows/collect_windows, driven by the peerlink service
+    # (service/peerlink.py _columnar_chunk). Per-key wire order survives
+    # by the identical argument: launches serialize under the engine lock
+    # (prep order == dispatch order), the device state chain orders the
+    # windows' effects, and a window whose prep yields LEFTOVERS cuts the
+    # group — the caller must collect and retire them through the
+    # request-object path before launching any later sub-window.
+
+    def launch_columnar_windows(self, windows, slow_mask: int,
+                                now_ms: Optional[int] = None, staging=None):
+        """Dispatch a PREFIX of 1..K columnar sub-windows as ONE device
+        launch (K > 1 rides the scan kernel) without blocking on the
+        readback.
+
+        `windows` is a list of column tuples (n, keys, key_off, name_len,
+        hits, limit, duration, algorithm, behavior) in the peerlink wire
+        layout (see submit_columnar), each 0 < n <= max_width; `staging`
+        follows the launch_windows contract (one dict per pipeline slot).
+        Windows prep in order under ONE lock hold; the first window whose
+        prep yields leftovers (duplicates, gregorian, slow-mask demotions,
+        invalid) is the LAST window dispatched — the group-cut barrier.
+
+        Returns None when the path cannot take the FIRST window at all
+        (nothing mutated — fall back to the object path); otherwise an
+        opaque handle for collect_columnar_windows with the cross-backend
+        contract: handle[0] is the per-window meta list (len = windows
+        CONSUMED, each meta's last element the leftover item indices) and
+        handle[1] an over-commit error message or None. On over-commit
+        the windows prepped before the failure still dispatch (their
+        directory commits must reach the device); the failing window and
+        everything after is NOT consumed — the caller error-fills those
+        items."""
+        if not self.supports_columnar():
+            return None
+        k_req = len(windows)
+        if not 0 < k_req <= self._MAX_SCAN:
+            return None
+        if any(not 0 < wc[0] <= self.max_width for wc in windows):
+            return None
+        if now_ms is None:
+            now_ms = millisecond_now()
+        from gubernator_tpu import native
+
+        w = max(_bucket_width(wc[0], self.min_width, self.max_width)
+                for wc in windows)
+        kb = _bucket_pow2(k_req) if k_req > 1 else 1
+        shape = (kb, 9, w)
+        buf = None if staging is None else staging.get(shape)
+        if buf is None:
+            buf = np.zeros(shape, np.int64)
+            if staging is not None:
+                staging[shape] = buf
+        else:
+            buf.fill(0)  # the prep contract: zeroed staging rows
+        metas: List[tuple] = []
+        failed = None
+        with self._lock:
+            t0 = time.perf_counter_ns()  # excludes the lock wait
+            total = 0
+            rounds = 0
+            for k, wc in enumerate(windows):
+                (n, keys, key_off, name_len, hits, limit, duration,
+                 algorithm, behavior) = wc
+                n0, lane_item, leftover, inject = native.prep_pack_columnar(
+                    self.directory, n, keys, key_off, name_len, hits,
+                    limit, duration, algorithm, behavior, slow_mask,
+                    buf[k])
+                if n0 == PREP_OVERCOMMIT:
+                    # earlier windows committed directory state and MUST
+                    # still dispatch; this window and the rest are not
+                    # consumed (the caller error-fills their items)
+                    self._apply_inject_rows(inject)
+                    buf[k][0, :] = -1  # partially-written row: all padding
+                    failed = (f"key directory over-committed: "
+                              f">{self.capacity} distinct keys in one "
+                              "lookup")
+                    break
+                if n0 < 0:
+                    if k == 0:
+                        return None  # nothing mutated: object-path fallback
+                    # defensive — the size preconditions rule this out;
+                    # nothing committed for THIS window, so it retires
+                    # whole through the caller's leftover path, cutting
+                    # the group here
+                    buf[k][0, :] = -1
+                    metas.append((0, None, np.arange(n, dtype=np.int32)))
+                    break
+                self._apply_inject_rows(inject)
+                if n0 == 0:
+                    buf[k][0, :] = -1  # prep leaves the slot row zeroed
+                metas.append((n0, lane_item, leftover))
+                total += n0
+                rounds += 1 if n0 else 0
+                if len(leftover):
+                    break  # group-cut barrier: leftovers retire first
+            m = len(metas)
+            t1 = time.perf_counter_ns()
+            self.stats.stage_ns["prep"] += t1 - t0
+            self.stats.requests += total
+            self.stats.batches += m
+            self.stats.rounds += rounds
+            staged = None
+            scanned = False
+            if total:
+                if m == 1:
+                    staged = self._dispatch_staged(buf[0], now_ms)
+                else:
+                    kb2 = _bucket_pow2(m)
+                    stack = buf if kb2 == kb else buf[:kb2]
+                    for kk in range(m, kb2):
+                        stack[kk][0, :] = -1  # unprepped rows: all padding
+                    staged = self._dispatch_scan_staged(stack, now_ms)
+                    scanned = True
+                self.stats.stage_ns["device"] += time.perf_counter_ns() - t1
+        return (metas, failed, staged, scanned)
+
+    def collect_columnar_windows(self, handle, outs):
+        """Block on a launched columnar group's readback (runs outside the
+        engine lock — dispatch order is already fixed) and scatter each
+        window's response rows into the caller's column buffers. `outs`
+        is one (status, limit, remaining, reset) array 4-tuple per
+        CONSUMED window, each sized to that window's item count. Returns
+        the per-window leftover index arrays — at most the LAST consumed
+        window's is non-empty (the group-cut barrier)."""
+        metas, _failed, staged, scanned = handle
+        t0 = time.perf_counter_ns()
+        rows_all = self._fetch_staged(staged) if staged is not None else None
+        t1 = time.perf_counter_ns()
+        over = 0
+        lanes = 0
+        leftovers = []
+        for k, ((n0, lane_item, leftover), out) in enumerate(
+                zip(metas, outs)):
+            if n0:
+                rows = rows_all[k] if scanned else rows_all
+                st, li, re, rs = out
+                st[lane_item] = rows[0, :n0]
+                li[lane_item] = rows[1, :n0]
+                re[lane_item] = rows[2, :n0]
+                rs[lane_item] = rows[3, :n0]
+                over += int(np.count_nonzero(rows[0, :n0] == 1))
+                lanes += n0
+            leftovers.append(leftover)
+        t2 = time.perf_counter_ns()
+        if lanes:
+            self._obs_device(t1 - t0, lanes)
+        with self._lock:  # concurrent completers: counters stay exact
+            self.stats.over_limit += over
+            self.stats.stage_ns["device"] += t1 - t0
+            self.stats.stage_ns["demux"] += t2 - t1
+        return leftovers
+
     # --------------------------------------------- native lone-request path
 
     def _apply_inject_rows(self, inject) -> None:

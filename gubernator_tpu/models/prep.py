@@ -52,6 +52,29 @@ def bucket_pow2(n: int) -> int:
     return k
 
 
+def bucket_splits(n: int, lo: int, hi: int) -> List[int]:
+    """Sub-window item counts for an n-item columnar chunk.
+
+    Chunks wider than one engine window (n > hi) must split. Stepping at
+    raw `hi` mints the capped terminal shape on capacity-capped engines
+    (hi not a power of two) and strands one-item straggler windows when a
+    chunk lands just over a window boundary; splitting on the pow2 bucket
+    ladder instead keeps every sub-window — and every scan stack built
+    over them — on exactly the shapes warmup()/warmup_pipeline() compiled.
+    Every piece but the last is the largest pow2 bucket width ≤ hi; the
+    remainder rides as one final piece (bucket_width pads it)."""
+    cap = lo
+    while cap * 2 <= hi:
+        cap *= 2
+    out = []
+    while n > cap:
+        out.append(cap)
+        n -= cap
+    if n:
+        out.append(n)
+    return out
+
+
 def preprocess(
     requests: Sequence[RateLimitReq], now_ms: int
 ) -> Tuple[List[Optional[RateLimitResp]], List[List[WorkItem]], int]:

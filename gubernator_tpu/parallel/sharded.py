@@ -696,6 +696,102 @@ class ShardedEngine:
                 self.stats["demux_ns"] += t2 - t1
         return leftover
 
+    # ------------------------------------------- pipelined columnar serving
+    # Mesh twin of Engine.launch_columnar_windows (models/engine.py has
+    # the full ordering argument): one shard_map launch per window, no
+    # readback between launches, group cut on the first window that
+    # yields leftovers.
+
+    def launch_columnar_windows(self, windows, slow_mask: int,
+                                now_ms: Optional[int] = None, staging=None):
+        """Dispatch a PREFIX of 1..K columnar sub-windows over the mesh
+        without blocking on any readback. Same wire layout and handle
+        contract as Engine.launch_columnar_windows: handle[0] is the
+        consumed-window meta list (each meta's last element the leftover
+        indices), handle[1] an over-commit message or None. `staging` is
+        accepted for contract parity (the mesh packer allocates per
+        window)."""
+        if not self.supports_columnar():
+            return None
+        if not windows or any(not 0 < wc[0] <= self.max_width
+                              for wc in windows):
+            return None
+        if now_ms is None:
+            now_ms = millisecond_now()
+        from gubernator_tpu import native
+
+        metas = []
+        failed = None
+        for k, wc in enumerate(windows):
+            (n, keys, key_off, name_len, hits, limit, duration,
+             algorithm, behavior) = wc
+            with self._lock:
+                t0 = time.perf_counter_ns()
+                n0, cols, lane_item, owner_count, leftover = \
+                    native.prep_route_columnar(
+                        self.directories, n, keys, key_off, name_len,
+                        hits, limit, duration, algorithm, behavior,
+                        slow_mask | _SLOW_MASK)
+                if n0 == PREP_OVERCOMMIT:
+                    # earlier windows already dispatched; this one and the
+                    # rest are not consumed (caller error-fills them)
+                    failed = ("key directory over-committed: "
+                              f">{self.plan.capacity_per_shard} distinct "
+                              "keys on one shard in one lookup")
+                    break
+                if n0 < 0:
+                    if k == 0:
+                        return None  # nothing mutated: object fallback
+                    # defensive: nothing committed for THIS window — it
+                    # retires whole through the caller's leftover path
+                    metas.append((0, None, [],
+                                  np.arange(n, dtype=np.int32)))
+                    break
+                t1 = time.perf_counter_ns()
+                self.stats["prep_ns"] += t1 - t0
+                self.stats["requests"] += n0
+                self.stats["batches"] += 1
+                out, placed = None, []
+                if n0:
+                    out, placed = self._pack_and_decide(
+                        cols, lane_item, owner_count, now_ms, t1)
+                metas.append((n0, out, placed, leftover))
+            if len(leftover):
+                break  # group-cut barrier: leftovers retire first
+        return (metas, failed)
+
+    def collect_columnar_windows(self, handle, outs):
+        """Block on a launched columnar group's mesh readbacks (in launch
+        order) and scatter each window's owner blocks into the caller's
+        column buffers. Same contract as Engine.collect_columnar_windows."""
+        metas, _failed = handle
+        over_status = int(Status.OVER_LIMIT)
+        leftovers = []
+        for (n0, out, placed, leftover), (o_st, o_li, o_re, o_rs) in zip(
+                metas, outs):
+            if n0:
+                t0 = time.perf_counter_ns()
+                rows = self._fetch_mesh(out)  # device sync, THIS window
+                t1 = time.perf_counter_ns()
+                over = 0
+                for r_, s_, _k, lanes in placed:
+                    blk = rows[r_, s_]
+                    cnt = len(lanes)
+                    li = np.asarray(lanes, np.int64)
+                    o_st[li] = blk[0, :cnt]
+                    o_li[li] = blk[1, :cnt]
+                    o_re[li] = blk[2, :cnt]
+                    o_rs[li] = blk[3, :cnt]
+                    over += int(np.count_nonzero(
+                        blk[0, :cnt] == over_status))
+                t2 = time.perf_counter_ns()
+                with self._lock:  # counters stay exact under concurrency
+                    self.stats["over_limit"] += over
+                    self.stats["device_ns"] += t1 - t0
+                    self.stats["demux_ns"] += t2 - t1
+            leftovers.append(leftover)
+        return leftovers
+
     # ----------------------------------------------------- pipelined serving
     # Launch/collect split for the combiner's depth-N pipeline
     # (models/engine.py has the single-chip twin and the ordering

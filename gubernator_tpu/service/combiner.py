@@ -58,7 +58,8 @@ from gubernator_tpu.types import RateLimitReq, RateLimitResp
 log = logging.getLogger("gubernator_tpu.combiner")
 
 # 'auto' pipeline depth resolves here until autotune() (the productized
-# bench.py 3/6 probe) refines it against the live link.
+# bench.py {1, 3, 6} probe) refines it against the live link — depth 1
+# winning degrades the combiner to the serial lock-step path.
 DEFAULT_PIPELINE_DEPTH = 3
 DEFAULT_PIPELINE_SCAN = 8
 
@@ -174,9 +175,12 @@ class BackendCombiner:
             "pipeline_inflight": self._inflight_n,
         }
 
-    def autotune(self, depths=(3, 6), probe_windows: int = 12) -> int:
+    def autotune(self, depths=(1, 3, 6), probe_windows: int = 12) -> int:
         """Resolve an 'auto' depth by timing no-op pipelined windows at
-        each candidate (bench.py's 3/6 probe, productized). Call BEFORE
+        each candidate (bench.py's depth probe, productized — depth 1 IS
+        a candidate, so a host where overlap loses outright — a single
+        shared core, a stalled link — auto-degrades to the serial
+        lock-step path instead of staying pinned pipelined). Call BEFORE
         serving traffic (daemon boot, after warmup): the probe dispatches
         real no-op windows — all-padding lanes, the table is untouched —
         and re-sizes the in-flight queue to the winner. No-op when the
@@ -206,8 +210,15 @@ class BackendCombiner:
             # the admission semaphore (the drainer only releases the one a
             # launch acquired, via the handle tuple) is race-free
             self._depth = best_d
-            self._slots = threading.Semaphore(best_d)
-            self._staging = [dict() for _ in range(best_d + 2)]
+            if best_d <= 1:
+                # overlap loses on this host: degrade to the serial
+                # lock-step path entirely (the drainer idles until close()
+                # joins it via the worker's sentinel)
+                self._depth = 1
+                self._pipelined = False
+            else:
+                self._slots = threading.Semaphore(best_d)
+                self._staging = [dict() for _ in range(best_d + 2)]
         m = self._metrics
         if m is not None and hasattr(m, "combiner_pipeline_depth"):
             m.combiner_pipeline_depth.set(best_d)

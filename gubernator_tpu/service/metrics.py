@@ -186,6 +186,45 @@ class Metrics:
             ["stage"], registry=self.registry,
             buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 100),
         )
+        # depth-N pipelined columnar serving (service/peerlink.py
+        # _columnar_chunk — the zero-object twin of the combiner_pipeline_*
+        # families; knobs are shared, see docs/OPERATIONS.md)
+        self.peerlink_columnar_depth = Gauge(
+            "peerlink_columnar_depth",
+            "Configured in-flight bound of the pipelined columnar path "
+            "(1 = serial lock-step submit/complete).",
+            registry=self.registry,
+        )
+        self.peerlink_columnar_windows = Counter(
+            "peerlink_columnar_windows_total",
+            "Columnar sub-windows launched through the depth-N pipeline.",
+            registry=self.registry,
+        )
+        self.peerlink_columnar_group_windows = Histogram(
+            "peerlink_columnar_group_windows",
+            "Columnar sub-windows coalesced into one scan-group launch.",
+            registry=self.registry,
+            buckets=(1, 2, 4, 8, 16, 32),
+        )
+        self.peerlink_columnar_occupancy = Histogram(
+            "peerlink_columnar_occupancy",
+            "In-flight columnar launches observed at each launch.",
+            registry=self.registry,
+            buckets=(0, 1, 2, 3, 4, 6, 8, 12, 16),
+        )
+        self.peerlink_columnar_fill_stalls = Counter(
+            "peerlink_columnar_fill_stalls_total",
+            "Columnar launches that waited on a readback because the "
+            "in-flight bound was reached (the link, not host prep, gates "
+            "the wire path).",
+            registry=self.registry,
+        )
+        self.peerlink_columnar_cuts = Counter(
+            "peerlink_columnar_cuts_total",
+            "Scan groups cut by the leftover-demotion barrier (duplicate "
+            "keys, gregorian, GLOBAL lanes force a pipeline drain).",
+            registry=self.registry,
+        )
         # TPU-native engine metrics (no reference analogue)
         self.engine_decisions = Counter(
             "engine_decisions_total",

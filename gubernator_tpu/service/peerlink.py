@@ -22,8 +22,10 @@ gRPC when the peer doesn't answer it.
 
 from __future__ import annotations
 
+import collections
 import ctypes
 import logging
+import os
 import socket
 import struct
 import threading
@@ -364,9 +366,30 @@ class PeerLinkService:
 
     def __init__(self, instance, port: int = 0, workers: int = 2,
                  grpc_port: Optional[int] = None, grpc_host: str = "",
-                 metrics=None):
+                 metrics=None, pipeline_depth=None, pipeline_scan=None,
+                 columnar_pipeline: Optional[bool] = None):
         from gubernator_tpu import native
         from gubernator_tpu.native import load_peerlink
+        from gubernator_tpu.service.combiner import (
+            DEFAULT_PIPELINE_DEPTH,
+            _env_depth,
+            _env_scan,
+        )
+
+        # Depth-N pipelined columnar serving (_columnar_chunk): the depth/
+        # scan knobs are SHARED with the object-path combiner
+        # (GUBER_PIPELINE_DEPTH / GUBER_PIPELINE_SCAN — the daemon passes
+        # the combiner's autotuned winner through pipeline_depth, so both
+        # wire protocols ride one resolved setting); GUBER_COLUMNAR_PIPELINE=0
+        # is the columnar-only escape hatch back to lock-step
+        # submit/complete. Depth 1 (pinned or auto-degraded) also pins
+        # lock-step.
+        self._col_depth = _env_depth(pipeline_depth) or DEFAULT_PIPELINE_DEPTH
+        self._col_scan = _env_scan(pipeline_scan)
+        if columnar_pipeline is None:
+            columnar_pipeline = os.environ.get(
+                "GUBER_COLUMNAR_PIPELINE", "1") != "0"
+        self._col_pipe = bool(columnar_pipeline) and self._col_depth > 1
 
         self._lib = load_peerlink()
         bound = ctypes.c_int(0)
@@ -389,10 +412,17 @@ class PeerLinkService:
                     f"peerlink: cannot bind gRPC port {grpc_port}")
             self.grpc_port = gp
         self.instance = instance
-        self.stats = {"batches": 0, "requests": 0, "errors": 0}
+        self.stats = {"batches": 0, "requests": 0, "errors": 0,
+                      # pipelined columnar serving (_columnar_chunk)
+                      "columnar_windows": 0, "columnar_groups": 0,
+                      "columnar_cuts": 0, "columnar_fill_stalls": 0}
         if metrics is not None and hasattr(metrics, "set_peerlink_stats"):
             # exports batches/requests/errors as peerlink_* families
             metrics.set_peerlink_stats(lambda: self.stats)
+        if metrics is not None and hasattr(metrics,
+                                           "peerlink_columnar_depth"):
+            metrics.peerlink_columnar_depth.set(
+                self._col_depth if self._col_pipe else 1)
         self._public_fast = False  # method-0 owner paths (standalone only)
         # native lone-request fast path: 1-item peer-hop frames decide in
         # the C++ IO thread against the engine's directory row mirrors
@@ -643,12 +673,14 @@ class PeerLinkService:
         Returns the concatenated error-string buffer.
 
         Peer-hop chunks ride the COLUMNAR path when the backend offers it
-        (Engine.submit_columnar): the wire columns go through the GIL-free
-        C prep straight to the device and the response rows scatter back
-        into these buffers — no RateLimitReq/RateLimitResp objects at all
-        on the hot path. Items the columnar prep can't take (invalid,
-        gregorian, GLOBAL/MULTI_REGION, duplicate occurrences) run through
-        the request-object path AFTER the packed round."""
+        (Engine.launch_columnar_windows / submit_columnar): the wire
+        columns go through the GIL-free C prep straight to the device —
+        scan-grouped and depth-pipelined for wide pulls (_columnar_chunk)
+        — and the response rows scatter back into these buffers; no
+        RateLimitReq/RateLimitResp objects at all on the hot path. Items
+        the columnar prep can't take (invalid, gregorian,
+        GLOBAL/MULTI_REGION, duplicate occurrences) run through the
+        request-object path AFTER the packed round."""
         self.stats["batches"] += 1
         self.stats["requests"] += got
         t_batch0 = time.perf_counter()
@@ -718,18 +750,6 @@ class PeerLinkService:
             except Exception:  # noqa: BLE001 — seeding is best-effort
                 pass
 
-        # offset fills: errors/metadata are sparse; one prefix sum each
-        def _sparse(pairs, off_col):
-            if not pairs:
-                off_col[1:got + 1] = 0
-                return b""
-            pairs.sort(key=lambda t: t[0])
-            lens = np.zeros(got, np.int64)
-            for i, e in pairs:
-                lens[i] = len(e)
-            off_col[1:got + 1] = np.cumsum(lens)
-            return b"".join(e for _, e in pairs)
-
         if self._metrics is not None and got:
             # every frame in the pull experienced ~this service time (the
             # batch IS the unit of work); native-lane RPCs never reach
@@ -750,21 +770,206 @@ class PeerLinkService:
                         method="GetPeerRateLimits").observe(ms)
             except Exception:  # noqa: BLE001
                 pass
-        return _sparse(errs, b["err_off"]), _sparse(metas, b["meta_off"])
+        return (self._sparse(errs, b["err_off"], got),
+                self._sparse(metas, b["meta_off"], got))
+
+    @staticmethod
+    def _sparse(pairs, off_col, got: int) -> bytes:
+        """Offset fill for the sparse error/metadata columns: one prefix
+        sum. Every producer emits pairs in ascending item order (chunks
+        advance monotonically, leftovers retire per sub-window in index
+        order, pipelined groups drain in dispatch order), so the common
+        path verifies order with one O(n) scan and skips the per-pull
+        O(n log n) sort."""
+        if not pairs:
+            off_col[1:got + 1] = 0
+            return b""
+        prev = -1
+        for i, _ in pairs:
+            if i < prev:
+                pairs.sort(key=lambda t: t[0])
+                break
+            prev = i
+        lens = np.zeros(got, np.int64)
+        for i, e in pairs:
+            lens[i] = len(e)
+        off_col[1:got + 1] = np.cumsum(lens)
+        return b"".join(e for _, e in pairs)
+
+    def _chunk_spans(self, eng, j: int, k: int) -> List[tuple]:
+        """Split [j, k) into engine sub-windows along the pow2 bucket
+        ladder (models/prep.py bucket_splits): a chunk one item over a
+        window boundary never mints an off-ladder XLA shape mid-serve,
+        even on a capacity-capped (non-pow2 max_width) engine."""
+        from gubernator_tpu.models.prep import bucket_splits
+
+        hi = int(getattr(eng, "max_width", 0)) or (k - j)
+        lo = int(getattr(eng, "min_width", 1)) or 1
+        spans = []
+        s0 = j
+        for ln in bucket_splits(k - j, min(lo, hi), hi):
+            spans.append((s0, s0 + ln))
+            s0 += ln
+        return spans
+
+    def _col_window(self, b: dict, s0: int, s1: int) -> tuple:
+        """One sub-window's wire columns, as launch_columnar_windows /
+        submit_columnar consume them (views into the pull buffers)."""
+        return (s1 - s0, b["keys"], b["key_off"][s0:s1 + 1],
+                b["name_len"][s0:s1], b["hits"][s0:s1],
+                b["limit"][s0:s1], b["duration"][s0:s1],
+                b["algorithm"][s0:s1], b["behavior"][s0:s1])
+
+    @staticmethod
+    def _col_outs(b: dict, s0: int, s1: int) -> tuple:
+        """One sub-window's response-row buffers (views into the pull
+        buffers — disjoint per span, so in-flight launches never race)."""
+        return (b["status"][s0:s1], b["r_limit"][s0:s1],
+                b["r_remaining"][s0:s1], b["r_reset"][s0:s1])
+
+    def _col_error_fill(self, msg: bytes, s0: int, k: int, b: dict,
+                        errs: list) -> None:
+        """Error-reply fill for items [s0, k) of a chunk (over-commit)."""
+        b["status"][s0:k] = 0
+        b["r_limit"][s0:k] = 0
+        b["r_remaining"][s0:k] = 0
+        b["r_reset"][s0:k] = 0
+        errs.extend((i, msg) for i in range(s0, k))
 
     def _columnar_chunk(self, m: int, eng, j: int, k: int, b: dict,
                         errs: list, metas: list) -> bool:
-        """Serve one peer-hop chunk columnar-end-to-end. Chunks wider than
-        the engine's max window split into sub-windows, applied
-        SEQUENTIALLY (complete i before submit i+1): the C prep's
-        duplicate tracking is per-submit, so a key demoted to the
+        """Serve one peer-hop chunk columnar-end-to-end, PIPELINED: the
+        chunk's sub-windows launch in scan groups of <= pipeline_scan
+        windows (one device call each, models/engine.py
+        launch_columnar_windows) with up to pipeline_depth group launches
+        in flight, and readbacks drain in dispatch order — host prep of
+        group g+1 overlaps device time of group g within the pull. A
+        sub-window that yields leftovers (duplicates, gregorian,
+        GLOBAL/MULTI_REGION, invalid) cuts its group AND barriers the
+        pipeline: every in-flight launch drains and the leftovers retire
+        through the request-object path before any later sub-window
+        preps — per-key wire order is the contract (the same argument the
+        object-path pipeline proved in tests/test_pipeline.py; the
+        columnar twin is tests/test_columnar_pipeline.py). Single-window
+        chunks and GUBER_COLUMNAR_PIPELINE=0 (or depth 1) keep the
+        lock-step path.
+
+        Overlap is INTRA-pull by design: pls_send_responses posts one
+        response frame set per pull (C++ Conn::pending retires whole),
+        so a window's rows cannot post early and launches cannot ride
+        across pull boundaries without a C++ response-contract change —
+        the pull's own width (up to MAX_N items = many sub-windows) is
+        what the pipeline overlaps. False = the engine can't take the
+        shape at all (nothing mutated)."""
+        launch = getattr(eng, "launch_columnar_windows", None)
+        spans = self._chunk_spans(eng, j, k)
+        if not self._col_pipe or launch is None or len(spans) <= 1:
+            return self._columnar_chunk_lockstep(m, eng, spans, k, b,
+                                                 errs, metas)
+        mt = self._metrics
+        # an over-eager GUBER_PIPELINE_SCAN must not push a group past the
+        # engine's compiled scan depth (launch would refuse it whole)
+        scan = min(self._col_scan, int(getattr(eng, "_MAX_SCAN", 0) or 1))
+        staging = b.get("_col_staging")
+        if staging is None:  # per-worker ring: one dict per pipeline slot
+            staging = b["_col_staging"] = [
+                dict() for _ in range(self._col_depth + 2)]
+        inflight: "collections.deque" = collections.deque()
+        seq = 0
+        wi = 0
+        n_spans = len(spans)
+        launched_any = False
+
+        def drain_one():
+            """Collect the oldest launch; retire its leftovers through the
+            object path (in dispatch order, so per-key order holds).
+            Returns the handle's over-commit message (or None)."""
+            handle, gspans = inflight.popleft()
+            outs = [self._col_outs(b, s0, s1) for s0, s1 in gspans]
+            leftovers = eng.collect_columnar_windows(handle, outs)
+            for (s0, _s1), left in zip(gspans, leftovers):
+                if left is not None and len(left):
+                    self._leftover_items(m, s0, left.tolist(), b, errs,
+                                         metas)
+            return handle[1]
+
+        while wi < n_spans or inflight:
+            barrier = False
+            while wi < n_spans and len(inflight) < self._col_depth:
+                gspans = spans[wi:wi + scan]
+                wins = [self._col_window(b, s0, s1) for s0, s1 in gspans]
+                h = launch(wins, _COLUMNAR_SLOW_MASK,
+                           staging=staging[seq % len(staging)])
+                if h is None:
+                    if not launched_any and not inflight:
+                        return False  # nothing mutated: object fallback
+                    # mid-chunk refusal (defensive): earlier spans already
+                    # applied — drain them, then retire the rest lock-step
+                    while inflight:
+                        drain_one()
+                    rest = spans[wi:]
+                    if not self._columnar_chunk_lockstep(
+                            m, eng, rest, k, b, errs, metas):
+                        self._object_chunk(m, rest[0][0], k, b, errs,
+                                           metas)
+                    return True
+                launched_any = True
+                seq += 1
+                win_metas, failed = h[0], h[1]
+                consumed = len(win_metas)
+                wi += consumed
+                inflight.append((h, gspans[:consumed]))
+                self.stats["columnar_windows"] += consumed
+                self.stats["columnar_groups"] += 1
+                if mt is not None:
+                    mt.peerlink_columnar_windows.inc(consumed)
+                    mt.peerlink_columnar_group_windows.observe(consumed)
+                    mt.peerlink_columnar_occupancy.observe(len(inflight))
+                cut = (consumed < len(gspans)
+                       or (consumed and win_metas[-1][-1] is not None
+                           and len(win_metas[-1][-1])))
+                if failed is not None or cut:
+                    # barrier: drain everything in order, retire the cut
+                    # window's leftovers (inside drain_one), THEN resume
+                    barrier = True
+                    if cut and failed is None:
+                        self.stats["columnar_cuts"] += 1
+                        if mt is not None:
+                            mt.peerlink_columnar_cuts.inc()
+                    break
+            if not inflight:
+                continue
+            if barrier or wi >= n_spans:
+                failed_msg = None
+                while inflight:
+                    failed_msg = drain_one() or failed_msg
+                if failed_msg is not None:
+                    # over-commit: the unconsumed remainder of the chunk
+                    # gets error replies (matching the lock-step contract)
+                    s_fail = spans[wi][0] if wi < n_spans else k
+                    self._col_error_fill(failed_msg.encode(), s_fail, k,
+                                         b, errs)
+                    return True
+            else:
+                # pipe full but the pull has more work: this drain IS the
+                # fill stall (the readback gates the next launch)
+                if len(inflight) >= self._col_depth:
+                    self.stats["columnar_fill_stalls"] += 1
+                    if mt is not None:
+                        mt.peerlink_columnar_fill_stalls.inc()
+                drain_one()
+        return True
+
+    def _columnar_chunk_lockstep(self, m: int, eng, spans, k: int,
+                                 b: dict, errs: list, metas: list) -> bool:
+        """The serial columnar path (GUBER_COLUMNAR_PIPELINE=0, depth 1,
+        single-window chunks, or engines without the launch/collect
+        split): complete sub-window i before submitting i+1 — the C
+        prep's duplicate tracking is per-submit, so a key demoted to the
         leftover tail of sub-window i must finish before a later
-        sub-window packs its next occurrence — per-key wire order is the
-        contract. False = the engine can't take the shape at all (nothing
-        mutated)."""
-        step = max(int(getattr(eng, "max_width", 0)) or (k - j), 1)
-        for s0 in range(j, k, step):
-            s1 = min(s0 + step, k)
+        sub-window packs its next occurrence. False = the engine can't
+        take the shape at all (nothing mutated)."""
+        for si, (s0, s1) in enumerate(spans):
             try:
                 h = eng.submit_columnar(
                     s1 - s0, b["keys"], b["key_off"][s0:s1 + 1],
@@ -773,15 +978,15 @@ class PeerLinkService:
                     b["algorithm"][s0:s1], b["behavior"][s0:s1],
                     _COLUMNAR_SLOW_MASK)
             except Exception as e:  # noqa: BLE001 — directory over-commit
-                msg = str(e).encode()
-                b["status"][s0:k] = 0
-                b["r_limit"][s0:k] = 0
-                b["r_remaining"][s0:k] = 0
-                b["r_reset"][s0:k] = 0
-                errs.extend((i, msg) for i in range(s0, k))
+                self._col_error_fill(str(e).encode(), s0, k, b, errs)
                 return True
-            if h is None:  # only possible on the sole full-range try
-                return False
+            if h is None:
+                if si == 0:
+                    return False  # nothing mutated: whole-chunk fallback
+                # defensive mid-stream refusal: earlier spans already
+                # applied, so the remainder retires via the object path
+                self._object_chunk(m, s0, k, b, errs, metas)
+                return True
             leftover = eng.complete_columnar(
                 h, b["status"][s0:s1], b["r_limit"][s0:s1],
                 b["r_remaining"][s0:s1], b["r_reset"][s0:s1])
