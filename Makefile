@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast bench bench-suite soak proto docker clean
+.PHONY: test test-fast bench bench-suite soak chaos proto docker clean
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -19,6 +19,14 @@ bench-suite:
 # 30s fault-injection soak: kill/restart chaos under load, invariant-judged
 soak:
 	PYTHONPATH=. python scripts/soak.py
+
+# deterministic fault-injection drills (circuit breaker, degraded-local,
+# recovery) with a randomized seed; -s keeps the seed line visible —
+# reproduce any failure with GUBER_CHAOS_SEED=<seed> make chaos
+chaos:
+	@seed=$${GUBER_CHAOS_SEED:-$$(od -An -N2 -tu2 /dev/urandom | tr -d ' ')}; \
+	echo "chaos seed: $$seed"; \
+	GUBER_CHAOS_SEED=$$seed python -m pytest tests/ -q -s -m chaos
 
 proto:
 	bash scripts/genproto.sh

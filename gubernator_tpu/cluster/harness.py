@@ -42,6 +42,11 @@ def test_behaviors() -> BehaviorConfig:
         # gRPC ports are dynamic here, so a fixed link offset could collide
         # with another instance's port; peerlink tests wire it explicitly
         peer_link_offset=0,
+        # breaker cooldown tracks the bounded channel-reconnect backoff
+        # (grpc_api.CHANNEL_OPTIONS, ~1 s): a kill/restart harness reuses
+        # PeerClients across the restart, so the production 5 s cooldown
+        # would stall recovery past the soak's settle grace
+        circuit_open_s=0.5,
     )
 
 

@@ -248,6 +248,16 @@ def main(argv=None) -> int:
 
     _apply_jax_platforms()
 
+    if conf.fault_spec:
+        # chaos drills: arm the deterministic fault plan before any peer
+        # client exists, and say so LOUDLY — an armed plan in production
+        # serving is an outage you configured
+        from gubernator_tpu.service import faults
+
+        faults.install(conf.fault_spec)
+        log.warning("FAULT INJECTION ACTIVE (GUBER_FAULT_SPEC): %s",
+                    conf.fault_spec)
+
     # form the cross-host device process group BEFORE the first backend use;
     # no-op for single-host deployments
     from gubernator_tpu.parallel.multihost import initialize_from_env
@@ -272,6 +282,12 @@ def main(argv=None) -> int:
     if conf.trace_sample > 0:
         log.info("request tracing on: sample=%.3g slow_request_ms=%.0f",
                  conf.trace_sample, conf.slow_request_ms)
+    if conf.behaviors.circuit_threshold > 0:
+        log.info(
+            "peer circuit breaker: threshold=%d cooldown=%.1fs "
+            "degraded_local=%s",
+            conf.behaviors.circuit_threshold, conf.behaviors.circuit_open_s,
+            "on" if conf.behaviors.degraded_local else "off")
     instance = Instance(
         InstanceConfig(
             behaviors=conf.behaviors,

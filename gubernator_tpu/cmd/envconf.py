@@ -206,6 +206,10 @@ class DaemonConfig:
     cross_host_stall_s: float = 10.0
     cross_host_secret: str = ""
     cross_host_group: List[str] = dataclasses.field(default_factory=list)
+    # deterministic fault injection (service/faults.py): an armed plan
+    # fails/delays the Nth transport call per peer — chaos drills and
+    # failure-mode rehearsal ONLY, never production serving
+    fault_spec: str = ""
     debug: bool = False
 
 
@@ -234,6 +238,13 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
     b.multi_region_sync_wait_s = _env_dur(
         "GUBER_MULTI_REGION_SYNC_WAIT", b.multi_region_sync_wait_s)
     b.peer_link_offset = _env_int("GUBER_PEER_LINK_OFFSET", b.peer_link_offset)
+    b.link_retry_s = _env_float("GUBER_LINK_RETRY_S", b.link_retry_s)
+
+    # peer-failure resilience (service/peer_client.py CircuitBreaker)
+    b.circuit_threshold = _env_int("GUBER_CIRCUIT_THRESHOLD",
+                                   b.circuit_threshold)
+    b.circuit_open_s = _env_dur("GUBER_CIRCUIT_OPEN", b.circuit_open_s)
+    b.degraded_local = _env_bool("GUBER_DEGRADED_LOCAL")
 
     conf = DaemonConfig(
         grpc_address=_env_str("GUBER_GRPC_ADDRESS", "0.0.0.0:81"),
@@ -295,6 +306,7 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         cross_host_stall_s=_env_dur("GUBER_CROSS_HOST_STALL", 10.0),
         cross_host_secret=_env_str("GUBER_CROSS_HOST_SECRET"),
         cross_host_group=_env_slice("GUBER_CROSS_HOST_GROUP"),
+        fault_spec=_env_str("GUBER_FAULT_SPEC"),
         debug=opts.debug or bool(os.environ.get("GUBER_DEBUG")),
     )
     if conf.collectives not in ("psum", "ring"):
@@ -309,6 +321,23 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         raise ValueError(
             f"'GUBER_TRACE_SAMPLE={conf.trace_sample}' is invalid; "
             "must be a fraction in [0, 1]")
+    if b.circuit_threshold < 0:
+        raise ValueError(
+            f"'GUBER_CIRCUIT_THRESHOLD={b.circuit_threshold}' is invalid; "
+            "must be >= 0 (0 disables the breaker)")
+    if b.circuit_open_s <= 0:
+        raise ValueError(
+            f"'GUBER_CIRCUIT_OPEN={b.circuit_open_s}' is invalid; "
+            "must be a positive duration")
+    if b.link_retry_s <= 0:
+        raise ValueError(
+            f"'GUBER_LINK_RETRY_S={b.link_retry_s}' is invalid; "
+            "must be positive seconds")
+    if conf.fault_spec:
+        # a typo'd chaos plan must fail the boot loudly, not inject nothing
+        from gubernator_tpu.service.faults import parse_spec
+
+        parse_spec(conf.fault_spec)
     return conf
 
 

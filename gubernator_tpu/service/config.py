@@ -37,6 +37,24 @@ class BehaviorConfig:
     # peer call rides gRPC. Transparent per-peer fallback to gRPC when the
     # link can't connect (mixed fleets with reference nodes keep working).
     peer_link_offset: int = 1000
+    # gRPC-fallback backoff before re-trying a peer's native link, seconds
+    # (GUBER_LINK_RETRY_S; jittered ±50% per attempt so a fleet doesn't
+    # re-dial a revived link port in one synchronized wave)
+    link_retry_s: float = 30.0
+
+    # peer-failure resilience (service/peer_client.py CircuitBreaker,
+    # docs/OPERATIONS.md "Failure modes"): a peer circuit opens after
+    # `circuit_threshold` CONSECUTIVE transport failures (peerlink and gRPC
+    # feed one breaker) and fails calls fast pre-send for `circuit_open_s`,
+    # then admits a single half-open probe. 0 disables the breaker.
+    circuit_threshold: int = 5
+    circuit_open_s: float = 5.0
+    # GUBER_DEGRADED_LOCAL: while a key's owner circuit is open, serve
+    # ordinary forwards locally as-if-owner (GLOBAL/MULTI_REGION pipeline
+    # flags stripped, responses marked metadata[degraded]=true) instead of
+    # returning errors. Off by default: split-brain over-admission is a
+    # policy choice the operator must opt into.
+    degraded_local: bool = False
 
 
 @dataclasses.dataclass
@@ -69,3 +87,9 @@ class InstanceConfig:
             raise ValueError(
                 f"behaviors.batch_limit cannot exceed '{MAX_BATCH_SIZE}'"
             )
+        if self.behaviors.circuit_threshold < 0:
+            raise ValueError("behaviors.circuit_threshold cannot be negative")
+        if self.behaviors.circuit_open_s <= 0:
+            raise ValueError("behaviors.circuit_open_s must be positive")
+        if self.behaviors.link_retry_s <= 0:
+            raise ValueError("behaviors.link_retry_s must be positive")

@@ -1,0 +1,243 @@
+"""Deterministic fault-injection harness (GUBER_FAULT_SPEC).
+
+Peer-failure behavior must be provable in milliseconds, not by killing
+processes and waiting out real timeouts: an injectable fault *plan* sits at
+the two transport choke points — the gRPC stub wrapper inside PeerClient and
+PeerLinkClient.call_async — and fails, delays, or "times out" exactly the
+Nth call to a given peer over a given transport. Counters are per
+(peer, transport), incremented under a lock, so a plan replays
+bit-identically run after run; that is what lets the circuit-breaker tests
+(tests/test_resilience.py) prove open/half-open/recover transitions inside
+tier-1 wall time.
+
+Fault actions map onto the delivery-uncertainty invariant the router
+enforces (instance.py _forward_group):
+
+- ``error``   — PRE-send transport failure (connect refused analogue).
+                Nothing reached the wire; callers may fall back or degrade.
+- ``timeout`` — POST-send deadline. The frame may be applying at the peer,
+                so the call must surface an error, never re-send.
+- ``drop``    — the frame vanished in flight; indistinguishable from
+                ``timeout`` to the caller, kept as a separate verb so plans
+                document intent.
+- ``delay:SECONDS`` — sleep, then let the call proceed (slow-peer soak).
+
+Spec grammar (rules separated by ``|``, fields by ``;``)::
+
+    GUBER_FAULT_SPEC="peer=10.0.0.2:81;transport=grpc;calls=1-5;action=error"
+    GUBER_FAULT_SPEC="peer=*;transport=peerlink;calls=3;action=delay:0.05|peer=*;calls=7-;action=timeout"
+
+``peer`` and ``transport`` default to ``*`` (any); ``calls`` takes ``N``,
+``N-M``, ``N-`` (from N on), ``*``, or a comma list of those; the first
+matching rule wins. The plan is process-global: ``install()`` arms it,
+``clear()`` disarms, and the hot-path hook ``on_call()`` is a single
+module-global ``None`` check when no plan is active.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+TRANSPORTS = ("grpc", "peerlink")
+ACTIONS = ("error", "timeout", "drop", "delay")
+
+
+class FaultError(ConnectionError):
+    """Injected PRE-send transport failure: nothing reached the wire, so
+    the caller may retry, fall back, or degrade without double-count risk."""
+
+
+class FaultTimeout(TimeoutError):
+    """Injected POST-send deadline: delivery is uncertain, so the caller
+    must surface an error exactly as a real timeout would — never re-send."""
+
+
+def _parse_calls(text: str):
+    """``calls=`` value -> list of (lo, hi) inclusive ranges; hi=None means
+    unbounded. ``*`` matches every call."""
+    text = text.strip()
+    if text in ("", "*"):
+        return [(1, None)]
+    ranges: List[Tuple[int, Optional[int]]] = []
+    for part in text.split(","):
+        part = part.strip()
+        if "-" in part:
+            lo, _, hi = part.partition("-")
+            ranges.append((int(lo), int(hi) if hi.strip() else None))
+        else:
+            ranges.append((int(part), int(part)))
+    for lo, hi in ranges:
+        if lo < 1 or (hi is not None and hi < lo):
+            raise ValueError(f"invalid calls range {text!r}")
+    return ranges
+
+
+class FaultRule:
+    """One injection rule: WHICH calls (peer, transport, Nth) get WHAT."""
+
+    __slots__ = ("peer", "transport", "calls", "action", "delay_s")
+
+    def __init__(self, peer: str = "*", transport: str = "*",
+                 calls: str = "*", action: str = "error"):
+        self.peer = peer
+        self.transport = transport
+        self.calls = _parse_calls(calls)
+        self.delay_s = 0.0
+        verb, _, arg = action.partition(":")
+        if verb not in ACTIONS:
+            raise ValueError(
+                f"unknown fault action {verb!r}; choices are {list(ACTIONS)}")
+        if verb == "delay":
+            self.delay_s = float(arg or "0.01")
+        elif arg:
+            raise ValueError(f"action {verb!r} takes no argument")
+        if transport not in ("*",) + TRANSPORTS:
+            raise ValueError(
+                f"unknown transport {transport!r}; choices are "
+                f"{['*'] + list(TRANSPORTS)}")
+        self.action = verb
+
+    def matches(self, peer: str, transport: str, n: int) -> bool:
+        if self.peer not in ("*", peer):
+            return False
+        if self.transport not in ("*", transport):
+            return False
+        return any(lo <= n and (hi is None or n <= hi)
+                   for lo, hi in self.calls)
+
+    def __repr__(self) -> str:  # pragma: no cover — debug aid
+        return (f"FaultRule(peer={self.peer!r}, transport={self.transport!r},"
+                f" action={self.action!r})")
+
+
+def parse_spec(spec: str) -> List[FaultRule]:
+    """GUBER_FAULT_SPEC text -> rules. Raises ValueError on malformed
+    input — a typo'd chaos plan must fail the boot loudly, not silently
+    inject nothing."""
+    rules = []
+    for chunk in spec.split("|"):
+        chunk = chunk.strip()
+        if not chunk:
+            continue
+        fields = {}
+        for field in chunk.split(";"):
+            field = field.strip()
+            if not field:
+                continue
+            if "=" not in field:
+                raise ValueError(f"malformed fault field {field!r} "
+                                 "(want key=value)")
+            key, _, value = field.partition("=")
+            key = key.strip()
+            if key not in ("peer", "transport", "calls", "action"):
+                raise ValueError(f"unknown fault field {key!r}")
+            fields[key] = value.strip()
+        rules.append(FaultRule(**fields))
+    return rules
+
+
+class FaultPlan:
+    """An armed set of rules plus the per-(peer, transport) call counters
+    that make the Nth-call semantics deterministic. The ``injected`` log
+    records every fault actually applied (tests assert against it)."""
+
+    def __init__(self, rules: Sequence[FaultRule]):
+        self.rules = list(rules)
+        self._lock = threading.Lock()
+        self._counts = {}
+        self.injected: List[str] = []
+
+    def call_count(self, peer: str, transport: str) -> int:
+        with self._lock:
+            return self._counts.get((peer, transport), 0)
+
+    def on_call(self, peer: str, transport: str) -> None:
+        """Count this call and apply the first matching rule (if any).
+        Raises FaultError/FaultTimeout, sleeps for delay, else returns."""
+        with self._lock:
+            n = self._counts.get((peer, transport), 0) + 1
+            self._counts[(peer, transport)] = n
+            rule = next((r for r in self.rules
+                         if r.matches(peer, transport, n)), None)
+            if rule is not None and rule.action != "delay":
+                self.injected.append(
+                    f"{transport}:{peer}:call{n}:{rule.action}")
+        if rule is None:
+            return
+        if rule.action == "delay":
+            time.sleep(rule.delay_s)
+            return
+        if rule.action == "error":
+            raise FaultError(
+                f"injected {transport} fault for {peer} (call {n})")
+        raise FaultTimeout(
+            f"injected {transport} {rule.action} for {peer} (call {n})")
+
+
+# ------------------------------------------------------------- global plan
+
+_active: Optional[FaultPlan] = None
+
+
+def install(plan) -> FaultPlan:
+    """Arm a FaultPlan (or a spec string / rule list) process-wide."""
+    global _active
+    if isinstance(plan, str):
+        plan = FaultPlan(parse_spec(plan))
+    elif isinstance(plan, (list, tuple)):
+        plan = FaultPlan(plan)
+    _active = plan
+    return plan
+
+
+def clear() -> None:
+    global _active
+    _active = None
+
+
+def active() -> Optional[FaultPlan]:
+    return _active
+
+
+def on_call(peer: str, transport: str) -> None:
+    """The transport-choke-point hook: free when no plan is armed."""
+    plan = _active
+    if plan is not None:
+        plan.on_call(peer, transport)
+
+
+def load_from_env() -> Optional[FaultPlan]:
+    """Arm GUBER_FAULT_SPEC from the environment (daemon boot)."""
+    spec = os.environ.get("GUBER_FAULT_SPEC", "").strip()
+    if not spec:
+        return None
+    return install(spec)
+
+
+class _FaultyStub:
+    """gRPC stub wrapper: applies the active plan before every RPC. Method
+    wrappers are cached on first use, so the steady-state overhead is one
+    attribute hit + one module-global check per call."""
+
+    def __init__(self, stub, peer: str):
+        self._stub = stub
+        self._peer = peer
+
+    def __getattr__(self, name):
+        inner = getattr(self._stub, name)
+        peer = self._peer
+
+        def call(*args, **kwargs):
+            on_call(peer, "grpc")
+            return inner(*args, **kwargs)
+
+        setattr(self, name, call)
+        return call
+
+
+def wrap_stub(stub, peer: str):
+    """Wrap a gRPC stub so the fault plan sees every call to `peer`."""
+    return _FaultyStub(stub, peer)

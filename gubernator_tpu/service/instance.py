@@ -18,6 +18,7 @@ from __future__ import annotations
 import dataclasses
 
 import logging
+import random
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -34,7 +35,12 @@ from gubernator_tpu.service.combiner import BackendCombiner
 from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
 from gubernator_tpu.service.global_manager import GlobalManager
 from gubernator_tpu.service.multiregion import MultiRegionManager
-from gubernator_tpu.service.peer_client import PeerClient, PeerNotReadyError
+from gubernator_tpu.service.peer_client import (
+    CIRCUIT_CLOSED,
+    CircuitOpenError,
+    PeerClient,
+    PeerNotReadyError,
+)
 from gubernator_tpu.types import (
     MAX_BATCH_SIZE,
     Behavior,
@@ -303,22 +309,50 @@ class Instance:
             )
         )
 
+    # health message bounds: under sustained failure the raw join of every
+    # retained error (100/peer x peers, 5-minute TTL) produced multi-KB
+    # health responses; report per-peer COUNTS plus capped samples instead
+    HEALTH_SAMPLES_PER_PEER = 2
+    HEALTH_SAMPLE_CHARS = 160
+    HEALTH_MESSAGE_CHARS = 2048
+
     def health_check(self) -> HealthCheckResp:
-        """Accumulate recent peer errors (reference: gubernator.go:287-325)."""
-        errs: List[str] = []
+        """Accumulate recent peer errors (reference: gubernator.go:287-325),
+        bounded: one line per failing peer with its error COUNT, circuit
+        state, and up to HEALTH_SAMPLES_PER_PEER deduped samples; the whole
+        message is capped at HEALTH_MESSAGE_CHARS."""
+        parts: List[str] = []
         if self.collective_global is not None:
             err = self.collective_global.health_error()
             if err:
-                errs.append(err)
+                parts.append(err)
         with self._peer_lock:
-            for peer in self.local_picker.peers():
-                errs.extend(peer.get_last_err())
-            for peer in self.region_picker.peers():
-                errs.extend(peer.get_last_err())
+            peers = self.local_picker.peers() + self.region_picker.peers()
             peer_count = self.local_picker.size() + self.region_picker.size()
-        if errs:
+        for peer in peers:
+            errs = peer.get_last_err()  # LRU-deduped per peer already
+            circuit = getattr(peer, "circuit", None)
+            circuit_note = ""
+            if circuit is not None and circuit.state != CIRCUIT_CLOSED:
+                circuit_note = f", circuit {circuit.state_name}"
+            if not errs and not circuit_note:
+                continue
+            prefix = f"{peer.info.address}: "
+            samples = "; ".join(
+                (e[len(prefix):] if e.startswith(prefix)
+                 else e)[:self.HEALTH_SAMPLE_CHARS]
+                for e in errs[:self.HEALTH_SAMPLES_PER_PEER])
+            line = f"{peer.info.address}: {len(errs)} errors{circuit_note}"
+            if samples:
+                line += f" ({samples})"
+            parts.append(line)
+        if parts:
+            message = " | ".join(parts)
+            if len(message) > self.HEALTH_MESSAGE_CHARS:
+                message = (message[:self.HEALTH_MESSAGE_CHARS]
+                           + f"... [{len(parts)} peers reporting]")
             return HealthCheckResp(
-                status="unhealthy", message="|".join(errs), peer_count=peer_count
+                status="unhealthy", message=message, peer_count=peer_count
             )
         return HealthCheckResp(status="healthy", peer_count=peer_count)
 
@@ -339,12 +373,14 @@ class Instance:
                 if info.datacenter and info.datacenter != self.data_center:
                     peer = self.region_picker.get_by_peer_info(info)
                     if peer is None:
-                        peer = PeerClient(self.conf.behaviors, info)
+                        peer = PeerClient(self.conf.behaviors, info,
+                                          metrics=self.conf.metrics)
                     new_region.add(peer)
                     continue
                 peer = self.local_picker.get_by_peer_info(info)
                 if peer is None:
-                    peer = PeerClient(self.conf.behaviors, info)
+                    peer = PeerClient(self.conf.behaviors, info,
+                                      metrics=self.conf.metrics)
                 else:
                     peer.info = info
                 new_local.add(peer)
@@ -404,6 +440,11 @@ class Instance:
     def local_peers(self) -> List[PeerClient]:
         with self._peer_lock:
             return self.local_picker.peers()
+
+    def all_peer_clients(self) -> List[PeerClient]:
+        """Every live PeerClient (local + region) — health/metrics walk."""
+        with self._peer_lock:
+            return self.local_picker.peers() + self.region_picker.peers()
 
     def region_pickers(self) -> Dict[str, object]:
         with self._peer_lock:
@@ -466,9 +507,15 @@ class Instance:
     def _forward(self, req: RateLimitReq, key: str,
                  span=None) -> RateLimitResp:
         """Relay to the owning peer, re-picking up to 5 times while peers
-        shut down (reference: gubernator.go:149-157,186-205)."""
+        shut down (reference: gubernator.go:149-157,186-205).
+
+        Re-picks back off with jitter and respect a deadline bounded by
+        the client's own batch timeout: a picker that keeps returning the
+        same closing peer must not spin the loop hot, and the loop must
+        never outlive the RPC deadline the caller is already paying."""
         last_err = ""
-        for _ in range(6):
+        deadline = time.monotonic() + self.conf.behaviors.batch_timeout_s
+        for attempt in range(6):
             try:
                 peer = self.get_peer(key)
             except Exception as e:  # noqa: BLE001
@@ -491,8 +538,20 @@ class Instance:
                         "peer.hop", span, t0, time.time_ns(),
                         {"peer": peer.info.address})
                 return resp
+            except CircuitOpenError:
+                # the owner's circuit is open: nothing was sent, so serve
+                # degraded-local (when enabled) or fail fast — either way
+                # in microseconds, never a batch_timeout_s stall
+                return self._degrade_or_error([req], peer)[0]
             except PeerNotReadyError as e:
                 last_err = str(e)
+                now = time.monotonic()
+                if now >= deadline or attempt == 5:
+                    break
+                # jittered backoff before the re-pick: membership updates
+                # need a beat to land, and zero-sleep spins pin a core
+                time.sleep(min(0.002 * (1 << attempt) * (0.5 + random.random()),
+                               0.05, deadline - now))
                 continue
             except Exception as e:  # noqa: BLE001
                 return RateLimitResp(
@@ -530,6 +589,10 @@ class Instance:
         t0 = time.time_ns() if span is not None else 0
         try:
             resps = peer.get_peer_rate_limits(reqs, trace_span=span)
+        except CircuitOpenError:
+            # owner circuit open: pre-send by construction, so the whole
+            # group may degrade locally in ONE owner-batch apply
+            return self._degrade_or_error(reqs, peer)
         except PeerNotReadyError:
             return [self._forward(r, r.hash_key(), span) for r in reqs]
         except Exception as e:  # noqa: BLE001
@@ -548,6 +611,41 @@ class Instance:
                 {"peer": peer.info.address, "requests": len(reqs)})
         for r in resps:
             r.metadata["owner"] = peer.info.address
+        return resps
+
+    def _degrade_or_error(
+        self, reqs: Sequence[RateLimitReq], peer: PeerClient
+    ) -> List[RateLimitResp]:
+        """The owner's circuit is OPEN (a pre-send condition: nothing
+        reached the wire, so local application cannot double-count).
+
+        With GUBER_DEGRADED_LOCAL on, apply the requests here as-if-owner —
+        the same owner-pipeline behavior-stripping the GLOBAL owner-down
+        fallback uses (GLOBAL broadcast and MULTI_REGION replication are
+        the real owner's job; running them off this node's partial view
+        would poison every peer's mirror) — and mark each response
+        metadata[degraded]=true so callers can tell enforced-but-approximate
+        answers from owner-authoritative ones. Off, fail fast with a
+        distinct error (still no batch_timeout_s stall: the breaker already
+        paid the timeout that opened it)."""
+        addr = peer.info.address
+        if not getattr(self.conf.behaviors, "degraded_local", False):
+            return [RateLimitResp(
+                error=f"circuit open to owner '{addr}' for "
+                      f"'{r.hash_key()}' - failing fast "
+                      f"(GUBER_DEGRADED_LOCAL=1 serves these locally)")
+                for r in reqs]
+        local = [without_behavior(r, Behavior.GLOBAL, Behavior.MULTI_REGION)
+                 for r in reqs]
+        resps = self.apply_owner_batch(local)
+        if self.conf.metrics is not None:
+            try:
+                self.conf.metrics.degraded_local.inc(len(resps))
+            except Exception:  # noqa: BLE001 — metrics must not break serving
+                pass
+        for r in resps:
+            r.metadata["owner"] = addr
+            r.metadata["degraded"] = "true"
         return resps
 
     def _get_global_rate_limit(

@@ -225,6 +225,29 @@ class Metrics:
             "keys, gregorian, GLOBAL lanes force a pipeline drain).",
             registry=self.registry,
         )
+        # peer-failure resilience (service/peer_client.py CircuitBreaker +
+        # instance.py degraded-local serving; docs/OPERATIONS.md "Failure
+        # modes"). circuit_open_total is LIVE (the breaker increments it at
+        # the open transition); circuit_state refreshes at exposition.
+        self.circuit_state = Gauge(
+            "circuit_state",
+            "Per-peer circuit breaker state (0=closed, 1=half-open, "
+            "2=open).",
+            ["peer"], registry=self.registry,
+        )
+        self.circuit_open = Counter(
+            "circuit_open_total",
+            "Circuit-breaker transitions to open, per peer (closed->open "
+            "on consecutive transport failures, half-open->open on a "
+            "failed recovery probe).",
+            ["peer"], registry=self.registry,
+        )
+        self.degraded_local = Counter(
+            "degraded_local_total",
+            "Forwarded requests served locally as-if-owner because the "
+            "owner's circuit was open (GUBER_DEGRADED_LOCAL=1).",
+            registry=self.registry,
+        )
         # TPU-native engine metrics (no reference analogue)
         self.engine_decisions = Counter(
             "engine_decisions_total",
@@ -386,6 +409,13 @@ class Metrics:
         if occupancy is not None:
             self.engine_key_table_size.set(occupancy)
             self.cache_size.set(occupancy)
+        all_peers = getattr(instance, "all_peer_clients", None)
+        if callable(all_peers):
+            for peer in all_peers():
+                circuit = getattr(peer, "circuit", None)
+                if circuit is not None:
+                    self.circuit_state.labels(
+                        peer=peer.info.address).set(circuit.state)
         gm = getattr(instance, "global_manager", None)
         if gm is not None:
             hits_depth, bcast_depth = gm.depths()

@@ -12,6 +12,7 @@ drains in-flight requests, and an LRU of recent errors feeding HealthCheck
 from __future__ import annotations
 
 import queue
+import random
 import threading
 import time
 from concurrent.futures import Future
@@ -20,6 +21,7 @@ from typing import List, Optional, Sequence
 
 import grpc
 
+from gubernator_tpu.service import faults
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.convert import req_to_pb, resp_from_pb
 from gubernator_tpu.service.grpc_api import CHANNEL_OPTIONS, PeersV1Stub
@@ -33,14 +35,129 @@ class PeerNotReadyError(RuntimeError):
     owner pick (reference: peer_client.go:359-383 IsNotReady)."""
 
 
+class CircuitOpenError(PeerNotReadyError):
+    """The peer's circuit breaker is open: recent transport failures
+    crossed the threshold, so calls fail fast PRE-send. A subclass of
+    PeerNotReadyError because the guarantees are identical — nothing was
+    sent, so the router may re-pick, degrade locally, or refund hits
+    without double-count risk."""
+
+
+CIRCUIT_CLOSED, CIRCUIT_HALF_OPEN, CIRCUIT_OPEN = 0, 1, 2
+_CIRCUIT_NAMES = {CIRCUIT_CLOSED: "closed", CIRCUIT_HALF_OPEN: "half-open",
+                  CIRCUIT_OPEN: "open"}
+
+
+class CircuitBreaker:
+    """Per-peer circuit shared by BOTH transports (peerlink and gRPC feed
+    one breaker): closed -> open after `circuit_threshold` consecutive
+    transport failures -> half-open single-probe after `circuit_open_s`
+    -> closed again on probe success. A dead peer then costs the fleet one
+    probe timeout per cooldown, not one `batch_timeout_s` stall per batch.
+
+    Thresholds are read from the live BehaviorConfig on every decision, so
+    tests (and future hot-reload) can tune a running breaker.
+    `circuit_threshold <= 0` disables the breaker entirely — every call
+    behaves exactly as before this layer existed."""
+
+    def __init__(self, conf: BehaviorConfig, address: str, metrics=None):
+        self.conf = conf
+        self.address = address
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = CIRCUIT_CLOSED
+        self._opened_at = 0.0
+        self._probing = False
+        self.opened_total = 0  # lifetime open transitions (health/debug)
+
+    @property
+    def enabled(self) -> bool:
+        return getattr(self.conf, "circuit_threshold", 0) > 0
+
+    @property
+    def state(self) -> int:
+        return self._state
+
+    @property
+    def state_name(self) -> str:
+        return _CIRCUIT_NAMES[self._state]
+
+    def _open_s(self) -> float:
+        return max(getattr(self.conf, "circuit_open_s", 5.0), 0.001)
+
+    def blocked(self) -> bool:
+        """Read-only fast-fail check: True only while OPEN inside the
+        cooldown. Does NOT consume the half-open probe slot, so callers on
+        the batched path can fail fast without starving the probe."""
+        return (self._state == CIRCUIT_OPEN
+                and time.monotonic() - self._opened_at < self._open_s())
+
+    def allow(self) -> bool:
+        """Admission check at the transport choke point. Exactly one
+        caller at a time gets through an open-but-cooled-down circuit: the
+        half-open probe whose outcome decides reopen vs close."""
+        if not self.enabled:
+            return True
+        with self._lock:
+            if self._state == CIRCUIT_CLOSED:
+                return True
+            if self._state == CIRCUIT_OPEN:
+                if time.monotonic() - self._opened_at < self._open_s():
+                    return False
+                self._state = CIRCUIT_HALF_OPEN
+                self._probing = True
+                return True
+            if self._probing:  # HALF_OPEN with the probe already in flight
+                return False
+            self._probing = True
+            return True
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._probing = False
+            self._state = CIRCUIT_CLOSED
+
+    def record_failure(self) -> None:
+        if not self.enabled:
+            return
+        opened = False
+        with self._lock:
+            self._failures += 1
+            if self._state == CIRCUIT_HALF_OPEN:
+                # the probe failed: reopen for another cooldown
+                self._state = CIRCUIT_OPEN
+                self._opened_at = time.monotonic()
+                self._probing = False
+                self.opened_total += 1
+                opened = True
+            elif (self._state == CIRCUIT_CLOSED
+                  and self._failures >= self.conf.circuit_threshold):
+                self._state = CIRCUIT_OPEN
+                self._opened_at = time.monotonic()
+                self.opened_total += 1
+                opened = True
+        if opened and self.metrics is not None:
+            try:
+                self.metrics.circuit_open.labels(peer=self.address).inc()
+            except Exception:  # noqa: BLE001 — metrics must not break calls
+                pass
+
+
 class PeerClient:
     """One remote peer: connection + batching queue + error history."""
 
     ERR_TTL_MS = 5 * 60 * 1000  # last-error retention (reference: peer_client.go:53)
 
-    def __init__(self, behaviors: BehaviorConfig, info: PeerInfo):
+    def __init__(self, behaviors: BehaviorConfig, info: PeerInfo,
+                 metrics=None):
         self.conf = behaviors
         self.info = info
+        self.metrics = metrics
+        # one breaker for BOTH transports: peerlink timeouts and gRPC
+        # failures feed the same consecutive-failure count
+        self.circuit = CircuitBreaker(behaviors, info.address, metrics)
         self._stub: Optional[PeersV1Stub] = None
         self._channel: Optional[grpc.Channel] = None
         self._queue: "queue.Queue" = queue.Queue()
@@ -55,7 +172,14 @@ class PeerClient:
 
     # ------------------------------------------------------- native link
 
-    LINK_RETRY_S = 30.0
+    LINK_RETRY_S = 30.0  # default when the BehaviorConfig predates the knob
+
+    def _link_retry_delay(self) -> float:
+        """gRPC-fallback backoff before the next link attempt
+        (GUBER_LINK_RETRY_S), jittered ±50% so a fleet that lost a peer
+        does not re-dial its revived link port in one synchronized wave."""
+        base = getattr(self.conf, "link_retry_s", 0.0) or self.LINK_RETRY_S
+        return base * (0.5 + random.random())
 
     def _peer_link(self):
         """The native link to this peer, or None (disabled / unreachable —
@@ -81,21 +205,29 @@ class PeerClient:
 
         host, _, port = self.info.address.rpartition(":")
         try:
-            link = PeerLinkClient(f"{host}:{int(port) + offset}")
+            link = PeerLinkClient(f"{host}:{int(port) + offset}",
+                                  fault_key=self.info.address)
         except (OSError, ValueError, PeerLinkError):
-            self._link_retry_at = time.monotonic() + self.LINK_RETRY_S
+            self._link_retry_at = time.monotonic() + self._link_retry_delay()
             return None
         with self._lock:
             if self._link is None and not self._closing:
                 self._link = link
                 return link
+            winner = self._link
         link.close()  # lost the race or closing
-        return self._link
+        # race tail: the winner may itself have died or been dropped since
+        # the install — hand back only a verified-live link, never a
+        # just-closed one (callers would burn a call on a dead socket and
+        # charge the breaker for it)
+        if winner is not None and not winner._closed:
+            return winner
+        return None
 
     def _drop_link(self) -> None:
         with self._lock:
             link, self._link = self._link, None
-        self._link_retry_at = time.monotonic() + self.LINK_RETRY_S
+        self._link_retry_at = time.monotonic() + self._link_retry_delay()
         if link is not None:
             link.close()
 
@@ -119,7 +251,10 @@ class PeerClient:
                 # grpc's default multi-second exponential backoff
                 self._channel = grpc.insecure_channel(
                     self.info.address, options=CHANNEL_OPTIONS)
-                self._stub = PeersV1Stub(self._channel)
+                # the fault-injection choke point for the gRPC transport:
+                # a no-op passthrough unless a plan is armed (faults.py)
+                self._stub = faults.wrap_stub(
+                    PeersV1Stub(self._channel), self.info.address)
                 self._thread = threading.Thread(
                     target=self._run, name=f"peer-batch-{self.info.address}",
                     daemon=True,
@@ -166,6 +301,11 @@ class PeerClient:
         if has_behavior(req.behavior, Behavior.NO_BATCHING):
             resps = self.get_peer_rate_limits([req], trace_span=trace_span)
             return resps[0]
+        if self.circuit.blocked():
+            # fail in microseconds instead of paying the batch window +
+            # timeout against a peer known-dead; blocked() (not allow())
+            # so this fast path can never consume the half-open probe slot
+            raise CircuitOpenError(self.info.address)
         self._connect()
         fut: "Future[RateLimitResp]" = Future()
         # check+enqueue atomically vs shutdown's closing flag: a request in
@@ -200,6 +340,11 @@ class PeerClient:
         owner: gRPC carries it as `traceparent` metadata, peerlink as a
         reserved carrier item in a TRACED frame — the owner's spans then
         share this request's trace id."""
+        if not self.circuit.allow():
+            # one gate for BOTH transports: the whole batch fails fast
+            # pre-send (one CircuitOpenError per batch, not one timeout
+            # per request) until the cooldown admits a half-open probe
+            raise CircuitOpenError(self.info.address)
         link = self._peer_link()
         if link is not None:
             from gubernator_tpu.service.peerlink import (
@@ -218,9 +363,12 @@ class PeerClient:
                         METHOD_GET_PEER_RATE_LIMITS | METHOD_TRACED,
                         [trace_carrier(trace_span)] + list(reqs),
                         self.conf.batch_timeout_s)
+                    self.circuit.record_success()
                     return resps[1:]  # drop the carrier's placeholder
-                return link.call(METHOD_GET_PEER_RATE_LIMITS, list(reqs),
-                                 self.conf.batch_timeout_s)
+                resps = link.call(METHOD_GET_PEER_RATE_LIMITS, list(reqs),
+                                  self.conf.batch_timeout_s)
+                self.circuit.record_success()
+                return resps
             except PeerLinkUnencodable:
                 pass  # THIS request can't ride the wire format; the link
                 # is healthy — route just this call over gRPC below
@@ -230,10 +378,14 @@ class PeerClient:
                 # Instance._forward_group documents) — surface the error,
                 # exactly as a gRPC deadline would
                 self._record_err(f"peerlink: {e}")
+                self.circuit.record_failure()
                 raise
             except PeerLinkError as e:
                 # broken link: back off to gRPC for a while (the peer may
-                # have restarted without the link, or be a reference node)
+                # have restarted without the link, or be a reference node).
+                # NOT a breaker failure by itself — the gRPC attempt below
+                # decides this call's outcome, and a healthy-gRPC peer with
+                # a dead link port must not accumulate toward open.
                 self._record_err(f"peerlink: {e}")
                 self._drop_link()
         stub = self._connect()
@@ -249,25 +401,44 @@ class PeerClient:
                 wait_for_ready=wait_for_ready, metadata=metadata)
         except grpc.RpcError as e:
             self._record_err(str(e.code()))
+            self.circuit.record_failure()
+            raise
+        except (faults.FaultError, faults.FaultTimeout) as e:
+            # injected transport failures charge the breaker exactly as
+            # their real counterparts would
+            self._record_err(f"fault: {e}")
+            self.circuit.record_failure()
             raise
         except ValueError as e:
             # grpc raises bare ValueError("Cannot invoke RPC on closed
             # channel!") when shutdown() closed the channel mid-call
             raise PeerNotReadyError(self.info.address) from e
+        self.circuit.record_success()
         return [resp_from_pb(m) for m in out.rate_limits]
 
     def update_peer_globals(self, updates) -> None:
         """Push a batch of UpdatePeerGlobal messages (reference:
         peer_client.go:142-160)."""
+        if not self.circuit.allow():
+            # GLOBAL broadcasts to a dead peer fail fast too; the manager
+            # counts them as broadcast errors and the next cooldown's
+            # probe re-opens the path
+            raise CircuitOpenError(self.info.address)
         stub = self._connect()
         msg = peers_pb.UpdatePeerGlobalsReq(globals=updates)
         try:
             stub.UpdatePeerGlobals(msg, timeout=self.conf.global_timeout_s)
         except grpc.RpcError as e:
             self._record_err(str(e.code()))
+            self.circuit.record_failure()
+            raise
+        except (faults.FaultError, faults.FaultTimeout) as e:
+            self._record_err(f"fault: {e}")
+            self.circuit.record_failure()
             raise
         except ValueError as e:
             raise PeerNotReadyError(self.info.address) from e
+        self.circuit.record_success()
 
     def get_last_err(self) -> List[str]:
         """Recent errors for HealthCheck (reference: peer_client.go:198-213)."""

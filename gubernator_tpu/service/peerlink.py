@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from gubernator_tpu.service import faults
 from gubernator_tpu.types import (
     MAX_BATCH_SIZE,
     SLOW_PATH_BEHAVIOR_MASK as _COLUMNAR_SLOW_MASK,
@@ -258,8 +259,15 @@ class PeerLinkClient:
     """One persistent framed connection: writers interleave under a lock,
     a reader thread demuxes responses by rid into futures."""
 
-    def __init__(self, address: str, connect_timeout_s: float = 1.0):
+    def __init__(self, address: str, connect_timeout_s: float = 1.0,
+                 fault_key: str = ""):
         host, _, port = address.rpartition(":")
+        self.address = address
+        # the fault-injection identity of this link (faults.py): PeerClient
+        # passes the peer's ADVERTISED address so one GUBER_FAULT_SPEC peer
+        # key covers both transports; standalone clients default to the
+        # link address itself
+        self._fault_key = fault_key or address
         self._sock = socket.create_connection(
             (host or "127.0.0.1", int(port)), timeout=connect_timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -298,6 +306,17 @@ class PeerLinkClient:
         the response list (pipelined callers keep several in flight)."""
         if self._closed:
             raise PeerLinkError("link closed")
+        if faults.active() is not None:
+            # the fault-injection choke point for the peerlink transport,
+            # translated into this wire's failure taxonomy: 'error' is a
+            # pre-send link break (callers fall back to gRPC), 'timeout'/
+            # 'drop' surface as delivery-uncertain PeerLinkTimeout
+            try:
+                faults.on_call(self._fault_key, "peerlink")
+            except faults.FaultError as e:
+                raise PeerLinkError(str(e)) from e
+            except faults.FaultTimeout as e:
+                raise PeerLinkTimeout(str(e)) from e
         # encode BEFORE registering: an unencodable request must not leak
         # a future that nobody will ever complete
         with self._flock:

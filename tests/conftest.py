@@ -46,6 +46,12 @@ def pytest_configure(config):
         "markers",
         "slow: excluded from the tier-1 run (-m 'not slow') — heavyweight "
         "allocations or long soaks")
+    config.addinivalue_line(
+        "markers",
+        "chaos: deterministic fault-injection / peer-failure drills "
+        "(service/faults.py). Fast and pinned-seed by default, so they run "
+        "in tier-1; `make chaos` re-runs them with a randomized "
+        "GUBER_CHAOS_SEED (printed for reproduction)")
 
 
 def pytest_sessionfinish(session, exitstatus):

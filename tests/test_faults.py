@@ -1,0 +1,171 @@
+"""Unit tests for the deterministic fault-injection harness
+(service/faults.py): spec grammar, Nth-call determinism, per-(peer,
+transport) counter isolation, and the module-global arm/disarm hooks the
+transports consult."""
+
+import time
+
+import pytest
+
+from gubernator_tpu.service import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Every test leaves the process with no armed plan — a leaked plan
+    would inject faults into unrelated suites."""
+    yield
+    faults.clear()
+
+
+class TestSpecParsing:
+    def test_full_grammar(self):
+        rules = faults.parse_spec(
+            "peer=10.0.0.2:81;transport=grpc;calls=1-5;action=error"
+            "|peer=*;calls=3,7-;action=timeout"
+            "|transport=peerlink;calls=2;action=delay:0.25")
+        assert len(rules) == 3
+        assert rules[0].peer == "10.0.0.2:81"
+        assert rules[0].transport == "grpc"
+        assert rules[0].calls == [(1, 5)]
+        assert rules[1].calls == [(3, 3), (7, None)]
+        assert rules[2].action == "delay" and rules[2].delay_s == 0.25
+
+    def test_defaults_are_wildcards(self):
+        (rule,) = faults.parse_spec("action=drop")
+        assert rule.peer == "*" and rule.transport == "*"
+        assert rule.matches("anyone:81", "grpc", 1)
+        assert rule.matches("anyone:81", "peerlink", 10 ** 6)
+
+    @pytest.mark.parametrize("bad", [
+        "action=explode",            # unknown verb
+        "transport=carrier-pigeon",  # unknown transport
+        "frobnicate=1",              # unknown field
+        "calls=0",                   # calls are 1-based
+        "calls=5-2",                 # inverted range
+        "action=error:nope",         # argument on an argless verb
+        "peer",                      # not key=value
+    ])
+    def test_malformed_specs_fail_loudly(self, bad):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+
+    def test_empty_chunks_ignored(self):
+        assert faults.parse_spec("") == []
+        assert len(faults.parse_spec("|action=error||")) == 1
+
+
+class TestPlanDeterminism:
+    def test_same_plan_replays_identically(self):
+        spec = "peer=a:1;calls=2-3;action=error|peer=a:1;calls=5;action=drop"
+        logs = []
+        for _ in range(2):
+            plan = faults.FaultPlan(faults.parse_spec(spec))
+            outcomes = []
+            for _ in range(6):
+                try:
+                    plan.on_call("a:1", "grpc")
+                    outcomes.append("ok")
+                except faults.FaultError:
+                    outcomes.append("error")
+                except faults.FaultTimeout:
+                    outcomes.append("timeout")
+            logs.append((outcomes, list(plan.injected)))
+        assert logs[0] == logs[1]
+        assert logs[0][0] == ["ok", "error", "error", "ok", "timeout", "ok"]
+
+    def test_counters_isolated_per_peer_and_transport(self):
+        plan = faults.FaultPlan(faults.parse_spec("calls=2;action=error"))
+        # call 1 on every (peer, transport) passes; call 2 faults — each
+        # pair advances its own counter
+        for peer, transport in [("a:1", "grpc"), ("a:1", "peerlink"),
+                                ("b:2", "grpc")]:
+            plan.on_call(peer, transport)
+            with pytest.raises(faults.FaultError):
+                plan.on_call(peer, transport)
+        assert plan.call_count("a:1", "grpc") == 2
+        assert plan.call_count("b:2", "peerlink") == 0
+
+    def test_first_matching_rule_wins(self):
+        plan = faults.FaultPlan(faults.parse_spec(
+            "calls=1;action=error|calls=1;action=timeout"))
+        with pytest.raises(faults.FaultError):
+            plan.on_call("x:1", "grpc")
+
+    def test_delay_sleeps_then_proceeds(self):
+        plan = faults.FaultPlan(faults.parse_spec("calls=1;action=delay:0.05"))
+        t0 = time.monotonic()
+        plan.on_call("x:1", "grpc")  # no raise
+        assert time.monotonic() - t0 >= 0.04
+        assert plan.injected == []  # delays let the call proceed
+
+
+class TestGlobalHooks:
+    def test_on_call_is_noop_without_plan(self):
+        faults.clear()
+        faults.on_call("x:1", "grpc")  # must not raise
+
+    def test_install_accepts_spec_string_and_clear_disarms(self):
+        plan = faults.install("calls=1;action=error")
+        assert faults.active() is plan
+        with pytest.raises(faults.FaultError):
+            faults.on_call("x:1", "grpc")
+        faults.clear()
+        assert faults.active() is None
+        faults.on_call("x:1", "grpc")
+
+    def test_load_from_env(self, monkeypatch):
+        monkeypatch.delenv("GUBER_FAULT_SPEC", raising=False)
+        assert faults.load_from_env() is None
+        monkeypatch.setenv("GUBER_FAULT_SPEC", "calls=1;action=timeout")
+        plan = faults.load_from_env()
+        assert plan is not None and faults.active() is plan
+
+    def test_wrapped_stub_injects_and_passes_through(self):
+        class Stub:
+            def GetPeerRateLimits(self, msg, **kw):
+                return ("ok", msg)
+
+        wrapped = faults.wrap_stub(Stub(), "p:1")
+        assert wrapped.GetPeerRateLimits("m") == ("ok", "m")  # disarmed:
+        # not even counted — plan counters start at install time
+        faults.install("peer=p:1;transport=grpc;calls=2;action=error")
+        assert wrapped.GetPeerRateLimits("m") == ("ok", "m")  # armed call 1
+        with pytest.raises(faults.FaultError):
+            wrapped.GetPeerRateLimits("m")  # armed call 2 faults
+
+
+class TestEnvconfIntegration:
+    def test_bad_fault_spec_fails_boot(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv("GUBER_FAULT_SPEC", "action=explode")
+        with pytest.raises(ValueError):
+            config_from_env([])
+
+    def test_good_fault_spec_carried(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv("GUBER_FAULT_SPEC", "calls=1;action=error")
+        conf = config_from_env([])
+        assert conf.fault_spec == "calls=1;action=error"
+
+    def test_resilience_knobs_parse(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv("GUBER_CIRCUIT_THRESHOLD", "3")
+        monkeypatch.setenv("GUBER_CIRCUIT_OPEN", "250ms")
+        monkeypatch.setenv("GUBER_DEGRADED_LOCAL", "1")
+        monkeypatch.setenv("GUBER_LINK_RETRY_S", "2.5")
+        b = config_from_env([]).behaviors
+        assert b.circuit_threshold == 3
+        assert b.circuit_open_s == pytest.approx(0.25)
+        assert b.degraded_local is True
+        assert b.link_retry_s == pytest.approx(2.5)
+
+    def test_negative_threshold_rejected(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv("GUBER_CIRCUIT_THRESHOLD", "-1")
+        with pytest.raises(ValueError):
+            config_from_env([])
