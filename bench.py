@@ -237,6 +237,167 @@ def _product_combiner_bench(eng, threads: int = 12, scan: int = 8,
     }
 
 
+def _overload_bench(eng, budget_ms: float = 150.0, seconds: float = 3.0,
+                    batch: int = 64, offered_x: float = 2.0) -> dict:
+    """Overload discipline through a REAL single-node Instance (admission
+    controller + deadline budgets + combiner dequeue shed), owner-local
+    serving (BENCH_r08 acceptance row).
+
+    First a closed-loop capacity probe, then open-loop offered load at
+    ~`offered_x` that capacity in two modes: ADMISSION (every call carries
+    a `budget_ms` deadline, GUBER_MAX_PENDING sized by Little's law to the
+    budget — capacity x budget) vs the no-admission, no-budget BASELINE
+    (PR 4 behavior: work queues unboundedly). Records goodput (decisions
+    answered WITHIN budget per second), shed rate, and accepted-call
+    p50/p99 — the claim under test is that shedding the excess beats
+    queueing it: the admission run's accepted p99 stays near the service
+    time while the baseline's grows with the backlog."""
+    import threading as _t
+    from concurrent.futures import ThreadPoolExecutor
+
+    from gubernator_tpu.cluster.harness import test_behaviors
+    from gubernator_tpu.service import deadline as deadline_mod
+    from gubernator_tpu.service.config import InstanceConfig
+    from gubernator_tpu.service.deadline import (
+        AdmissionRejectedError,
+        DeadlineExceededError,
+    )
+    from gubernator_tpu.service.instance import Instance
+    from gubernator_tpu.types import PeerInfo, RateLimitReq
+
+    behaviors = test_behaviors()
+    behaviors.max_pending = 0
+    inst = Instance(InstanceConfig(behaviors=behaviors, backend=eng),
+                    advertise_address="bench-local")
+    inst.set_peers([PeerInfo(address="bench-local")])  # all owner-local
+
+    rng = np.random.RandomState(31)
+    pool_keys = ["k%d" % i
+                 for i in rng.choice(TABLE_CAPACITY, 4096, replace=False)]
+
+    def make_batch(i: int):
+        base = (i * 17) % (len(pool_keys) - batch)
+        return [RateLimitReq(name="b", unique_key=k, hits=1, limit=1 << 30,
+                             duration=3_600_000)
+                for k in pool_keys[base:base + batch]]
+
+    try:
+        # warm the instance path AND make the whole key pool resident:
+        # first-touch inserts are slower than steady-state hits, and a
+        # capacity probe over cold keys would under-measure — "2x
+        # capacity" would then not actually overload the warm open loop
+        for start in range(0, len(pool_keys), batch):
+            inst.get_rate_limits(
+                [RateLimitReq(name="b", unique_key=k, hits=1,
+                              limit=1 << 30, duration=3_600_000)
+                 for k in pool_keys[start:start + batch]])
+
+        # ---- closed-loop capacity probe --------------------------------
+        # concurrency matches the open loop's client pool order: the
+        # combiner merges concurrent calls into wider windows, so a
+        # low-thread probe would UNDER-measure capacity and 2x "offered"
+        # would not actually overload the node
+        n_probe_threads, probe_s = 24, 1.5
+        counts = [0] * n_probe_threads
+        stop_at = time.perf_counter() + probe_s
+
+        def probe_worker(ti: int) -> None:
+            i = ti
+            while time.perf_counter() < stop_at:
+                inst.get_rate_limits(make_batch(i))
+                counts[ti] += batch
+                i += n_probe_threads
+
+        ts = [_t.Thread(target=probe_worker, args=(ti,), daemon=True)
+              for ti in range(n_probe_threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        capacity = sum(counts) / probe_s  # decisions/s, closed loop
+
+        def open_loop(admission_on: bool) -> dict:
+            behaviors.max_pending = (
+                max(2 * batch, int(capacity * budget_ms / 1e3))
+                if admission_on else 0)
+            lock = _t.Lock()
+            lat_ms, sheds = [], [0]
+
+            def one(i: int) -> None:
+                dl = (deadline_mod.capture(budget_ms)
+                      if admission_on else None)
+                token = deadline_mod.use(dl) if dl is not None else None
+                t0 = time.perf_counter()
+                try:
+                    err = inst.get_rate_limits(make_batch(i))[0].error
+                except (AdmissionRejectedError, DeadlineExceededError):
+                    err = "SHED"
+                finally:
+                    if token is not None:
+                        deadline_mod.reset(token)
+                dt = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    if err:
+                        sheds[0] += 1
+                    else:
+                        lat_ms.append(dt)
+
+            # burst dispatch on a coarse tick: per-call sleep pacing
+            # cannot sustain the offered rate (sleep granularity alone
+            # would throttle the generator below capacity)
+            tick = 0.02
+            per_tick = max(1, int(round(
+                offered_x * capacity * tick / batch)))
+            n_ticks = max(4, int(seconds / tick))
+            n_offered = per_tick * n_ticks
+            pool = ThreadPoolExecutor(max_workers=256)
+            futs = []
+            idx = 0
+            t_start = time.perf_counter()
+            for ti in range(n_ticks):
+                delay = t_start + ti * tick - time.perf_counter()
+                if delay > 0:
+                    time.sleep(delay)
+                for _ in range(per_tick):
+                    futs.append(pool.submit(one, 100 + idx))
+                    idx += 1
+            for f in futs:
+                f.result()
+            wall = time.perf_counter() - t_start
+            pool.shutdown()
+            good = [d for d in lat_ms if d <= budget_ms]
+            pct = (lambda q: round(float(np.percentile(lat_ms, q)), 1)) \
+                if lat_ms else (lambda q: None)
+            return {
+                "offered_calls": n_offered,
+                "served_calls": len(lat_ms),
+                "shed_calls": sheds[0],
+                "shed_rate": round(sheds[0] / max(n_offered, 1), 3),
+                "goodput_decisions_per_sec": round(
+                    len(good) * batch / wall, 1),
+                "accepted_p50_ms": pct(50),
+                "accepted_p99_ms": pct(99),
+                "max_pending": behaviors.max_pending,
+            }
+
+        baseline = open_loop(admission_on=False)
+        admission = open_loop(admission_on=True)
+    finally:
+        inst.close()
+    return {
+        "overload": {
+            "scope": "Instance.get_rate_limits owner-local, open-loop "
+                     f"offered at {offered_x}x closed-loop capacity, "
+                     f"{batch}-wide calls, budget {budget_ms:.0f} ms",
+            "capacity_decisions_per_sec": round(capacity, 1),
+            "offered_x": offered_x,
+            "budget_ms": budget_ms,
+            "baseline_no_admission": baseline,
+            "admission": admission,
+        },
+    }
+
+
 FRAME_WIDTH = 1024  # peerlink MAX_FRAME_ITEMS: the wire's frame cap
 
 
@@ -799,6 +960,15 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — report, don't die
             columnar_row = {"columnar_pipeline": {"error": str(e)}}
 
+    # ---- overload: admission + deadline shedding vs the queueing baseline
+    # Offered load at ~2x measured capacity through a real Instance;
+    # BENCH_r08 records goodput, shed rate, and accepted p99 for the
+    # admission run vs the no-admission baseline (PR 5's acceptance row).
+    try:
+        overload_row = _overload_bench(eng)
+    except Exception as e:  # noqa: BLE001 — report, don't die
+        overload_row = {"overload": {"error": str(e)}}
+
     # trace-derived serving-stack phase split (never fails the bench)
     try:
         phases = phase_breakdown()
@@ -813,6 +983,7 @@ def main() -> None:
                 **serving_row,
                 **product_row,
                 **columnar_row,
+                **overload_row,
                 "phase_breakdown_ms": phases,
                 "unit": UNIT,
                 "vs_baseline": round(decisions_per_sec / REFERENCE_BASELINE_RPS, 2),

@@ -288,6 +288,16 @@ def main(argv=None) -> int:
             "degraded_local=%s",
             conf.behaviors.circuit_threshold, conf.behaviors.circuit_open_s,
             "on" if conf.behaviors.degraded_local else "off")
+    if conf.behaviors.max_pending > 0:
+        log.info(
+            "admission control: max_pending=%d (brownout at 75%%) "
+            "default_deadline_ms=%.0f min_hop_budget_ms=%.1f",
+            conf.behaviors.max_pending, conf.behaviors.default_deadline_ms,
+            conf.behaviors.min_hop_budget_ms)
+    else:
+        log.warning(
+            "admission control DISABLED (GUBER_MAX_PENDING=0): a "
+            "saturated node will stall in its queues instead of shedding")
     instance = Instance(
         InstanceConfig(
             behaviors=conf.behaviors,

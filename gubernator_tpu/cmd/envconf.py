@@ -246,6 +246,14 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
     b.circuit_open_s = _env_dur("GUBER_CIRCUIT_OPEN", b.circuit_open_s)
     b.degraded_local = _env_bool("GUBER_DEGRADED_LOCAL")
 
+    # overload safety: deadline budgets + admission control
+    # (service/deadline.py, instance.py AdmissionController)
+    b.default_deadline_ms = _env_float("GUBER_DEFAULT_DEADLINE_MS",
+                                       b.default_deadline_ms)
+    b.min_hop_budget_ms = _env_float("GUBER_MIN_HOP_BUDGET_MS",
+                                     b.min_hop_budget_ms)
+    b.max_pending = _env_int("GUBER_MAX_PENDING", b.max_pending)
+
     conf = DaemonConfig(
         grpc_address=_env_str("GUBER_GRPC_ADDRESS", "0.0.0.0:81"),
         grpc_native=_env_str("GUBER_GRPC_NATIVE", "1") != "0",
@@ -333,6 +341,18 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         raise ValueError(
             f"'GUBER_LINK_RETRY_S={b.link_retry_s}' is invalid; "
             "must be positive seconds")
+    if b.default_deadline_ms < 0:
+        raise ValueError(
+            f"'GUBER_DEFAULT_DEADLINE_MS={b.default_deadline_ms}' is "
+            "invalid; must be >= 0 ms (0 = no default budget)")
+    if b.min_hop_budget_ms <= 0:
+        raise ValueError(
+            f"'GUBER_MIN_HOP_BUDGET_MS={b.min_hop_budget_ms}' is invalid; "
+            "must be positive milliseconds")
+    if b.max_pending < 0:
+        raise ValueError(
+            f"'GUBER_MAX_PENDING={b.max_pending}' is invalid; "
+            "must be >= 0 (0 disables admission control)")
     if conf.fault_spec:
         # a typo'd chaos plan must fail the boot loudly, not inject nothing
         from gubernator_tpu.service.faults import parse_spec

@@ -53,6 +53,7 @@ from concurrent.futures import Future
 from typing import List, Optional, Sequence
 
 from gubernator_tpu.obs import trace
+from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.types import RateLimitReq, RateLimitResp
 
 log = logging.getLogger("gubernator_tpu.combiner")
@@ -101,9 +102,17 @@ class BackendCombiner:
         self._metrics = metrics
         self._tracer = tracer
         self._cond = threading.Condition()
-        # pending entry: (reqs, now_ms, future, enqueue time_ns, span|None)
+        # pending entry: (reqs, now_ms, future, enqueue time_ns, span|None,
+        # deadline|None)
         self._pending: List[tuple] = []
         self._closed = False
+        # submitted-but-unresolved request count: the combiner's share of
+        # the admission controller's pending-work reading. Incremented at
+        # submit, decremented by each future's done callback — so it spans
+        # queue wait AND in-flight device time, whatever path resolved it.
+        self._backlog = 0
+        self._backlog_lock = threading.Lock()
+        self._deadline_shed = 0
         # Counter state lives in the daemon's Prometheus registry when one
         # is attached (combiner_* families); these ints are the always-on
         # dict view the in-process harnesses and tests read.
@@ -160,6 +169,12 @@ class BackendCombiner:
         return self._depth
 
     @property
+    def backlog(self) -> int:
+        """Requests submitted and not yet resolved (queued + in flight) —
+        the admission controller's combiner term."""
+        return self._backlog
+
+    @property
     def stats(self) -> dict:
         """Dict view of the combiner counters (windows actually merged >1
         submission under "merged_windows"); pipeline state rides along —
@@ -173,6 +188,8 @@ class BackendCombiner:
             "fill_stalls": self._fill_stalls,
             "pipeline_depth": self._depth,
             "pipeline_inflight": self._inflight_n,
+            "backlog": self._backlog,
+            "deadline_shed": self._deadline_shed,
         }
 
     def autotune(self, depths=(1, 3, 6), probe_windows: int = 12) -> int:
@@ -244,11 +261,16 @@ class BackendCombiner:
             fut.set_result([])
             return fut
         span = trace.current()  # None on every untraced request
+        dl = deadline_mod.current()  # None on every unbudgeted request
+        n = len(reqs)
         with self._cond:
             if self._closed:
                 raise RuntimeError("combiner is closed")
+            with self._backlog_lock:
+                self._backlog += n
+            fut.add_done_callback(lambda _f: self._shrink_backlog(n))
             self._pending.append(
-                (list(reqs), now_ms, fut, time.time_ns(), span))
+                (list(reqs), now_ms, fut, time.time_ns(), span, dl))
             self._submissions += 1
             self._cond.notify()
         m = self._metrics
@@ -314,7 +336,38 @@ class BackendCombiner:
             if self._drainer is not None:
                 self._inflight.put(None)  # drain sentinel: finish in-flight
 
+    def _shrink_backlog(self, n: int) -> None:
+        with self._backlog_lock:
+            self._backlog -= n
+
+    def _shed_expired(self, batch: List[tuple]) -> List[tuple]:
+        """Dequeue-time deadline enforcement: a submission whose budget
+        died waiting in the queue is answered DEADLINE_EXCEEDED here,
+        before it can occupy a device window — under overload the queue
+        wait IS where budgets die, and dispatching dead work would push
+        every live request behind it past its own deadline too."""
+        live = batch
+        for entry in batch:
+            dl = entry[5]
+            if dl is None or not dl.expired():
+                continue
+            if live is batch:  # copy lazily: expiry is the rare path
+                live = [e for e in batch if e is not entry]
+            else:
+                live.remove(entry)
+            fut = entry[2]
+            if not fut.done():
+                fut.set_exception(deadline_mod.DeadlineExceededError(
+                    f"request budget ({dl.budget_ms:.0f} ms) expired in "
+                    f"the combiner queue"))
+            self._deadline_shed += 1
+            if self._metrics is not None:
+                self._metrics.deadline_expired.labels(
+                    stage=deadline_mod.STAGE_QUEUE).inc()
+        return live
+
     def _execute(self, batch: List[tuple]) -> None:
+        batch = self._shed_expired(batch)
         # group by explicit timestamp: tests pin now_ms; production passes
         # None, which resolves at launch — exactly the reference's behavior
         # of stamping at processing, not arrival
@@ -339,7 +392,7 @@ class BackendCombiner:
         t_launch = time.time_ns()
         flat: List[RateLimitReq] = []
         spans = []
-        for reqs, _, fut, t_enq, req_span in entries:
+        for reqs, _, fut, t_enq, req_span, _dl in entries:
             spans.append((len(flat), len(reqs), fut))
             flat.extend(reqs)
             if m is not None:
@@ -423,7 +476,7 @@ class BackendCombiner:
             self._windows += 1
             if merged:
                 self._merged_windows += 1
-            for reqs, _, fut, t_enq, req_span in entries:
+            for reqs, _, fut, t_enq, req_span, _dl in entries:
                 if len(entries) == 1:
                     flat = list(reqs) if not isinstance(reqs, list) else reqs
                 else:
@@ -500,7 +553,7 @@ class BackendCombiner:
                     group, t_launch, t_launched, t_collect, t_done)
                 for entries, resps in zip(group, results):
                     pos = 0
-                    for reqs, _, fut, _t, _s in entries:
+                    for reqs, _, fut, _t, _s, _d in entries:
                         fut.set_result(resps[pos:pos + len(reqs)])
                         pos += len(reqs)
             except BaseException as e:  # noqa: BLE001 — never die silently

@@ -56,6 +56,25 @@ class BehaviorConfig:
     # policy choice the operator must opt into.
     degraded_local: bool = False
 
+    # overload safety: deadline budgets + admission control
+    # (service/deadline.py, instance.py AdmissionController;
+    # docs/OPERATIONS.md "Overload & deadlines").
+    # GUBER_DEFAULT_DEADLINE_MS: budget assigned to ingress requests that
+    # carry none of their own (gRPC context deadline / X-Request-Deadline-Ms
+    # header win when present). 0 = requests without an explicit deadline
+    # have no budget — every deadline site is then a None check.
+    default_deadline_ms: float = 0.0
+    # GUBER_MIN_HOP_BUDGET_MS: floor on the budget a forwarded hop is
+    # granted — below it the caller sheds instead of burning a wire round
+    # trip on a timeout that cannot succeed.
+    min_hop_budget_ms: float = 5.0
+    # GUBER_MAX_PENDING: pending-work cap (combiner backlog + in-flight
+    # forwards + GLOBAL pipeline depth). Non-owner forwards and GLOBAL
+    # broadcasts shed at 75% of it (brownout), everything at 100%
+    # (RESOURCE_EXHAUSTED). 0 disables admission control entirely —
+    # behavior is then bit-identical to the pre-admission code.
+    max_pending: int = 8192
+
 
 @dataclasses.dataclass
 class InstanceConfig:
@@ -93,3 +112,11 @@ class InstanceConfig:
             raise ValueError("behaviors.circuit_open_s must be positive")
         if self.behaviors.link_retry_s <= 0:
             raise ValueError("behaviors.link_retry_s must be positive")
+        if self.behaviors.default_deadline_ms < 0:
+            raise ValueError(
+                "behaviors.default_deadline_ms cannot be negative")
+        if self.behaviors.min_hop_budget_ms <= 0:
+            raise ValueError("behaviors.min_hop_budget_ms must be positive")
+        if self.behaviors.max_pending < 0:
+            raise ValueError("behaviors.max_pending cannot be negative "
+                             "(0 disables admission control)")

@@ -134,10 +134,14 @@ class _Pipeline:
 class GlobalManager:
     """Owns both GLOBAL pipelines for one Instance."""
 
-    def __init__(self, instance, behaviors: BehaviorConfig, metrics=None):
+    def __init__(self, instance, behaviors: BehaviorConfig, metrics=None,
+                 admission=None):
         self.instance = instance
         self.conf = behaviors
         self.metrics = metrics
+        # admission controller (instance.py): under pressure, GLOBAL
+        # broadcasts are the FIRST work class to shed — see queue_update
+        self.admission = admission
         self._hits = _Pipeline(
             "hits", behaviors.global_sync_wait_s, behaviors.global_batch_limit,
             self._send_hits,
@@ -157,7 +161,16 @@ class GlobalManager:
 
     def queue_update(self, req: RateLimitReq) -> None:
         """Owner: broadcast this key's state on the next window
-        (reference: global.go:67-69)."""
+        (reference: global.go:67-69).
+
+        Under admission brownout the broadcast is DROPPED instead of
+        queued: each broadcast window re-reads authoritative state, so a
+        dropped update is regenerated by the key's next applied GLOBAL
+        hit — making it the cheapest backlog on the node to not grow
+        while the serving path is the thing that needs the capacity."""
+        if self.admission is not None and self.admission.enabled \
+                and self.admission.shed_broadcast():
+            return
         self._broadcasts.queue(req, aggregate_hits=False)
 
     def depths(self) -> tuple:

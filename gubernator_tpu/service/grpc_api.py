@@ -16,6 +16,7 @@ from collections import OrderedDict
 
 import grpc
 
+from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.pb import gubernator_pb2 as pb
 from gubernator_tpu.service.pb import peers_pb2 as peers_pb
 
@@ -27,18 +28,67 @@ def _serialize(msg):
     return msg.SerializeToString()
 
 
+def _overload_guarded(method, instance=None):
+    """The overload discipline, applied structurally at handler
+    registration so EVERY bound method gets it (service/deadline.py):
+
+    - pre-dispatch, a request whose client already disconnected or whose
+      gRPC deadline died in the accept queue aborts DEADLINE_EXCEEDED
+      before the servicer spends a microsecond on it — under saturation
+      the accept queue is exactly where deadlines die;
+    - shed outcomes raised anywhere below (combiner dequeue, admission
+      gate, forward path) map to their canonical status codes
+      (DEADLINE_EXCEEDED / RESOURCE_EXHAUSTED) instead of UNKNOWN, as a
+      backstop for servicer methods that don't map them themselves."""
+
+    def call(request, context):
+        try:
+            active = context.is_active()
+        except Exception:  # noqa: BLE001 — raw-punt contexts
+            active = True
+        if not active:
+            _count(instance, deadline_mod.STAGE_INGRESS)
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                          "client disconnected before dispatch")
+        try:
+            remaining = context.time_remaining()
+        except Exception:  # noqa: BLE001 — raw-punt contexts have no clock
+            remaining = None
+        if remaining is not None and remaining <= 0:
+            _count(instance, deadline_mod.STAGE_INGRESS)
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                          "request deadline expired before dispatch")
+        try:
+            return method(request, context)
+        except deadline_mod.AdmissionRejectedError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        except deadline_mod.DeadlineExceededError as e:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+
+    return call
+
+
+def _count(instance, stage: str) -> None:
+    counter = getattr(instance, "_count_expired", None)
+    if counter is not None:
+        counter(stage)
+
+
 def v1_handler(servicer) -> grpc.GenericRpcHandler:
     """Bind a servicer with GetRateLimits/HealthCheck methods
     (signature: fn(request_pb, context) -> response_pb)."""
+    inst = getattr(servicer, "instance", None)
     return grpc.method_handlers_generic_handler(
         V1_SERVICE,
         {
             "GetRateLimits": grpc.unary_unary_rpc_method_handler(
-                servicer.GetRateLimits,
+                _overload_guarded(servicer.GetRateLimits, inst),
                 request_deserializer=pb.GetRateLimitsReq.FromString,
                 response_serializer=_serialize,
             ),
             "HealthCheck": grpc.unary_unary_rpc_method_handler(
+                # NOT guarded: a saturated node must still answer its
+                # health probes — that's how operators see the shed state
                 servicer.HealthCheck,
                 request_deserializer=pb.HealthCheckReq.FromString,
                 response_serializer=_serialize,
@@ -49,16 +99,17 @@ def v1_handler(servicer) -> grpc.GenericRpcHandler:
 
 def peers_handler(servicer) -> grpc.GenericRpcHandler:
     """Bind a servicer with GetPeerRateLimits/UpdatePeerGlobals methods."""
+    inst = getattr(servicer, "instance", None)
     return grpc.method_handlers_generic_handler(
         PEERS_SERVICE,
         {
             "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
-                servicer.GetPeerRateLimits,
+                _overload_guarded(servicer.GetPeerRateLimits, inst),
                 request_deserializer=peers_pb.GetPeerRateLimitsReq.FromString,
                 response_serializer=_serialize,
             ),
             "UpdatePeerGlobals": grpc.unary_unary_rpc_method_handler(
-                servicer.UpdatePeerGlobals,
+                _overload_guarded(servicer.UpdatePeerGlobals, inst),
                 request_deserializer=peers_pb.UpdatePeerGlobalsReq.FromString,
                 response_serializer=_serialize,
             ),

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
@@ -28,6 +29,7 @@ from google.protobuf import json_format
 
 from gubernator_tpu.obs import trace
 from gubernator_tpu.obs.introspect import debug_vars
+from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.convert import (
     health_to_pb,
     req_from_pb,
@@ -72,14 +74,22 @@ class HttpGateway:
             def _reply_json(self, code: int, msg) -> None:
                 self._reply(code, json_format.MessageToJson(msg).encode())
 
-            def _reply_error(self, code: int, message: str) -> None:
+            def _reply_error(self, code: int, message: str,
+                             retry_after_s: Optional[float] = None) -> None:
                 # grpc-gateway error shape: {"error": ..., "code": ...};
                 # messages may contain quotes (json_format.ParseError
                 # embeds the offending token), so build real JSON
-                self._reply(
-                    code,
-                    json.dumps({"error": message, "code": code}).encode(),
-                )
+                body = json.dumps({"error": message, "code": code}).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                if retry_after_s is not None:
+                    # RFC 9110 delay-seconds (integer, rounded up): a
+                    # shed client should wait at least this long
+                    self.send_header(
+                        "Retry-After", str(max(1, int(retry_after_s + 0.5))))
+                self.end_headers()
+                self.wfile.write(body)
 
             def do_GET(self):
                 if self.path == "/v1/HealthCheck":
@@ -118,6 +128,24 @@ class HttpGateway:
                     return
                 self._reply(200, json.dumps(body, default=str).encode())
 
+            def _ingress_deadline(self):
+                """The request's deadline budget: the client's
+                X-Request-Deadline-Ms header when present and sane, else
+                GUBER_DEFAULT_DEADLINE_MS (0 = no budget). Garbage in the
+                header serves without a budget, never a 400 — exactly the
+                gRPC metadata rule."""
+                raw = self.headers.get(deadline_mod.HTTP_HEADER)
+                if raw is not None:
+                    try:
+                        budget = float(raw)
+                    except (TypeError, ValueError):
+                        budget = 0.0
+                    if budget > 0 and math.isfinite(budget):
+                        return deadline_mod.capture(budget)
+                return deadline_mod.capture(getattr(
+                    gateway.instance.conf.behaviors,
+                    "default_deadline_ms", 0.0))
+
             def do_POST(self):
                 if self.path != "/v1/GetRateLimits":
                     self._reply_error(404, "not found")
@@ -134,14 +162,37 @@ class HttpGateway:
                     "ingress", self.headers.get("traceparent")) \
                     if tracer.active else None
                 token = trace.use(span) if span is not None else None
+                # deadline budget: X-Request-Deadline-Ms header, else the
+                # env default (0 = no budget); shed outcomes map to the
+                # HTTP statuses a well-behaved client backs off on
+                dl = self._ingress_deadline()
+                dtoken = None
+                if dl is not None:
+                    gateway.instance.observe_budget("public", dl.budget_ms)
+                    if dl.expired():
+                        gateway.instance._count_expired(  # noqa: SLF001
+                            deadline_mod.STAGE_INGRESS)
+                        self._reply_error(
+                            504, "request deadline expired before dispatch")
+                        return
+                    dtoken = deadline_mod.use(dl)
                 try:
                     resps = gateway.instance.get_rate_limits(
                         [req_from_pb(m) for m in msg.requests]
                     )
+                except deadline_mod.AdmissionRejectedError as e:
+                    self._reply_error(429, str(e),
+                                      retry_after_s=e.retry_after_s)
+                    return
+                except deadline_mod.DeadlineExceededError as e:
+                    self._reply_error(504, str(e))
+                    return
                 except ApiError as e:
                     self._reply_error(400, e.message)
                     return
                 finally:
+                    if dtoken is not None:
+                        deadline_mod.reset(dtoken)
                     if span is not None:
                         span.set("requests", len(msg.requests))
                         span.set("transport", "http")

@@ -31,7 +31,12 @@ from gubernator_tpu.cluster.pickers import (
 )
 from gubernator_tpu.obs import trace
 from gubernator_tpu.obs.trace import Tracer
+from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.combiner import BackendCombiner
+from gubernator_tpu.service.deadline import (
+    AdmissionRejectedError,
+    DeadlineExceededError,
+)
 from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
 from gubernator_tpu.service.global_manager import GlobalManager
 from gubernator_tpu.service.multiregion import MultiRegionManager
@@ -82,6 +87,116 @@ class _GlobalStatus:
         self.reset_time = reset_time
 
 
+class AdmissionController:
+    """Load-shedding gate for one Instance (docs/OPERATIONS.md "Overload &
+    deadlines"): weighs the node's pending work — combiner backlog +
+    in-flight forwards + GLOBAL pipeline depth, the queues that grow
+    without bound when offered load exceeds capacity — against
+    GUBER_MAX_PENDING, and rejects new work FAST instead of letting it
+    stall in queues whose wait already exceeds any useful deadline.
+
+    Two pressure levels give the brownout order (cheapest work first):
+
+    - BROWNOUT (>= 75% of max_pending): non-owner forwards and GLOBAL
+      async broadcasts shed — the client can retry a forward against a
+      healthier moment, and a dropped broadcast regenerates on the next
+      applied GLOBAL hit; owner-authoritative decisions keep serving.
+    - SATURATED (>= max_pending): everything sheds
+      (`RESOURCE_EXHAUSTED` / HTTP 429 + Retry-After) — admitting more
+      work can only push the whole queue past its deadlines.
+
+    `max_pending <= 0` disables the controller entirely: every check is
+    one attribute read, and serving is bit-identical to the pre-admission
+    code. Thresholds read live from the BehaviorConfig, so tests and
+    future hot-reload can tune a running node."""
+
+    ADMIT, BROWNOUT, SATURATED = 0, 1, 2
+    BROWNOUT_FRACTION = 0.75
+    RETRY_AFTER_S = 1.0
+
+    def __init__(self, instance: "Instance", metrics=None):
+        self.instance = instance
+        self.metrics = metrics
+        self.stats = {"shed_forward": 0, "shed_broadcast": 0,
+                      "shed_ingress": 0, "shed_peer": 0}
+
+    @property
+    def max_pending(self) -> int:
+        return getattr(self.instance.conf.behaviors, "max_pending", 0)
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_pending > 0
+
+    def pending(self) -> int:
+        """The pending-work reading, from live counters the metric
+        families already export (combiner backlog, forward pool,
+        global_queue_depth)."""
+        inst = self.instance
+        n = inst.combiner.backlog + inst._forward_inflight  # noqa: SLF001
+        gm = getattr(inst, "global_manager", None)
+        if gm is not None:
+            hits, bcast = gm.depths()
+            n += hits + bcast
+        return n
+
+    def level(self) -> int:
+        """Current pressure level; ADMIT when disabled."""
+        cap = self.max_pending
+        if cap <= 0:
+            return self.ADMIT
+        pending = self.pending()
+        if pending >= cap:
+            return self.SATURATED
+        if pending >= cap * self.BROWNOUT_FRACTION:
+            return self.BROWNOUT
+        return self.ADMIT
+
+    def check_ingress(self, priority: str = "ingress") -> int:
+        """The whole-call gate: raises RESOURCE_EXHAUSTED at SATURATED,
+        else returns the level so the caller can apply per-class
+        brownout shedding."""
+        lvl = self.level()
+        if lvl >= self.SATURATED:
+            self.shed("saturated", priority)
+            raise AdmissionRejectedError(
+                f"RESOURCE_EXHAUSTED: node saturated "
+                f"({self.pending()} pending >= max_pending "
+                f"{self.max_pending}); shedding new work",
+                retry_after_s=self.RETRY_AFTER_S)
+        return lvl
+
+    def shed_broadcast(self) -> bool:
+        """GLOBAL broadcast gate (GlobalManager.queue_update): True =
+        drop this broadcast — it is regenerated by the next applied
+        GLOBAL hit once pressure clears, so it is the cheapest work on
+        the node to not do."""
+        if self.level() >= self.BROWNOUT:
+            self.shed("brownout", "broadcast")
+            return True
+        return False
+
+    def shed(self, reason: str, priority: str, n: int = 1) -> None:
+        self.stats[f"shed_{priority}"] = \
+            self.stats.get(f"shed_{priority}", 0) + n
+        if self.metrics is not None:
+            try:
+                self.metrics.admission_shed.labels(
+                    reason=reason, priority=priority).inc(n)
+            except Exception:  # noqa: BLE001 — metrics must not break
+                pass
+
+    def shed_response(self, owner_addr: str) -> RateLimitResp:
+        """The per-request brownout answer for a shed forward: an error
+        the client can recognize and retry (HTTP clients see the same
+        text; whole-call saturation instead maps to the RPC status)."""
+        return RateLimitResp(
+            error=f"RESOURCE_EXHAUSTED: admission shed "
+                  f"(pending {self.pending()} of max_pending "
+                  f"{self.max_pending}); retry later",
+            metadata={"owner": owner_addr, "shed": "admission"})
+
+
 class Instance:
     """One serving process (reference: gubernator.go:41-48)."""
 
@@ -121,8 +236,20 @@ class Instance:
             self.local_picker.new())
         self._peer_lock = threading.RLock()
 
+        # overload safety (service/deadline.py): in-flight forward count
+        # feeds the admission controller's pending-work reading; the
+        # controller itself gates ingress/forward/broadcast work against
+        # GUBER_MAX_PENDING (0 disables — checks become one int read)
+        self._forward_inflight = 0
+        self._forward_lock = threading.Lock()
+        self.admission = AdmissionController(self, metrics=conf.metrics)
+        # last deadline budget observed per surface (debug/test witness;
+        # the request_budget_ms histogram is the production view)
+        self.last_budget_ms: Dict[str, float] = {}
+
         self.global_manager = GlobalManager(
-            self, conf.behaviors, metrics=conf.metrics
+            self, conf.behaviors, metrics=conf.metrics,
+            admission=self.admission,
         )
         self.multiregion_manager = MultiRegionManager(self, conf.behaviors)
         # non-owner cache of GLOBAL statuses (reference: gubernator.go:251-264)
@@ -210,13 +337,26 @@ class Instance:
                 "OUT_OF_RANGE",
                 f"Requests.RateLimits list too large; max size is '{MAX_BATCH_SIZE}'",
             )
+        # one ContextVar read each per call — the entire routing-path cost
+        # of tracing/deadlines when off; both are handed explicitly to the
+        # forward pool (contexts do not cross its threads)
+        span = trace.current()
+        dl = deadline_mod.current()
+        if dl is not None and dl.expired():
+            # late work is the cheapest work to drop: the client stopped
+            # waiting, so dispatching would only delay live requests
+            self._count_expired(deadline_mod.STAGE_INGRESS)
+            raise DeadlineExceededError(
+                f"request budget ({dl.budget_ms:.0f} ms) exhausted before "
+                "dispatch")
+        # SATURATED rejects the whole call in microseconds; BROWNOUT lets
+        # owner-local work through and sheds the non-owner forwards below
+        admission = self.admission
+        brownout = (admission.enabled
+                    and admission.check_ingress() >= admission.BROWNOUT)
         responses: List[Optional[RateLimitResp]] = [None] * len(requests)
         local: List[int] = []
         remote: Dict[str, tuple] = {}  # owner addr -> (peer, [batch indices])
-        # one ContextVar read per call — the entire routing-path cost of
-        # tracing when off; the active span (if any) is handed explicitly
-        # to the forward pool (contexts do not cross its threads)
-        span = trace.current()
 
         for i, req in enumerate(requests):
             if not req.unique_key:
@@ -245,6 +385,12 @@ class Instance:
                 local.append(i)
             elif has_behavior(req.behavior, Behavior.GLOBAL):
                 responses[i] = self._get_global_rate_limit(req, peer)
+            elif brownout:
+                # brownout order: non-owner forwards shed FIRST — the
+                # client can retry them against any moment or node, while
+                # owner-local decisions have nowhere else to go
+                admission.shed("brownout", "forward")
+                responses[i] = admission.shed_response(peer.info.address)
             else:
                 remote.setdefault(peer.info.address, (peer, []))[1].append(i)
 
@@ -252,12 +398,14 @@ class Instance:
         for peer, idxs in remote.values():
             if len(idxs) == 1:
                 req = requests[idxs[0]]
-                futures.append((idxs, self._forward_pool.submit(
-                    self._forward_as_list, req, req.hash_key(), span)))
+                fut = self._forward_pool.submit(
+                    self._forward_as_list, req, req.hash_key(), span, dl)
             else:
-                futures.append((idxs, self._forward_pool.submit(
+                fut = self._forward_pool.submit(
                     self._forward_group, peer,
-                    [requests[i] for i in idxs], span)))
+                    [requests[i] for i in idxs], span, dl)
+            self._track_forward(fut, len(idxs))
+            futures.append((idxs, fut))
 
         if local:
             batch = [requests[i] for i in local]
@@ -280,6 +428,17 @@ class Instance:
                 f"'PeerRequest.rate_limits' list too large; max size is "
                 f"'{MAX_BATCH_SIZE}'",
             )
+        dl = deadline_mod.current()
+        if dl is not None and dl.expired():
+            self._count_expired(deadline_mod.STAGE_INGRESS)
+            raise DeadlineExceededError(
+                f"hop budget ({dl.budget_ms:.0f} ms) exhausted before "
+                "owner apply")
+        if self.admission.enabled:
+            # forwarded owner batches are owner work (shed LAST, only at
+            # saturation); the forwarding node gets a fast
+            # RESOURCE_EXHAUSTED it can surface without a timeout stall
+            self.admission.check_ingress(priority="peer")
         return self.apply_owner_batch(list(requests), from_peer_rpc=True)
 
     def update_peer_globals(self, updates) -> None:
@@ -322,6 +481,18 @@ class Instance:
         state, and up to HEALTH_SAMPLES_PER_PEER deduped samples; the whole
         message is capped at HEALTH_MESSAGE_CHARS."""
         parts: List[str] = []
+        adm = self.admission
+        if adm.enabled:
+            lvl = adm.level()
+            if lvl > adm.ADMIT:
+                state = "saturated" if lvl >= adm.SATURATED else "brownout"
+                sheds = ", ".join(
+                    f"{k[5:]}={v}" for k, v in sorted(adm.stats.items())
+                    if v)
+                parts.append(
+                    f"admission {state}: pending {adm.pending()} of "
+                    f"max_pending {adm.max_pending}"
+                    + (f" (shed {sheds})" if sheds else ""))
         if self.collective_global is not None:
             err = self.collective_global.health_error()
             if err:
@@ -437,6 +608,38 @@ class Instance:
         with self._peer_lock:
             return self.local_picker.get(key)
 
+    def _track_forward(self, fut, n: int) -> None:
+        """Count `n` requests as in-flight forwards until `fut` resolves
+        — the forward-pool term of the admission pending reading."""
+        with self._forward_lock:
+            self._forward_inflight += n
+
+        def _untrack(_f, n=n):
+            with self._forward_lock:
+                self._forward_inflight -= n
+
+        fut.add_done_callback(_untrack)
+
+    def _count_expired(self, stage: str) -> None:
+        if self.conf.metrics is not None:
+            try:
+                self.conf.metrics.deadline_expired.labels(stage=stage).inc()
+            except Exception:  # noqa: BLE001 — metrics must not break
+                pass
+
+    def observe_budget(self, surface: str, budget_ms: float) -> None:
+        """Record a captured deadline budget (public ingress or the
+        decremented hop budget a peer surface received) — the
+        request_budget_ms histogram plus a last-value witness the wire
+        round-trip tests read."""
+        self.last_budget_ms[surface] = budget_ms
+        if self.conf.metrics is not None:
+            try:
+                self.conf.metrics.request_budget_ms.labels(
+                    surface=surface).observe(budget_ms)
+            except Exception:  # noqa: BLE001 — metrics must not break
+                pass
+
     def local_peers(self) -> List[PeerClient]:
         with self._peer_lock:
             return self.local_picker.peers()
@@ -468,6 +671,11 @@ class Instance:
         already aggregated a batch (the peerlink workers): the engine's own
         lock serializes concurrent windows, and skipping the combiner saves
         two thread handoffs on the lone-request latency path."""
+        if self.admission.enabled:
+            # the peerlink hop's admission gate (the gRPC hop checks in
+            # get_peer_rate_limits): shed at saturation only — owner work
+            # goes last in the brownout order
+            self.admission.check_ingress(priority="peer")
         return self.backend.get_rate_limits(
             self._strip_owner_batch(requests, from_peer_rpc), now_ms=now_ms)
 
@@ -504,18 +712,28 @@ class Instance:
 
     # ------------------------------------------------------------ internals
 
-    def _forward(self, req: RateLimitReq, key: str,
-                 span=None) -> RateLimitResp:
+    def _forward(self, req: RateLimitReq, key: str, span=None,
+                 dl=None) -> RateLimitResp:
         """Relay to the owning peer, re-picking up to 5 times while peers
         shut down (reference: gubernator.go:149-157,186-205).
 
         Re-picks back off with jitter and respect a deadline bounded by
-        the client's own batch timeout: a picker that keeps returning the
-        same closing peer must not spin the loop hot, and the loop must
-        never outlive the RPC deadline the caller is already paying."""
+        the client's own batch timeout AND the request's remaining budget
+        (`dl`, service/deadline.py): a picker that keeps returning the
+        same closing peer must not spin the loop hot, the loop must never
+        outlive the RPC deadline the caller is already paying, and no
+        retry — circuit probe included — may start past a dead budget."""
         last_err = ""
         deadline = time.monotonic() + self.conf.behaviors.batch_timeout_s
+        if dl is not None:
+            deadline = min(deadline, dl.expires_at)
         for attempt in range(6):
+            if dl is not None and dl.expired():
+                self._count_expired(deadline_mod.STAGE_FORWARD)
+                return RateLimitResp(
+                    error=f"DEADLINE_EXCEEDED: budget "
+                          f"({dl.budget_ms:.0f} ms) expired while "
+                          f"forwarding '{key}' - '{last_err}'")
             try:
                 peer = self.get_peer(key)
             except Exception as e:  # noqa: BLE001
@@ -524,14 +742,20 @@ class Instance:
                 )
             if peer.info.is_owner:  # membership changed under us
                 token = trace.use(span) if span is not None else None
+                dtoken = deadline_mod.use(dl) if dl is not None else None
                 try:
                     return self.apply_owner_batch([req])[0]
+                except DeadlineExceededError as e:
+                    return RateLimitResp(error=f"DEADLINE_EXCEEDED: {e}")
                 finally:
+                    if dtoken is not None:
+                        deadline_mod.reset(dtoken)
                     if token is not None:
                         trace.reset(token)
             t0 = time.time_ns() if span is not None else 0
             try:
-                resp = peer.get_peer_rate_limit(req, trace_span=span)
+                resp = peer.get_peer_rate_limit(req, trace_span=span,
+                                                deadline=dl)
                 resp.metadata["owner"] = peer.info.address
                 if span is not None:
                     self.tracer.record_span(
@@ -542,7 +766,11 @@ class Instance:
                 # the owner's circuit is open: nothing was sent, so serve
                 # degraded-local (when enabled) or fail fast — either way
                 # in microseconds, never a batch_timeout_s stall
-                return self._degrade_or_error([req], peer)[0]
+                return self._degrade_or_error([req], peer, dl=dl)[0]
+            except DeadlineExceededError as e:
+                # the budget died in flight: no re-pick can help, and the
+                # caller has already stopped listening — surface it
+                return RateLimitResp(error=f"DEADLINE_EXCEEDED: {e}")
             except PeerNotReadyError as e:
                 last_err = str(e)
                 now = time.monotonic()
@@ -562,12 +790,12 @@ class Instance:
             f"'{key}' - '{last_err}'"
         )
 
-    def _forward_as_list(self, req: RateLimitReq, key: str,
-                         span=None) -> List[RateLimitResp]:
-        return [self._forward(req, key, span)]
+    def _forward_as_list(self, req: RateLimitReq, key: str, span=None,
+                         dl=None) -> List[RateLimitResp]:
+        return [self._forward(req, key, span, dl)]
 
     def _forward_group(
-        self, peer: PeerClient, reqs: List[RateLimitReq], span=None
+        self, peer: PeerClient, reqs: List[RateLimitReq], span=None, dl=None
     ) -> List[RateLimitResp]:
         """Forward several same-owner requests as ONE ordered batch.
 
@@ -588,13 +816,17 @@ class Instance:
         surface as error responses, exactly like the per-request path."""
         t0 = time.time_ns() if span is not None else 0
         try:
-            resps = peer.get_peer_rate_limits(reqs, trace_span=span)
+            resps = peer.get_peer_rate_limits(reqs, trace_span=span,
+                                              deadline=dl)
         except CircuitOpenError:
             # owner circuit open: pre-send by construction, so the whole
             # group may degrade locally in ONE owner-batch apply
-            return self._degrade_or_error(reqs, peer)
+            return self._degrade_or_error(reqs, peer, dl=dl)
+        except DeadlineExceededError as e:
+            return [RateLimitResp(error=f"DEADLINE_EXCEEDED: {e}")
+                    for _ in reqs]
         except PeerNotReadyError:
-            return [self._forward(r, r.hash_key(), span) for r in reqs]
+            return [self._forward(r, r.hash_key(), span, dl) for r in reqs]
         except Exception as e:  # noqa: BLE001
             return [RateLimitResp(
                 error=f"while fetching rate limit '{r.hash_key()}' "
@@ -614,7 +846,7 @@ class Instance:
         return resps
 
     def _degrade_or_error(
-        self, reqs: Sequence[RateLimitReq], peer: PeerClient
+        self, reqs: Sequence[RateLimitReq], peer: PeerClient, dl=None
     ) -> List[RateLimitResp]:
         """The owner's circuit is OPEN (a pre-send condition: nothing
         reached the wire, so local application cannot double-count).
@@ -637,7 +869,17 @@ class Instance:
                 for r in reqs]
         local = [without_behavior(r, Behavior.GLOBAL, Behavior.MULTI_REGION)
                  for r in reqs]
-        resps = self.apply_owner_batch(local)
+        dtoken = deadline_mod.use(dl) if dl is not None else None
+        try:
+            resps = self.apply_owner_batch(local)
+        except DeadlineExceededError as e:
+            # the budget died before the degraded window ran: same
+            # per-request error shape as every other forward failure
+            return [RateLimitResp(error=f"DEADLINE_EXCEEDED: {e}")
+                    for _ in reqs]
+        finally:
+            if dtoken is not None:
+                deadline_mod.reset(dtoken)
         if self.conf.metrics is not None:
             try:
                 self.conf.metrics.degraded_local.inc(len(resps))

@@ -248,6 +248,42 @@ class Metrics:
             "owner's circuit was open (GUBER_DEGRADED_LOCAL=1).",
             registry=self.registry,
         )
+        # deadline budgets + admission control (service/deadline.py,
+        # instance.py AdmissionController; docs/OPERATIONS.md "Overload &
+        # deadlines"). All incremented live at the choke points.
+        self.deadline_expired = Counter(
+            "deadline_expired_total",
+            "Requests shed because their deadline budget expired, by "
+            "stage (ingress = surface pre-dispatch, queue = combiner "
+            "dequeue, forward = router/peer-call pre-send, batch = "
+            "micro-batch flush).",
+            ["stage"], registry=self.registry,
+        )
+        self.admission_shed = Counter(
+            "admission_shed_total",
+            "Work refused by the admission controller, by pressure level "
+            "(reason: brownout = 75% of GUBER_MAX_PENDING, saturated = "
+            "at/over it) and work class (priority: forward = non-owner "
+            "forwards, broadcast = GLOBAL async broadcasts, peer = "
+            "forwarded owner batches, ingress = whole public calls).",
+            ["reason", "priority"], registry=self.registry,
+        )
+        self.admission_pending = Gauge(
+            "admission_pending",
+            "Pending work the admission controller weighs against "
+            "GUBER_MAX_PENDING: combiner backlog + in-flight forwards + "
+            "GLOBAL pipeline depth (refreshed at scrape).",
+            registry=self.registry,
+        )
+        self.request_budget_ms = Histogram(
+            "request_budget_ms",
+            "Deadline budget observed at capture, by surface (public = "
+            "ingress gRPC/HTTP, peer = decremented hop budget received "
+            "over gRPC metadata or the peerlink carrier).",
+            ["surface"], registry=self.registry,
+            buckets=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+                     10000),
+        )
         # TPU-native engine metrics (no reference analogue)
         self.engine_decisions = Counter(
             "engine_decisions_total",
@@ -416,6 +452,9 @@ class Metrics:
                 if circuit is not None:
                     self.circuit_state.labels(
                         peer=peer.info.address).set(circuit.state)
+        adm = getattr(instance, "admission", None)
+        if adm is not None:
+            self.admission_pending.set(adm.pending())
         gm = getattr(instance, "global_manager", None)
         if gm is not None:
             hits_depth, bcast_depth = gm.depths()

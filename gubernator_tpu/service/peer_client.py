@@ -22,6 +22,7 @@ from typing import List, Optional, Sequence
 import grpc
 
 from gubernator_tpu.service import faults
+from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.convert import req_to_pb, resp_from_pb
 from gubernator_tpu.service.grpc_api import CHANNEL_OPTIONS, PeersV1Stub
@@ -294,13 +295,25 @@ class PeerClient:
 
     # ------------------------------------------------------------------ API
 
-    def get_peer_rate_limit(self, req: RateLimitReq,
-                            trace_span=None) -> RateLimitResp:
+    def get_peer_rate_limit(self, req: RateLimitReq, trace_span=None,
+                            deadline=None) -> RateLimitResp:
         """Forward one request to this peer, batching unless NO_BATCHING
-        (reference: peer_client.go:127-140)."""
+        (reference: peer_client.go:127-140).
+
+        `deadline` (service/deadline.py, defaulting to the context's
+        active budget) bounds the wait for the batched response: an
+        already-expired budget sheds pre-send, and a caller never waits
+        past its own remaining time for a batch flush it cannot use."""
+        if deadline is None:
+            deadline = deadline_mod.current()
         if has_behavior(req.behavior, Behavior.NO_BATCHING):
-            resps = self.get_peer_rate_limits([req], trace_span=trace_span)
+            resps = self.get_peer_rate_limits([req], trace_span=trace_span,
+                                              deadline=deadline)
             return resps[0]
+        if deadline is not None and deadline.expired():
+            self._count_expired(deadline_mod.STAGE_FORWARD)
+            raise deadline_mod.DeadlineExceededError(
+                f"budget expired before forwarding to {self.info.address}")
         if self.circuit.blocked():
             # fail in microseconds instead of paying the batch window +
             # timeout against a peer known-dead; blocked() (not allow())
@@ -315,16 +328,34 @@ class PeerClient:
         with self._lock:
             if self._closing:
                 raise PeerNotReadyError(self.info.address)
-            self._queue.put((req, fut, trace_span))
+            self._queue.put((req, fut, trace_span, deadline))
+        timeout_s = self.conf.batch_timeout_s
+        if deadline is not None:
+            # never below the hop floor: the batch worker was granted at
+            # least that much, so cutting the wait shorter would abandon
+            # a response already being earned
+            timeout_s = min(timeout_s, max(
+                deadline.remaining_s(),
+                self._min_hop_budget_ms() / 1e3))
         try:
-            return fut.result(timeout=self.conf.batch_timeout_s)
+            return fut.result(timeout=timeout_s)
         except _FutureTimeout:
+            if deadline is not None and deadline.expired():
+                # the budget, not the peer, ran out — the batch may still
+                # be applying at the peer (delivery-uncertain, same
+                # no-resend rule as a transport timeout), but the caller
+                # sheds NOW instead of stalling out the full batch window
+                self._count_expired(deadline_mod.STAGE_FORWARD)
+                self._record_err("deadline expired awaiting batch response")
+                raise deadline_mod.DeadlineExceededError(
+                    f"budget expired awaiting batched response from "
+                    f"{self.info.address}") from None
             self._record_err("batch response timeout")
             raise
 
     def get_peer_rate_limits(
         self, reqs: Sequence[RateLimitReq], wait_for_ready: bool = False,
-        trace_span=None,
+        trace_span=None, deadline=None,
     ) -> List[RateLimitResp]:
         """One peer call carrying the whole batch: the native link when the
         peer answers it (~4-5x cheaper than Python gRPC), else gRPC.
@@ -339,7 +370,30 @@ class PeerClient:
         `trace_span` (obs/trace.py) propagates W3C trace context to the
         owner: gRPC carries it as `traceparent` metadata, peerlink as a
         reserved carrier item in a TRACED frame — the owner's spans then
-        share this request's trace id."""
+        share this request's trace id.
+
+        `deadline` (service/deadline.py, defaulting to the context's
+        active budget) turns the fixed `batch_timeout_s` RPC timeout into
+        `min(remaining budget, batch_timeout)` floored at
+        GUBER_MIN_HOP_BUDGET_MS, and propagates the granted hop budget to
+        the owner — `guber-deadline-ms` metadata over gRPC, a reserved
+        carrier item behind METHOD_DEADLINE over peerlink — so every hop
+        works against a strictly smaller budget than its caller's."""
+        if deadline is None:
+            deadline = deadline_mod.current()
+        timeout_s = self.conf.batch_timeout_s
+        hop_ms = None
+        if deadline is not None:
+            remaining = deadline.remaining_ms()
+            if remaining <= 0:
+                self._count_expired(deadline_mod.STAGE_FORWARD)
+                raise deadline_mod.DeadlineExceededError(
+                    f"budget expired before forwarding to "
+                    f"{self.info.address}")
+            hop_ms = deadline_mod.hop_budget_ms(
+                remaining, self.conf.batch_timeout_s,
+                self._min_hop_budget_ms())
+            timeout_s = hop_ms / 1e3
         if not self.circuit.allow():
             # one gate for BOTH transports: the whole batch fails fast
             # pre-send (one CircuitOpenError per batch, not one timeout
@@ -348,25 +402,36 @@ class PeerClient:
         link = self._peer_link()
         if link is not None:
             from gubernator_tpu.service.peerlink import (
+                METHOD_DEADLINE,
                 METHOD_GET_PEER_RATE_LIMITS,
                 MAX_FRAME_ITEMS,
                 METHOD_TRACED,
                 PeerLinkError,
                 PeerLinkTimeout,
                 PeerLinkUnencodable,
+                deadline_carrier,
                 trace_carrier,
             )
 
+            flags = 0
+            carriers = []
+            if trace_span is not None:
+                flags |= METHOD_TRACED
+                carriers.append(trace_carrier(trace_span))
+            if hop_ms is not None:
+                flags |= METHOD_DEADLINE
+                carriers.append(deadline_carrier(hop_ms))
             try:
-                if trace_span is not None and len(reqs) < MAX_FRAME_ITEMS:
+                if carriers and \
+                        len(reqs) + len(carriers) <= MAX_FRAME_ITEMS:
                     resps = link.call(
-                        METHOD_GET_PEER_RATE_LIMITS | METHOD_TRACED,
-                        [trace_carrier(trace_span)] + list(reqs),
-                        self.conf.batch_timeout_s)
+                        METHOD_GET_PEER_RATE_LIMITS | flags,
+                        carriers + list(reqs), timeout_s)
                     self.circuit.record_success()
-                    return resps[1:]  # drop the carrier's placeholder
+                    # drop the carriers' placeholder lanes
+                    return resps[len(carriers):]
                 resps = link.call(METHOD_GET_PEER_RATE_LIMITS, list(reqs),
-                                  self.conf.batch_timeout_s)
+                                  timeout_s)
                 self.circuit.record_success()
                 return resps
             except PeerLinkUnencodable:
@@ -390,18 +455,31 @@ class PeerClient:
                 self._drop_link()
         stub = self._connect()
         msg = peers_pb.GetPeerRateLimitsReq(requests=[req_to_pb(r) for r in reqs])
-        metadata = None
+        metadata = []
         if trace_span is not None:
             from gubernator_tpu.obs.trace import format_traceparent
 
-            metadata = (("traceparent", format_traceparent(trace_span)),)
+            metadata.append(("traceparent", format_traceparent(trace_span)))
+        if hop_ms is not None:
+            # the DECREMENTED budget: strictly smaller than the caller's
+            # own capture, because remaining_ms() already paid the time
+            # spent routing/queueing on this node
+            metadata.append((deadline_mod.METADATA_KEY, f"{hop_ms:.3f}"))
         try:
             out = stub.GetPeerRateLimits(
-                msg, timeout=self.conf.batch_timeout_s,
-                wait_for_ready=wait_for_ready, metadata=metadata)
+                msg, timeout=timeout_s,
+                wait_for_ready=wait_for_ready,
+                metadata=tuple(metadata) or None)
         except grpc.RpcError as e:
             self._record_err(str(e.code()))
-            self.circuit.record_failure()
+            if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                # an admission shed: the peer is ALIVE and answering fast
+                # — charging the breaker would convert its overload into
+                # an open circuit (and, degraded-local, split-brain), the
+                # opposite of backing off
+                self.circuit.record_success()
+            else:
+                self.circuit.record_failure()
             raise
         except (faults.FaultError, faults.FaultTimeout) as e:
             # injected transport failures charge the breaker exactly as
@@ -457,6 +535,16 @@ class PeerClient:
             CacheItem(key=msg, expire_at=int(time.time() * 1000) + self.ERR_TTL_MS)
         )
 
+    def _min_hop_budget_ms(self) -> float:
+        return getattr(self.conf, "min_hop_budget_ms", 5.0)
+
+    def _count_expired(self, stage: str) -> None:
+        if self.metrics is not None:
+            try:
+                self.metrics.deadline_expired.labels(stage=stage).inc()
+            except Exception:  # noqa: BLE001 — metrics must not break calls
+                pass
+
     def _run(self) -> None:
         """Batch loop: flush at batch_limit items or batch_wait after the
         first enqueue (reference: peer_client.go:243-283)."""
@@ -489,19 +577,49 @@ class PeerClient:
         """Send one batch, demuxing responses by index
         (reference: peer_client.go:287-319). One RPC carries one trace
         context: the first traced entry's (a merged batch IS one shared
-        hop — co-batched traces share its owner-side spans)."""
-        span = next((s for _, _, s in batch if s is not None), None)
+        hop — co-batched traces share its owner-side spans).
+
+        Entries whose deadline died waiting for the batch window are shed
+        HERE, pre-send: their callers already stopped waiting, so carrying
+        them would spend wire and owner work on answers nobody reads. The
+        RPC runs under the WIDEST surviving budget — tighter co-batched
+        callers stop waiting individually through their own result
+        timeout, and failing the whole batch at the tightest budget would
+        punish long-budget entries for their neighbors."""
+        live = []
+        dl = None
+        for entry in batch:
+            edl = entry[3]
+            if edl is not None and edl.expired():
+                fut = entry[1]
+                if not fut.done():
+                    fut.set_exception(deadline_mod.DeadlineExceededError(
+                        "budget expired in the peer batch queue"))
+                self._count_expired(deadline_mod.STAGE_BATCH)
+                continue
+            if edl is not None and (dl is None
+                                    or edl.expires_at > dl.expires_at):
+                dl = edl
+            live.append(entry)
+        if not live:
+            return
+        if any(e[3] is None for e in live):
+            # an unbudgeted entry deserves the full batch timeout; the
+            # budgeted co-riders still bound their own waits
+            dl = None
+        span = next((s for _, _, s, _ in live if s is not None), None)
         try:
             resps = self.get_peer_rate_limits(
-                [req for req, _, _ in batch], trace_span=span)
-            if len(resps) != len(batch):
+                [req for req, _, _, _ in live], trace_span=span,
+                deadline=dl)
+            if len(resps) != len(live):
                 raise RuntimeError(
                     f"server responded with incorrect rate limit list size: "
-                    f"{len(resps)} != {len(batch)}"
+                    f"{len(resps)} != {len(live)}"
                 )
-            for (_, fut, _), resp in zip(batch, resps):
+            for (_, fut, _, _), resp in zip(live, resps):
                 fut.set_result(resp)
         except Exception as e:  # noqa: BLE001 — every waiter must wake
-            for _, fut, _ in batch:
+            for _, fut, _, _ in live:
                 if not fut.done():
                     fut.set_exception(e)

@@ -57,6 +57,15 @@ METHOD_GET_PEER_RATE_LIMITS = 1
 # flag intact.
 METHOD_TRACED = 0x80
 TRACE_CARRIER_NAME = "tp"
+# Second reserved method-byte flag: the frame carries a deadline-budget
+# carrier item (its unique_key holds the remaining hop budget in ms as a
+# decimal string — service/deadline.py). Same no-C++-change trick as
+# METHOD_TRACED: flagged methods never match the IO-thread fast paths, so
+# the carrier reaches the Python workers intact. Carrier order when both
+# flags are set: trace first, deadline second.
+METHOD_DEADLINE = 0x40
+METHOD_FLAGS = METHOD_TRACED | METHOD_DEADLINE
+DEADLINE_CARRIER_NAME = "dl"
 
 
 def trace_carrier(span) -> RateLimitReq:
@@ -65,6 +74,14 @@ def trace_carrier(span) -> RateLimitReq:
 
     return RateLimitReq(name=TRACE_CARRIER_NAME,
                         unique_key=format_traceparent(span))
+
+
+def deadline_carrier(budget_ms: float) -> RateLimitReq:
+    """The reserved carrier item of a DEADLINE frame (see
+    METHOD_DEADLINE): the budget this hop was granted, already
+    decremented by the sender's elapsed time."""
+    return RateLimitReq(name=DEADLINE_CARRIER_NAME,
+                        unique_key=f"{budget_ms:.3f}")
 
 
 # Columnar wire layout (see native/peerlink.cpp): fields ride as arrays,
@@ -712,7 +729,7 @@ class PeerLinkService:
             # their method name).
             rids = b["rid"][:got]
             conns = b["conn"][:got]
-            meth = b["method"][:got] & ~METHOD_TRACED  # count by base method
+            meth = b["method"][:got] & ~METHOD_FLAGS  # count by base method
             starts = np.ones(got, bool)
             starts[1:] = ((rids[1:] != rids[:-1])
                           | (conns[1:] != conns[:-1]))
@@ -741,11 +758,12 @@ class PeerLinkService:
             columnar_ok = eng is not None and (
                 m == METHOD_GET_PEER_RATE_LIMITS
                 or (m == METHOD_GET_RATE_LIMITS and self._public_fast))
-            if m & METHOD_TRACED:
-                # sampled frames: decode the carrier, record owner-side
-                # spans, ride the combiner (the traced window's wait is
-                # part of the phase picture)
-                self._traced_chunk(m, j, k, b, errs, metas)
+            if m & METHOD_FLAGS:
+                # flagged frames (trace and/or deadline): decode the
+                # carrier item(s), install the contexts, ride the combiner
+                # (a traced window's wait is part of the phase picture; a
+                # budgeted window's wait is where its budget dies)
+                self._carrier_chunk(m, j, k, b, errs, metas)
             elif not (columnar_ok
                       and self._columnar_chunk(m, eng, j, k, b, errs,
                                                metas)):
@@ -880,6 +898,13 @@ class PeerLinkService:
         the pull's own width (up to MAX_N items = many sub-windows) is
         what the pipeline overlaps. False = the engine can't take the
         shape at all (nothing mutated)."""
+        adm = getattr(self.instance, "admission", None)
+        if adm is not None and adm.enabled and adm.level() >= adm.SATURATED:
+            # saturated: demote the chunk to the object path, whose
+            # admission gate answers RESOURCE_EXHAUSTED error rows in
+            # microseconds — the zero-object fast path must not become
+            # the hole overload pours through (one int compare when off)
+            return False
         launch = getattr(eng, "launch_columnar_windows", None)
         spans = self._chunk_spans(eng, j, k)
         if not self._col_pipe or launch is None or len(spans) <= 1:
@@ -1067,56 +1092,79 @@ class PeerLinkService:
         if metas is not None and resp.metadata:
             metas.append((i, _encode_pb_metadata(resp.metadata)))
 
-    def _traced_chunk(self, m: int, j: int, k: int, b: dict,
-                      errs: list, metas: list) -> None:
-        """A run of TRACED items: split at frame boundaries (rid/conn
-        change — the aggregated pull may have merged several traced
-        frames) and handle each with its own trace context."""
+    def _carrier_chunk(self, m: int, j: int, k: int, b: dict,
+                       errs: list, metas: list) -> None:
+        """A run of flagged (traced/deadlined) items: split at frame
+        boundaries (rid/conn change — the aggregated pull may have merged
+        several flagged frames) and handle each with its own contexts."""
         rid, conn = b["rid"], b["conn"]
         i = j
         while i < k:
             e = i + 1
             while e < k and rid[e] == rid[i] and conn[e] == conn[i]:
                 e += 1
-            # the carrier is item 0 OF ITS FRAME; a frame continued from a
+            # the carriers lead THEIR FRAME; a frame continued from a
             # previous (batch-cap-split) chunk carries no new context
             frame_start = i == 0 or rid[i] != rid[i - 1] \
                 or conn[i] != conn[i - 1]
-            self._traced_frame(m & ~METHOD_TRACED, i, e, b, errs, metas,
-                               frame_start)
+            self._carrier_frame(m, i, e, b, errs, metas, frame_start)
             i = e
 
-    def _traced_frame(self, base: int, i: int, e: int, b: dict, errs: list,
-                      metas: list, frame_start: bool) -> None:
-        from gubernator_tpu.obs import trace
+    def _carrier_item(self, b: dict, i: int) -> str:
+        """A carrier item's unique_key field, decoded ("" for garbage —
+        the link port is unauthenticated, so a crafted carrier degrades
+        to context-less serving, never a worker death)."""
+        lo, hi = int(b["key_off"][i]), int(b["key_off"][i + 1])
+        split = lo + int(b["name_len"][i])
+        try:
+            return b["keys"][split:hi].decode()
+        except UnicodeDecodeError:
+            return ""
 
+    def _carrier_frame(self, m: int, i: int, e: int, b: dict, errs: list,
+                       metas: list, frame_start: bool) -> None:
+        from gubernator_tpu.obs import trace
+        from gubernator_tpu.service import deadline as deadline_mod
+
+        base = m & ~METHOD_FLAGS
         span = None
+        dl = None
         start = i
         if frame_start:
-            # decode the reserved carrier item's traceparent
-            lo, hi = int(b["key_off"][i]), int(b["key_off"][i + 1])
-            split = lo + int(b["name_len"][i])
-            tracer = getattr(self.instance, "tracer", None)
-            if tracer is not None:
-                try:
+            if m & METHOD_TRACED and start < e:
+                tracer = getattr(self.instance, "tracer", None)
+                if tracer is not None:
                     span = tracer.continue_trace(
-                        "owner.apply", b["keys"][split:hi].decode())
-                except UnicodeDecodeError:
-                    span = None
-            if span is not None:
-                span.set("transport", "peerlink")
-            self._fill_one(b, i, RateLimitResp(), errs, metas)
-            start = i + 1
+                        "owner.apply", self._carrier_item(b, start))
+                if span is not None:
+                    span.set("transport", "peerlink")
+                self._fill_one(b, start, RateLimitResp(), errs, metas)
+                start += 1
+            if m & METHOD_DEADLINE and start < e:
+                try:
+                    budget_ms = float(self._carrier_item(b, start))
+                except ValueError:
+                    budget_ms = 0.0
+                dl = deadline_mod.capture(budget_ms)
+                if dl is not None:
+                    note = getattr(self.instance, "observe_budget", None)
+                    if note is not None:
+                        note("peer", budget_ms)
+                self._fill_one(b, start, RateLimitResp(), errs, metas)
+                start += 1
         if start >= e:
             return
         token = trace.use(span)
+        dtoken = deadline_mod.use(dl)
         try:
             # via the combiner (direct=False): a traced window's
             # enqueue->launch wait is exactly the phase a sampled request
-            # exists to measure
+            # exists to measure, and a budgeted window's queue wait is
+            # where the combiner's dequeue-time shed can catch it
             self._object_chunk(base, start, e, b, errs, metas,
-                               direct=span is None)
+                               direct=span is None and dl is None)
         finally:
+            deadline_mod.reset(dtoken)
             trace.reset(token)
             if span is not None:
                 self.instance.tracer.finish(span)

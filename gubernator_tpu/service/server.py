@@ -14,6 +14,7 @@ from typing import Optional
 import grpc
 
 from gubernator_tpu.obs import trace
+from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.convert import (
     health_to_pb,
     req_from_pb,
@@ -32,6 +33,10 @@ MAX_MESSAGE_BYTES = 1024 * 1024
 _CODES = {
     "OUT_OF_RANGE": grpc.StatusCode.OUT_OF_RANGE,
     "INVALID_ARGUMENT": grpc.StatusCode.INVALID_ARGUMENT,
+    # overload outcomes (service/deadline.py): shed work maps to the
+    # status a well-behaved client backs off on, not a generic error
+    "DEADLINE_EXCEEDED": grpc.StatusCode.DEADLINE_EXCEEDED,
+    "RESOURCE_EXHAUSTED": grpc.StatusCode.RESOURCE_EXHAUSTED,
 }
 
 
@@ -47,6 +52,57 @@ def _incoming_traceparent(instance, context) -> str:
         return ""
 
 
+def _ingress_deadline(instance, context):
+    """Capture the public request's deadline budget: the client's own
+    gRPC context deadline when it set one, else GUBER_DEFAULT_DEADLINE_MS
+    (0 = no budget, every downstream deadline site is a None check)."""
+    remaining = None
+    try:
+        remaining = context.time_remaining()  # None without a deadline
+    except Exception:  # noqa: BLE001 — raw-punt contexts have no clock
+        remaining = None
+    if remaining is not None:
+        # capture() maps grpcio's no-deadline sentinel (~int64-max
+        # seconds) to None — fall through to the env default then
+        dl = deadline_mod.capture(remaining * 1e3)
+        if dl is not None:
+            return dl
+    return deadline_mod.capture(
+        getattr(instance.conf.behaviors, "default_deadline_ms", 0.0))
+
+
+def _hop_deadline(instance, context):
+    """Capture a peer surface's hop budget: the forwarding node's
+    decremented `guber-deadline-ms` metadata wins (it already paid the
+    upstream elapsed time); a bare gRPC deadline from a non-framework
+    peer still bounds the work."""
+    budget_ms = None
+    try:
+        budget_ms = deadline_mod.from_metadata(context.invocation_metadata())
+    except Exception:  # noqa: BLE001 — raw-punt contexts carry no metadata
+        budget_ms = None
+    if budget_ms is None:
+        try:
+            remaining = context.time_remaining()
+        except Exception:  # noqa: BLE001
+            remaining = None
+        if remaining is None:
+            return None
+        budget_ms = remaining * 1e3
+    dl = deadline_mod.capture(budget_ms)
+    if dl is not None:
+        instance.observe_budget("peer", budget_ms)
+    return dl
+
+
+def _abort_shed(instance, context, e) -> None:
+    """Map a shed outcome onto its gRPC status (satellite of the overload
+    work: DEADLINE_EXCEEDED / RESOURCE_EXHAUSTED instead of UNKNOWN)."""
+    if isinstance(e, deadline_mod.AdmissionRejectedError):
+        context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+    context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+
+
 class V1Servicer:
     """Public API endpoints (reference: proto/gubernator.proto:27-45)."""
 
@@ -60,13 +116,31 @@ class V1Servicer:
             "ingress", _incoming_traceparent(self.instance, context)) \
             if self.instance.tracer.active else None
         token = trace.use(span) if span is not None else None
+        # deadline budget: client gRPC deadline or the env default; the
+        # pre-dispatch check is the cheapest shed point of all — a dead or
+        # disconnected client costs zero routing work
+        dl = _ingress_deadline(self.instance, context)
+        dtoken = None
+        if dl is not None:
+            self.instance.observe_budget("public", dl.budget_ms)
+            if not context.is_active() or dl.expired():
+                self.instance._count_expired(  # noqa: SLF001
+                    deadline_mod.STAGE_INGRESS)
+                context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                              "request deadline expired before dispatch")
+            dtoken = deadline_mod.use(dl)
         try:
             resps = self.instance.get_rate_limits(
                 [req_from_pb(m) for m in request.requests]
             )
+        except (deadline_mod.DeadlineExceededError,
+                deadline_mod.AdmissionRejectedError) as e:
+            _abort_shed(self.instance, context, e)
         except ApiError as e:
             context.abort(_CODES.get(e.code, grpc.StatusCode.UNKNOWN), e.message)
         finally:
+            if dtoken is not None:
+                deadline_mod.reset(dtoken)
             if span is not None:
                 span.set("requests", len(request.requests))
                 span.set("transport", "grpc")
@@ -93,13 +167,23 @@ class PeersV1Servicer:
         if span is not None:
             span.set("transport", "grpc")
         token = trace.use(span) if span is not None else None
+        # hop budget: the forwarder's decremented guber-deadline-ms
+        # metadata (or a bare client deadline from a non-framework peer);
+        # the combiner's dequeue-time shed reads it from the context
+        dl = _hop_deadline(self.instance, context)
+        dtoken = deadline_mod.use(dl) if dl is not None else None
         try:
             resps = self.instance.get_peer_rate_limits(
                 [req_from_pb(m) for m in request.requests]
             )
+        except (deadline_mod.DeadlineExceededError,
+                deadline_mod.AdmissionRejectedError) as e:
+            _abort_shed(self.instance, context, e)
         except ApiError as e:
             context.abort(_CODES.get(e.code, grpc.StatusCode.UNKNOWN), e.message)
         finally:
+            if dtoken is not None:
+                deadline_mod.reset(dtoken)
             if span is not None:
                 trace.reset(token)
                 self.instance.tracer.finish(span)

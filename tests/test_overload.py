@@ -1,0 +1,566 @@
+"""Overload drill: end-to-end deadline budgets + admission control.
+
+Proves the issue's acceptance criteria deterministically, in tier-1 wall
+time: (a) a queued ticket whose budget expires is shed at combiner dequeue,
+before it can occupy a device window; (b) a forwarded hop receives a
+STRICTLY smaller budget than its caller captured — asserted over both the
+gRPC metadata path and the peerlink carrier wire; (c) a saturated instance
+answers RESOURCE_EXHAUSTED in < 50 ms while owner-local traffic still
+completes (brownout order); (d) a faults.py delay fault upstream converts
+to fast sheds, never batch-window stalls; and the GUBER_MAX_PENDING=0
+escape hatch restores pre-admission behavior exactly.
+
+The randomized variant rides the `chaos` marker (`make chaos` re-runs it
+with a random GUBER_CHAOS_SEED, printed for reproduction)."""
+
+import json
+import os
+import random
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import grpc
+import pytest
+
+from gubernator_tpu.cluster.harness import LocalCluster, wire_peerlink
+from gubernator_tpu.cluster.harness import test_behaviors as _behaviors
+from gubernator_tpu.service import deadline as deadline_mod
+from gubernator_tpu.service import faults
+from gubernator_tpu.service.combiner import BackendCombiner
+from gubernator_tpu.service.convert import req_to_pb
+from gubernator_tpu.service.deadline import (
+    AdmissionRejectedError,
+    Deadline,
+    DeadlineExceededError,
+)
+from gubernator_tpu.service.grpc_api import dial_v1
+from gubernator_tpu.service.http_gateway import HttpGateway
+from gubernator_tpu.service.peer_client import PeerClient
+from gubernator_tpu.service.pb import gubernator_pb2 as pb
+from gubernator_tpu.types import PeerInfo, RateLimitReq, RateLimitResp
+
+
+def _rl(key, hits=1, limit=100, duration=60_000, behavior=0, name="test"):
+    return RateLimitReq(name=name, unique_key=key, hits=hits, limit=limit,
+                        duration=duration, behavior=behavior)
+
+
+def _key_owned_by(instance, owner_addr, prefix="ov"):
+    for i in range(3000):
+        k = f"{i}{prefix}"
+        if instance.get_peer(f"test_{k}").info.address == owner_addr:
+            return k
+    raise AssertionError(f"no probe key routed to {owner_addr}")
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def duo():
+    c = LocalCluster().start(2)
+    yield c
+    c.stop()
+
+
+@pytest.fixture
+def calm(duo):
+    """The shared duo with admission/deadline state restored afterwards —
+    tests mutate thresholds and the pending-work counters freely."""
+    yield duo
+    for ci in duo.instances:
+        b = ci.instance.conf.behaviors
+        b.max_pending = 8192
+        b.default_deadline_ms = 0.0
+        ci.instance._forward_inflight = 0
+
+
+class TestDeadlinePrimitives:
+    def test_capture_none_zero_negative(self):
+        assert deadline_mod.capture(None) is None
+        assert deadline_mod.capture(0) is None
+        assert deadline_mod.capture(-5) is None
+        assert deadline_mod.capture(50).budget_ms == 50.0
+
+    def test_remaining_self_decrements(self):
+        dl = Deadline(100)
+        first = dl.remaining_ms()
+        time.sleep(0.02)
+        second = dl.remaining_ms()
+        assert second < first <= 100
+        assert not dl.expired()
+        assert Deadline(0.001).expired() or time.sleep(0.01) or \
+            Deadline(0.001).expired()
+
+    def test_hop_budget_min_and_floor(self):
+        # a hop never gets more than the caller has left...
+        assert deadline_mod.hop_budget_ms(80.0, 10.0, 5.0) == 80.0
+        # ...or than the configured RPC timeout...
+        assert deadline_mod.hop_budget_ms(5000.0, 0.5, 5.0) == 500.0
+        # ...but always at least the floor
+        assert deadline_mod.hop_budget_ms(0.3, 10.0, 5.0) == 5.0
+
+    def test_grpc_metadata_roundtrip(self):
+        md = ((deadline_mod.METADATA_KEY, "123.456"),)
+        assert deadline_mod.from_metadata(md) == 123.456
+        assert deadline_mod.from_metadata(None) is None
+        assert deadline_mod.from_metadata(()) is None
+        for garbage in ("", "nan", "inf", "-3", "0", "x"):
+            got = deadline_mod.from_metadata(
+                ((deadline_mod.METADATA_KEY, garbage),))
+            assert got is None, garbage
+
+    def test_peerlink_carrier_roundtrip(self):
+        from gubernator_tpu.service.peerlink import (
+            DEADLINE_CARRIER_NAME,
+            METHOD_DEADLINE,
+            METHOD_FLAGS,
+            METHOD_TRACED,
+            deadline_carrier,
+        )
+
+        item = deadline_carrier(321.125)
+        assert item.name == DEADLINE_CARRIER_NAME
+        assert float(item.unique_key) == 321.125
+        # the two flag bits never collide with each other or base methods
+        assert METHOD_DEADLINE & METHOD_TRACED == 0
+        assert METHOD_FLAGS == METHOD_DEADLINE | METHOD_TRACED
+
+    def test_context_handoff(self):
+        assert deadline_mod.current() is None
+        dl = Deadline(1000)
+        token = deadline_mod.use(dl)
+        assert deadline_mod.current() is dl
+        deadline_mod.reset(token)
+        assert deadline_mod.current() is None
+
+
+class _BlockingBackend:
+    """Serial backend that parks inside the first window until released —
+    the deterministic stand-in for a saturated device."""
+
+    def __init__(self):
+        self.seen = []
+        self.entered = threading.Event()
+        self.release = threading.Event()
+
+    def get_rate_limits(self, reqs, now_ms=None):
+        self.entered.set()
+        assert self.release.wait(10), "test never released the backend"
+        self.seen.extend(r.unique_key for r in reqs)
+        return [RateLimitResp(limit=r.limit, remaining=r.limit - r.hits)
+                for r in reqs]
+
+
+class TestCombinerQueueShed:
+    def test_expired_ticket_never_reaches_dispatch(self):
+        """(a): a ticket whose budget dies in the combiner queue is
+        answered DEADLINE_EXCEEDED at dequeue — the backend never sees
+        its key, and live work behind it still completes."""
+        backend = _BlockingBackend()
+        c = BackendCombiner(backend)
+        try:
+            # A occupies the (serial) backend
+            fut_a = c.submit_async([_rl("live_a")], 1_000)
+            assert backend.entered.wait(5)
+            # B joins the queue carrying a 20 ms budget; C is unbudgeted
+            token = deadline_mod.use(deadline_mod.capture(20))
+            try:
+                fut_b = c.submit_async([_rl("doomed_b")], 1_000)
+            finally:
+                deadline_mod.reset(token)
+            fut_c = c.submit_async([_rl("live_c")], 1_000)
+            time.sleep(0.05)  # B's budget dies while A holds the device
+            backend.release.set()
+            assert fut_a.result(timeout=5)[0].error == ""
+            with pytest.raises(DeadlineExceededError):
+                fut_b.result(timeout=5)
+            assert fut_c.result(timeout=5)[0].error == ""
+            assert "doomed_b" not in backend.seen  # never dispatched
+            assert backend.seen.count("live_c") == 1
+            assert c.stats["deadline_shed"] == 1
+            assert c.stats["backlog"] == 0  # shed work left the reading
+        finally:
+            backend.release.set()
+            c.close()
+
+    def test_unbudgeted_tickets_never_shed(self):
+        """Escape-hatch half: with no deadline anywhere, the queue-shed
+        path is a None check per entry and every ticket dispatches."""
+        backend = _BlockingBackend()
+        backend.release.set()
+        c = BackendCombiner(backend)
+        try:
+            for i in range(4):
+                assert c.submit([_rl(f"nb{i}")], 1_000)[0].error == ""
+            assert c.stats["deadline_shed"] == 0
+            assert len(backend.seen) == 4
+        finally:
+            c.close()
+
+
+class TestHopBudgetDecrement:
+    def test_grpc_forward_carries_smaller_budget(self, calm):
+        """(b), gRPC wire: the owner's received hop budget is strictly
+        smaller than the budget the ingress node captured from the
+        client's own gRPC deadline."""
+        inst0 = calm.instances[0].instance
+        owner_ci = calm.instances[1]
+        key = _key_owned_by(inst0, owner_ci.address, prefix="hb")
+        owner_ci.instance.last_budget_ms.pop("peer", None)
+        stub = dial_v1(calm.instances[0].address)
+        resp = stub.GetRateLimits(
+            pb.GetRateLimitsReq(requests=[req_to_pb(_rl(key))]),
+            timeout=2.0)  # the client's deadline IS the budget
+        assert resp.responses[0].error == ""
+        ingress = inst0.last_budget_ms["public"]
+        hop = owner_ci.instance.last_budget_ms["peer"]
+        assert 0 < ingress <= 2000
+        assert 0 < hop < ingress, (hop, ingress)
+
+    def test_peerlink_forward_carries_smaller_budget(self, duo):
+        """(b), peerlink wire: the METHOD_DEADLINE carrier round-trips
+        the decremented budget over the native link."""
+        links = wire_peerlink(duo)
+        if not links:
+            pytest.skip("no free peerlink port offset on this host")
+        ci0, ci1 = duo.instances
+        pc = PeerClient(ci0.instance.conf.behaviors,
+                        PeerInfo(address=ci1.address))
+        try:
+            ci1.instance.last_budget_ms.pop("peer", None)
+            dl = deadline_mod.capture(800)
+            time.sleep(0.005)  # measurable spend before the hop
+            r = pc.get_peer_rate_limits([_rl("plbudget")], deadline=dl)[0]
+            assert r.error == ""
+            assert pc._link is not None  # rode the native link
+            hop = ci1.instance.last_budget_ms["peer"]
+            assert 0 < hop < 800, hop
+        finally:
+            pc.shutdown(timeout_s=2)
+            for svc in links:
+                svc.close()
+            for ci in duo.instances:
+                ci.instance.conf.behaviors.peer_link_offset = 0
+
+    def test_expired_budget_sheds_before_the_wire(self, calm):
+        """A dead budget never buys a wire round trip: the forward sheds
+        at the caller in microseconds."""
+        inst0 = calm.instances[0].instance
+        key = _key_owned_by(inst0, calm.instances[1].address, prefix="xp")
+        dl = Deadline(0.001)
+        time.sleep(0.002)
+        token = deadline_mod.use(dl)
+        t0 = time.monotonic()
+        try:
+            with pytest.raises(DeadlineExceededError):
+                inst0.get_rate_limits([_rl(key)])
+        finally:
+            deadline_mod.reset(token)
+        assert time.monotonic() - t0 < 0.05
+
+
+class TestAdmissionControl:
+    def test_saturated_sheds_fast_with_status(self, calm):
+        """(c): at/over GUBER_MAX_PENDING the whole call is refused in
+        < 50 ms with RESOURCE_EXHAUSTED — never a queue-wait stall — and
+        the gRPC surface maps it to the canonical status code."""
+        inst0 = calm.instances[0].instance
+        inst0.conf.behaviors.max_pending = 8
+        inst0._forward_inflight = 16  # 2x saturation
+        t0 = time.monotonic()
+        with pytest.raises(AdmissionRejectedError) as exc:
+            inst0.get_rate_limits([_rl("sat_local")])
+        assert time.monotonic() - t0 < 0.05
+        assert exc.value.retry_after_s > 0
+        stub = dial_v1(calm.instances[0].address)
+        with pytest.raises(grpc.RpcError) as rpc_exc:
+            stub.GetRateLimits(
+                pb.GetRateLimitsReq(requests=[req_to_pb(_rl("sat_rpc"))]),
+                timeout=5)
+        assert rpc_exc.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+        # health reports the admission state while saturated
+        hc = inst0.health_check()
+        assert "admission saturated" in hc.message
+        assert "pending 16" in hc.message
+        # pressure clears -> the same call completes
+        inst0._forward_inflight = 0
+        assert inst0.get_rate_limits([_rl("sat_local")])[0].error == ""
+
+    def test_brownout_sheds_forwards_owner_work_completes(self, calm):
+        """(c), brownout order: between 75% and 100% of the cap,
+        non-owner forwards shed first while owner-local decisions keep
+        completing."""
+        inst0 = calm.instances[0].instance
+        owner_addr = calm.instances[1].address
+        local_key = _key_owned_by(inst0, calm.instances[0].address,
+                                  prefix="bl")
+        remote_key = _key_owned_by(inst0, owner_addr, prefix="br")
+        inst0.conf.behaviors.max_pending = 10
+        inst0._forward_inflight = 8  # 80%: brownout, not saturation
+        t0 = time.monotonic()
+        rs = inst0.get_rate_limits([_rl(local_key), _rl(remote_key)])
+        dt = time.monotonic() - t0
+        assert rs[0].error == ""  # owner-local served
+        assert "RESOURCE_EXHAUSTED" in rs[1].error  # forward shed
+        assert rs[1].metadata["shed"] == "admission"
+        assert rs[1].metadata["owner"] == owner_addr
+        assert dt < 0.5, f"brownout call took {dt * 1e3:.0f} ms"
+        assert inst0.admission.stats["shed_forward"] >= 1
+
+    def test_brownout_drops_global_broadcasts(self, calm):
+        """Broadcasts are the first class shed: under brownout
+        queue_update drops instead of growing the GLOBAL pipeline."""
+        inst0 = calm.instances[0].instance
+        gm = inst0.global_manager
+        inst0.conf.behaviors.max_pending = 10
+        inst0._forward_inflight = 8
+        before = gm.depths()[1]
+        gm.queue_update(_rl("gshed"))
+        assert gm.depths()[1] == before  # dropped, not queued
+        assert inst0.admission.stats["shed_broadcast"] >= 1
+        # pressure clears -> broadcasts queue again
+        inst0._forward_inflight = 0
+        gm.queue_update(_rl("gshed"))
+        assert gm.depths()[1] == before + 1
+
+    def test_peer_surface_sheds_at_saturation_only(self, calm):
+        """Forwarded owner batches are owner work: admitted through
+        brownout, refused only at saturation (so the forwarding node
+        gets a fast error instead of a timeout)."""
+        inst1 = calm.instances[1].instance
+        inst1.conf.behaviors.max_pending = 10
+        inst1._forward_inflight = 8  # brownout: peer work still admitted
+        assert inst1.get_peer_rate_limits([_rl("psrv")])[0].error == ""
+        inst1._forward_inflight = 10  # saturated: refused
+        with pytest.raises(AdmissionRejectedError):
+            inst1.get_peer_rate_limits([_rl("psrv")])
+        assert inst1.admission.stats["shed_peer"] >= 1
+
+    def test_shed_peer_does_not_charge_circuit_breaker(self, calm):
+        """A RESOURCE_EXHAUSTED answer proves the peer is alive and
+        fast: it must never accumulate toward opening its circuit (an
+        open circuit + degraded-local on an overloaded-but-alive owner
+        would split the brain exactly when traffic peaks)."""
+        inst0 = calm.instances[0].instance
+        inst1 = calm.instances[1].instance
+        owner_addr = calm.instances[1].address
+        key = _key_owned_by(inst0, owner_addr, prefix="cb")
+        peer = inst0.get_peer(f"test_{key}")
+        inst1.conf.behaviors.max_pending = 4
+        inst1._forward_inflight = 8
+        for _ in range(peer.conf.circuit_threshold + 2):
+            r = inst0.get_rate_limits([_rl(key)])[0]
+            assert "RESOURCE_EXHAUSTED" in r.error
+        assert peer.circuit.state == 0  # CLOSED
+        inst1._forward_inflight = 0
+        assert inst0.get_rate_limits([_rl(key)])[0].error == ""
+
+    def test_metrics_families_exposed(self, calm):
+        text = calm.instances[0].metrics.render(
+            calm.instances[0].instance).decode()
+        assert "admission_pending" in text
+        assert "admission_shed_total" in text
+        assert "deadline_expired_total" in text
+        assert "request_budget_ms" in text
+
+
+class TestDelayFaultConvertsToShed:
+    def test_upstream_delay_sheds_fast_not_stalls(self):
+        """(d): a delay fault on the owner's transport + a request budget
+        turns what would be a full batch-window stall into a shed at
+        ~budget milliseconds."""
+        c = LocalCluster().start(2)
+        try:
+            inst0 = c.instances[0].instance
+            owner_addr = c.instances[1].address
+            key = _key_owned_by(inst0, owner_addr, prefix="dl")
+            # owner answers, but only after 1.5 s — far past the budget
+            faults.install(f"peer={owner_addr};action=delay:1.5")
+            token = deadline_mod.use(deadline_mod.capture(150))
+            t0 = time.monotonic()
+            try:
+                r = inst0.get_rate_limits([_rl(key)])[0]
+            finally:
+                deadline_mod.reset(token)
+            dt = time.monotonic() - t0
+            assert "DEADLINE_EXCEEDED" in r.error, r.error
+            # shed at ~budget: far under the injected delay, and nowhere
+            # near the harness's 10 s batch timeout
+            assert dt < 1.0, f"delay fault stalled the caller {dt:.2f}s"
+        finally:
+            faults.clear()
+            c.stop()
+
+
+class TestHttpSurface:
+    def test_header_budget_and_504_and_429(self, calm):
+        inst0 = calm.instances[0].instance
+        gw = HttpGateway(inst0, "127.0.0.1:0")
+        gw.start()
+        try:
+            body = json.dumps({"requests": [
+                {"name": "test", "uniqueKey": "http_ok", "hits": 1,
+                 "limit": 10, "duration": 60000}]}).encode()
+
+            def post(headers):
+                req = urllib.request.Request(
+                    f"http://{gw.address}/v1/GetRateLimits", data=body,
+                    headers={"Content-Type": "application/json", **headers})
+                return urllib.request.urlopen(req, timeout=10)
+
+            # a sane header budget is captured and observed
+            out = json.loads(post(
+                {deadline_mod.HTTP_HEADER: "1500"}).read())
+            assert out["responses"][0].get("error", "") == ""
+            assert 0 < inst0.last_budget_ms["public"] <= 1500
+            # an expired budget -> 504 before any routing work
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post({deadline_mod.HTTP_HEADER: "0.000001"})
+            assert err.value.code == 504
+            # garbage header -> served without a budget, never a 4xx
+            assert json.loads(post(
+                {deadline_mod.HTTP_HEADER: "bogus"}).read())[
+                    "responses"][0].get("error", "") == ""
+            # saturation -> 429 + Retry-After
+            inst0.conf.behaviors.max_pending = 4
+            inst0._forward_inflight = 8
+            with pytest.raises(urllib.error.HTTPError) as err:
+                post({})
+            assert err.value.code == 429
+            assert int(err.value.headers["Retry-After"]) >= 1
+        finally:
+            gw.close()
+
+
+class TestEscapeHatch:
+    def test_max_pending_zero_disables_admission(self, calm):
+        """GUBER_MAX_PENDING=0: the controller reports ADMIT whatever the
+        backlog reads, nothing sheds, and serving matches PR 4."""
+        inst0 = calm.instances[0].instance
+        inst0.conf.behaviors.max_pending = 0
+        inst0._forward_inflight = 10 ** 6  # absurd pending: still admitted
+        adm = inst0.admission
+        assert not adm.enabled
+        assert adm.level() == adm.ADMIT
+        key = _key_owned_by(inst0, calm.instances[1].address, prefix="eh")
+        before = dict(adm.stats)
+        rs = inst0.get_rate_limits([_rl("eh_local", limit=7), _rl(key)])
+        assert [r.error for r in rs] == ["", ""]
+        assert rs[0].remaining == 6  # enforced, not stubbed
+        assert adm.stats == before  # nothing shed while disabled
+        # broadcasts flow too
+        before = inst0.global_manager.depths()[1]
+        inst0.global_manager.queue_update(_rl("eh_g"))
+        assert inst0.global_manager.depths()[1] == before + 1
+
+    def test_no_budget_serves_identically(self, calm):
+        """No client deadline + GUBER_DEFAULT_DEADLINE_MS=0: no Deadline
+        object exists anywhere on the path (the bit-identical half of
+        the escape hatch)."""
+        inst0 = calm.instances[0].instance
+        inst0.last_budget_ms.clear()
+        stub = dial_v1(calm.instances[0].address)
+        resp = stub.GetRateLimits(pb.GetRateLimitsReq(
+            requests=[req_to_pb(_rl("nobudget", limit=9))]))  # no timeout
+        assert resp.responses[0].error == ""
+        assert resp.responses[0].remaining == 8
+        assert inst0.last_budget_ms == {}  # no budget was ever captured
+
+    def test_default_deadline_env_applies(self, calm):
+        """GUBER_DEFAULT_DEADLINE_MS > 0 budgets clientless requests."""
+        inst0 = calm.instances[0].instance
+        inst0.conf.behaviors.default_deadline_ms = 5000.0
+        inst0.last_budget_ms.clear()
+        stub = dial_v1(calm.instances[0].address)
+        stub.GetRateLimits(pb.GetRateLimitsReq(
+            requests=[req_to_pb(_rl("defbudget"))]))  # still no timeout
+        assert 0 < inst0.last_budget_ms["public"] <= 5000
+
+
+class TestEnvKnobs:
+    def test_roundtrip(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv("GUBER_MAX_PENDING", "512")
+        monkeypatch.setenv("GUBER_DEFAULT_DEADLINE_MS", "750")
+        monkeypatch.setenv("GUBER_MIN_HOP_BUDGET_MS", "2.5")
+        b = config_from_env([]).behaviors
+        assert b.max_pending == 512
+        assert b.default_deadline_ms == 750.0
+        assert b.min_hop_budget_ms == 2.5
+
+    def test_defaults(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        for var in ("GUBER_MAX_PENDING", "GUBER_DEFAULT_DEADLINE_MS",
+                    "GUBER_MIN_HOP_BUDGET_MS"):
+            monkeypatch.delenv(var, raising=False)
+        b = config_from_env([]).behaviors
+        assert b.max_pending == 8192
+        assert b.default_deadline_ms == 0.0
+        assert b.min_hop_budget_ms == 5.0
+
+    @pytest.mark.parametrize("var,val", [
+        ("GUBER_MAX_PENDING", "-1"),
+        ("GUBER_DEFAULT_DEADLINE_MS", "-10"),
+        ("GUBER_MIN_HOP_BUDGET_MS", "0"),
+    ])
+    def test_validation(self, monkeypatch, var, val):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv(var, val)
+        with pytest.raises(ValueError, match=var):
+            config_from_env([])
+
+
+@pytest.mark.chaos
+class TestChaosOverload:
+    def test_overload_invariants_hold_for_any_seed(self):
+        """Randomized drill (`make chaos`): the seed varies the budget,
+        the injected delay, and the pending cap; the invariants may not:
+        a budget shorter than the upstream delay always sheds (fast), and
+        a saturated node always answers RESOURCE_EXHAUSTED in < 50 ms.
+        Reproduce any failure with GUBER_CHAOS_SEED=<seed> make chaos."""
+        seed = int(os.environ.get("GUBER_CHAOS_SEED", "0") or "0")
+        rng = random.Random(seed)
+        budget_ms = rng.uniform(40, 140)
+        delay_s = rng.uniform(1.0, 2.0)  # always past the budget
+        cap = rng.randint(1, 6)
+        print(f"chaos seed: {seed} (budget={budget_ms:.0f}ms "
+              f"delay={delay_s:.2f}s cap={cap})")
+        c = LocalCluster().start(2)
+        try:
+            inst0 = c.instances[0].instance
+            owner_addr = c.instances[1].address
+            key = _key_owned_by(inst0, owner_addr, prefix=f"co{seed}")
+            # invariant 1: budget < upstream delay -> shed, never a stall
+            faults.install(f"peer={owner_addr};action=delay:{delay_s}")
+            token = deadline_mod.use(deadline_mod.capture(budget_ms))
+            t0 = time.monotonic()
+            try:
+                r = inst0.get_rate_limits([_rl(key)])[0]
+            finally:
+                deadline_mod.reset(token)
+            dt = time.monotonic() - t0
+            assert "DEADLINE_EXCEEDED" in r.error, r.error
+            assert dt < delay_s, f"shed took {dt:.2f}s >= delay {delay_s}s"
+            faults.clear()
+            # invariant 2: any saturation level rejects fast, and recovery
+            # is immediate once pending clears
+            inst0.conf.behaviors.max_pending = cap
+            inst0._forward_inflight = cap * 2
+            t0 = time.monotonic()
+            with pytest.raises(AdmissionRejectedError):
+                inst0.get_rate_limits([_rl("chaos_sat")])
+            assert time.monotonic() - t0 < 0.05
+            inst0._forward_inflight = 0
+            assert inst0.get_rate_limits([_rl("chaos_sat")])[0].error == ""
+        finally:
+            faults.clear()
+            c.stop()

@@ -402,7 +402,7 @@ class TestForwardRepickBackoff:
         peer = inst0.get_peer(f"test_{key}")
         calls = []
 
-        def not_ready(req, trace_span=None):
+        def not_ready(req, trace_span=None, deadline=None):
             calls.append(time.monotonic())
             raise PeerNotReadyError(peer.info.address)
 
@@ -423,7 +423,7 @@ class TestForwardRepickBackoff:
         peer = inst0.get_peer(f"test_{key}")
         calls = []
 
-        def slow_not_ready(req, trace_span=None):
+        def slow_not_ready(req, trace_span=None, deadline=None):
             calls.append(1)
             time.sleep(0.03)
             raise PeerNotReadyError(peer.info.address)
