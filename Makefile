@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast bench bench-suite soak chaos proto docker clean
+.PHONY: test test-fast bench bench-skew bench-suite soak chaos proto docker clean
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -12,6 +12,11 @@ test-fast:
 
 bench:
 	python bench.py
+
+# Zipf-1.1 skew through a 2-node loopback cluster: uniform vs leases-off
+# vs leases-on rows (client p99 + hot-owner work share, BENCH_r09)
+bench-skew:
+	python bench.py --skew
 
 bench-suite:
 	python scripts/bench_suite.py

@@ -30,6 +30,7 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 from __future__ import annotations
 
 import json
+import sys
 import time
 
 import numpy as np
@@ -549,6 +550,157 @@ def _columnar_pipeline_bench(eng, scan: int = 8,
     }
 
 
+def _multichip_section() -> dict:
+    """Fold the latest MULTICHIP_r*.json into the bench record.
+
+    The multichip runs land as sibling artifacts of the BENCH_r* files;
+    surfacing the newest one here makes every bench record self-contained
+    about the mesh tier's last known state instead of requiring a second
+    artifact lookup."""
+    import glob
+    import os
+
+    files = sorted(glob.glob(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "MULTICHIP_r*.json")))
+    if not files:
+        return {}
+    latest = files[-1]
+    try:
+        with open(latest) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        return {"multichip": {"source": os.path.basename(latest),
+                              "error": str(e)}}
+    out = {"source": os.path.basename(latest)}
+    for k in ("n_devices", "rc", "ok", "skipped", "note"):
+        if k in data:
+            out[k] = data[k]
+    return {"multichip": out}
+
+
+def _skew_bench(n_calls: int = 1200, n_keys: int = 32,
+                zipf_a: float = 1.1) -> dict:
+    """Zipf-head skew through a REAL 2-node loopback cluster: the hot-key
+    lease tier's acceptance row (BENCH_r09).
+
+    Three workloads through the same client node, measured at the client
+    (per-call p50/p99) and at the hot key's owner (engine-request share —
+    the work consistent hashing concentrates on one host):
+
+    - uniform: n_keys keys, flat — the no-skew reference row;
+    - zipf_off: Zipf-`zipf_a` keys, leases disabled — every head hit is a
+      forward RPC to the owner;
+    - zipf_on: the SAME key sequence with GUBER_HOT_LEASES semantics armed
+      — the owner detects the head, grants budgeted leases, and the client
+      node answers the head locally, draining hits asynchronously.
+
+    The claim under test: zipf_on cuts both the client p99 and the owner's
+    work share vs zipf_off, approaching the uniform row."""
+    from gubernator_tpu.cluster.harness import LocalCluster
+    from gubernator_tpu.types import RateLimitReq
+
+    rng = np.random.RandomState(9)
+    zipf_seq = [int(z) % n_keys for z in rng.zipf(zipf_a, size=n_calls)]
+    uniform_seq = [int(u) for u in rng.randint(0, n_keys, size=n_calls)]
+
+    def reqs_for(seq, prefix):
+        # leading digits vary: trailing-suffix keys can collapse onto one
+        # fnv ring arc (cluster/harness.py ownership probes do the same)
+        return [RateLimitReq(name="skew", unique_key=f"{k}{prefix}",
+                             hits=1, limit=1 << 30, duration=3_600_000)
+                for k in seq]
+
+    head = int(np.bincount(zipf_seq).argmax())
+
+    # The 2-node fnv ring can land arbitrarily lopsided for one boot's
+    # random ports (one arc owning ~everything) — a row where the client
+    # owns nothing measures only the micro-batch window, not skew. Re-roll
+    # until both nodes own a real share of the workload's keys.
+    c = None
+    for _ in range(6):
+        c = LocalCluster().start(2)
+        owners = [c.owner_of(f"skew_{k}z").address for k in range(n_keys)]
+        share = owners.count(owners[0]) / n_keys
+        if 0.2 <= share <= 0.8:
+            break
+        c.stop()
+    try:
+        hot_owner = c.owner_of(f"skew_{head}z")
+        # drive from the node that does NOT own the Zipf head, so head
+        # hits actually cross the wire (the skew problem under test)
+        client = next(ci for ci in c.instances if ci is not hot_owner)
+
+        leased_before = [0]
+
+        def run_row(reqs, head_unique):
+            # per-engine request deltas attribute the row's work
+            before = [ci.instance.backend.stats.requests
+                      for ci in c.instances]
+            lat = np.empty(len(reqs))
+            head_mask = np.zeros(len(reqs), bool)
+            t_start = time.perf_counter()
+            for i, r in enumerate(reqs):
+                head_mask[i] = r.unique_key == head_unique
+                t0 = time.perf_counter()
+                resp = client.instance.get_rate_limits([r])[0]
+                lat[i] = time.perf_counter() - t0
+                if resp.error:
+                    raise RuntimeError(resp.error)
+            wall = time.perf_counter() - t_start
+            owner_i = c.instances.index(hot_owner)
+            deltas = [ci.instance.backend.stats.requests - b
+                      for ci, b in zip(c.instances, before)]
+            leased = client.instance.leases.stats["local_answers"] \
+                - leased_before[0]
+            leased_before[0] += leased
+            head_lat = lat[head_mask]
+            row = {
+                "calls": len(reqs),
+                "calls_per_sec": round(len(reqs) / wall, 1),
+                "client_p50_ms": round(
+                    float(np.percentile(lat, 50) * 1e3), 3),
+                "client_p99_ms": round(
+                    float(np.percentile(lat, 99) * 1e3), 3),
+                "hot_owner_engine_requests": int(deltas[owner_i]),
+                "hot_owner_work_share": round(
+                    deltas[owner_i] / max(sum(deltas), 1), 3),
+                "leased_answers_total": int(leased),
+            }
+            if head_lat.size:
+                # the skew victim's own latency: head-key calls are the
+                # ones a lease converts from cross-host forwards (the
+                # micro-batch window + RPC) into local table reads
+                row["head_calls"] = int(head_lat.size)
+                row["head_p50_ms"] = round(
+                    float(np.percentile(head_lat, 50) * 1e3), 3)
+                row["head_p99_ms"] = round(
+                    float(np.percentile(head_lat, 99) * 1e3), 3)
+            return row
+
+        head_unique = f"{head}z"
+        rows = {"uniform": run_row(reqs_for(uniform_seq, "u"), "")}
+        rows["zipf_off"] = run_row(reqs_for(zipf_seq, "z"), head_unique)
+
+        for ci in c.instances:
+            b = ci.instance.conf.behaviors
+            b.hot_leases = True
+            # the head must cross the rate threshold at this rig's
+            # closed-loop call rate (Zipf-1.1 head ≈ 11% of ~100-200/s)
+            # while the ~2%-share tail keys stay cold
+            b.hot_lease_rate = 5.0
+            b.hot_lease_window_s = 0.5
+            b.hot_lease_ttl_s = 1.0
+            b.hot_lease_fraction = 0.5
+            ci.instance.leases.arm()
+        rows["zipf_on"] = run_row(reqs_for(zipf_seq, "z"), head_unique)
+        rows["zipf_a"] = zipf_a
+        rows["n_keys"] = n_keys
+        return {"skew": rows}
+    finally:
+        c.stop()
+
+
 def main() -> None:
     watchdog = _init_watchdog()
     import jax
@@ -969,6 +1121,17 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — report, don't die
         overload_row = {"overload": {"error": str(e)}}
 
+    # ---- skew: Zipf-head traffic vs the hot-key lease tier -----------------
+    # A real 2-node loopback cluster under Zipf-1.1 load; BENCH_r09 records
+    # client p99 + hot-owner work share for uniform / leases-off / leases-on
+    # (opt-in via --skew: the cluster boot pays two engine warmups).
+    skew_row = {}
+    if "--skew" in sys.argv:
+        try:
+            skew_row = _skew_bench()
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            skew_row = {"skew": {"error": str(e)}}
+
     # trace-derived serving-stack phase split (never fails the bench)
     try:
         phases = phase_breakdown()
@@ -984,6 +1147,8 @@ def main() -> None:
                 **product_row,
                 **columnar_row,
                 **overload_row,
+                **skew_row,
+                **_multichip_section(),
                 "phase_breakdown_ms": phases,
                 "unit": UNIT,
                 "vs_baseline": round(decisions_per_sec / REFERENCE_BASELINE_RPS, 2),

@@ -298,6 +298,13 @@ def main(argv=None) -> int:
         log.warning(
             "admission control DISABLED (GUBER_MAX_PENDING=0): a "
             "saturated node will stall in its queues instead of shedding")
+    if conf.behaviors.hot_leases:
+        log.info(
+            "hot-key lease tier: rate=%.0f/s window=%.1fs ttl=%.0fms "
+            "fraction=%.2f",
+            conf.behaviors.hot_lease_rate, conf.behaviors.hot_lease_window_s,
+            conf.behaviors.hot_lease_ttl_s * 1000.0,
+            conf.behaviors.hot_lease_fraction)
     instance = Instance(
         InstanceConfig(
             behaviors=conf.behaviors,

@@ -254,6 +254,15 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
                                      b.min_hop_budget_ms)
     b.max_pending = _env_int("GUBER_MAX_PENDING", b.max_pending)
 
+    # hot-key lease tier (service/leases.py)
+    b.hot_leases = _env_bool("GUBER_HOT_LEASES")
+    b.hot_lease_rate = _env_float("GUBER_HOT_LEASE_RATE", b.hot_lease_rate)
+    b.hot_lease_window_s = _env_dur("GUBER_HOT_LEASE_WINDOW",
+                                    b.hot_lease_window_s)
+    b.hot_lease_ttl_s = _env_dur("GUBER_HOT_LEASE_TTL", b.hot_lease_ttl_s)
+    b.hot_lease_fraction = _env_float("GUBER_HOT_LEASE_FRACTION",
+                                      b.hot_lease_fraction)
+
     conf = DaemonConfig(
         grpc_address=_env_str("GUBER_GRPC_ADDRESS", "0.0.0.0:81"),
         grpc_native=_env_str("GUBER_GRPC_NATIVE", "1") != "0",

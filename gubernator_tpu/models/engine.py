@@ -213,6 +213,11 @@ class Engine:
         # daemon/harness after construction, None keeps every observation
         # site a no-op
         self.metrics = None
+        # hot-key detector (service/leases.py HotKeyTracker); attached by
+        # LeaseManager.arm() when GUBER_HOT_LEASES is set — same None-is-off
+        # contract as metrics, so the staging dispatchers stay untouched
+        # when the lease tier is disabled
+        self.hot_tracker = None
         self._lock = threading.Lock()
         if donate is None:
             from gubernator_tpu.utils.platform import donation_supported
@@ -309,6 +314,11 @@ class Engine:
         (4 B/lane — the hits==1, few-configs serving shape) when eligible,
         compact (20 B/lane) otherwise, wide as the last resort. Returns an
         opaque handle for _fetch_staged."""
+        ht = self.hot_tracker
+        if ht is not None:
+            # the staged rows are already host numpy: two bulk adds per
+            # window, no per-key cost (service/leases.py)
+            ht.feed_slots(packed[0], packed[1])
         w = packed.shape[1]
         if self._staging != "wide":
             if self._lean_ok:
@@ -332,6 +342,9 @@ class Engine:
         """decide_scan dispatch of a wide i64[K, 9, W] stack, shipped
         lean/compact when eligible. Handle contract matches
         _dispatch_staged."""
+        ht = self.hot_tracker
+        if ht is not None:
+            ht.feed_slots(stacked[:, 0, :], stacked[:, 1, :])
         k, w = stacked.shape[0], stacked.shape[2]
         if self._staging != "wide":
             if self._lean_ok:
@@ -990,6 +1003,10 @@ class Engine:
         self.stats.native_singles += 1
         if out[0] == 1:
             self.stats.over_limit += 1
+        if self.hot_tracker is not None:
+            # native decides bypass the staging dispatchers, so they feed
+            # the detector by key instead of by slot row
+            self.hot_tracker.feed_key(req.hash_key(), req.hits)
         return RateLimitResp(status=int(out[0]), limit=out[1],
                              remaining=out[2], reset_time=out[3])
 
@@ -1011,6 +1028,63 @@ class Engine:
                 return False  # vacant row: nothing to mirror
             d.mirror_seed(key, row)
         return True
+
+    # ------------------------------------------------------ hot-key support
+
+    def resolve_slots(self, slots) -> dict:
+        """Map a SMALL set of slots back to their hash-key strings.
+
+        The directory only maps key→slot; the reverse walk costs one
+        items_raw arena scan, so the hot-key tracker calls this once per
+        detection window and only for the few slots that crossed the rate
+        threshold — never on the serving path. Slots without a live
+        directory entry (recycled mid-window) are simply absent from the
+        result."""
+        want = set(int(s) for s in slots)
+        if not want:
+            return {}
+        out: dict = {}
+        if hasattr(self.directory, "items_raw"):
+            blob, off, slots32 = self.directory.items_raw()
+            sl = np.asarray(slots32, np.int64)
+            off = np.asarray(off, np.int64)
+            hit = np.nonzero(np.isin(
+                sl, np.fromiter(want, np.int64, len(want))))[0]
+            for i in hit:
+                lo, hi = int(off[i]), int(off[i + 1])
+                try:
+                    out[int(sl[i])] = bytes(blob[lo:hi]).decode("utf-8")
+                except UnicodeDecodeError:
+                    continue
+        else:  # python-twin directory
+            for key, s in self.directory.items():
+                if int(s) in want:
+                    out[int(s)] = key
+        return out
+
+    def device_hit_counts(self, keys) -> dict:
+        """Per-key lifetime attempt counters from device row field 7
+        (ops/decide.py accumulates every round's requested hits there —
+        the durable, on-device view the windowed host tracker samples).
+        Debug/test surface: one gather dispatch for the whole key list."""
+        d = self.directory
+        peek = getattr(d, "peek_slot", None)
+        with self._lock:
+            pairs = []
+            for key in keys:
+                if peek is not None:
+                    slot = peek(key)
+                else:
+                    slot = dict(d.items()).get(key, -1)
+                if slot >= 0:
+                    pairs.append((key, int(slot)))
+            if not pairs:
+                return {}
+            # direct fancy-index fetch: _gather serves the 7 snapshot
+            # fields only, and this debug surface needn't be jitted
+            rows = np.asarray(
+                self.state[jnp.asarray([s for _, s in pairs], I32)])
+        return {key: int(rows[i, 7]) for i, (key, _) in enumerate(pairs)}
 
     # ------------------------------------------------------- persistence SPI
 

@@ -84,6 +84,10 @@ def debug_vars(instance) -> dict:
         out["trace"] = {"sample": tracer.sample, "slow_ms": tracer.slow_ms,
                         **tracer.stats}
 
+    lm = getattr(instance, "leases", None)
+    if lm is not None and lm.enabled:
+        out["leases"] = lm.debug()
+
     cg = getattr(instance, "collective_global", None)
     if cg is not None:
         out["collective_global"] = dict(cg.stats)

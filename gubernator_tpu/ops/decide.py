@@ -306,7 +306,12 @@ def decide(state: TableState, reqs: ReqBatch, now_ms: jax.Array) -> Tuple[TableS
             n_stamp,
             n_exp,
             n_status.astype(I64),
-            rows[:, 7],  # pad field rides along unchanged
+            # field 7: per-key lifetime attempt counter — every round adds
+            # its requested hits (admitted or rejected), giving the lease
+            # tier a device-resident hit count with zero extra dispatches
+            # (service/leases.py). Responses and snapshots never read it,
+            # so decision outputs are bit-identical with leases off.
+            rows[:, 7] + jnp.where(active, r_hits, 0),
         ],
         axis=1,
     )

@@ -75,6 +75,27 @@ class BehaviorConfig:
     # behavior is then bit-identical to the pre-admission code.
     max_pending: int = 8192
 
+    # hot-key lease tier (service/leases.py; docs/OPERATIONS.md
+    # "Skew & leases"). GUBER_HOT_LEASES turns the whole tier on; off
+    # (default) keeps every hook a guarded no-op and the serving path
+    # bit-identical to the pre-lease tree.
+    hot_leases: bool = False
+    # GUBER_HOT_LEASE_RATE: hits/s over a detection window that makes a
+    # key "hot" — on the owner (apply-window feeds) and on non-owners
+    # (their own forward counts, the peerlink lease-ask heuristic).
+    hot_lease_rate: float = 500.0
+    # GUBER_HOT_LEASE_WINDOW: detection window length, seconds.
+    hot_lease_window_s: float = 1.0
+    # GUBER_HOT_LEASE_TTL: lease lifetime, seconds. Also the staleness
+    # bound: a revoked/partitioned lease over-admits at most its budget
+    # and dies unrenewed after this long.
+    hot_lease_ttl_s: float = 0.5
+    # GUBER_HOT_LEASE_FRACTION: slice of (remaining - outstanding) one
+    # grant hands out. Overshoot is bounded by the outstanding budget, so
+    # the fraction trades local-serving runway against worst-case
+    # over-admission.
+    hot_lease_fraction: float = 0.2
+
 
 @dataclasses.dataclass
 class InstanceConfig:
@@ -120,3 +141,12 @@ class InstanceConfig:
         if self.behaviors.max_pending < 0:
             raise ValueError("behaviors.max_pending cannot be negative "
                              "(0 disables admission control)")
+        if self.behaviors.hot_lease_rate <= 0:
+            raise ValueError("behaviors.hot_lease_rate must be positive")
+        if self.behaviors.hot_lease_window_s <= 0:
+            raise ValueError("behaviors.hot_lease_window_s must be positive")
+        if self.behaviors.hot_lease_ttl_s <= 0:
+            raise ValueError("behaviors.hot_lease_ttl_s must be positive")
+        if not 0.0 < self.behaviors.hot_lease_fraction <= 1.0:
+            raise ValueError(
+                "behaviors.hot_lease_fraction must be in (0, 1]")

@@ -66,6 +66,15 @@ class _Pipeline:
 
     def queue(self, req: RateLimitReq, aggregate_hits: bool) -> None:
         with self._lock:
+            # coalesce per key: latest authoritative state wins (broadcast)
+            # or hits aggregate (async hits) — either way a hot key holds
+            # ONE pending entry, so Zipf-head traffic cannot flood the
+            # pipeline. The deadline arms only on the empty->non-empty
+            # transition: re-queues of an already-pending key must neither
+            # push the flush out (each re-arm used to reset the timer, so a
+            # hot key could postpone its own flush indefinitely) nor fire a
+            # wakeup per request.
+            was_empty = not self._pending
             if aggregate_hits:
                 prev = self._pending.get(req.hash_key())
                 if prev is not None:
@@ -74,9 +83,9 @@ class _Pipeline:
                     req = dataclasses.replace(req, hits=req.hits + prev.hits)
             self._pending[req.hash_key()] = req
             n = len(self._pending)
-            if n == 1:
+            if was_empty:
                 self._deadline = time.monotonic() + self._wait_s
-        if n == 1 or n >= self._limit:
+        if was_empty or n >= self._limit:
             self._wake.set()
 
     def depth(self) -> int:
@@ -206,12 +215,21 @@ class GlobalManager:
                 self.instance.apply_owner_batch(reqs)
             else:
                 try:
-                    peer.get_peer_rate_limits(reqs)
+                    resps = peer.get_peer_rate_limits(reqs)
                 except Exception:  # noqa: BLE001
                     log.exception(
                         "error sending global hits to '%s'", peer.info.address
                     )
                     continue
+                lm = getattr(self.instance, "leases", None)
+                if lm is not None and lm.enabled:
+                    # leased hot keys drain through this pipeline, so the
+                    # owner's responses double as the lease renewal
+                    # channel: grants in their metadata install here with
+                    # zero extra RPCs — and a broken drain path stops
+                    # renewal with it (service/leases.py)
+                    lm.install_from_responses(reqs, resps,
+                                              peer.info.address)
             self.stats["hits_sent"] += len(reqs)
 
     def _broadcast(self, batch: Dict[str, RateLimitReq]) -> None:

@@ -39,6 +39,7 @@ from gubernator_tpu.service.deadline import (
 )
 from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
 from gubernator_tpu.service.global_manager import GlobalManager
+from gubernator_tpu.service.leases import LeaseManager
 from gubernator_tpu.service.multiregion import MultiRegionManager
 from gubernator_tpu.service.peer_client import (
     CIRCUIT_CLOSED,
@@ -247,6 +248,13 @@ class Instance:
         # the request_budget_ms histogram is the production view)
         self.last_budget_ms: Dict[str, float] = {}
 
+        # hot-key lease tier (service/leases.py): always constructed so
+        # every hook is one `enabled` check; the detector only attaches to
+        # the backend when GUBER_HOT_LEASES is set (arm())
+        self.leases = LeaseManager(self)
+        if getattr(conf.behaviors, "hot_leases", False):
+            self.leases.arm()
+
         self.global_manager = GlobalManager(
             self, conf.behaviors, metrics=conf.metrics,
             admission=self.admission,
@@ -385,6 +393,13 @@ class Instance:
                 local.append(i)
             elif has_behavior(req.behavior, Behavior.GLOBAL):
                 responses[i] = self._get_global_rate_limit(req, peer)
+            elif (leased := self.leases.try_consume(
+                    req, peer.info.address)) is not None:
+                # held hot-key lease: answered from leased budget, hits
+                # drain to the owner asynchronously (service/leases.py).
+                # Checked BEFORE brownout — a lease answer is pure local
+                # work, strictly cheaper than the shed response
+                responses[i] = leased
             elif brownout:
                 # brownout order: non-owner forwards shed FIRST — the
                 # client can retry them against any moment or node, while
@@ -439,7 +454,13 @@ class Instance:
             # saturation); the forwarding node gets a fast
             # RESOURCE_EXHAUSTED it can surface without a timeout stall
             self.admission.check_ingress(priority="peer")
-        return self.apply_owner_batch(list(requests), from_peer_rpc=True)
+        responses = self.apply_owner_batch(list(requests), from_peer_rpc=True)
+        if self.leases.enabled:
+            # owner side of the lease tier: hot keys' responses carry a
+            # budget grant in their metadata (every metadata-bearing wire;
+            # the peerlink client asks via its carrier lane instead)
+            self.leases.attach_grants(requests, responses)
+        return responses
 
     def update_peer_globals(self, updates) -> None:
         """Receive an owner's GLOBAL broadcast (reference: gubernator.go:251-264).
@@ -517,15 +538,21 @@ class Instance:
             if samples:
                 line += f" ({samples})"
             parts.append(line)
+        # lease-tier state is annotation only: the tier degrades to strict
+        # forwarding on its own, so it must never flip a node unhealthy
+        lease_note = self.leases.health_note()
         if parts:
             message = " | ".join(parts)
             if len(message) > self.HEALTH_MESSAGE_CHARS:
                 message = (message[:self.HEALTH_MESSAGE_CHARS]
                            + f"... [{len(parts)} peers reporting]")
+            if lease_note:
+                message += f" | {lease_note}"
             return HealthCheckResp(
                 status="unhealthy", message=message, peer_count=peer_count
             )
-        return HealthCheckResp(status="healthy", peer_count=peer_count)
+        return HealthCheckResp(status="healthy", peer_count=peer_count,
+                               message=lease_note)
 
     def set_peers(self, peer_infos: Sequence[PeerInfo]) -> None:
         """Rebuild pickers on membership change, reusing live PeerClients and
@@ -552,6 +579,11 @@ class Instance:
                 if peer is None:
                     peer = PeerClient(self.conf.behaviors, info,
                                       metrics=self.conf.metrics)
+                    # the micro-batched per-request path flushes inside the
+                    # client's worker thread, out of Instance's sight — the
+                    # advisor lets that flush attach a hot-key lease ask to
+                    # its batch exactly like _forward_group does inline
+                    peer.lease_advisor = self.leases.want
                 else:
                     peer.info = info
                 new_local.add(peer)
@@ -757,6 +789,10 @@ class Instance:
                 resp = peer.get_peer_rate_limit(req, trace_span=span,
                                                 deadline=dl)
                 resp.metadata["owner"] = peer.info.address
+                if self.leases.enabled:
+                    self.leases.note_forwards((req,))
+                    self.leases.install_from_responses(
+                        (req,), (resp,), peer.info.address)
                 if span is not None:
                     self.tracer.record_span(
                         "peer.hop", span, t0, time.time_ns(),
@@ -815,9 +851,18 @@ class Instance:
         applied the batch, so re-sending would double-count hits — those
         surface as error responses, exactly like the per-request path."""
         t0 = time.time_ns() if span is not None else 0
+        lease_want = None
+        if self.leases.enabled:
+            # non-owner half of the lease tier: count these forwards into
+            # the local hot window and, when one of the keys is local-hot,
+            # ask the owner for a lease (the peerlink wire carries the ask
+            # as a reserved carrier; the gRPC wire grants unprompted)
+            self.leases.note_forwards(reqs)
+            lease_want = self.leases.want(reqs)
         try:
             resps = peer.get_peer_rate_limits(reqs, trace_span=span,
-                                              deadline=dl)
+                                              deadline=dl,
+                                              lease_want=lease_want)
         except CircuitOpenError:
             # owner circuit open: pre-send by construction, so the whole
             # group may degrade locally in ONE owner-batch apply
@@ -843,6 +888,9 @@ class Instance:
                 {"peer": peer.info.address, "requests": len(reqs)})
         for r in resps:
             r.metadata["owner"] = peer.info.address
+        if self.leases.enabled:
+            self.leases.install_from_responses(reqs, resps,
+                                               peer.info.address)
         return resps
 
     def _degrade_or_error(

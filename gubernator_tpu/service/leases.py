@@ -1,0 +1,588 @@
+"""Hot-key lease tier: survive Zipf-head traffic without melting the owner.
+
+Million-user traffic is zipfian, and consistent hashing sends every hit on
+a key to its single owner — micro-batching (service/combiner.py) bounds the
+kernel cost but the RPC fan-in still lands on one host (PAPER.md §0;
+reference architecture.md:19-25). The reference's GLOBAL mode shows the
+answer shape — serve locally, reconcile asynchronously (global.go:28-239) —
+but there it is a manual per-request opt-in. This module applies it
+*automatically*, with bounded overshoot:
+
+- **detect** (owner): the engine feeds every apply window's staged
+  (slot, hits) rows into a :class:`HotKeyTracker`; keys whose windowed
+  hit-rate crosses ``hot_lease_rate`` become *hot*. The device table keeps
+  the same per-key attempt counter durably in row field 7 (ops/decide.py) —
+  the host tracker is the rolling-window view of that counter.
+- **grant** (owner): a hot key's forwarded responses carry a lease — a
+  budget slice of the *remaining* limit plus a TTL — on the response
+  metadata (gRPC wire) or a reserved carrier lane (peerlink wire,
+  service/peerlink.py METHOD_LEASE). The owner does NOT deduct granted
+  budget up front; it only refuses to grant more than
+  ``remaining - outstanding``, so total admits are bounded by
+  ``limit + outstanding lease budget``.
+- **serve** (non-owner): a held lease answers the key locally from the
+  leased budget; consumed hits drain back to the owner through the existing
+  GLOBAL async-hit pipeline (global_manager.queue_hit → PeersV1), whose
+  responses double as the renewal channel.
+- **interlocks**: grants and renewals shed FIRST under admission brownout
+  (before any serving work is touched), and an open circuit to the owner
+  freezes renewal — a non-owner never mints budget, so a partitioned lease
+  dies at its TTL and the key falls back to strict forwarding.
+
+``GUBER_HOT_LEASES=0`` (the default) keeps every hook a guarded no-op and
+the serving path bit-identical to the pre-lease tree
+(tests/test_leases.py::test_leases_off_bit_identical).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from gubernator_tpu.types import (
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+    has_behavior,
+)
+
+log = logging.getLogger("gubernator_tpu.leases")
+
+# Forward-response metadata key carrying a grant: "budget:ttl_ms:seq".
+# Rides resp.metadata over every wire that has one (grpcio, the raw punt
+# path, the native front's metas column); the peerlink Python wire has no
+# response metadata, so there the same triple rides the lease carrier's
+# response lane instead (service/peerlink.py) and the client re-materializes
+# this metadata key — the install path below is wire-agnostic.
+GRANT_METADATA_KEY = "guber-lease"
+# Stamped on responses a non-owner answered from leased budget.
+LEASED_METADATA_KEY = "leased"
+
+# Behaviors a lease must never answer locally: GLOBAL has its own
+# serve-local tier, MULTI_REGION replication is the owner's job, and
+# RESET_REMAINING is a semantic write that must reach the authoritative row.
+_LEASE_EXEMPT = (Behavior.GLOBAL | Behavior.MULTI_REGION
+                 | Behavior.RESET_REMAINING)
+
+
+class HotKeyTracker:
+    """Windowed per-key hit-rate detector fed by the engine's apply windows.
+
+    The engine already stages every window's (slot, hits) rows host-side
+    before device dispatch; `feed_slots` accumulates them into a
+    capacity-sized counter array (two numpy bulk ops per window — no
+    per-key cost). Once per ``window_s`` the counters roll: slots whose
+    rate crossed ``rate_threshold`` are resolved to key strings — only
+    then, and only for the hot few — via the engine's directory
+    (`Engine.resolve_slots`). Native-single decides bypass staging, so
+    they feed by key (`feed_key`) into a dict counter merged at roll time.
+
+    Hot status lasts until the end of the *next* window (grants keep their
+    own TTLs, so a key cooling off simply stops renewing).
+    """
+
+    def __init__(self, capacity: int, rate_threshold: float,
+                 window_s: float, resolver=None):
+        self._capacity = int(capacity)
+        self._rate = float(rate_threshold)
+        self._window_s = float(window_s)
+        self._resolver = resolver  # callable([slot]) -> {slot: hash_key}
+        self._counts = np.zeros(self._capacity, dtype=np.int64)
+        self._key_counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._window_start = time.monotonic()
+        self._hot: Dict[str, float] = {}  # hash_key -> observed rate (hits/s)
+        self._has_hot = False
+        self.stats = {"windows": 0, "hot_keys": 0}
+
+    # ------------------------------------------------------------- feeding
+
+    def feed_slots(self, slots, hits) -> None:
+        """One staged apply window: `slots` i64 row (-1 = padding) and the
+        matching `hits` row, both host numpy."""
+        slots = np.asarray(slots).ravel()
+        hits = np.asarray(hits).ravel()
+        with self._lock:
+            m = (slots >= 0) & (slots < self._capacity)
+            if m.any():
+                np.add.at(self._counts, slots[m], hits[m])
+            self._maybe_roll_locked()
+
+    def feed_key(self, key: str, hits: int) -> None:
+        """Keyed feed for paths that never stage slot rows
+        (Engine.decide_native_single)."""
+        with self._lock:
+            self._key_counts[key] = self._key_counts.get(key, 0) + int(hits)
+            self._maybe_roll_locked()
+
+    # ------------------------------------------------------------- reading
+
+    def has_hot(self) -> bool:
+        """Lock-free fast guard for the serving path."""
+        return self._has_hot
+
+    def is_hot(self, key: str) -> bool:
+        return key in self._hot
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._hot)
+
+    # ----------------------------------------------------------- internals
+
+    def _maybe_roll_locked(self) -> None:
+        now = time.monotonic()
+        span = now - self._window_start
+        if span < self._window_s:
+            return
+        need = max(self._rate * span, 1.0)
+        hot: Dict[str, float] = {}
+        hot_slots = np.nonzero(self._counts >= need)[0]
+        if hot_slots.size and self._resolver is not None:
+            try:
+                names = self._resolver([int(s) for s in hot_slots])
+            except Exception:  # noqa: BLE001 — detection must not break serving
+                log.exception("hot-slot resolve failed")
+                names = {}
+            for s, key in names.items():
+                hot[key] = float(self._counts[int(s)]) / span
+        for key, cnt in self._key_counts.items():
+            if cnt >= need:
+                hot[key] = max(hot.get(key, 0.0), cnt / span)
+        self._hot = hot
+        self._has_hot = bool(hot)
+        self.stats["windows"] += 1
+        self.stats["hot_keys"] = len(hot)
+        # full reset each window: one memset per window_s, and the counters
+        # stay exact (decay schemes drift under bursty arrival)
+        self._counts.fill(0)
+        self._key_counts.clear()
+        self._window_start = now
+
+
+@dataclasses.dataclass
+class _Grant:
+    """Owner-side record of one outstanding lease."""
+    budget: int
+    minted: float      # monotonic seconds
+    expires: float     # monotonic seconds
+    seq: int
+
+
+@dataclasses.dataclass
+class _Held:
+    """Non-owner-side record of one held lease."""
+    owner: str
+    budget: int        # hits still answerable locally
+    expires: float     # monotonic seconds
+    seq: int
+    limit: int
+    remaining: int     # local approximate view, drained asynchronously
+    reset_ms: int
+
+
+class LeaseManager:
+    """Grant/renew/revoke lifecycle for one Instance — both roles.
+
+    Every instance is an owner for its keys and a potential leaseholder
+    for everyone else's, so one manager carries both tables:
+
+    - ``_grants`` (owner): per-key outstanding budget, minted against the
+      key's live *remaining* and throttled to one grant per half-TTL per
+      key so the drain-response renewal loop cannot inflate outstanding.
+    - ``_held`` (non-owner): per-key leased budget consumed by
+      ``try_consume`` on the routing path; exhaustion or TTL expiry makes
+      the next request forward normally, and that forward's response
+      carries the renewal.
+
+    All knobs are read live from ``instance.conf.behaviors`` so tests (and
+    SIGHUP-style reconfig) can flip them on a running instance; ``arm()``
+    builds the detector and hangs it on the backend.
+    """
+
+    def __init__(self, instance):
+        self.instance = instance
+        self._lock = threading.Lock()
+        self._grants: Dict[str, List[_Grant]] = {}
+        self._held: Dict[str, _Held] = {}
+        self._seq = 0
+        # non-owner ask heuristic (peerlink wire only): windowed count of
+        # forwards per key; keys crossing the same hot_lease_rate become
+        # local-hot and the next forward carries a lease ask
+        self._fwd_counts: Dict[str, int] = {}
+        self._fwd_window_start = time.monotonic()
+        self._local_hot: Dict[str, float] = {}
+        self.stats = {
+            "grants": 0, "granted_budget": 0, "denied_cold": 0,
+            "denied_exhausted": 0, "denied_throttled": 0, "shed_brownout": 0,
+            "installs": 0, "renewals": 0, "local_answers": 0,
+            "local_hits": 0, "drained_hits": 0, "expired_held": 0,
+            "expired_grants": 0, "revoked": 0,
+        }
+
+    # ------------------------------------------------------------- plumbing
+
+    @property
+    def _behaviors(self):
+        return self.instance.conf.behaviors
+
+    @property
+    def enabled(self) -> bool:
+        return bool(getattr(self._behaviors, "hot_leases", False))
+
+    @property
+    def _metrics(self):
+        return self.instance.conf.metrics
+
+    def _count(self, family: str, n: int = 1, reason: str = "") -> None:
+        m = self._metrics
+        if m is None:
+            return
+        try:
+            c = getattr(m, family)
+            (c.labels(reason=reason) if reason else c).inc(n)
+        except Exception:  # noqa: BLE001 — metrics must not break serving
+            pass
+
+    def arm(self) -> None:
+        """Build the hot-key detector and attach it to the backend.
+
+        Called from Instance.__init__ when ``hot_leases`` is set at
+        construction, and by tests that flip the knob on a live instance.
+        Idempotent; a backend without staging hooks (no ``hot_tracker``
+        attribute) degrades to keyed feeds only."""
+        backend = self.instance.backend
+        if getattr(backend, "hot_tracker", None) is not None:
+            return
+        b = self._behaviors
+        capacity = int(getattr(backend, "capacity", 0) or 0)
+        resolver = getattr(backend, "resolve_slots", None)
+        tracker = HotKeyTracker(
+            capacity=max(capacity, 1),
+            rate_threshold=getattr(b, "hot_lease_rate", 500.0),
+            window_s=getattr(b, "hot_lease_window_s", 1.0),
+            resolver=resolver,
+        )
+        try:
+            backend.hot_tracker = tracker
+        except AttributeError:
+            log.warning("backend %r cannot host a hot tracker",
+                        type(backend).__name__)
+
+    def tracker(self) -> Optional[HotKeyTracker]:
+        return getattr(self.instance.backend, "hot_tracker", None)
+
+    # ----------------------------------------------------------- owner side
+
+    def grant(self, key: str, remaining: int,
+              reset_ms: int = 0) -> Optional[tuple]:
+        """Mint one lease for `key` or return None.
+
+        Denials, in shed order: admission brownout first (grants are the
+        most shed-able work on the node — the asker just falls back to
+        strict forwarding), then cold keys, then per-key grant throttling
+        (one grant per half-TTL keeps the drain-response renewal loop from
+        inflating outstanding), then budget exhaustion
+        (``remaining - outstanding`` has nothing left to slice)."""
+        if not self.enabled:
+            return None
+        adm = self.instance.admission
+        if adm is not None and adm.enabled and adm.level() >= adm.BROWNOUT:
+            self.stats["shed_brownout"] += 1
+            self._count("lease_shed", reason="brownout")
+            return None
+        t = self.tracker()
+        if t is None or not t.is_hot(key):
+            self.stats["denied_cold"] += 1
+            return None
+        b = self._behaviors
+        ttl_ms = int(float(getattr(b, "hot_lease_ttl_s", 0.5)) * 1000)
+        if reset_ms > 0:
+            # never lease past the window reset: the budget is a slice of
+            # THIS window's remaining
+            left = reset_ms - int(time.time() * 1000)
+            if left <= 0:
+                self.stats["denied_exhausted"] += 1
+                return None
+            ttl_ms = min(ttl_ms, left)
+        fraction = float(getattr(b, "hot_lease_fraction", 0.2))
+        now = time.monotonic()
+        with self._lock:
+            grants = self._grants.get(key)
+            if grants:
+                live = [g for g in grants if g.expires > now]
+                self.stats["expired_grants"] += len(grants) - len(live)
+                if live:
+                    self._grants[key] = live
+                else:
+                    del self._grants[key]
+                grants = live
+            if grants and grants[-1].minted + ttl_ms / 2000.0 > now:
+                self.stats["denied_throttled"] += 1
+                return None
+            outstanding = sum(g.budget for g in grants) if grants else 0
+            budget = int((int(remaining) - outstanding) * fraction)
+            if budget <= 0:
+                self.stats["denied_exhausted"] += 1
+                return None
+            self._seq += 1
+            seq = self._seq
+            self._grants.setdefault(key, []).append(
+                _Grant(budget=budget, minted=now,
+                       expires=now + ttl_ms / 1000.0, seq=seq))
+            self.stats["grants"] += 1
+            self.stats["granted_budget"] += budget
+        self._count("lease_grants")
+        if log.isEnabledFor(logging.DEBUG):
+            log.debug("granted lease key=%s budget=%d ttl=%dms seq=%d",
+                      key, budget, ttl_ms, seq)
+        return budget, ttl_ms, seq
+
+    def attach_grants(self, requests: Sequence[RateLimitReq],
+                      responses: Sequence[RateLimitResp]) -> None:
+        """Owner: pin grants onto a forwarded batch's hot responses.
+
+        Walks the batch tail-first so the LAST occurrence of a duplicated
+        key — the one whose `remaining` reflects the whole batch — sizes
+        the grant. Exempt behaviors and error rows never carry one. The
+        peerlink wire does not call this (its client asks explicitly via
+        the METHOD_LEASE carrier); every metadata-bearing wire does."""
+        if not self.enabled:
+            return
+        t = self.tracker()
+        if t is None or not t.has_hot():
+            return
+        seen = set()
+        for req, resp in zip(reversed(list(requests)),
+                             reversed(list(responses))):
+            key = req.hash_key()
+            if key in seen:
+                continue
+            seen.add(key)
+            if resp.error or req.behavior & _LEASE_EXEMPT:
+                continue
+            if not t.is_hot(key):
+                continue
+            g = self.grant(key, resp.remaining, resp.reset_time)
+            if g is not None:
+                resp.metadata[GRANT_METADATA_KEY] = f"{g[0]}:{g[1]}:{g[2]}"
+
+    def outstanding(self, key: Optional[str] = None) -> int:
+        """Unexpired granted budget — per key, or the node total."""
+        now = time.monotonic()
+        with self._lock:
+            if key is not None:
+                return sum(g.budget for g in self._grants.get(key, ())
+                           if g.expires > now)
+            return sum(g.budget for gl in self._grants.values()
+                       for g in gl if g.expires > now)
+
+    def revoke(self, key: Optional[str] = None) -> int:
+        """Owner: forget outstanding grants (chaos drills, operator action
+        via faults/debug tooling). Local bookkeeping only — the holder's
+        copy dies at its TTL; that bounded staleness IS the protocol's
+        overshoot story, so revocation frees budget for new grants without
+        any recall RPC."""
+        with self._lock:
+            if key is None:
+                n = sum(len(gl) for gl in self._grants.values())
+                self._grants.clear()
+            else:
+                n = len(self._grants.pop(key, ()))
+            self.stats["revoked"] += n
+        return n
+
+    # ------------------------------------------------------- non-owner side
+
+    def install(self, key: str, owner_addr: str, resp: RateLimitResp,
+                encoded: str) -> None:
+        """Install/renew a grant that arrived on a forward response."""
+        try:
+            budget, ttl_ms, seq = (int(x) for x in encoded.split(":"))
+        except ValueError:
+            return
+        if budget <= 0 or ttl_ms <= 0:
+            return
+        now = time.monotonic()
+        with self._lock:
+            h = self._held.get(key)
+            if h is not None and h.seq >= seq:
+                return  # duplicate or out-of-order grant
+            renewal = h is not None
+            self._held[key] = _Held(
+                owner=owner_addr, budget=budget,
+                expires=now + ttl_ms / 1000.0, seq=seq,
+                limit=resp.limit, remaining=resp.remaining,
+                reset_ms=resp.reset_time)
+            self.stats["renewals" if renewal else "installs"] += 1
+        self._count("lease_installs")
+
+    def install_from_responses(self, reqs: Sequence[RateLimitReq],
+                               resps: Sequence[RateLimitResp],
+                               owner_addr: str) -> None:
+        """Scan a forward (or async-hit drain) response batch for grants.
+        The drain responses riding the GLOBAL hit pipeline make this the
+        steady-state renewal channel: no extra RPCs, and a broken drain
+        path automatically stops renewal too."""
+        if not self.enabled:
+            return
+        for req, resp in zip(reqs, resps):
+            enc = resp.metadata.get(GRANT_METADATA_KEY)
+            if enc:
+                self.install(req.hash_key(), owner_addr, resp, enc)
+
+    def try_consume(self, req: RateLimitReq,
+                    owner_addr: str) -> Optional[RateLimitResp]:
+        """Answer `req` from held leased budget, or None to forward.
+
+        None covers: leases off, nothing held for the key, exempt
+        behavior, peek (hits=0 wants the authoritative row), TTL expiry,
+        and budget exhaustion — in every case the caller's normal forward
+        doubles as the renewal request. A consumed answer drains its hits
+        to the owner on the GLOBAL async-hit pipeline."""
+        if not self.enabled or not self._held:
+            return None
+        if req.hits <= 0 or req.behavior & _LEASE_EXEMPT:
+            return None
+        key = req.hash_key()
+        now = time.monotonic()
+        with self._lock:
+            h = self._held.get(key)
+            if h is None:
+                return None
+            if h.expires <= now:
+                del self._held[key]
+                self.stats["expired_held"] += 1
+                self._count("lease_expired")
+                return None
+            if req.hits > h.budget:
+                self.stats["denied_exhausted"] += 1
+                return None
+            h.budget -= req.hits
+            h.remaining = max(h.remaining - req.hits, 0)
+            resp = RateLimitResp(
+                status=int(Status.UNDER_LIMIT),
+                limit=h.limit,
+                remaining=h.remaining,
+                reset_time=h.reset_ms,
+                metadata={LEASED_METADATA_KEY: "true", "owner": h.owner},
+            )
+            self.stats["local_answers"] += 1
+            self.stats["local_hits"] += req.hits
+            self.stats["drained_hits"] += req.hits
+        # drain OUTSIDE the lease lock: queue_hit takes the pipeline lock
+        self.instance.global_manager.queue_hit(req)
+        self._count("lease_local_answers")
+        self._count("lease_drained_hits", req.hits)
+        return resp
+
+    def drop_held(self, key: Optional[str] = None) -> int:
+        """Non-owner: abandon held leases (chaos drills/tests)."""
+        with self._lock:
+            if key is None:
+                n = len(self._held)
+                self._held.clear()
+            else:
+                n = 1 if self._held.pop(key, None) is not None else 0
+        return n
+
+    def held_count(self) -> int:
+        now = time.monotonic()
+        with self._lock:
+            return sum(1 for h in self._held.values() if h.expires > now)
+
+    # ------------------------------------------- peerlink ask heuristic
+
+    def note_forwards(self, reqs: Sequence[RateLimitReq]) -> None:
+        """Non-owner: count forwarded keys into the local hot window (the
+        owner can't see per-source rates over the peerlink wire, so the
+        asker detects its own hot forwards with the same rate knob)."""
+        if not self.enabled:
+            return
+        b = self._behaviors
+        window_s = float(getattr(b, "hot_lease_window_s", 1.0))
+        rate = float(getattr(b, "hot_lease_rate", 500.0))
+        now = time.monotonic()
+        with self._lock:
+            for r in reqs:
+                if r.hits > 0 and not r.behavior & _LEASE_EXEMPT:
+                    k = r.hash_key()
+                    self._fwd_counts[k] = self._fwd_counts.get(k, 0) + r.hits
+            span = now - self._fwd_window_start
+            if span >= window_s:
+                need = max(rate * span, 1.0)
+                self._local_hot = {
+                    k: c / span for k, c in self._fwd_counts.items()
+                    if c >= need}
+                self._fwd_counts.clear()
+                self._fwd_window_start = now
+
+    def want(self, reqs: Sequence[RateLimitReq]) -> Optional[str]:
+        """The hash key (if any) this forward should ask a lease for —
+        one carrier per frame, so the hottest eligible key wins."""
+        if not self.enabled or not self._local_hot:
+            return None
+        now = time.monotonic()
+        b = self._behaviors
+        ttl_s = float(getattr(b, "hot_lease_ttl_s", 0.5))
+        best, best_rate = None, 0.0
+        with self._lock:
+            for r in reqs:
+                k = r.hash_key()
+                rate = self._local_hot.get(k)
+                if rate is None or rate <= best_rate:
+                    continue
+                if r.behavior & _LEASE_EXEMPT:
+                    continue
+                h = self._held.get(k)
+                if h is not None and h.budget > r.hits \
+                        and h.expires - now > ttl_s / 4:
+                    continue  # current lease still comfortably serves
+                best, best_rate = k, rate
+        return best
+
+    # --------------------------------------------------------- observation
+
+    def health_note(self) -> str:
+        """One line for health_check. Lease state never flips a node
+        unhealthy — the tier is an optimization with strict-forwarding
+        fallback — so this only annotates the message."""
+        if not self.enabled:
+            return ""
+        held = self.held_count()
+        out = self.outstanding()
+        t = self.tracker()
+        hot = len(t.snapshot()) if t is not None else 0
+        if not (held or out or hot):
+            return ""
+        return (f"leases: {hot} hot keys, {held} held, "
+                f"{out} budget outstanding")
+
+    def debug(self) -> dict:
+        """/v1/debug/vars section (obs/introspect.py)."""
+        now = time.monotonic()
+        t = self.tracker()
+        with self._lock:
+            held = {
+                k: {"owner": h.owner, "budget": h.budget, "seq": h.seq,
+                    "ttl_ms": max(int((h.expires - now) * 1000), 0)}
+                for k, h in self._held.items()}
+            grants = {
+                k: [{"budget": g.budget, "seq": g.seq,
+                     "ttl_ms": max(int((g.expires - now) * 1000), 0)}
+                    for g in gl if g.expires > now]
+                for k, gl in self._grants.items()}
+        return {
+            "enabled": self.enabled,
+            "stats": dict(self.stats),
+            "hot": t.snapshot() if t is not None else {},
+            "held": held,
+            "grants": {k: v for k, v in grants.items() if v},
+            "outstanding_budget": self.outstanding(),
+        }

@@ -275,6 +275,65 @@ class Metrics:
             "GLOBAL pipeline depth (refreshed at scrape).",
             registry=self.registry,
         )
+        # hot-key lease tier (service/leases.py; docs/OPERATIONS.md
+        # "Skew & leases"). Counters increment live at the lease manager;
+        # the gauges refresh at scrape (observe_instance).
+        self.lease_grants = Counter(
+            "lease_grants_total",
+            "Hot-key lease grants minted by this node as an owner (each "
+            "hands a budget slice of the key's remaining limit to a "
+            "non-owner for one TTL).",
+            registry=self.registry,
+        )
+        self.lease_installs = Counter(
+            "lease_installs_total",
+            "Lease grants installed/renewed by this node as a non-owner "
+            "(arrived on forward responses or async-hit drain responses).",
+            registry=self.registry,
+        )
+        self.lease_local_answers = Counter(
+            "lease_local_answers_total",
+            "Requests answered locally from held lease budget instead of "
+            "forwarding to the owner.",
+            registry=self.registry,
+        )
+        self.lease_drained_hits = Counter(
+            "lease_drained_hits_total",
+            "Hits consumed against held leases and drained back to their "
+            "owners through the GLOBAL async-hit pipeline.",
+            registry=self.registry,
+        )
+        self.lease_expired = Counter(
+            "lease_expired_total",
+            "Held leases that died at their TTL without renewal (the "
+            "fail-closed path: an unreachable or browned-out owner stops "
+            "renewing and the key falls back to strict forwarding).",
+            registry=self.registry,
+        )
+        self.lease_shed = Counter(
+            "lease_shed_total",
+            "Lease grants/renewals refused by reason (brownout = grants "
+            "shed first under admission pressure).",
+            ["reason"], registry=self.registry,
+        )
+        self.lease_outstanding_budget = Gauge(
+            "lease_outstanding_budget",
+            "Unexpired granted budget outstanding on this owner — the "
+            "node's current worst-case over-admission bound "
+            "(limit + this value).",
+            registry=self.registry,
+        )
+        self.lease_held_keys = Gauge(
+            "lease_held_keys",
+            "Keys this non-owner currently serves from a live lease.",
+            registry=self.registry,
+        )
+        self.lease_hot_keys = Gauge(
+            "lease_hot_keys",
+            "Keys the hot-key tracker currently flags as over the "
+            "GUBER_HOT_LEASE_RATE detection threshold.",
+            registry=self.registry,
+        )
         self.request_budget_ms = Histogram(
             "request_budget_ms",
             "Deadline budget observed at capture, by surface (public = "
@@ -477,6 +536,13 @@ class Metrics:
         if mr is not None:
             for name, counter in self.multiregion.items():
                 self._set_counter(counter, mr.stats.get(name, 0))
+        lm = getattr(instance, "leases", None)
+        if lm is not None and lm.enabled:
+            self.lease_outstanding_budget.set(lm.outstanding())
+            self.lease_held_keys.set(lm.held_count())
+            tracker = lm.tracker()
+            if tracker is not None:
+                self.lease_hot_keys.set(len(tracker.snapshot()))
         cache = getattr(instance, "_global_cache", None)
         if cache is not None:
             self.global_cache_size.set(len(cache))

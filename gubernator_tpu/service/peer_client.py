@@ -170,6 +170,10 @@ class PeerClient:
         # False while in gRPC-fallback backoff
         self._link = None
         self._link_retry_at = 0.0
+        # set by the owning Instance to LeaseManager.want: lets the batch
+        # worker attach a hot-key lease ask to micro-batched flushes, the
+        # path where the Instance is not on the call stack
+        self.lease_advisor = None
 
     # ------------------------------------------------------- native link
 
@@ -355,7 +359,7 @@ class PeerClient:
 
     def get_peer_rate_limits(
         self, reqs: Sequence[RateLimitReq], wait_for_ready: bool = False,
-        trace_span=None, deadline=None,
+        trace_span=None, deadline=None, lease_want: Optional[str] = None,
     ) -> List[RateLimitResp]:
         """One peer call carrying the whole batch: the native link when the
         peer answers it (~4-5x cheaper than Python gRPC), else gRPC.
@@ -378,7 +382,14 @@ class PeerClient:
         GUBER_MIN_HOP_BUDGET_MS, and propagates the granted hop budget to
         the owner — `guber-deadline-ms` metadata over gRPC, a reserved
         carrier item behind METHOD_DEADLINE over peerlink — so every hop
-        works against a strictly smaller budget than its caller's."""
+        works against a strictly smaller budget than its caller's.
+
+        `lease_want` (service/leases.py) names a hash key this caller
+        wants a hot-key lease for. Over peerlink it rides a METHOD_LEASE
+        carrier and the owner's grant comes back in the carrier's own
+        response lane, re-materialized here as the same
+        `guber-lease` response metadata the gRPC wire carries natively —
+        Instance's install path never sees which wire answered."""
         if deadline is None:
             deadline = deadline_mod.current()
         timeout_s = self.conf.batch_timeout_s
@@ -404,12 +415,14 @@ class PeerClient:
             from gubernator_tpu.service.peerlink import (
                 METHOD_DEADLINE,
                 METHOD_GET_PEER_RATE_LIMITS,
+                METHOD_LEASE,
                 MAX_FRAME_ITEMS,
                 METHOD_TRACED,
                 PeerLinkError,
                 PeerLinkTimeout,
                 PeerLinkUnencodable,
                 deadline_carrier,
+                lease_carrier,
                 trace_carrier,
             )
 
@@ -421,6 +434,11 @@ class PeerClient:
             if hop_ms is not None:
                 flags |= METHOD_DEADLINE
                 carriers.append(deadline_carrier(hop_ms))
+            lease_lane = -1
+            if lease_want:
+                flags |= METHOD_LEASE
+                lease_lane = len(carriers)
+                carriers.append(lease_carrier(lease_want))
             try:
                 if carriers and \
                         len(reqs) + len(carriers) <= MAX_FRAME_ITEMS:
@@ -428,8 +446,23 @@ class PeerClient:
                         METHOD_GET_PEER_RATE_LIMITS | flags,
                         carriers + list(reqs), timeout_s)
                     self.circuit.record_success()
-                    # drop the carriers' placeholder lanes
-                    return resps[len(carriers):]
+                    body = resps[len(carriers):]
+                    if lease_lane >= 0:
+                        # grant encoding (peerlink._fill_lease_lane):
+                        # status = frame-relative index of the granted
+                        # item (-1 = no grant), limit = budget,
+                        # remaining = ttl_ms, reset = seq
+                        lane = resps[lease_lane]
+                        gi = int(lane.status)
+                        if 0 <= gi < len(body) and lane.limit > 0:
+                            from gubernator_tpu.service.leases import (
+                                GRANT_METADATA_KEY)
+
+                            body[gi].metadata[GRANT_METADATA_KEY] = (
+                                f"{lane.limit}:{lane.remaining}:"
+                                f"{lane.reset_time}")
+                    # the carriers' placeholder lanes are dropped
+                    return body
                 resps = link.call(METHOD_GET_PEER_RATE_LIMITS, list(reqs),
                                   timeout_s)
                 self.circuit.record_success()
@@ -608,10 +641,17 @@ class PeerClient:
             # budgeted co-riders still bound their own waits
             dl = None
         span = next((s for _, _, s, _ in live if s is not None), None)
+        reqs = [req for req, _, _, _ in live]
+        lease_want = None
+        if self.lease_advisor is not None:
+            try:
+                lease_want = self.lease_advisor(reqs)
+            except Exception:  # noqa: BLE001 — an ask is best-effort
+                lease_want = None
         try:
             resps = self.get_peer_rate_limits(
-                [req for req, _, _, _ in live], trace_span=span,
-                deadline=dl)
+                reqs, trace_span=span, deadline=dl,
+                lease_want=lease_want)
             if len(resps) != len(live):
                 raise RuntimeError(
                     f"server responded with incorrect rate limit list size: "

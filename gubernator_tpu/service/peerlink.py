@@ -64,8 +64,18 @@ TRACE_CARRIER_NAME = "tp"
 # the carrier reaches the Python workers intact. Carrier order when both
 # flags are set: trace first, deadline second.
 METHOD_DEADLINE = 0x40
-METHOD_FLAGS = METHOD_TRACED | METHOD_DEADLINE
+# Third reserved method-byte flag: the frame carries a hot-key lease ask
+# (service/leases.py — its unique_key holds the hash key the sender wants
+# a lease for). Same no-C++-change trick again; the peerlink response
+# format has no metadata column on the Python side, so the owner's grant
+# rides back IN the carrier's own response lane (_fill_lease_lane):
+# status = frame-relative index of the granted item (-1 = no grant),
+# limit = budget, remaining = ttl_ms, reset = seq. Carrier order when
+# several flags are set: trace, deadline, lease.
+METHOD_LEASE = 0x20
+METHOD_FLAGS = METHOD_TRACED | METHOD_DEADLINE | METHOD_LEASE
 DEADLINE_CARRIER_NAME = "dl"
+LEASE_CARRIER_NAME = "ls"
 
 
 def trace_carrier(span) -> RateLimitReq:
@@ -82,6 +92,13 @@ def deadline_carrier(budget_ms: float) -> RateLimitReq:
     decremented by the sender's elapsed time."""
     return RateLimitReq(name=DEADLINE_CARRIER_NAME,
                         unique_key=f"{budget_ms:.3f}")
+
+
+def lease_carrier(hash_key: str) -> RateLimitReq:
+    """The reserved carrier item of a LEASE frame (see METHOD_LEASE):
+    the hash key this sender wants a hot-key lease for. Its response
+    lane carries the owner's grant instead of a zero placeholder."""
+    return RateLimitReq(name=LEASE_CARRIER_NAME, unique_key=hash_key)
 
 
 # Columnar wire layout (see native/peerlink.cpp): fields ride as arrays,
@@ -1129,6 +1146,8 @@ class PeerLinkService:
         base = m & ~METHOD_FLAGS
         span = None
         dl = None
+        lease_lane = -1
+        lease_key = ""
         start = i
         if frame_start:
             if m & METHOD_TRACED and start < e:
@@ -1152,6 +1171,18 @@ class PeerLinkService:
                         note("peer", budget_ms)
                 self._fill_one(b, start, RateLimitResp(), errs, metas)
                 start += 1
+            if m & METHOD_LEASE and start < e:
+                lease_lane = start
+                lease_key = self._carrier_item(b, start)
+                # pre-fill the no-grant shape NOW (response buffers are
+                # reused across batches — every lane must be written even
+                # when the frame turns out to be carriers-only); the real
+                # grant overwrites it after the chunk is handled
+                b["status"][lease_lane] = -1
+                b["r_limit"][lease_lane] = 0
+                b["r_remaining"][lease_lane] = 0
+                b["r_reset"][lease_lane] = 0
+                start += 1
         if start >= e:
             return
         token = trace.use(span)
@@ -1168,6 +1199,38 @@ class PeerLinkService:
             trace.reset(token)
             if span is not None:
                 self.instance.tracer.finish(span)
+        if lease_lane >= 0 and base == METHOD_GET_PEER_RATE_LIMITS:
+            self._fill_lease_lane(b, lease_lane, start, e, lease_key)
+
+    def _fill_lease_lane(self, b: dict, lane: int, j: int, k: int,
+                         key: str) -> None:
+        """Answer a METHOD_LEASE ask: find the asked key's LAST occurrence
+        among the frame's handled items [j, k) — its response columns
+        reflect the whole frame's deductions — and overwrite the carrier's
+        response lane with the owner's grant (encoding documented at
+        METHOD_LEASE). The lane keeps its pre-filled no-grant shape when
+        the key is absent, cold, throttled, or shed."""
+        lm = getattr(self.instance, "leases", None)
+        if lm is None or not lm.enabled or not key:
+            return
+        koff, nlen, raw = b["key_off"], b["name_len"], b["keys"]
+        for i in range(k - 1, j - 1, -1):
+            lo, hi = int(koff[i]), int(koff[i + 1])
+            split = lo + int(nlen[i])
+            try:
+                if raw[lo:split].decode() + "_" + raw[split:hi].decode() \
+                        != key:
+                    continue
+            except UnicodeDecodeError:
+                continue
+            g = lm.grant(key, int(b["r_remaining"][i]),
+                         int(b["r_reset"][i]))
+            if g is not None:
+                b["status"][lane] = i - j
+                b["r_limit"][lane] = g[0]
+                b["r_remaining"][lane] = g[1]
+                b["r_reset"][lane] = g[2]
+            return
 
     def _object_chunk(self, m: int, j: int, k: int, b: dict,
                       errs: list, metas: list,

@@ -26,6 +26,45 @@ if not os.environ.get("GUBER_TEST_TPU"):
 import jax as _jax  # noqa: E402
 
 _cache_dir = os.path.join(os.path.dirname(__file__), ".jax_cache")
+
+# Self-healing for a poisoned cache: a run killed mid-write (OOM kill,
+# watchdog SIGKILL, ctrl-C at the wrong instant) can leave a truncated
+# entry that segfaults the NEXT run at deserialization time. Each session
+# drops a pid-stamped sentinel in the cache dir and removes it on clean
+# finish (pytest_sessionfinish below); finding a sentinel whose pid is no
+# longer alive means the previous run died uncleanly with the cache dir
+# open for writing — wipe it and recompile warm entries rather than risk
+# the segfault. A sentinel whose pid IS alive is a concurrent run sharing
+# the cache; leave it alone.
+_sentinel = os.path.join(_cache_dir, ".session.pid")
+
+
+def _stale_sentinel() -> bool:
+    try:
+        with open(_sentinel) as f:
+            pid = int(f.read().strip() or 0)
+    except (OSError, ValueError):
+        return False
+    if pid <= 0 or pid == os.getpid():
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return True  # recorded owner is dead: unclean shutdown
+    except PermissionError:
+        pass  # alive but not ours
+    return False
+
+
+if _stale_sentinel():
+    import shutil
+
+    shutil.rmtree(_cache_dir, ignore_errors=True)
+
+os.makedirs(_cache_dir, exist_ok=True)
+with open(_sentinel, "w") as _f:
+    _f.write(str(os.getpid()))
+
 _jax.config.update("jax_compilation_cache_dir", _cache_dir)
 _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
@@ -56,6 +95,14 @@ def pytest_configure(config):
 
 def pytest_sessionfinish(session, exitstatus):
     _session_exit["code"] = int(exitstatus)
+    # clean finish: retire the cache sentinel ONLY if this session still
+    # owns it (a concurrent run may have replaced it after wiping)
+    try:
+        with open(_sentinel) as f:
+            if int(f.read().strip() or 0) == os.getpid():
+                os.unlink(_sentinel)
+    except (OSError, ValueError):
+        pass
 
 
 def pytest_unconfigure(config):

