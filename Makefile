@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast bench bench-skew bench-suite soak chaos proto docker clean
+.PHONY: test test-fast bench bench-skew bench-wire bench-suite soak chaos proto docker clean native
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -18,6 +18,11 @@ bench:
 bench-skew:
 	python bench.py --skew
 
+# wire contract v1 vs v2 over a loopback peerlink, bare CPU rig plus a
+# link-emulated (BENCH_r05-class tunnel latency) regime (BENCH_r10)
+bench-wire:
+	python bench.py --wire
+
 bench-suite:
 	python scripts/bench_suite.py
 
@@ -33,6 +38,13 @@ chaos:
 	echo "chaos seed: $$seed"; \
 	GUBER_CHAOS_SEED=$$seed python -m pytest tests/ -q -s -m chaos
 
+# rebuild both native components (keydir.cpp, peerlink.cpp) plus their
+# tsan variants from source into the mtime-keyed .so cache names the
+# loaders expect; stale caches are deleted. tests/test_native_build.py is
+# the tier-1 drift check (a cached .so older than its source fails).
+native:
+	python scripts/build_native.py
+
 proto:
 	bash scripts/genproto.sh
 
@@ -41,4 +53,6 @@ docker:
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true
-	rm -f gubernator_tpu/native/_keydir_*.so
+	rm -f gubernator_tpu/native/_keydir_*.so \
+	      gubernator_tpu/native/_peerlink_*.so \
+	      gubernator_tpu/native/_tsan_*.so

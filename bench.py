@@ -701,6 +701,156 @@ def _skew_bench(n_calls: int = 1200, n_keys: int = 32,
         c.stop()
 
 
+class _LinkLagBackend:
+    """Bench-only engine wrapper emulating a LINK-BOUND rig on the CPU
+    fallback: a launched columnar group's readback lands `link_ms` after
+    dispatch (the transfer progresses in the background while the host
+    works, exactly how the BENCH_r05 tunnel rig behaves), so
+    collect_columnar_windows blocks only for the REMAINDER. A serving
+    loop that overlaps other work with in-flight readbacks pays nothing;
+    one that drains right after launching pays the full latency."""
+
+    def __init__(self, eng, link_ms: float):
+        self._eng = eng
+        self._lag = link_ms / 1e3
+        self._due = {}
+
+    def __getattr__(self, name):
+        return getattr(self._eng, name)
+
+    def launch_columnar_windows(self, *a, **kw):
+        h = self._eng.launch_columnar_windows(*a, **kw)
+        if h is not None:
+            self._due[id(h)] = time.perf_counter() + self._lag
+        return h
+
+    def collect_columnar_windows(self, h, outs):
+        wait = self._due.pop(id(h), 0) - time.perf_counter()
+        if wait > 0:
+            time.sleep(wait)
+        return self._eng.collect_columnar_windows(h, outs)
+
+
+def _wire_bench(n_frames: int = 48, frame_w: int = 1024,
+                inflight: int = 8, link_ms: float = 8.0) -> dict:
+    """Wire contract v1 vs v2 over a real loopback peerlink (BENCH_r10).
+
+    The client keeps `inflight` frames of `frame_w` requests in flight
+    (call_async closed loop, replenish-on-complete); the only variable
+    is the wire contract: v1 whole-frame replies with _worker_v1's
+    per-pull barrier (the PR-7 baseline) vs v2 seq-numbered partial
+    posts with cross-pull pipelining (_worker_v2). One worker, so the
+    contract itself — not worker-count parallelism — is what's measured;
+    frame_w spans four max_width=256 sub-windows so every pull carries
+    multiple scan groups.
+
+    Two regimes per contract: the bare CPU-fallback rig (zero-latency
+    loopback — the barrier has nothing to hide, so v1 and v2 should tie
+    within the partial-post overhead), and a LINK-EMULATED rig
+    (readbacks land `link_ms` after dispatch, BENCH_r05-class tunnel
+    latency) — the link-bound regime where the v1 contract drains the
+    pipeline at every pull boundary while v2 keeps it fed. The rows
+    record the negotiated version and the server's boundary-stall and
+    partial-post counters, so the win is attributable to removed
+    stalls, not noise."""
+    import collections
+
+    from gubernator_tpu.models.engine import Engine
+    from gubernator_tpu.service.config import InstanceConfig
+    from gubernator_tpu.service.instance import Instance
+    from gubernator_tpu.service.peerlink import (
+        METHOD_GET_PEER_RATE_LIMITS,
+        PeerLinkClient,
+        PeerLinkService,
+    )
+    from gubernator_tpu.types import RateLimitReq
+
+    med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+
+    def run(v2: bool, lag_ms: float) -> dict:
+        eng = Engine(capacity=1 << 17, min_width=8, max_width=256)
+        if not eng.supports_columnar():
+            raise RuntimeError("native columnar prep unavailable")
+        back = _LinkLagBackend(eng, lag_ms) if lag_ms else eng
+        inst = Instance(InstanceConfig(backend=back),
+                        advertise_address="self")
+        svc = PeerLinkService(inst, port=0, workers=1, pipeline_depth=3,
+                              pipeline_scan=2, wire_v2=v2)
+        cli = PeerLinkClient(f"127.0.0.1:{svc.port}", wire_v2=v2)
+        try:
+            def frame(i):
+                base = (i * frame_w) % (1 << 16)
+                return [RateLimitReq(
+                    name="w", unique_key=f"k{base + j}", hits=1,
+                    limit=1 << 30, duration=3_600_000)
+                    for j in range(frame_w)]
+
+            def drive(k):
+                pend = collections.deque()
+                i = 0
+                t0 = time.perf_counter()
+                while i < k or pend:
+                    while i < k and len(pend) < inflight:
+                        fut, _ = cli.call_async(
+                            METHOD_GET_PEER_RATE_LIMITS, frame(i))
+                        pend.append(fut)
+                        i += 1
+                    resps = pend.popleft().result(timeout=120)
+                    assert len(resps) == frame_w
+                return k * frame_w / (time.perf_counter() - t0)
+
+            drive(16)  # warm: compiles + server buffer ring
+            rate = med([drive(n_frames) for _ in range(3)])
+            return {
+                "decisions_per_sec": round(rate, 1),
+                "negotiated_version": cli.wire_version,
+                "partial_posts": svc.wire_partial_posts(),
+                "pull_boundary_stalls": svc.stats["pull_boundary_stalls"],
+            }
+        finally:
+            cli.close()
+            svc.close()
+            inst.close()
+
+    def pair(lag_ms: float) -> dict:
+        v1 = run(False, lag_ms)
+        v2 = run(True, lag_ms)
+        return {
+            "v1": v1,
+            "v2": v2,
+            "speedup_v2_vs_v1": round(
+                v2["decisions_per_sec"]
+                / max(v1["decisions_per_sec"], 1.0), 2),
+        }
+
+    cpu_rig = pair(0.0)
+    emulated = pair(link_ms)
+    return {
+        "wire_v2_speedup_link_bound": emulated["speedup_v2_vs_v1"],
+        "wire": {
+            "scope": "loopback peerlink, closed loop with "
+                     f"{inflight} x {frame_w}-request frames in flight, "
+                     "1 worker, pipelined columnar server (depth 3, "
+                     "scan 2, max_width 256); v1 = whole-frame + "
+                     "per-pull barrier, v2 = partial posts + cross-pull "
+                     "pipelining (docs/wire.md)",
+            "cpu_rig": cpu_rig,
+            "link_emulated": {
+                **emulated,
+                "link_ms": link_ms,
+                "note": "readbacks land link_ms after dispatch "
+                        "(BENCH_r05-class tunnel latency emulated on "
+                        "the CPU fallback; transfers progress while "
+                        "the host works) — the link-bound regime where "
+                        "the per-pull barrier is the structural cost",
+            },
+            "frames_per_run": n_frames,
+            "frame_width": frame_w,
+            "inflight_frames": inflight,
+        },
+    }
+
+
 def main() -> None:
     watchdog = _init_watchdog()
     import jax
@@ -1132,6 +1282,18 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — report, don't die
             skew_row = {"skew": {"error": str(e)}}
 
+    # ---- wire contract v2: partial posts vs the v1 whole-frame barrier ----
+    # A real loopback peerlink client/server pair, closed loop with frames
+    # in flight; BENCH_r10 records v1 vs v2 decisions/s plus the negotiated
+    # version and the server's partial-post/boundary-stall counters
+    # (opt-in via --wire; acceptance is v2 >= 1.3x the v1 pipelined row).
+    wire_row = {}
+    if "--wire" in sys.argv:
+        try:
+            wire_row = _wire_bench()
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            wire_row = {"wire": {"error": str(e)}}
+
     # trace-derived serving-stack phase split (never fails the bench)
     try:
         phases = phase_breakdown()
@@ -1148,6 +1310,7 @@ def main() -> None:
                 **columnar_row,
                 **overload_row,
                 **skew_row,
+                **wire_row,
                 **_multichip_section(),
                 "phase_breakdown_ms": phases,
                 "unit": UNIT,

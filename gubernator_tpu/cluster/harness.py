@@ -85,7 +85,10 @@ def wire_peerlink(cluster: "LocalCluster"):
         try:
             for i, ci in enumerate(cluster.instances):
                 attempt.append(
-                    PeerLinkService(ci.instance, port=ports[i] + offset))
+                    PeerLinkService(
+                        ci.instance, port=ports[i] + offset,
+                        wire_v2=getattr(
+                            ci.instance.conf.behaviors, "wire_v2", None)))
         except PeerLinkError:
             for svc in attempt:
                 svc.close()

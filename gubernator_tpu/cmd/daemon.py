@@ -391,7 +391,8 @@ def main(argv=None) -> int:
                 grpc_port=conf_grpc_port, grpc_host=conf_grpc_host,
                 metrics=metrics, pipeline_depth=columnar_depth,
                 pipeline_scan=conf.pipeline_scan,
-                columnar_pipeline=conf.columnar_pipeline)
+                columnar_pipeline=conf.columnar_pipeline,
+                wire_v2=conf.behaviors.wire_v2)
             port = conf_grpc_port
             metrics.set_native_front(peerlink.native_hits)
             log.info("native gRPC front on :%d (peerlink on %d, "
@@ -423,7 +424,8 @@ def main(argv=None) -> int:
                     instance, port=link_port, metrics=metrics,
                     pipeline_depth=columnar_depth,
                     pipeline_scan=conf.pipeline_scan,
-                    columnar_pipeline=conf.columnar_pipeline)
+                    columnar_pipeline=conf.columnar_pipeline,
+                    wire_v2=conf.behaviors.wire_v2)
                 log.info("peerlink serving on port %d", peerlink.port)
             except (PeerLinkError, RuntimeError) as e:
                 log.warning("peerlink disabled: %s (peer calls ride gRPC)",

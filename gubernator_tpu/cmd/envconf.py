@@ -239,6 +239,9 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         "GUBER_MULTI_REGION_SYNC_WAIT", b.multi_region_sync_wait_s)
     b.peer_link_offset = _env_int("GUBER_PEER_LINK_OFFSET", b.peer_link_offset)
     b.link_retry_s = _env_float("GUBER_LINK_RETRY_S", b.link_retry_s)
+    # wire contract v2 (docs/wire.md): resolved here so the daemon and
+    # every PeerClient see one consistent answer for the process
+    b.wire_v2 = os.environ.get("GUBER_WIRE_V2", "1") != "0"
 
     # peer-failure resilience (service/peer_client.py CircuitBreaker)
     b.circuit_threshold = _env_int("GUBER_CIRCUIT_THRESHOLD",

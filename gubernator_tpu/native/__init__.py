@@ -187,6 +187,10 @@ def load_peerlink() -> ctypes.CDLL:
         c = ctypes
         lib.pls_start.restype = c.c_void_p
         lib.pls_start.argtypes = [c.c_int, c.POINTER(c.c_int)]
+        # v2-capable start: third arg caps the negotiable wire contract
+        # (2 = greet clients / accept HELLO; 1 = byte-exact v1 server)
+        lib.pls_start2.restype = c.c_void_p
+        lib.pls_start2.argtypes = [c.c_int, c.POINTER(c.c_int), c.c_int]
         lib.pls_stop.argtypes = [c.c_void_p]
         lib.pls_free.argtypes = [c.c_void_p]
         lib.pls_port.restype = c.c_int
@@ -206,6 +210,20 @@ def load_peerlink() -> ctypes.CDLL:
             c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
             c.c_char_p, c.c_void_p, c.c_char_p,
         ]
+        lib.pls_send_partial.argtypes = [
+            # h, conn_token, rid, base, n, status, limit, remaining,
+            # reset, err_off, err_buf, meta_off, meta_buf — 13 params;
+            # err_off/meta_off are SPAN-relative (n+1 entries each)
+            c.c_void_p, c.c_ulonglong, c.c_ulonglong, c.c_int, c.c_int,
+            c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_char_p, c.c_void_p, c.c_char_p,
+        ]
+        lib.pls_pending_count.restype = c.c_longlong
+        lib.pls_pending_count.argtypes = [c.c_void_p]
+        lib.pls_partial_posts.restype = c.c_longlong
+        lib.pls_partial_posts.argtypes = [c.c_void_p]
+        lib.pls_v2_conns.restype = c.c_longlong
+        lib.pls_v2_conns.argtypes = [c.c_void_p]
         # ---- gRPC/HTTP/2 front ----
         lib.pls_start_grpc.restype = c.c_int
         lib.pls_start_grpc.argtypes = [c.c_void_p, c.c_int, c.c_char_p]

@@ -37,6 +37,31 @@
 // method 0 = GetRateLimits (public lean surface, router semantics),
 // method 1 = GetPeerRateLimits (owner apply). Responses echo rid/method.
 //
+// ---- wire contract v2 (docs/wire.md) ----
+// Real methods occupy 0x00..0xE1 (method | carrier flags 0x80/0x40/0x20);
+// the 0xF0..0xFF method range is reserved for CONTROL frames:
+//
+//   0xF0 GREETING  server -> client, sent on accept when the server can
+//                  speak v2. Shaped as a valid v1 reply frame (rid 0,
+//                  count 1, version in the status column) so a v1 client
+//                  parses it and drops the unknown rid silently.
+//   0xF1 HELLO     client -> server, sent only after a GREETING (so it
+//                  never reaches a v1 server). Body is the bare 11-byte
+//                  header; count carries the client's max version. Flips
+//                  the conn to v2.
+//   0xF2 PARTIAL   server -> client, v2 reply streaming: one contiguous
+//                  row-span of a rid's reply, sent as soon as the span's
+//                  rows finalize —
+//     u32 len | u64 rid | u8 0xF2 | u16 count | u16 seq | u16 base
+//             | u8 final | i32 status[count] | i64 limit[count]
+//             | i64 remaining[count] | i64 reset[count]
+//             | u16 err_len[count] | err blob
+//   seq is per-rid send order (client checks it), base the row offset
+//   inside the original request frame, final=1 on the span that
+//   completes the rid. Spans of DIFFERENT rids interleave freely; spans
+//   of one rid are seq-ordered. A whole v1 reply frame may still arrive
+//   for any rid (native fast path, error fill) and is authoritative.
+//
 // Threading: one epoll IO thread owns every socket. Parsed frames land on
 // a mutex+condvar queue; Python worker threads block in pls_next_batch()
 // (ctypes CDLL call -> GIL dropped) and wake with EVERYTHING pending —
@@ -71,6 +96,11 @@ namespace {
 
 constexpr uint32_t kMaxFrame = 4u << 20;  // 4 MB, > 1000-item batches
 
+// v2 control methods (header comment: "wire contract v2")
+constexpr uint8_t kMethodGreeting = 0xF0;
+constexpr uint8_t kMethodHello = 0xF1;
+constexpr uint8_t kMethodPartial = 0xF2;
+
 // The native lone-request fast path (VERDICT r2 item 6): a 1-item
 // GetPeerRateLimits frame can be decided right here in the IO thread —
 // keydir.cpp's decide_one against the key's row mirror — and answered
@@ -97,6 +127,15 @@ struct PendingReply {
   uint16_t expected = 0;
   uint16_t got = 0;
   uint32_t h2_stream = 0;  // nonzero: reply as a gRPC/H2 response
+  uint16_t next_seq = 0;   // v2 streaming: per-rid partial-frame order
+  // The conn's negotiated version WHEN THIS RID WAS PARSED. The HELLO
+  // races the client's first request frames (the client pipelines without
+  // waiting for the greeting round-trip), so a rid parsed pre-upgrade may
+  // start accumulating v1-style while the conn flips to v2 under it —
+  // branching on c->wire_version at post time would then stream only the
+  // post-upgrade spans and the client's reassembly would end with holes.
+  // Latching per-rid makes every rid all-whole-frame or all-partial.
+  bool wire_v2 = false;
   // columnar reply assembly, by item index
   std::vector<int32_t> status;
   std::vector<int64_t> limit, remaining, reset;
@@ -584,6 +623,9 @@ struct Conn {
   std::string outbuf;
   bool want_write = false;
   std::map<uint64_t, PendingReply> pending;  // rid -> reply assembly
+  // negotiated wire contract (guarded by s->mu): 1 until the client's
+  // HELLO lands; h2 conns never negotiate (gRPC framing is the contract)
+  int wire_version = 1;
 };
 
 struct Server {
@@ -621,6 +663,12 @@ struct Server {
   // accept method-0 (public GetRateLimits) frames too: only safe while
   // this node owns every key (no routing); re-armed on peer changes
   std::atomic<bool> native_public{false};
+
+  // ---- wire contract v2 ----
+  // set before the IO thread starts; >= 2 sends the GREETING on accept
+  int wire_v2_max = 1;
+  std::atomic<long long> partial_posts{0};  // v2 partial frames streamed
+  std::atomic<long long> v2_conns{0};       // conns that upgraded to v2
 };
 
 bool direct_send(Server* s, Conn* c, const std::string& frame);
@@ -680,6 +728,30 @@ bool try_native_single(Server* s, Conn* c, const Frame& f) {
   return true;
 }
 
+// The v2 GREETING, shaped as a valid v1 reply frame (rid 0 — client rids
+// start at 1 — method 0xF0, count 1, version in the status column) so a
+// v1 client parses it and drops the unknown rid without error.
+std::string greeting_frame() {
+  const uint16_t cnt = 1;
+  const uint16_t elen = 0;
+  const uint32_t len = 11 + (4 + 8 + 8 + 8 + 2);
+  const uint64_t rid = 0;
+  const int32_t version = 2;
+  const int64_t zero = 0;
+  std::string frame;
+  frame.reserve(4 + len);
+  frame.append((const char*)&len, 4);
+  frame.append((const char*)&rid, 8);
+  frame.push_back((char)kMethodGreeting);
+  frame.append((const char*)&cnt, 2);
+  frame.append((const char*)&version, 4);
+  frame.append((const char*)&zero, 8);
+  frame.append((const char*)&zero, 8);
+  frame.append((const char*)&zero, 8);
+  frame.append((const char*)&elen, 2);
+  return frame;
+}
+
 void set_nonblock(int fd) {
   int fl = fcntl(fd, F_GETFL, 0);
   fcntl(fd, F_SETFL, fl | O_NONBLOCK);
@@ -725,6 +797,20 @@ bool drain_inbuf(Server* s, Conn* c) {
     if (!rd(p, end, &f.rid)) return false;
     if (!rd(p, end, &f.method)) return false;
     if (!rd(p, end, &f.count)) return false;
+    if ((f.method & 0xF0) == 0xF0) {
+      // v2 control frame: HELLO upgrades the conn (count carries the
+      // client's max version); unknown control methods skip — forward
+      // compatibility, a bad control frame must not kill the conn
+      if (f.method == kMethodHello) {
+        std::lock_guard<std::mutex> g(s->mu);
+        const bool v2 = f.count >= 2 && s->wire_v2_max >= 2;
+        if (v2 && c->wire_version < 2)
+          s->v2_conns.fetch_add(1, std::memory_order_relaxed);
+        c->wire_version = v2 ? 2 : 1;
+      }
+      off += 4 + len;
+      continue;
+    }
     // bounds keep one frame always deliverable in a single pull
     // (count <= 1024 < MAX_N, fields <= 1024 B -> ~2 MB = KEY_CAP); a
     // count of 0 is rejected too — it could never complete a reply
@@ -754,6 +840,8 @@ bool drain_inbuf(Server* s, Conn* c) {
       pr.method = f.method;
       pr.expected = count;
       pr.got = 0;
+      pr.next_seq = 0;  // a reused rid restarts its partial stream
+      pr.wire_v2 = c->wire_version >= 2;
       pr.status.assign(count, 0);
       pr.limit.assign(count, 0);
       pr.remaining.assign(count, 0);
@@ -1062,6 +1150,8 @@ bool h2_route_complete(Server* s, Conn* c, uint32_t sid) {
     rep.h2_stream = sid;
     rep.expected = f.count;
     rep.got = 0;
+    rep.next_seq = 0;
+    rep.wire_v2 = false;  // H2 replies always leave whole
     rep.status.assign(f.count, 0);
     rep.limit.assign(f.count, 0);
     rep.remaining.assign(f.count, 0);
@@ -1306,6 +1396,50 @@ bool h2_drain(Server* s, Conn* c) {
   return true;
 }
 
+// Serialize a completed pending reply (v1 whole-frame or gRPC/H2) into
+// *out and erase the pending entry. Caller holds s->mu and has verified
+// pr.got == pr.expected. Shared by pls_send_responses and the v1/H2
+// accumulate path of pls_send_partial so both emit identical bytes.
+void finish_pending(Server* s, Conn* c,
+                    std::map<uint64_t, PendingReply>::iterator pit,
+                    std::string* out) {
+  PendingReply& pr = pit->second;
+  if (pr.h2_stream) {
+    // gRPC/H2 connection: serialize the pb response and send
+    std::string pb;
+    for (int j2 = 0; j2 < pr.expected; j2++) {
+      pb_put_resp_item(&pb, pr.status[j2], pr.limit[j2], pr.remaining[j2],
+                       pr.reset[j2], pr.err[j2], pr.meta[j2]);
+    }
+    const uint32_t sid2 = pr.h2_stream;
+    c->pending.erase(pit);
+    h2_append_response(s, c, sid2, pb, out);
+    return;
+  }
+  uint16_t cnt = pr.expected;
+  size_t ebytes = 0;
+  for (auto& e : pr.err) ebytes += e.size();
+  uint32_t len = 11 + cnt * (4 + 8 + 8 + 8 + 2) + (uint32_t)ebytes;
+  std::string frame;
+  frame.reserve(4 + len);
+  frame.append((const char*)&len, 4);
+  uint64_t r = pit->first;
+  frame.append((const char*)&r, 8);
+  frame.push_back((char)pr.method);
+  frame.append((const char*)&cnt, 2);
+  frame.append((const char*)pr.status.data(), cnt * 4);
+  frame.append((const char*)pr.limit.data(), cnt * 8);
+  frame.append((const char*)pr.remaining.data(), cnt * 8);
+  frame.append((const char*)pr.reset.data(), cnt * 8);
+  for (auto& e : pr.err) {
+    uint16_t el = (uint16_t)e.size();
+    frame.append((const char*)&el, 2);
+  }
+  for (auto& e : pr.err) frame += e;
+  c->pending.erase(pit);
+  *out += frame;
+}
+
 void io_loop(Server* s) {
   epoll_event evs[64];
   while (true) {
@@ -1333,7 +1467,12 @@ void io_loop(Server* s) {
             ev.events = EPOLLIN;
             ev.data.u64 = c->token;
             epoll_ctl(s->epoll_fd, EPOLL_CTL_ADD, fd, &ev);
-            s->conns[c->token] = std::move(c);
+            Conn* cp = c.get();
+            s->conns[cp->token] = std::move(c);
+            // server speaks first: v2-capable columnar conns get the
+            // GREETING; a v1 client parses-and-drops it (rid 0)
+            if (!cp->h2 && s->wire_v2_max >= 2)
+              direct_send(s, cp, greeting_frame());
           }
         }
         continue;
@@ -1389,8 +1528,12 @@ extern "C" {
 // insecure); deploy it on the peer network only, or set
 // GUBER_PEER_LINK_OFFSET=0 to disable and keep every peer call on gRPC.
 // Returns an opaque handle, or 0 on failure; *bound_port gets the port.
-void* pls_start(int port, int* bound_port) {
+// wire_v2_max caps the negotiable wire contract: >= 2 turns on the
+// GREETING/HELLO upgrade (GUBER_WIRE_V2), 1 keeps the server byte-exact
+// v1 — it never greets and ignores HELLOs.
+void* pls_start2(int port, int* bound_port, int wire_v2_max) {
   auto s = std::make_unique<Server>();
+  s->wire_v2_max = wire_v2_max;
   s->listen_fd = socket(AF_INET, SOCK_STREAM, 0);
   if (s->listen_fd < 0) return nullptr;
   int one = 1;
@@ -1422,6 +1565,12 @@ void* pls_start(int port, int* bound_port) {
   Server* raw = s.release();
   raw->io = std::thread(io_loop, raw);
   return raw;
+}
+
+// Legacy 2-arg ABI, kept so out-of-tree callers (tsan harness scripts)
+// stay valid: a v1-only server, bit-identical to the pre-v2 contract.
+void* pls_start(int port, int* bound_port) {
+  return pls_start2(port, bound_port, 1);
 }
 
 // Stop the IO thread and wake every blocked puller (they return -1).
@@ -1639,47 +1788,117 @@ void pls_send_responses(void* h, int n, const unsigned long long* conn_token,
       const int mlen = meta_off[i + 1] - meta_off[i];
       pr.meta[j].assign(meta_buf + meta_off[i], (size_t)mlen);
     }
-    if (pr.got == pr.expected && pr.h2_stream) {
-          // gRPC/H2 connection: serialize the pb response and send
-      std::string pb;
-      for (int j2 = 0; j2 < pr.expected; j2++) {
-        pb_put_resp_item(&pb, pr.status[j2], pr.limit[j2],
-                         pr.remaining[j2], pr.reset[j2], pr.err[j2],
-                         pr.meta[j2]);
-      }
-      const uint32_t sid2 = pr.h2_stream;
-      c->pending.erase(pit);
-      h2_append_response(s, c, sid2, pb, &acc[c]);
-      continue;
-    }
-    if (pr.got == pr.expected) {
-      uint16_t cnt = pr.expected;
-      size_t ebytes = 0;
-      for (auto& e : pr.err) ebytes += e.size();
-      uint32_t len = 11 + cnt * (4 + 8 + 8 + 8 + 2) + (uint32_t)ebytes;
-      std::string frame;
-      frame.reserve(4 + len);
-      frame.append((const char*)&len, 4);
-      uint64_t r = rid[i];
-      frame.append((const char*)&r, 8);
-      frame.push_back((char)pr.method);
-      frame.append((const char*)&cnt, 2);
-      frame.append((const char*)pr.status.data(), cnt * 4);
-      frame.append((const char*)pr.limit.data(), cnt * 8);
-      frame.append((const char*)pr.remaining.data(), cnt * 8);
-      frame.append((const char*)pr.reset.data(), cnt * 8);
-      for (auto& e : pr.err) {
-        uint16_t el = (uint16_t)e.size();
-        frame.append((const char*)&el, 2);
-      }
-      for (auto& e : pr.err) frame += e;
-      c->pending.erase(pit);
-      acc[c] += frame;
-    }
+    if (pr.got == pr.expected) finish_pending(s, c, pit, &acc[c]);
   }
   for (auto& [c, bytes] : acc) {
     if (!bytes.empty()) direct_send(s, c, bytes);
   }
+}
+
+// Post one contiguous row-span [base, base+n) of a rid's reply (wire
+// contract v2). On a negotiated-v2 columnar conn the span streams NOW as
+// a seq-numbered 0xF2 partial frame — per-rid seq order, cross-rid
+// interleaving free — and the pending entry is erased when the final
+// span posts. On a v1 conn or a gRPC/H2 stream the rows accumulate into
+// the pending entry and the reply leaves whole once complete, exactly as
+// pls_send_responses would send it: callers never branch on the peer's
+// version. err_off/meta_off are span-relative (n+1 entries); meta_off
+// may be null when no H2 metadata rides along.
+void pls_send_partial(void* h, unsigned long long conn_token,
+                      unsigned long long rid, int base, int n,
+                      const int* status, const long long* limit,
+                      const long long* remaining, const long long* reset,
+                      const int* err_off, const char* err_buf,
+                      const int* meta_off, const char* meta_buf) {
+  auto* s = (Server*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  auto cit = s->conns.find(conn_token);
+  if (cit == s->conns.end()) return;  // client vanished
+  Conn* c = cit->second.get();
+  auto pit = c->pending.find(rid);
+  if (pit == c->pending.end()) return;  // already final (or raced close)
+  PendingReply& pr = pit->second;
+  if (base < 0 || n <= 0 || base + n > (int)pr.expected) return;
+  if (pr.wire_v2 && pr.h2_stream == 0) {
+    int fresh = 0;
+    for (int k = 0; k < n; k++) {
+      if (!pr.filled[base + k]) {
+        pr.filled[base + k] = 1;
+        pr.got++;
+        fresh++;
+      }
+    }
+    if (fresh == 0) return;  // span already streamed
+    const uint16_t cnt = (uint16_t)n;
+    const uint16_t seq = pr.next_seq++;
+    const uint16_t b16 = (uint16_t)base;
+    const uint8_t fin = pr.got == pr.expected ? 1 : 0;
+    const size_t ebytes = (size_t)(err_off[n] - err_off[0]);
+    const uint32_t len =
+        11 + 5 + cnt * (4 + 8 + 8 + 8 + 2) + (uint32_t)ebytes;
+    std::string frame;
+    frame.reserve(4 + len);
+    frame.append((const char*)&len, 4);
+    uint64_t r = rid;
+    frame.append((const char*)&r, 8);
+    frame.push_back((char)kMethodPartial);
+    frame.append((const char*)&cnt, 2);
+    frame.append((const char*)&seq, 2);
+    frame.append((const char*)&b16, 2);
+    frame.push_back((char)fin);
+    frame.append((const char*)status, cnt * 4);
+    frame.append((const char*)limit, cnt * 8);
+    frame.append((const char*)remaining, cnt * 8);
+    frame.append((const char*)reset, cnt * 8);
+    for (int k = 0; k < n; k++) {
+      const uint16_t el = (uint16_t)(err_off[k + 1] - err_off[k]);
+      frame.append((const char*)&el, 2);
+    }
+    if (ebytes) frame.append(err_buf + err_off[0], ebytes);
+    if (fin) c->pending.erase(pit);
+    direct_send(s, c, frame);
+    s->partial_posts.fetch_add(1, std::memory_order_relaxed);
+    return;
+  }
+  // v1 / H2 destination: accumulate; the reply leaves whole when full
+  for (int k = 0; k < n; k++) {
+    const int j = base + k;
+    if (!pr.filled[j]) pr.got++;
+    pr.filled[j] = 1;
+    pr.status[j] = status[k];
+    pr.limit[j] = limit[k];
+    pr.remaining[j] = remaining[k];
+    pr.reset[j] = reset[k];
+    pr.err[j].assign(err_buf + err_off[k],
+                     (size_t)(err_off[k + 1] - err_off[k]));
+    if (meta_off != nullptr) {
+      pr.meta[j].assign(meta_buf + meta_off[k],
+                        (size_t)(meta_off[k + 1] - meta_off[k]));
+    }
+  }
+  if (pr.got == pr.expected) {
+    std::string out;
+    finish_pending(s, c, pit, &out);
+    if (!out.empty()) direct_send(s, c, out);
+  }
+}
+
+// Live reply-assembly entries across every conn: the leak probe the
+// wire-v2 tests assert on after disconnect/teardown.
+long long pls_pending_count(void* h) {
+  auto* s = (Server*)h;
+  std::lock_guard<std::mutex> g(s->mu);
+  long long total = 0;
+  for (auto& [tok, c] : s->conns) total += (long long)c->pending.size();
+  return total;
+}
+
+long long pls_partial_posts(void* h) {
+  return ((Server*)h)->partial_posts.load(std::memory_order_relaxed);
+}
+
+long long pls_v2_conns(void* h) {
+  return ((Server*)h)->v2_conns.load(std::memory_order_relaxed);
 }
 
 int pls_port(void* h) { return ((Server*)h)->port; }

@@ -79,6 +79,21 @@ def debug_vars(instance) -> dict:
             ],
         }
 
+    pls = getattr(instance, "peerlink_service", None)
+    if pls is not None:
+        # wire contract v2 occupancy (docs/wire.md): negotiated versions
+        # per outbound link plus the server side's partial-post counters —
+        # pending_replies at idle is the reassembly-leak probe
+        wire = dict(pls.wire_debug())
+        all_peers = getattr(instance, "all_peer_clients", None)
+        if callable(all_peers):
+            wire["peer_versions"] = {
+                p.info.address: p.link_wire_version()
+                for p in all_peers()
+                if hasattr(p, "link_wire_version")
+            }
+        out["wire"] = wire
+
     tracer = getattr(instance, "tracer", None)
     if tracer is not None:
         out["trace"] = {"sample": tracer.sample, "slow_ms": tracer.slow_ms,

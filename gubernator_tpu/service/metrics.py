@@ -225,6 +225,29 @@ class Metrics:
             "keys, gregorian, GLOBAL lanes force a pipeline drain).",
             registry=self.registry,
         )
+        # wire contract v2 (docs/wire.md; service/peerlink.py _worker_v2).
+        # pull_boundary_stalls counts the moments the worker had nothing to
+        # launch and fell back to draining inflight readbacks: on v1 that is
+        # the per-pull barrier the v2 contract removes, on v2 it only fires
+        # when the link itself runs dry.
+        self.peerlink_pull_boundary_stalls = Counter(
+            "peerlink_pull_boundary_stalls_total",
+            "Worker iterations stalled at a pull boundary waiting on "
+            "readbacks with no new requests to launch.",
+            registry=self.registry,
+        )
+        self.peerlink_wire_version = Gauge(
+            "peerlink_wire_version",
+            "Negotiated peerlink wire contract per peer (0 = no live "
+            "link, 1 = whole-frame, 2 = partial posts).",
+            ["peer"], registry=self.registry,
+        )
+        self.peerlink_partial_span_items = Histogram(
+            "peerlink_partial_span_items",
+            "Rows per pls_send_partial post (v2 sub-window spans).",
+            registry=self.registry,
+            buckets=(1, 8, 32, 64, 128, 256, 512, 1024),
+        )
         # peer-failure resilience (service/peer_client.py CircuitBreaker +
         # instance.py degraded-local serving; docs/OPERATIONS.md "Failure
         # modes"). circuit_open_total is LIVE (the breaker increments it at
@@ -511,6 +534,10 @@ class Metrics:
                 if circuit is not None:
                     self.circuit_state.labels(
                         peer=peer.info.address).set(circuit.state)
+                wv = getattr(peer, "link_wire_version", None)
+                if callable(wv):
+                    self.peerlink_wire_version.labels(
+                        peer=peer.info.address).set(wv())
         adm = getattr(instance, "admission", None)
         if adm is not None:
             self.admission_pending.set(adm.pending())

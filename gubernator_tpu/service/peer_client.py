@@ -211,7 +211,8 @@ class PeerClient:
         host, _, port = self.info.address.rpartition(":")
         try:
             link = PeerLinkClient(f"{host}:{int(port) + offset}",
-                                  fault_key=self.info.address)
+                                  fault_key=self.info.address,
+                                  wire_v2=getattr(self.conf, "wire_v2", None))
         except (OSError, ValueError, PeerLinkError):
             self._link_retry_at = time.monotonic() + self._link_retry_delay()
             return None
@@ -228,6 +229,14 @@ class PeerClient:
         if winner is not None and not winner._closed:
             return winner
         return None
+
+    def link_wire_version(self) -> int:
+        """Negotiated wire contract of the live link (0 = no live link).
+        Exposed as peerlink_wire_version{peer} at metrics exposition."""
+        link = self._link
+        if link is None or link is False or link._closed:
+            return 0
+        return getattr(link, "wire_version", 1)
 
     def _drop_link(self) -> None:
         with self._lock:

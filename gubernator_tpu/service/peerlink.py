@@ -174,6 +174,26 @@ class PeerLinkUnencodable(PeerLinkError):
 MAX_FIELD_BYTES = 1024
 MAX_FRAME_ITEMS = 1024
 
+# ---- wire contract v2 (docs/wire.md) ----
+# Reserved control-method range: real methods occupy 0x00..0xE1 (method |
+# carrier flags), so 0xF0..0xFF can carry control frames both ends of a
+# MIXED-version link tolerate: the GREETING is shaped as a valid v1 reply
+# frame with rid 0 (client rids start at 1 — a v1 client parses it and
+# drops the unknown rid), and the HELLO is only ever sent in answer to a
+# GREETING, so it never reaches a v1 server.
+WIRE_GREETING = 0xF0  # server -> client on accept: "I can speak v2"
+WIRE_HELLO = 0xF1     # client -> server: upgrade this conn to v2
+WIRE_PARTIAL = 0xF2   # server -> client: seq-numbered partial reply
+
+_PARTIAL_HDR = struct.Struct("<QBHHHB")  # rid, 0xF2, count, seq, base, final
+
+
+def _wire_v2_enabled() -> bool:
+    """GUBER_WIRE_V2=0 pins this process to the v1 whole-frame contract
+    on both ends (escape hatch — proven bit-identical by differential
+    test): the server never greets, the client never answers one."""
+    return os.environ.get("GUBER_WIRE_V2", "1") != "0"
+
 
 def encode_request_frame(rid: int, method: int,
                          reqs: Sequence[RateLimitReq]) -> bytes:
@@ -243,7 +263,20 @@ def encode_request_frame(rid: int, method: int,
 
 def decode_response_frame(payload: memoryview) -> List[RateLimitResp]:
     _rid, _method, count = struct.unpack_from("<QBH", payload, 0)
-    off = 11
+    return _decode_resp_items(payload, count, 11)
+
+
+def decode_partial_frame(payload: memoryview):
+    """Decode one v2 0xF2 partial reply frame (header layout documented
+    at WIRE_PARTIAL / docs/wire.md): (rid, seq, base, final, resps)."""
+    rid, _m, count, seq, base, fin = _PARTIAL_HDR.unpack_from(payload, 0)
+    return rid, seq, base, bool(fin), _decode_resp_items(payload, count, 16)
+
+
+def _decode_resp_items(payload: memoryview, count: int,
+                       off: int) -> List[RateLimitResp]:
+    """The response columns shared by the v1 whole frame and the v2
+    partial frame — same layout, different header length."""
     if count <= 4:  # mirror the tiny-frame encode fast path
         st = struct.unpack_from(f"<{count}i", payload, off)
         off += 4 * count
@@ -294,7 +327,7 @@ class PeerLinkClient:
     a reader thread demuxes responses by rid into futures."""
 
     def __init__(self, address: str, connect_timeout_s: float = 1.0,
-                 fault_key: str = ""):
+                 fault_key: str = "", wire_v2: Optional[bool] = None):
         host, _, port = address.rpartition(":")
         self.address = address
         # the fault-injection identity of this link (faults.py): PeerClient
@@ -311,6 +344,16 @@ class PeerLinkClient:
         self._flock = threading.Lock()
         self._rid = 0
         self._closed = False
+        # wire contract v2: stay at v1 until the server's GREETING proves
+        # it streams partial replies; the HELLO upgrade goes out from the
+        # reader thread. Reassembly state (guarded by _flock) must never
+        # outlive its future — call(), _fail and whole-frame arrival all
+        # clear it, so a dead rid cannot leak rows.
+        self._want_v2 = (_wire_v2_enabled() if wire_v2 is None
+                         else bool(wire_v2))
+        self.wire_version = 1
+        self._expected: Dict[int, int] = {}  # rid -> response count due
+        self._partial: Dict[int, list] = {}  # rid -> [rows, next_seq]
         self._reader = threading.Thread(
             target=self._read_loop, name=f"peerlink-read-{address}",
             daemon=True)
@@ -326,6 +369,8 @@ class PeerLinkClient:
         except FutureTimeout:
             with self._flock:
                 self._futures.pop(rid, None)
+                self._expected.pop(rid, None)
+                self._partial.pop(rid, None)
             raise PeerLinkTimeout("peerlink response timeout") from None
         except PeerLinkError as e:
             # the frame was already delivered to the socket when the link
@@ -360,6 +405,7 @@ class PeerLinkClient:
         fut: Future = Future()
         with self._flock:
             self._futures[rid] = fut
+            self._expected[rid] = len(reqs)
         try:
             with self._wlock:
                 self._sock.sendall(frame)
@@ -378,6 +424,12 @@ class PeerLinkClient:
 
     # ------------------------------------------------------------ internals
 
+    def partial_state_count(self) -> int:
+        """Live partial-reassembly entries (the leak probe the wire-v2
+        tests assert on after timeouts/disconnects)."""
+        with self._flock:
+            return len(self._partial)
+
     def _read_loop(self) -> None:
         buf = bytearray()
         try:
@@ -391,24 +443,99 @@ class PeerLinkClient:
                     if len(buf) - 4 < length:
                         break
                     payload = memoryview(buf)[4:4 + length]
-                    (rid,) = struct.unpack_from("<Q", payload, 0)
+                    rid, method = struct.unpack_from("<QB", payload, 0)
+                    if method >= WIRE_GREETING:
+                        self._control_frame(method, payload)
+                        del payload
+                        del buf[:4 + length]
+                        continue
                     resps = decode_response_frame(payload)
                     del payload
                     del buf[:4 + length]
                     with self._flock:
                         fut = self._futures.pop(rid, None)
+                        # a whole v1 frame is authoritative (native fast
+                        # path, server-side error fill): any partial
+                        # reassembly it supersedes is dropped
+                        self._expected.pop(rid, None)
+                        self._partial.pop(rid, None)
                     if fut is not None and not fut.done():
                         fut.set_result(resps)
         except Exception as e:  # noqa: BLE001 — reader dies: fail all waiters
             self._fail(e)
 
+    def _control_frame(self, method: int, payload: memoryview) -> None:
+        """One v2 control frame off the read loop (layouts: docs/wire.md).
+        Unknown control methods skip — forward compatibility; a raised
+        exception (out-of-contract partial stream) fails the link."""
+        if method == WIRE_GREETING:
+            # version rides in the status column of the v1-shaped greeting
+            (server_max,) = struct.unpack_from("<i", payload, 11)
+            if self._want_v2 and server_max >= 2 and not self._closed:
+                with self._wlock:
+                    self._sock.sendall(
+                        struct.pack("<IQBH", 11, 0, WIRE_HELLO, 2))
+                self.wire_version = 2
+            return
+        if method != WIRE_PARTIAL:
+            return
+        rid, seq, base, fin, items = decode_partial_frame(payload)
+        fire = None
+        rows: list = []
+        with self._flock:
+            n_exp = self._expected.get(rid)
+            if n_exp is None:
+                # the caller already gave up (timeout) or the rid was
+                # superseded by a whole frame: drop, never reassemble
+                self._partial.pop(rid, None)
+                return
+            st = self._partial.get(rid)
+            if st is None:
+                st = self._partial[rid] = [[None] * n_exp, 0]
+            rows = st[0]
+            if seq != st[1] or base + len(items) > n_exp:
+                raise PeerLinkError(
+                    f"partial reply out of contract (rid={rid} seq={seq} "
+                    f"want={st[1]} base={base} n={len(items)}/{n_exp})")
+            st[1] = seq + 1
+            rows[base:base + len(items)] = items
+            if fin:
+                if any(r is None for r in rows):
+                    raise PeerLinkError(
+                        f"final partial left holes (rid={rid})")
+                del self._partial[rid]
+                del self._expected[rid]
+                fire = self._futures.pop(rid, None)
+        if fire is not None and not fire.done():
+            fire.set_result(rows)
+
     def _fail(self, exc: Exception) -> None:
         self._closed = True
         with self._flock:
             futs, self._futures = self._futures, {}
+            self._expected.clear()
+            self._partial.clear()
         for fut in futs.values():
             if not fut.done():
                 fut.set_exception(PeerLinkError(str(exc)))
+
+
+class _PullCtx:
+    """One pull's buffers + reply bookkeeping on the v2 wire path: rows
+    post to the wire as their sub-windows finalize (pls_send_partial),
+    and in-flight launches may outlive _handle_batch, so the pull's
+    buffer set and its error/metadata sidecars must live until every
+    launch referencing them drains (live == 0)."""
+
+    __slots__ = ("b", "got", "errs", "metas", "live", "posted")
+
+    def __init__(self, b: dict, got: int):
+        self.b = b
+        self.got = got
+        self.errs: List[tuple] = []   # (item index, error bytes)
+        self.metas: List[tuple] = []  # (item index, pb metadata bytes)
+        self.live = 0    # launches in flight referencing these buffers
+        self.posted = 0  # rows handed to pls_send_partial so far
 
 
 class PeerLinkService:
@@ -420,7 +547,8 @@ class PeerLinkService:
     def __init__(self, instance, port: int = 0, workers: int = 2,
                  grpc_port: Optional[int] = None, grpc_host: str = "",
                  metrics=None, pipeline_depth=None, pipeline_scan=None,
-                 columnar_pipeline: Optional[bool] = None):
+                 columnar_pipeline: Optional[bool] = None,
+                 wire_v2: Optional[bool] = None):
         from gubernator_tpu import native
         from gubernator_tpu.native import load_peerlink
         from gubernator_tpu.service.combiner import (
@@ -444,9 +572,20 @@ class PeerLinkService:
                 "GUBER_COLUMNAR_PIPELINE", "1") != "0"
         self._col_pipe = bool(columnar_pipeline) and self._col_depth > 1
 
+        # wire contract v2 (docs/wire.md): the server greets v2-capable
+        # clients on accept and streams seq-numbered partial replies to
+        # them, which is what lets the worker pipeline ride ACROSS pull
+        # boundaries (_worker_v2). GUBER_WIRE_V2=0 pins the v1 whole-frame
+        # contract end to end — server never greets, worker keeps the
+        # per-pull barrier verbatim.
+        if wire_v2 is None:
+            wire_v2 = _wire_v2_enabled()
+        self._wire_v2 = bool(wire_v2)
+
         self._lib = load_peerlink()
         bound = ctypes.c_int(0)
-        self._handle = self._lib.pls_start(port, ctypes.byref(bound))
+        self._handle = self._lib.pls_start2(port, ctypes.byref(bound),
+                                            2 if self._wire_v2 else 1)
         if not self._handle:
             raise PeerLinkError(f"peerlink: cannot bind port {port}")
         self.port = bound.value
@@ -455,6 +594,12 @@ class PeerLinkService:
         # decided in C, the rest punts to the Python servicers below
         self.grpc_port: Optional[int] = None
         self._metrics = metrics
+        # new wire-v2 families, resolved once (older/minimal Metrics
+        # objects in tests may not carry them)
+        self._mt_stall = getattr(metrics, "peerlink_pull_boundary_stalls",
+                                 None)
+        self._mt_span = getattr(metrics, "peerlink_partial_span_items",
+                                None)
         if grpc_port is not None:
             gp = self._lib.pls_start_grpc(self._handle, grpc_port,
                                           grpc_host.encode())
@@ -465,10 +610,17 @@ class PeerLinkService:
                     f"peerlink: cannot bind gRPC port {grpc_port}")
             self.grpc_port = gp
         self.instance = instance
+        # /v1/debug/vars "wire" section (obs/introspect.py) reads live
+        # wire-contract state off this back-reference
+        instance.peerlink_service = self
         self.stats = {"batches": 0, "requests": 0, "errors": 0,
                       # pipelined columnar serving (_columnar_chunk)
                       "columnar_windows": 0, "columnar_groups": 0,
-                      "columnar_cuts": 0, "columnar_fill_stalls": 0}
+                      "columnar_cuts": 0, "columnar_fill_stalls": 0,
+                      # wire v2: times the worker had launches in flight
+                      # but nothing new to pull (v1 pays this EVERY pull;
+                      # ~0 under sustained v2 load = the win's receipt)
+                      "pull_boundary_stalls": 0}
         if metrics is not None and hasattr(metrics, "set_peerlink_stats"):
             # exports batches/requests/errors as peerlink_* families
             metrics.set_peerlink_stats(lambda: self.stats)
@@ -519,6 +671,26 @@ class PeerLinkService:
     def native_hits(self) -> int:
         """Lone requests answered by the C++ IO thread (no Python)."""
         return int(self._lib.pls_native_hits(self._handle))
+
+    def wire_partial_posts(self) -> int:
+        """v2 partial frames streamed so far (C++ counter)."""
+        return int(self._lib.pls_partial_posts(self._handle))
+
+    def wire_pending_count(self) -> int:
+        """Live C++ reply-assembly entries across every conn — the leak
+        probe the wire-v2 tests assert returns to zero."""
+        return int(self._lib.pls_pending_count(self._handle))
+
+    def wire_debug(self) -> dict:
+        """The /v1/debug/vars "wire" section: negotiated-contract state
+        and the partial-streaming counters."""
+        return {
+            "v2_enabled": self._wire_v2,
+            "v2_conns": int(self._lib.pls_v2_conns(self._handle)),
+            "partial_posts": self.wire_partial_posts(),
+            "pending_replies": self.wire_pending_count(),
+            "pull_boundary_stalls": self.stats["pull_boundary_stalls"],
+        }
 
     def _rearm_public(self) -> None:
         sole = bool(getattr(self.instance, "is_sole_owner",
@@ -628,6 +800,8 @@ class PeerLinkService:
 
     def close(self) -> None:
         self._stop = True
+        if getattr(self.instance, "peerlink_service", None) is self:
+            self.instance.peerlink_service = None
         # a stale peer-change listener would poke the freed native handle
         if hasattr(self.instance, "off_peers_change"):
             self.instance.off_peers_change(self._rearm_public)
@@ -642,7 +816,13 @@ class PeerLinkService:
 
     # ------------------------------------------------------------ internals
 
-    def _worker(self) -> None:
+    def _mk_pull_bufs(self) -> dict:
+        """One pull-buffer set: request columns in, response rows out,
+        plus the pre-built ctypes argument tuples pls_next_batch and
+        pls_send_responses consume (pointers are stable — the arrays
+        never reallocate). The v1 worker owns one set; the v2 worker
+        rotates a ring so the next pull preps while launches against
+        earlier sets are still in flight."""
         n = self.MAX_N
         b = {
             "keys": ctypes.create_string_buffer(self.KEY_CAP),
@@ -670,14 +850,31 @@ class PeerLinkService:
         def p(a):
             return a.ctypes.data_as(ctypes.c_void_p)
 
-        args = (b["keys"], self.KEY_CAP, p(b["key_off"]), p(b["name_len"]),
-                p(b["hits"]), p(b["limit"]), p(b["duration"]),
-                p(b["algorithm"]), p(b["behavior"]), p(b["method"]),
-                p(b["idx"]), p(b["conn"]), p(b["rid"]), n)
-        resp_ptrs = (p(b["conn"]), p(b["rid"]), p(b["idx"]), p(b["status"]),
-                     p(b["r_limit"]), p(b["r_remaining"]), p(b["r_reset"]),
-                     p(b["err_off"]))
-        meta_ptr = p(b["meta_off"])
+        b["args"] = (b["keys"], self.KEY_CAP, p(b["key_off"]),
+                     p(b["name_len"]), p(b["hits"]), p(b["limit"]),
+                     p(b["duration"]), p(b["algorithm"]), p(b["behavior"]),
+                     p(b["method"]), p(b["idx"]), p(b["conn"]), p(b["rid"]),
+                     n)
+        b["resp_ptrs"] = (p(b["conn"]), p(b["rid"]), p(b["idx"]),
+                          p(b["status"]), p(b["r_limit"]),
+                          p(b["r_remaining"]), p(b["r_reset"]),
+                          p(b["err_off"]))
+        b["meta_ptr"] = p(b["meta_off"])
+        return b
+
+    def _worker(self) -> None:
+        if self._wire_v2:
+            self._worker_v2()
+        else:
+            self._worker_v1()
+
+    def _worker_v1(self) -> None:
+        """The v1 whole-frame loop, kept verbatim: every pull is handled,
+        answered with ONE pls_send_responses, and only then is the next
+        pull taken — the per-pull barrier GUBER_WIRE_V2=0 promises (and
+        the differential tests prove bit-identical)."""
+        b = self._mk_pull_bufs()
+        args, resp_ptrs, meta_ptr = b["args"], b["resp_ptrs"], b["meta_ptr"]
         while not self._stop:
             got = self._lib.pls_next_batch(
                 self._handle, 200_000, *args)  # 200 ms idle tick
@@ -709,6 +906,197 @@ class PeerLinkService:
                 log.exception("peerlink send_responses failed")
                 self.stats["errors"] += 1
 
+    def _worker_v2(self) -> None:
+        """The cross-pull pipelined loop (wire contract v2): columnar
+        launches stay in flight ACROSS pull boundaries — while a group
+        rides the device its earlier rows are already on the wire as
+        partial frames (_post_span), and the next pull preps into a
+        DIFFERENT buffer set of the ring. A set is reused only once no
+        in-flight launch references it, so with more sets than pipeline
+        depth the ring blocks only when the device is the bottleneck
+        anyway. This removes the v1 contract's per-pull barrier: the
+        worker polls for new frames while work is in flight and counts a
+        pull_boundary_stall each time the poll comes back empty (v1 paid
+        that stall at EVERY pull)."""
+        depth = self._col_depth if self._col_pipe else 1
+        nsets = min(depth, 4) + 1
+        sets = [self._mk_pull_bufs() for _ in range(nsets)]
+        ws = {
+            # (eng, handle, gspans, ctx, method) in dispatch order — the
+            # shared pipeline every columnar chunk launches into
+            "inflight": collections.deque(),
+            # worker-level staging ring with a MONOTONIC slot cursor:
+            # per-chunk cursors would reuse slot 0 across chunks/pulls
+            # while a launch still holds it
+            "staging": [dict() for _ in range(depth + 2)],
+            "seq": 0,
+            "ctxs": [None] * nsets,  # the ctx last prepped into each set
+            "cur": 0,
+        }
+        while not self._stop:
+            cur = ws["cur"]
+            old = ws["ctxs"][cur]
+            while old is not None and old.live > 0 and ws["inflight"]:
+                self._drain_one_entry(ws)  # free this set's buffers
+            b = sets[cur]
+            if ws["inflight"]:
+                got = self._lib.pls_next_batch(self._handle, 0, *b["args"])
+                if got == 0:
+                    # launches in flight, nothing new to pull: the v1
+                    # contract drained the WHOLE pipe here every pull —
+                    # count the boundary stall the v2 contract removes,
+                    # retire the oldest launch, poll again
+                    self.stats["pull_boundary_stalls"] += 1
+                    if self._mt_stall is not None:
+                        self._mt_stall.inc()
+                    self._drain_one_entry(ws)
+                    continue
+            else:
+                got = self._lib.pls_next_batch(
+                    self._handle, 200_000, *b["args"])  # 200 ms idle tick
+            if got < 0:
+                try:
+                    self._drain_all(ws)  # stopping: settle device work
+                except Exception:  # noqa: BLE001
+                    log.exception("peerlink drain on stop failed")
+                return
+            if got == 0:
+                continue
+            ctx = _PullCtx(b, got)
+            ws["ctxs"][cur] = ctx
+            ws["cur"] = (cur + 1) % nsets
+            try:
+                self._handle_batch(got, b, ctx=ctx, ws=ws)
+            except Exception:  # noqa: BLE001 — a worker must never die
+                log.exception("peerlink batch failed")
+                self.stats["errors"] += 1
+                self._recover_batch(ws, ctx)
+
+    def _recover_batch(self, ws: dict, ctx: _PullCtx) -> None:
+        """Exception recovery on the v2 path: settle the shared pipeline,
+        then answer EVERY row of the failed pull with an error reply via
+        pls_send_responses — rids already streamed to completion are
+        skipped by C++ (their pending entries are gone), partially
+        streamed rids complete as an authoritative whole error frame,
+        untouched rids get the plain v1 error fill. Nothing hangs."""
+        try:
+            self._drain_all(ws)
+        except Exception:  # noqa: BLE001 — drain blew up too: drop refs
+            log.exception("peerlink pipeline drain failed")
+            ws["inflight"].clear()
+            for c2 in ws["ctxs"]:
+                if c2 is not None:
+                    c2.live = 0
+        b, got = ctx.b, ctx.got
+        err_buf = self._fail_batch(got, b)
+        b["meta_off"][:got + 1] = 0
+        try:
+            self._lib.pls_send_responses(
+                self._handle, got, *b["resp_ptrs"], err_buf,
+                b["meta_ptr"], b"")
+        except Exception:  # noqa: BLE001
+            log.exception("peerlink send_responses failed")
+            self.stats["errors"] += 1
+        ctx.errs.clear()
+        ctx.metas.clear()
+        ctx.posted = ctx.got
+
+    def _drain_one_entry(self, ws: dict) -> Optional[str]:
+        """Collect the OLDEST in-flight launch (dispatch order = per-key
+        order), retire its cut leftovers through the object path, and
+        post the group's finalized rows to the wire. Returns the
+        handle's over-commit message (or None)."""
+        eng, handle, gspans, ctx, m = ws["inflight"].popleft()
+        ctx.live -= 1
+        if not gspans:  # consumed nothing (over-commit at window 0)
+            return handle[1]
+        b = ctx.b
+        outs = [self._col_outs(b, s0, s1) for s0, s1 in gspans]
+        leftovers = eng.collect_columnar_windows(handle, outs)
+        for (s0, _s1), left in zip(gspans, leftovers):
+            if left is not None and len(left):
+                self._leftover_items(m, s0, left.tolist(), b, ctx.errs,
+                                     ctx.metas)
+        self._post_span(ctx, gspans[0][0], gspans[-1][1])
+        return handle[1]
+
+    def _drain_all(self, ws: dict) -> Optional[str]:
+        """Pipeline barrier: drain every in-flight launch in dispatch
+        order. Returns the last over-commit message seen (or None)."""
+        msg = None
+        while ws["inflight"]:
+            msg = self._drain_one_entry(ws) or msg
+        return msg
+
+    def _post_span(self, ctx: _PullCtx, lo: int, hi: int) -> None:
+        """Post finalized rows [lo, hi) of a pull to the wire, one
+        pls_send_partial per (conn, rid) run: C++ streams the span NOW to
+        a v2 peer (seq-numbered partial frame) and accumulates the v1/H2
+        whole-frame contract otherwise. base is frame-relative
+        (b["idx"]), so one rid's runs may post in any base order across
+        calls — seq keeps the client's reassembly honest."""
+        if hi <= lo:
+            return
+        b = ctx.b
+        rids, conns, idxs = b["rid"], b["conn"], b["idx"]
+        cast = ctypes.c_void_p
+        i = lo
+        while i < hi:
+            e = i + 1
+            # a run must not cross a FRAME boundary: a client may reuse a
+            # rid back-to-back (duplicate-rid fuzz), which (conn, rid)
+            # equality alone would merge into one oversized span that the
+            # C++ bounds check rejects — and the rid then never completes.
+            # Within a frame the pull keeps items contiguous, so idx
+            # advances by exactly 1; anything else starts a new frame.
+            while (e < hi and rids[e] == rids[i] and conns[e] == conns[i]
+                   and idxs[e] == idxs[e - 1] + 1):
+                e += 1
+            eo, eb = self._run_sidecar(ctx.errs, i, e)
+            mo, mb = self._run_sidecar(ctx.metas, i, e)
+            self._lib.pls_send_partial(
+                self._handle, int(conns[i]), int(rids[i]),
+                int(b["idx"][i]), e - i,
+                b["status"][i:e].ctypes.data_as(cast),
+                b["r_limit"][i:e].ctypes.data_as(cast),
+                b["r_remaining"][i:e].ctypes.data_as(cast),
+                b["r_reset"][i:e].ctypes.data_as(cast),
+                eo.ctypes.data_as(cast), eb, mo.ctypes.data_as(cast), mb)
+            if self._mt_span is not None:
+                self._mt_span.observe(e - i)
+            i = e
+        ctx.posted += hi - lo
+
+    @staticmethod
+    def _run_sidecar(pairs: list, lo: int, hi: int):
+        """Extract the (index, bytes) sidecar entries for items [lo, hi)
+        as a span-relative offset column + blob, REMOVING them from the
+        list (each row posts exactly once). Entries may sit out of index
+        order — inline object retirement interleaves with group drains."""
+        n = hi - lo
+        off = np.zeros(n + 1, np.int32)
+        if not pairs:
+            return off, b""
+        mine: Dict[int, bytes] = {}
+        keep = []
+        for t in pairs:
+            if lo <= t[0] < hi:
+                mine[t[0]] = t[1]
+            else:
+                keep.append(t)
+        if not mine:
+            return off, b""
+        pairs[:] = keep
+        total = 0
+        blob = []
+        for o in range(n):
+            seg = mine.get(lo + o)
+            if seg:
+                blob.append(seg)
+                total += len(seg)
+            off[o + 1] = total
+        return off, b"".join(blob)
+
     @staticmethod
     def _fail_batch(got: int, b: dict) -> bytes:
         """Last-resort response fill: every item in the pull gets an error
@@ -721,9 +1109,14 @@ class PeerLinkService:
         b["err_off"][:got + 1] = np.arange(got + 1, dtype=np.int32) * len(msg)
         return msg * got
 
-    def _handle_batch(self, got: int, b: dict) -> bytes:
+    def _handle_batch(self, got: int, b: dict, ctx: "_PullCtx" = None,
+                      ws: dict = None) -> tuple:
         """Decode -> handler calls -> fill the reusable response buffers.
-        Returns the concatenated error-string buffer.
+        v1 (ctx None): returns the (error, metadata) sidecar buffers for
+        the caller's single pls_send_responses. v2 (ctx set): every row
+        posts to the wire THROUGH this call via _post_span — per chunk
+        for carrier/object chunks, per drained group for columnar chunks,
+        which may leave clean groups in flight in ws when it returns.
 
         Peer-hop chunks ride the COLUMNAR path when the backend offers it
         (Engine.launch_columnar_windows / submit_columnar): the wire
@@ -756,10 +1149,26 @@ class PeerLinkService:
             self._count_rpc("GetPeerRateLimits", True, n1)
             self._frames_in_batch = (n0, n1)
         method = b["method"]
-        errs: List[tuple] = []  # (item index, error bytes), ascending
-        metas: List[tuple] = []  # (item index, encoded pb metadata)
+        if ctx is not None:  # v2: sidecars live with the pull's buffers
+            errs, metas = ctx.errs, ctx.metas
+        else:
+            errs = []   # (item index, error bytes), ascending
+            metas = []  # (item index, encoded pb metadata)
         cb = getattr(self.instance, "columnar_backend", None)
         eng = cb() if callable(cb) else None
+
+        # a lone non-slow miss seeds the IO-thread mirror below. The seed
+        # snapshots the key's device row, so it must install BEFORE the
+        # reply reaches the wire: once the client can send the key's next
+        # request, a late seed would overwrite natively-applied hits with
+        # the stale snapshot (the v1 loop got this ordering for free —
+        # it sent the whole frame after _handle_batch returned)
+        lone_seed = (
+            got == 1 and self._seed_engine is not None
+            and (int(method[0]) == METHOD_GET_PEER_RATE_LIMITS
+                 or (int(method[0]) == METHOD_GET_RATE_LIMITS
+                     and self._public_fast))
+            and not (int(b["behavior"][0]) & _COLUMNAR_SLOW_MASK))
 
         # one handler call per contiguous same-method run (chunked at the
         # batch cap — the aggregation may have merged many frames)
@@ -781,17 +1190,31 @@ class PeerLinkService:
                 # (a traced window's wait is part of the phase picture; a
                 # budgeted window's wait is where its budget dies)
                 self._carrier_chunk(m, j, k, b, errs, metas)
+                if ctx is not None:
+                    # post AFTER the whole carrier frame handling — the
+                    # lease grant overwrites its lane last
+                    self._post_span(ctx, j, k)
+            elif ctx is not None:
+                if lone_seed:
+                    # seed-ordering: decide lock-step WITHOUT posting;
+                    # the seed block below runs first, then the post
+                    if not (columnar_ok and self._columnar_chunk(
+                            m, eng, j, k, b, errs, metas)):
+                        self._object_chunk(m, j, k, b, errs, metas)
+                # v2: the columnar path posts its own spans as groups
+                # drain (and may leave clean groups in flight); object
+                # chunks post whole here
+                elif not (columnar_ok and self._columnar_chunk_v2(
+                        m, eng, j, k, ctx, ws)):
+                    self._object_chunk(m, j, k, b, errs, metas)
+                    self._post_span(ctx, j, k)
             elif not (columnar_ok
                       and self._columnar_chunk(m, eng, j, k, b, errs,
                                                metas)):
                 self._object_chunk(m, j, k, b, errs, metas)
             j = k
 
-        if got == 1 and self._seed_engine is not None and \
-                (int(method[0]) == METHOD_GET_PEER_RATE_LIMITS
-                 or (int(method[0]) == METHOD_GET_RATE_LIMITS
-                     and self._public_fast)) and \
-                not (int(b["behavior"][0]) & _COLUMNAR_SLOW_MASK):
+        if lone_seed:
             # a lone peer-hop reached Python = the IO-thread fast path
             # missed (cold/invalidated mirror). Seed it so the NEXT lone
             # request for this key decides natively.
@@ -803,6 +1226,8 @@ class PeerLinkService:
                     + b["keys"][split:hi].decode())
             except Exception:  # noqa: BLE001 — seeding is best-effort
                 pass
+            if ctx is not None:
+                self._post_span(ctx, 0, got)  # mirror installed: post now
 
         if self._metrics is not None and got:
             # every frame in the pull experienced ~this service time (the
@@ -824,6 +1249,8 @@ class PeerLinkService:
                         method="GetPeerRateLimits").observe(ms)
             except Exception:  # noqa: BLE001
                 pass
+        if ctx is not None:
+            return None, None  # every row already posted (or in flight)
         return (self._sparse(errs, b["err_off"], got),
                 self._sparse(metas, b["meta_off"], got))
 
@@ -908,13 +1335,15 @@ class PeerLinkService:
         chunks and GUBER_COLUMNAR_PIPELINE=0 (or depth 1) keep the
         lock-step path.
 
-        Overlap is INTRA-pull by design: pls_send_responses posts one
-        response frame set per pull (C++ Conn::pending retires whole),
-        so a window's rows cannot post early and launches cannot ride
-        across pull boundaries without a C++ response-contract change —
-        the pull's own width (up to MAX_N items = many sub-windows) is
-        what the pipeline overlaps. False = the engine can't take the
-        shape at all (nothing mutated)."""
+        Overlap here is INTRA-pull: the v1 response contract posts one
+        whole frame set per pull (C++ Conn::pending retires whole), so a
+        window's rows cannot post early and launches cannot ride across
+        pull boundaries — the pull's own width (up to MAX_N items = many
+        sub-windows) is what this path overlaps. The v2 wire contract
+        removes exactly that barrier (_columnar_chunk_v2 + _worker_v2:
+        partial posting via pls_send_partial); this path is kept verbatim
+        for v1 peers and GUBER_WIRE_V2=0. False = the engine can't take
+        the shape at all (nothing mutated)."""
         adm = getattr(self.instance, "admission", None)
         if adm is not None and adm.enabled and adm.level() >= adm.SATURATED:
             # saturated: demote the chunk to the object path, whose
@@ -1001,6 +1430,14 @@ class PeerLinkService:
             if not inflight:
                 continue
             if barrier or wi >= n_spans:
+                if not barrier:
+                    # the v1 response contract forces this full drain at
+                    # the chunk/pull boundary — the stall wire v2 removes
+                    # (counted on both paths so BENCH_r10 can attribute
+                    # the win to its absence)
+                    self.stats["pull_boundary_stalls"] += 1
+                    if self._mt_stall is not None:
+                        self._mt_stall.inc()
                 failed_msg = None
                 while inflight:
                     failed_msg = drain_one() or failed_msg
@@ -1019,6 +1456,103 @@ class PeerLinkService:
                     if mt is not None:
                         mt.peerlink_columnar_fill_stalls.inc()
                 drain_one()
+        return True
+
+    def _columnar_chunk_v2(self, m: int, eng, j: int, k: int,
+                           ctx: _PullCtx, ws: dict) -> bool:
+        """_columnar_chunk's cross-pull twin (wire contract v2): groups
+        launch into the WORKER-level pipeline (ws["inflight"]) and clean
+        groups may still be in flight when this chunk — and this whole
+        pull — returns; each drained group's rows post immediately as
+        partial frames, so early rows ride the wire while later
+        sub-windows (or the next pull's prep) ride the device.
+
+        Per-key order still holds: deductions apply at LAUNCH time (the C
+        prep packs and submits synchronously; only the readback defers),
+        so dispatch order is application order across chunks and pulls —
+        and a cut (leftovers: duplicates, gregorian, GLOBAL/MULTI_REGION,
+        invalid) or an over-commit barriers the WHOLE shared pipeline
+        before anything later dispatches, exactly as the v1 path barriers
+        within its pull. Only leftover-free groups ever stay in flight.
+        False = the engine can't take the shape (nothing mutated; the
+        caller retires the chunk via the object path and posts it)."""
+        b = ctx.b
+        adm = getattr(self.instance, "admission", None)
+        if adm is not None and adm.enabled and adm.level() >= adm.SATURATED:
+            return False  # demote to the object path's admission gate
+        launch = getattr(eng, "launch_columnar_windows", None)
+        spans = self._chunk_spans(eng, j, k)
+        if not self._col_pipe or launch is None or len(spans) <= 1:
+            # lock-step serve: complete before return, post per chunk
+            ok = self._columnar_chunk_lockstep(m, eng, spans, k, b,
+                                               ctx.errs, ctx.metas)
+            if ok:
+                self._post_span(ctx, j, k)
+            return ok
+        mt = self._metrics
+        scan = min(self._col_scan, int(getattr(eng, "_MAX_SCAN", 0) or 1))
+        staging = ws["staging"]
+        inflight = ws["inflight"]
+        wi = 0
+        n_spans = len(spans)
+        launched_any = False
+        while wi < n_spans:
+            if len(inflight) >= self._col_depth:
+                # pipe full: the oldest readback gates the next launch
+                self.stats["columnar_fill_stalls"] += 1
+                if mt is not None:
+                    mt.peerlink_columnar_fill_stalls.inc()
+                self._drain_one_entry(ws)
+                continue
+            gspans = spans[wi:wi + scan]
+            wins = [self._col_window(b, s0, s1) for s0, s1 in gspans]
+            h = launch(wins, _COLUMNAR_SLOW_MASK,
+                       staging=staging[ws["seq"] % len(staging)])
+            if h is None:
+                if not launched_any:
+                    return False  # nothing of THIS chunk mutated
+                # mid-chunk refusal (defensive): earlier spans already
+                # applied — barrier, then retire the rest lock-step
+                self._drain_all(ws)
+                rest = spans[wi:]
+                if not self._columnar_chunk_lockstep(
+                        m, eng, rest, k, b, ctx.errs, ctx.metas):
+                    self._object_chunk(m, rest[0][0], k, b, ctx.errs,
+                                       ctx.metas)
+                self._post_span(ctx, rest[0][0], k)
+                return True
+            launched_any = True
+            ws["seq"] += 1
+            win_metas, failed = h[0], h[1]
+            consumed = len(win_metas)
+            wi += consumed
+            inflight.append((eng, h, gspans[:consumed], ctx, m))
+            ctx.live += 1
+            self.stats["columnar_windows"] += consumed
+            self.stats["columnar_groups"] += 1
+            if mt is not None:
+                mt.peerlink_columnar_windows.inc(consumed)
+                mt.peerlink_columnar_group_windows.observe(consumed)
+                mt.peerlink_columnar_occupancy.observe(len(inflight))
+            cut = (consumed < len(gspans)
+                   or (consumed and win_metas[-1][-1] is not None
+                       and len(win_metas[-1][-1])))
+            if failed is not None or cut:
+                if cut and failed is None:
+                    self.stats["columnar_cuts"] += 1
+                    if mt is not None:
+                        mt.peerlink_columnar_cuts.inc()
+                # barrier: drain in dispatch order (the cut window's
+                # leftovers retire inside _drain_one_entry), then resume
+                failed_msg = self._drain_all(ws)
+                if failed_msg is not None:
+                    # over-commit: the unconsumed remainder of the chunk
+                    # gets error replies (the lock-step contract)
+                    s_fail = spans[wi][0] if wi < n_spans else k
+                    self._col_error_fill(failed_msg.encode(), s_fail, k,
+                                         b, ctx.errs)
+                    self._post_span(ctx, s_fail, k)
+                    return True
         return True
 
     def _columnar_chunk_lockstep(self, m: int, eng, spans, k: int,
@@ -1276,8 +1810,16 @@ class PeerLinkService:
             elif m == METHOD_GET_PEER_RATE_LIMITS:
                 handled = self.instance.apply_owner_batch(
                     good, from_peer_rpc=True)
-            else:
+            elif m == METHOD_GET_RATE_LIMITS:
                 handled = self.instance.get_rate_limits(good)
+            else:
+                # unknown method byte (the C parser accepts any non-control
+                # value structurally): answer UNIMPLEMENTED per item — never
+                # serve a decision under a contract we don't speak, never
+                # strand the rid
+                handled = [RateLimitResp(
+                    error=f"unimplemented wire method 0x{m:02x}")
+                    for _ in good]
         except Exception as e:  # noqa: BLE001 — per-item error replies
             handled = [RateLimitResp(error=str(e)) for _ in good]
         if len(good) == len(reqs):

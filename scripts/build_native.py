@@ -1,0 +1,68 @@
+#!/usr/bin/env python
+"""Rebuild every native component from source (`make native`).
+
+Produces the exact mtime-keyed cache names the runtime loaders
+(gubernator_tpu/native/__init__.py) and the TSan suite (tests/test_tsan.py)
+expect, deleting stale caches — so after editing keydir.cpp or peerlink.cpp
+one command restores a verifiable binary set:
+
+    _keydir_<mtime>.so          g++ -O2            (runtime)
+    _peerlink_<mtime>.so        g++ -O2            (runtime)
+    _tsan_keydir_<mtime>.so     g++ -O1 -g -fsanitize=thread
+    _tsan_peerlink_<mtime>.so   g++ -O1 -g -fsanitize=thread
+
+tests/test_native_build.py is the matching drift check: it fails when a
+cached .so predates its source or misses the exported symbol surface.
+"""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+NATIVE = os.path.join(ROOT, "gubernator_tpu", "native")
+PYINC = f"-I{sysconfig.get_paths()['include']}"
+
+# (source, cache prefix, extra flags) for each build flavor
+BUILDS = [
+    ("keydir.cpp", "_keydir_", ["-O2", PYINC]),
+    ("peerlink.cpp", "_peerlink_", ["-O2"]),
+    ("keydir.cpp", "_tsan_keydir_",
+     ["-O1", "-g", "-fsanitize=thread", "-pthread", PYINC]),
+    ("peerlink.cpp", "_tsan_peerlink_",
+     ["-O1", "-g", "-fsanitize=thread", "-pthread"]),
+]
+
+
+def build(src_name: str, prefix: str, flags) -> str:
+    src = os.path.join(NATIVE, src_name)
+    mtime = int(os.stat(src).st_mtime)
+    path = os.path.join(NATIVE, f"{prefix}{mtime}.so")
+    fresh = not os.path.exists(path)
+    if fresh:
+        tmp = path + ".tmp"
+        subprocess.run(
+            ["g++", *flags, "-shared", "-fPIC", "-std=c++17",
+             "-o", tmp, src],
+            check=True)
+        os.replace(tmp, path)
+    for name in os.listdir(NATIVE):
+        if name.startswith(prefix) and name.endswith(".so") and \
+                os.path.join(NATIVE, name) != path:
+            os.unlink(os.path.join(NATIVE, name))
+    print(f"{'built' if fresh else 'cached'}  {os.path.relpath(path, ROOT)}")
+    return path
+
+
+def main() -> int:
+    for src, prefix, flags in BUILDS:
+        build(src, prefix, flags)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,136 @@
+"""Native build hygiene (`make native` + the drift check).
+
+The runtime loads mtime-keyed .so caches built from keydir.cpp and
+peerlink.cpp (gubernator_tpu/native/__init__.py _build_lib); the TSan
+suite builds its own variants the same way. Those binaries are only
+trustworthy if (a) the sources still compile with the exact production
+flags, (b) every cached .so in the tree matches its source's CURRENT
+mtime (a stale cache name means the binary predates the source), and
+(c) the loaded libraries export the full symbol surface the ctypes
+registrations bind — including the wire-contract-v2 additions.
+"""
+
+import ctypes
+import os
+import subprocess
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+NATIVE = os.path.join(HERE, "..", "gubernator_tpu", "native")
+
+SOURCES = {
+    "keydir.cpp": ("_keydir_", "_tsan_keydir_"),
+    "peerlink.cpp": ("_peerlink_", "_tsan_peerlink_"),
+}
+
+# the ctypes surface each component must export (drift here = a .so
+# built from older source than the Python bindings expect)
+KEYDIR_SYMBOLS = [
+    "keydir_new", "keydir_free", "keydir_lookup_batch", "keydir_mirror_seed",
+    "keydir_decide_one", "keydir_mirror_flush", "keydir_drop", "keydir_peek",
+    "keydir_dump", "keydir_size", "keydir_evictions", "fnv1a_owner_batch",
+    "fnv1a_fingerprint_batch", "keydir_prep_pack_columnar",
+    "keydir_prep_route_columnar",
+]
+PEERLINK_SYMBOLS = [
+    "pls_start", "pls_start2", "pls_stop", "pls_port", "pls_next_batch",
+    "pls_send_responses", "pls_send_partial", "pls_pending_count",
+    "pls_partial_posts", "pls_v2_conns", "pls_set_native",
+]
+
+
+def _compile_check(src_name: str, extra=()):
+    """The tier-1-fast rebuild proof: the committed source compiles with
+    the production flag set (syntax+type check only — full codegen is
+    `make native` / the mtime cache)."""
+    src = os.path.join(NATIVE, src_name)
+    r = subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-fsyntax-only",
+         *extra, src],
+        capture_output=True, text=True)
+    assert r.returncode == 0, f"{src_name} no longer compiles:\n{r.stderr}"
+
+
+class TestSourcesCompile:
+    def test_keydir_compiles(self):
+        import sysconfig
+
+        _compile_check("keydir.cpp",
+                       [f"-I{sysconfig.get_paths()['include']}"])
+
+    def test_peerlink_compiles(self):
+        _compile_check("peerlink.cpp")
+
+
+class TestCacheDrift:
+    @pytest.mark.parametrize("src_name", sorted(SOURCES))
+    def test_cached_so_matches_source_mtime(self, src_name):
+        """Every cached .so present for a component must carry the
+        source's CURRENT mtime in its name — a mismatch means the binary
+        was built from different source than what's in the tree (the
+        unverifiable-binary failure `make native` fixes)."""
+        mtime = int(os.stat(os.path.join(NATIVE, src_name)).st_mtime)
+        for prefix in SOURCES[src_name]:
+            cached = [n for n in os.listdir(NATIVE)
+                      if n.startswith(prefix) and n.endswith(".so")]
+            for name in cached:
+                assert name == f"{prefix}{mtime}.so", (
+                    f"{name} drifted from {src_name} (mtime {mtime}): "
+                    "run `make native`")
+
+    def test_loader_builds_current_cache(self):
+        """load_library()/load_peerlink() must land on (or build) the
+        current-mtime cache, never a stale one."""
+        from gubernator_tpu import native
+
+        native.load_library()
+        native.load_peerlink()
+        for src_name, (prefix, _tsan) in SOURCES.items():
+            mtime = int(os.stat(os.path.join(NATIVE, src_name)).st_mtime)
+            assert os.path.exists(
+                os.path.join(NATIVE, f"{prefix}{mtime}.so"))
+
+
+class TestSymbolSurface:
+    def test_keydir_exports(self):
+        from gubernator_tpu import native
+
+        lib = native.load_library()
+        for sym in KEYDIR_SYMBOLS:
+            assert hasattr(lib, sym), f"keydir.cpp lost export {sym}"
+
+    def test_peerlink_exports(self):
+        from gubernator_tpu import native
+
+        lib = native.load_peerlink()
+        for sym in PEERLINK_SYMBOLS:
+            assert hasattr(lib, sym), f"peerlink.cpp lost export {sym}"
+
+    @pytest.mark.slow
+    def test_tsan_variants_build_and_export(self):
+        """The `make native` tsan flavors build from the same source and
+        carry the same surface (tests/test_tsan.py loads them by name)."""
+        import sysconfig
+
+        for src_name, flags, symbols in (
+            ("peerlink.cpp", [], PEERLINK_SYMBOLS),
+            ("keydir.cpp", [f"-I{sysconfig.get_paths()['include']}"],
+             KEYDIR_SYMBOLS),
+        ):
+            prefix = SOURCES[src_name][1]
+            src = os.path.join(NATIVE, src_name)
+            mtime = int(os.stat(src).st_mtime)
+            path = os.path.join(NATIVE, f"{prefix}{mtime}.so")
+            if not os.path.exists(path):
+                tmp = path + ".tmp"
+                subprocess.run(
+                    ["g++", "-O1", "-g", "-shared", "-fPIC", "-std=c++17",
+                     "-fsanitize=thread", "-pthread", *flags,
+                     "-o", tmp, src],
+                    check=True, capture_output=True)
+                os.replace(tmp, path)
+            nm = subprocess.run(["nm", "-D", path], capture_output=True,
+                                text=True, check=True).stdout
+            for sym in symbols:
+                assert f" T {sym}" in nm, f"{path} lost export {sym}"

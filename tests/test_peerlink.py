@@ -172,16 +172,26 @@ class TestTransportCorrectness:
         with socket_mod.create_connection(("127.0.0.1", svc.port), 5.0) as s:
             s.sendall(struct_mod.pack("<I", len(body)) + body)
             buf = b""
+            start = 0
             while True:
+                if len(buf) - start >= 4:
+                    (length,) = struct_mod.unpack_from("<I", buf, start)
+                    if len(buf) - start - 4 >= length:
+                        # skip control frames (the v2 greeting rides rid 0
+                        # with a reserved method byte — docs/wire.md); a
+                        # rid-matching consumer never sees them as replies
+                        (got_rid,) = struct_mod.unpack_from(
+                            "<Q", buf, start + 4)
+                        if got_rid == rid:
+                            break
+                        start += 4 + length
+                        continue
                 chunk = s.recv(65536)
                 assert chunk, "server closed without responding"
                 buf += chunk
-                if len(buf) >= 4:
-                    (length,) = struct_mod.unpack_from("<I", buf, 0)
-                    if len(buf) - 4 >= length:
-                        break
         from gubernator_tpu.service.peerlink import decode_response_frame
-        resps = decode_response_frame(memoryview(buf)[4:4 + length])
+        resps = decode_response_frame(
+            memoryview(buf)[start + 4:start + 4 + length])
         assert len(resps) == 2
         assert "utf-8" in resps[0].error
         assert resps[1].error == ""

@@ -1,0 +1,396 @@
+"""Frame codec robustness (wire-contract satellite, ISSUE 8).
+
+The link port is unauthenticated: arbitrary bytes can arrive. The
+contract under fuzz is (a) the Python codec round-trips every encodable
+request and rejects unencodable ones loudly, (b) a malformed frame
+kills AT MOST its own connection — the IO thread and every other
+connection keep serving, (c) a bad frame on a healthy connection errors
+only its own rid (duplicate rid, unknown method), and (d) the client's
+_read_loop survives unknown control frames (forward compatibility).
+"""
+
+import socket
+import struct
+import threading
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.models.engine import Engine
+from gubernator_tpu.service.config import InstanceConfig
+from gubernator_tpu.service.instance import Instance
+from gubernator_tpu.service.peerlink import (
+    MAX_FIELD_BYTES,
+    MAX_FRAME_ITEMS,
+    METHOD_GET_PEER_RATE_LIMITS,
+    PeerLinkClient,
+    PeerLinkService,
+    PeerLinkUnencodable,
+    WIRE_PARTIAL,
+    decode_partial_frame,
+    decode_response_frame,
+    encode_request_frame,
+)
+from gubernator_tpu.types import Algorithm, RateLimitReq
+
+
+def _req(key, name="fz", hits=1, limit=10):
+    return RateLimitReq(name=name, unique_key=key, hits=hits, limit=limit,
+                        duration=60_000, algorithm=Algorithm.TOKEN_BUCKET)
+
+
+@pytest.fixture(scope="module")
+def served():
+    eng = Engine(capacity=2048, min_width=8, max_width=64)
+    inst = Instance(InstanceConfig(backend=eng), advertise_address="self")
+    svc = PeerLinkService(inst, port=0)
+    yield inst, svc
+    svc.close()
+    inst.close()
+
+
+# --------------------------------------------------------------- codec
+
+
+def _parse_request_frame(frame: bytes):
+    """Reference decoder for the documented request layout (docs/wire.md)
+    — deliberately independent of the encoder's internals."""
+    (length,) = struct.unpack_from("<I", frame, 0)
+    assert length == len(frame) - 4
+    rid, method, n = struct.unpack_from("<QBH", frame, 4)
+    off = 4 + 11
+    name_len = struct.unpack_from(f"<{n}H", frame, off)
+    off += 2 * n
+    ukey_len = struct.unpack_from(f"<{n}H", frame, off)
+    off += 2 * n
+    names, ukeys = [], []
+    for a, b in zip(name_len, ukey_len):
+        names.append(frame[off:off + a].decode())
+        off += a
+        ukeys.append(frame[off:off + b].decode())
+        off += b
+    hits = struct.unpack_from(f"<{n}q", frame, off)
+    off += 8 * n
+    limit = struct.unpack_from(f"<{n}q", frame, off)
+    off += 8 * n
+    duration = struct.unpack_from(f"<{n}q", frame, off)
+    off += 8 * n
+    algo = struct.unpack_from(f"<{n}I", frame, off)
+    off += 4 * n
+    behavior = struct.unpack_from(f"<{n}I", frame, off)
+    off += 4 * n
+    assert off == len(frame)
+    return rid, method, list(zip(names, ukeys, hits, limit, duration,
+                                 algo, behavior))
+
+
+class TestCodecProperties:
+    def test_encode_round_trips_all_three_size_paths(self):
+        """The 1-item, tiny (<=4) and numpy encoders must produce the
+        SAME documented layout: parse each back field-by-field."""
+        rng = np.random.default_rng(7)
+        for n in (1, 2, 4, 5, 37, 1024):
+            reqs = [
+                _req(f"k{i}-{rng.integers(1 << 30)}",
+                     name=f"ns{i % 3}",
+                     hits=int(rng.integers(0, 1 << 40)),
+                     limit=int(rng.integers(1, 1 << 50)))
+                for i in range(n)
+            ]
+            frame = encode_request_frame(99, METHOD_GET_PEER_RATE_LIMITS,
+                                         reqs)
+            rid, method, items = _parse_request_frame(frame)
+            assert rid == 99 and method == METHOD_GET_PEER_RATE_LIMITS
+            assert len(items) == n
+            for r, (nm, uk, h, li, du, al, be) in zip(reqs, items):
+                assert (nm, uk, h, li, du, al, be) == (
+                    r.name, r.unique_key, r.hits, r.limit, r.duration,
+                    int(r.algorithm), int(r.behavior))
+
+    def test_unencodable_raises_not_truncates(self):
+        with pytest.raises(PeerLinkUnencodable):
+            encode_request_frame(1, 1, [])
+        with pytest.raises(PeerLinkUnencodable):
+            encode_request_frame(
+                1, 1, [_req("k")] * (MAX_FRAME_ITEMS + 1))
+        for n in (1, 3, 9):  # every encoder path bound-checks the fields
+            reqs = [_req("k")] * (n - 1) + [_req("x" * (MAX_FIELD_BYTES + 1))]
+            with pytest.raises(PeerLinkUnencodable):
+                encode_request_frame(1, 1, reqs)
+
+    def test_response_and_partial_decode_agree(self):
+        """The v1 whole frame and v2 partial frame share the response
+        columns; both decoders must read the same rows."""
+        for count in (1, 3, 7):
+            st = list(range(count))
+            cols = (struct.pack(f"<{count}i", *st)
+                    + struct.pack(f"<{count}q", *(x + 10 for x in st))
+                    + struct.pack(f"<{count}q", *(x + 20 for x in st))
+                    + struct.pack(f"<{count}q", *(x + 30 for x in st))
+                    + struct.pack(f"<{count}H", *([2] * count))
+                    + b"e!" * count)
+            v1 = struct.pack("<QBH", 5, 1, count) + cols
+            v2 = struct.pack("<QBHHHB", 5, WIRE_PARTIAL, count, 3, 8, 1) \
+                + cols
+            a = decode_response_frame(memoryview(v1))
+            rid, seq, base, fin, p = decode_partial_frame(memoryview(v2))
+            assert (rid, seq, base, fin) == (5, 3, 8, True)
+            assert len(a) == len(p) == count
+            for x, y in zip(a, p):
+                assert (x.status, x.limit, x.remaining, x.reset_time,
+                        x.error) == (y.status, y.limit, y.remaining,
+                                     y.reset_time, y.error)
+
+
+# ----------------------------------------------------- server under fuzz
+
+
+def _drain_replies(sock, want_rid, timeout=30.0):
+    """Read frames until want_rid's reply arrives, skipping control
+    frames; returns the reply's decoded items."""
+    sock.settimeout(timeout)
+    buf = b""
+    while True:
+        if len(buf) >= 4:
+            (length,) = struct.unpack_from("<I", buf, 0)
+            if len(buf) - 4 >= length:
+                payload = memoryview(buf)[4:4 + length]
+                rid, method = struct.unpack_from("<QB", payload, 0)
+                if method == WIRE_PARTIAL:
+                    got_rid, _s, _b, fin, items = \
+                        decode_partial_frame(payload)
+                    if got_rid == want_rid and fin:
+                        return items
+                elif rid == want_rid:
+                    return decode_response_frame(payload)
+                buf = buf[4 + length:]
+                continue
+        chunk = sock.recv(65536)
+        assert chunk, "server closed the connection"
+        buf += chunk
+
+
+def _valid_frame(rid, key=b"ok", name=b"fz", hits=1, limit=10):
+    body = (struct.pack("<QBHHH", rid, METHOD_GET_PEER_RATE_LIMITS, 1,
+                        len(name), len(key))
+            + name + key
+            + struct.pack("<qqqII", hits, limit, 60_000, 0, 0))
+    return struct.pack("<I", len(body)) + body
+
+
+class TestServerFuzz:
+    def _expect_closed(self, svc, payload: bytes):
+        """Send bytes; the server must close THIS conn (unparseable
+        stream) while the IO thread keeps serving new connections."""
+        with socket.create_connection(("127.0.0.1", svc.port), 5.0) as s:
+            s.sendall(payload)
+            s.settimeout(5.0)
+            # read until EOF: anything before it is greeting/partial noise
+            while True:
+                try:
+                    if not s.recv(65536):
+                        break
+                except socket.timeout:
+                    pytest.fail("conn not closed on malformed frame")
+        # the IO thread survived: a fresh conn still serves
+        with socket.create_connection(("127.0.0.1", svc.port), 5.0) as s2:
+            s2.sendall(_valid_frame(1))
+            items = _drain_replies(s2, 1)
+            assert items[0].error == ""
+
+    def test_truncated_length_prefix(self, served):
+        _, svc = served
+        self._expect_closed(svc, struct.pack("<I", 5) + b"\x00" * 5)
+
+    def test_oversize_length(self, served):
+        _, svc = served
+        self._expect_closed(svc, struct.pack("<I", 0xFFFFFFF0))
+
+    def test_oversize_count(self, served):
+        _, svc = served
+        body = struct.pack("<QBH", 9, 1, 2000) + b"\x00" * 64
+        self._expect_closed(svc, struct.pack("<I", len(body)) + body)
+
+    def test_zero_count(self, served):
+        _, svc = served
+        body = struct.pack("<QBH", 9, 1, 0)
+        self._expect_closed(svc, struct.pack("<I", len(body)) + body)
+
+    def test_oversize_field_length(self, served):
+        _, svc = served
+        body = (struct.pack("<QBHHH", 9, 1, 1, 2000, 2)
+                + b"x" * 2002
+                + struct.pack("<qqqII", 1, 10, 60_000, 0, 0))
+        self._expect_closed(svc, struct.pack("<I", len(body)) + body)
+
+    def test_body_shorter_than_columns(self, served):
+        _, svc = served
+        body = struct.pack("<QBHHH", 9, 1, 1, 2, 2) + b"nmuk"  # no columns
+        self._expect_closed(svc, struct.pack("<I", len(body)) + body)
+
+    def test_unknown_method_byte_errors_only_its_rid(self, served):
+        """Method 0x07 parses structurally; the Python worker answers it
+        with per-item errors — and the SAME conn keeps serving."""
+        _, svc = served
+        with socket.create_connection(("127.0.0.1", svc.port), 5.0) as s:
+            body = (struct.pack("<QBHHH", 41, 0x07, 1, 2, 2) + b"fzuk"
+                    + struct.pack("<qqqII", 1, 10, 60_000, 0, 0))
+            s.sendall(struct.pack("<I", len(body)) + body)
+            bad = _drain_replies(s, 41)
+            assert bad[0].error != ""
+            s.sendall(_valid_frame(42, key=b"um-after"))
+            good = _drain_replies(s, 42)
+            assert good[0].error == ""
+
+    def test_duplicate_rid_single_reply_conn_survives(self, served):
+        """Two frames with one rid: the second overwrites the pending
+        entry; the conn must get exactly one completed reply for that
+        rid, no crash, and keep serving."""
+        _, svc = served
+        with socket.create_connection(("127.0.0.1", svc.port), 5.0) as s:
+            s.sendall(_valid_frame(77, key=b"dupr-a")
+                      + _valid_frame(77, key=b"dupr-b"))
+            _drain_replies(s, 77)
+            s.sendall(_valid_frame(78, key=b"dupr-after"))
+            ok = _drain_replies(s, 78)
+            assert ok[0].error == ""
+        # no pending entry leaked for the duplicated rid
+        deadline = threading.Event()
+        for _ in range(50):
+            if svc.wire_pending_count() == 0:
+                break
+            deadline.wait(0.05)
+        assert svc.wire_pending_count() == 0
+
+    def test_mismatched_duplicate_rid_counts(self, served):
+        """Duplicate rid where the second frame has a DIFFERENT count:
+        partial posts for the first frame must bounds-check against the
+        replacement pending entry — no overflow, no stuck conn."""
+        _, svc = served
+        with socket.create_connection(("127.0.0.1", svc.port), 5.0) as s:
+            reqs3 = [_req(f"dupc{i}") for i in range(3)]
+            frame3 = encode_request_frame(91, METHOD_GET_PEER_RATE_LIMITS,
+                                          reqs3)
+            s.sendall(frame3 + _valid_frame(91, key=b"dupc-solo"))
+            _drain_replies(s, 91)
+            s.sendall(_valid_frame(92, key=b"dupc-after"))
+            assert _drain_replies(s, 92)[0].error == ""
+
+    def test_malformed_conn_does_not_kill_inflight_neighbors(self, served):
+        """A conn dying mid-parse must not take down frames in flight on
+        OTHER conns sharing the IO thread."""
+        _, svc = served
+        cli = PeerLinkClient(f"127.0.0.1:{svc.port}")
+        try:
+            stop = threading.Event()
+            results = []
+
+            def hammer():
+                i = 0
+                while not stop.is_set() and i < 200:
+                    out = cli.call(METHOD_GET_PEER_RATE_LIMITS,
+                                   [_req("neighbor")], 5.0)
+                    results.append(out[0].error)
+                    i += 1
+
+            t = threading.Thread(target=hammer, daemon=True)
+            t.start()
+            for _ in range(10):
+                with socket.create_connection(
+                        ("127.0.0.1", svc.port), 5.0) as s:
+                    s.sendall(struct.pack("<I", 0xFFFFFFF0))
+            stop.set()
+            t.join(timeout=20)
+            assert not t.is_alive()
+            assert results and all(e == "" for e in results)
+        finally:
+            cli.close()
+
+
+# ----------------------------------------------------- client under fuzz
+
+
+class TestClientFuzz:
+    def _fake_server(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+        return srv
+
+    def test_unknown_control_frames_skipped(self, served):
+        """0xF3..0xFF control frames must not kill _read_loop (forward
+        compatibility with future wire revisions)."""
+        srv = self._fake_server()
+        port = srv.getsockname()[1]
+        cli = PeerLinkClient(f"127.0.0.1:{port}")
+        conn, _ = srv.accept()
+        try:
+            for m in (0xF3, 0xFF):
+                body = struct.pack("<QBH", 0, m, 7) + b"junk-operand"
+                conn.sendall(struct.pack("<I", len(body)) + body)
+            # the link still works: complete a real call through it
+            fut, rid = cli.call_async(METHOD_GET_PEER_RATE_LIMITS,
+                                      [_req("cf")])
+            # read the request off the wire, answer it v1-style
+            raw = conn.recv(65536)
+            assert raw
+            reply = (struct.pack("<QBH", rid, 1, 1)
+                     + struct.pack("<i", 0) + struct.pack("<qqq", 1, 2, 3)
+                     + struct.pack("<H", 0))
+            conn.sendall(struct.pack("<I", len(reply)) + reply)
+            out = fut.result(timeout=5)
+            assert out[0].remaining == 2
+        finally:
+            conn.close()
+            srv.close()
+            cli.close()
+
+    def test_partial_for_unknown_rid_dropped(self, served):
+        """A partial frame for a rid nobody registered must be dropped
+        without creating reassembly state."""
+        srv = self._fake_server()
+        port = srv.getsockname()[1]
+        cli = PeerLinkClient(f"127.0.0.1:{port}")
+        conn, _ = srv.accept()
+        try:
+            cols = (struct.pack("<i", 0) + struct.pack("<qqq", 1, 2, 3)
+                    + struct.pack("<H", 0))
+            body = struct.pack("<QBHHHB", 424242, WIRE_PARTIAL, 1, 0, 0, 1) \
+                + cols
+            conn.sendall(struct.pack("<I", len(body)) + body)
+            for _ in range(50):
+                if cli.partial_state_count() == 0 and not cli._closed:
+                    break
+                threading.Event().wait(0.02)
+            assert cli.partial_state_count() == 0
+            assert not cli._closed
+        finally:
+            conn.close()
+            srv.close()
+            cli.close()
+
+    def test_out_of_contract_partial_fails_the_link_loudly(self, served):
+        """A seq jump is unrecoverable corruption: the link must die with
+        PeerLinkError (callers fall back to gRPC), not hang."""
+        from gubernator_tpu.service.peerlink import PeerLinkError
+
+        srv = self._fake_server()
+        port = srv.getsockname()[1]
+        cli = PeerLinkClient(f"127.0.0.1:{port}", wire_v2=True)
+        conn, _ = srv.accept()
+        try:
+            fut, rid = cli.call_async(METHOD_GET_PEER_RATE_LIMITS,
+                                      [_req("sj"), _req("sj2")])
+            conn.recv(65536)
+            cols = (struct.pack("<i", 0) + struct.pack("<qqq", 1, 2, 3)
+                    + struct.pack("<H", 0))
+            bad = struct.pack("<QBHHHB", rid, WIRE_PARTIAL, 1, 5, 0, 0) \
+                + cols  # seq 5 when 0 is due
+            conn.sendall(struct.pack("<I", len(bad)) + bad)
+            with pytest.raises(PeerLinkError):
+                fut.result(timeout=5)
+            assert cli.partial_state_count() == 0
+        finally:
+            conn.close()
+            srv.close()
+            cli.close()
