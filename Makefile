@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast bench bench-skew bench-wire bench-suite soak chaos proto docker clean native
+.PHONY: test test-fast bench bench-skew bench-wire bench-suite bench-check soak chaos proto docker clean native
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -25,6 +25,11 @@ bench-wire:
 
 bench-suite:
 	python scripts/bench_suite.py
+
+# diff the two newest BENCH_r*.json rounds; fails on a >25% cliff in a
+# throughput/latency key both rounds measured (see scripts/bench_check.py)
+bench-check:
+	python scripts/bench_check.py
 
 # 30s fault-injection soak: kill/restart chaos under load, invariant-judged
 soak:

@@ -152,6 +152,103 @@ def phase_breakdown() -> dict:
             inst.close()
 
 
+def _obs_bench(n_calls: int = 1500, batch: int = 64, reps: int = 3) -> dict:
+    """Observability-plane overhead on the serving path: the SAME
+    single-node Instance serving identical batch streams with the flight
+    recorder enabled vs GUBER_FLIGHT_RECORDER=0 (the escape hatch turns
+    emit() into one attribute test). The anomaly engine's observe() runs
+    on both sides — it IS the always-on plane; what the hatch removes is
+    the recorder. The flag alternates every CHUNK calls within one pass
+    (shared-CPU drift between coarse reps dwarfs the cost under test;
+    fine interleaving lands both sides in the same drift regime);
+    acceptance is overhead <= 2%.
+
+    Steady-state serving emits no events (recorder kinds are rare state
+    EDGES — circuit flips, brownout enter/exit, queue high-water), so
+    this measures the per-batch fixed cost: the enabled check, the
+    anomaly feed, and the wrapper bookkeeping. A per-sweep timing for
+    the detector pass rides along informationally."""
+    from gubernator_tpu.models.engine import Engine
+    from gubernator_tpu.service.config import InstanceConfig
+    from gubernator_tpu.service.instance import Instance
+    from gubernator_tpu.types import PeerInfo, RateLimitReq
+
+    inst = Instance(InstanceConfig(backend=Engine(capacity=262_144)),
+                    advertise_address="127.0.0.1:1")
+    inst.set_peers([PeerInfo(address="127.0.0.1:1")])  # self-owned: no RPC
+    frames = [
+        [RateLimitReq(name="obsbench", unique_key=f"k{(i * batch + j) % 4096}",
+                      hits=1, limit=1 << 30, duration=3_600_000)
+         for j in range(batch)]
+        for i in range(n_calls)
+    ]
+    try:
+        for f in frames[:100]:  # compile + warm the width bucket
+            inst.get_rate_limits(f)
+
+        import gc
+        import statistics
+
+        CHUNK = 25
+        elapsed = {True: 0.0, False: 0.0}
+        calls = {True: 0, False: 0}
+        pair_overheads = []  # per adjacent on/off pair: scheduler
+        # hiccups land in single chunks; the median ignores them
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            for rep in range(reps):
+                i = 0
+                while i + 2 * CHUNK <= n_calls:
+                    first = len(pair_overheads) % 2 == 0
+                    rate = {}
+                    for enabled in (first, not first):
+                        inst.recorder.enabled = enabled
+                        chunk = frames[i:i + CHUNK]
+                        i += CHUNK
+                        t0 = time.perf_counter()
+                        for f in chunk:
+                            inst.get_rate_limits(f)
+                        dt = time.perf_counter() - t0
+                        elapsed[enabled] += dt
+                        calls[enabled] += CHUNK
+                        rate[enabled] = CHUNK * batch / dt
+                    pair_overheads.append(
+                        (rate[False] - rate[True]) / rate[False])
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        inst.recorder.enabled = True
+        on = calls[True] * batch / elapsed[True]
+        off = calls[False] * batch / elapsed[False]
+        overhead_pct = statistics.median(pair_overheads) * 100.0
+
+        t0 = time.perf_counter()
+        sweeps = 50
+        for _ in range(sweeps):
+            inst.anomaly.check(now=time.monotonic())
+            time.sleep(0.02)  # past the sweep-coalescing guard
+        sweep_us = ((time.perf_counter() - t0) / sweeps - 0.02) * 1e6
+
+        return {
+            "observability": {
+                "recorder_on_decisions_per_sec": round(on, 1),
+                "recorder_off_decisions_per_sec": round(off, 1),
+                # positive = the enabled recorder costs throughput;
+                # median over on/off chunk pairs, hiccup-robust
+                "overhead_pct": round(overhead_pct, 2),
+                "chunk_pairs": len(pair_overheads),
+                "anomaly_sweep_us": round(max(sweep_us, 0.0), 1),
+                "slo_batches_observed": inst.anomaly.debug()["slo"]["total"],
+                "reps": reps,
+                "batch": batch,
+                "calls_per_rep": n_calls,
+            }
+        }
+    finally:
+        inst.close()
+
+
 def _product_combiner_bench(eng, threads: int = 12, scan: int = 8,
                             subs_per_thread: int = 24) -> dict:
     """Serving throughput through the PRODUCT combiner path — not a
@@ -293,29 +390,32 @@ def _overload_bench(eng, budget_ms: float = 150.0, seconds: float = 3.0,
                               limit=1 << 30, duration=3_600_000)
                  for k in pool_keys[start:start + batch]])
 
-        # ---- closed-loop capacity probe --------------------------------
-        # concurrency matches the open loop's client pool order: the
-        # combiner merges concurrent calls into wider windows, so a
-        # low-thread probe would UNDER-measure capacity and 2x "offered"
-        # would not actually overload the node
-        n_probe_threads, probe_s = 24, 1.5
-        counts = [0] * n_probe_threads
-        stop_at = time.perf_counter() + probe_s
+        def measure_capacity() -> float:
+            # ---- closed-loop capacity probe ----------------------------
+            # concurrency matches the open loop's client pool order: the
+            # combiner merges concurrent calls into wider windows, so a
+            # low-thread probe would UNDER-measure capacity and 2x
+            # "offered" would not actually overload the node
+            n_probe_threads, probe_s = 24, 1.5
+            counts = [0] * n_probe_threads
+            stop_at = time.perf_counter() + probe_s
 
-        def probe_worker(ti: int) -> None:
-            i = ti
-            while time.perf_counter() < stop_at:
-                inst.get_rate_limits(make_batch(i))
-                counts[ti] += batch
-                i += n_probe_threads
+            def probe_worker(ti: int) -> None:
+                i = ti
+                while time.perf_counter() < stop_at:
+                    inst.get_rate_limits(make_batch(i))
+                    counts[ti] += batch
+                    i += n_probe_threads
 
-        ts = [_t.Thread(target=probe_worker, args=(ti,), daemon=True)
-              for ti in range(n_probe_threads)]
-        for t in ts:
-            t.start()
-        for t in ts:
-            t.join()
-        capacity = sum(counts) / probe_s  # decisions/s, closed loop
+            ts = [_t.Thread(target=probe_worker, args=(ti,), daemon=True)
+                  for ti in range(n_probe_threads)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            return sum(counts) / probe_s  # decisions/s, closed loop
+
+        capacity = measure_capacity()
 
         def open_loop(admission_on: bool) -> dict:
             behaviors.max_pending = (
@@ -381,8 +481,24 @@ def _overload_bench(eng, budget_ms: float = 150.0, seconds: float = 3.0,
                 "max_pending": behaviors.max_pending,
             }
 
-        baseline = open_loop(admission_on=False)
-        admission = open_loop(admission_on=True)
+        # A shared-rig probe can land in a descheduled window and report
+        # a fraction of the node's real capacity. Such a draw fails the
+        # bench's own premise — "offered at 2x capacity" then does not
+        # overload anything (shed rate 0, baseline p99 inside budget) and
+        # the row measures the rig hiccup, not the overload discipline.
+        # Detect that and retake the probe instead of recording it.
+        attempts = 1
+        while True:
+            baseline = open_loop(admission_on=False)
+            admission = open_loop(admission_on=True)
+            # sheds are the unambiguous signature that offered load
+            # actually exceeded capacity (a backlogged-baseline p99 can
+            # spike on an under-measured probe too, so it proves nothing)
+            if admission["shed_calls"] > 0 or attempts >= 3:
+                break
+            attempts += 1
+            behaviors.max_pending = 0  # re-probe closed-loop, no admission
+            capacity = measure_capacity()
     finally:
         inst.close()
     return {
@@ -393,6 +509,7 @@ def _overload_bench(eng, budget_ms: float = 150.0, seconds: float = 3.0,
             "capacity_decisions_per_sec": round(capacity, 1),
             "offered_x": offered_x,
             "budget_ms": budget_ms,
+            "probe_attempts": attempts,
             "baseline_no_admission": baseline,
             "admission": admission,
         },
@@ -1294,6 +1411,15 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — report, don't die
             wire_row = {"wire": {"error": str(e)}}
 
+    # ---- observability plane: flight recorder on vs the escape hatch ------
+    # Single-node serving with the recorder enabled vs disabled on the same
+    # Instance; BENCH_r11 records the overhead (acceptance <= 2%) plus the
+    # anomaly detector sweep cost.
+    try:
+        obs_row = _obs_bench()
+    except Exception as e:  # noqa: BLE001 — report, don't die
+        obs_row = {"observability": {"error": str(e)}}
+
     # trace-derived serving-stack phase split (never fails the bench)
     try:
         phases = phase_breakdown()
@@ -1311,6 +1437,7 @@ def main() -> None:
                 **overload_row,
                 **skew_row,
                 **wire_row,
+                **obs_row,
                 **_multichip_section(),
                 "phase_breakdown_ms": phases,
                 "unit": UNIT,

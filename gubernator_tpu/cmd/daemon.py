@@ -305,6 +305,21 @@ def main(argv=None) -> int:
             conf.behaviors.hot_lease_rate, conf.behaviors.hot_lease_window_s,
             conf.behaviors.hot_lease_ttl_s * 1000.0,
             conf.behaviors.hot_lease_fraction)
+    # observability plane (obs/): the flight recorder is the always-on
+    # black box; the slow-request log gets a size-rotated file sink when
+    # a path is configured
+    from gubernator_tpu.obs.events import FlightRecorder
+    from gubernator_tpu.obs.trace import install_slow_log_file
+
+    recorder = FlightRecorder(capacity=conf.flight_recorder_capacity,
+                              enabled=conf.flight_recorder)
+    if not conf.flight_recorder:
+        log.info("flight recorder OFF (GUBER_FLIGHT_RECORDER=0)")
+    if conf.slow_log_path:
+        if install_slow_log_file(conf.slow_log_path,
+                                 max_mb=conf.slow_log_max_mb) is not None:
+            log.info("slow-request log: %s (rotate at %.0f MB)",
+                     conf.slow_log_path, conf.slow_log_max_mb)
     instance = Instance(
         InstanceConfig(
             behaviors=conf.behaviors,
@@ -313,11 +328,27 @@ def main(argv=None) -> int:
             local_picker=build_picker(conf),
             metrics=metrics,
             tracer=tracer,
+            recorder=recorder,
+            anomaly_interval_s=conf.anomaly_interval_s,
+            slo_target_ms=conf.slo_target_ms,
+            slo_objective=conf.slo_objective,
             pipeline_depth=conf.pipeline_depth or None,  # 0 -> env/auto
             pipeline_scan=conf.pipeline_scan,
         ),
         advertise_address=advertise,
     )
+    if conf.bundle_dir:
+        from gubernator_tpu.obs.bundle import BundleWriter
+
+        instance.bundle_writer = BundleWriter(
+            conf.bundle_dir, min_interval_s=conf.bundle_interval_s,
+            keep=conf.bundle_keep)
+        log.info("anomaly diagnostic bundles -> %s (keep %d, min %.0fs "
+                 "apart)", conf.bundle_dir, conf.bundle_keep,
+                 conf.bundle_interval_s)
+    # background detector sweep; in-process/test clusters instead ride
+    # the maybe_check() piggyback on health probes and metric scrapes
+    instance.anomaly.start()
     columnar_pipe = (conf.columnar_pipeline and conf.pipeline_depth != 1
                      and getattr(backend, "supports_columnar",
                                  lambda: False)())

@@ -188,6 +188,20 @@ class DaemonConfig:
     trace_sample: float = 0.0
     slow_request_ms: float = 0.0
     debug_endpoints: bool = True
+    # observability plane (obs/events.py, obs/anomaly.py, obs/bundle.py):
+    # flight_recorder is the always-on black box (=0 is the escape hatch);
+    # bundle_dir enables anomaly-triggered diagnostic bundles;
+    # slow_log_path/max_mb bound the slow-request JSON log on disk
+    flight_recorder: bool = True
+    flight_recorder_capacity: int = 4096
+    bundle_dir: str = ""
+    bundle_interval_s: float = 60.0
+    bundle_keep: int = 20
+    slow_log_path: str = ""
+    slow_log_max_mb: float = 64.0
+    anomaly_interval_s: float = 5.0
+    slo_target_ms: float = 250.0
+    slo_objective: float = 0.999
     # GLOBAL-sync collective implementation for the sharded backend:
     # "psum" (XLA, default) or "ring" (Pallas ICI ring — TPU-compiled only,
     # single-region meshes; see ops/ring.py)
@@ -316,6 +330,18 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         trace_sample=_env_float("GUBER_TRACE_SAMPLE", 0.0),
         slow_request_ms=_env_float("GUBER_SLOW_REQUEST_MS", 0.0),
         debug_endpoints=_env_str("GUBER_DEBUG_ENDPOINTS", "1") != "0",
+        flight_recorder=_env_str("GUBER_FLIGHT_RECORDER", "1") not in
+        ("0", "f", "false", "no", "off"),
+        flight_recorder_capacity=_env_int(
+            "GUBER_FLIGHT_RECORDER_CAPACITY", 4096),
+        bundle_dir=_env_str("GUBER_BUNDLE_DIR"),
+        bundle_interval_s=_env_dur("GUBER_BUNDLE_INTERVAL", 60.0),
+        bundle_keep=_env_int("GUBER_BUNDLE_KEEP", 20),
+        slow_log_path=_env_str("GUBER_SLOW_LOG_PATH"),
+        slow_log_max_mb=_env_float("GUBER_SLOW_LOG_MAX_MB", 64.0),
+        anomaly_interval_s=_env_dur("GUBER_ANOMALY_INTERVAL", 5.0),
+        slo_target_ms=_env_float("GUBER_SLO_TARGET_MS", 250.0),
+        slo_objective=_env_float("GUBER_SLO_OBJECTIVE", 0.999),
         collectives=_env_str("GUBER_COLLECTIVES", "psum"),
         coordinator_address=_env_str("GUBER_COORDINATOR_ADDRESS"),
         num_hosts=_env_int("GUBER_NUM_HOSTS", 1),
@@ -365,6 +391,34 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         raise ValueError(
             f"'GUBER_MAX_PENDING={b.max_pending}' is invalid; "
             "must be >= 0 (0 disables admission control)")
+    if conf.flight_recorder_capacity < 16:
+        raise ValueError(
+            f"'GUBER_FLIGHT_RECORDER_CAPACITY="
+            f"{conf.flight_recorder_capacity}' is invalid; must be >= 16")
+    if conf.bundle_interval_s < 0:
+        raise ValueError(
+            f"'GUBER_BUNDLE_INTERVAL={conf.bundle_interval_s}' is invalid; "
+            "must be >= 0 seconds (0 = no rate limit)")
+    if conf.bundle_keep < 1:
+        raise ValueError(
+            f"'GUBER_BUNDLE_KEEP={conf.bundle_keep}' is invalid; "
+            "must be >= 1")
+    if conf.slow_log_max_mb <= 0:
+        raise ValueError(
+            f"'GUBER_SLOW_LOG_MAX_MB={conf.slow_log_max_mb}' is invalid; "
+            "must be positive megabytes")
+    if conf.anomaly_interval_s <= 0:
+        raise ValueError(
+            f"'GUBER_ANOMALY_INTERVAL={conf.anomaly_interval_s}' is "
+            "invalid; must be a positive duration")
+    if conf.slo_target_ms <= 0:
+        raise ValueError(
+            f"'GUBER_SLO_TARGET_MS={conf.slo_target_ms}' is invalid; "
+            "must be positive milliseconds")
+    if not 0.0 < conf.slo_objective < 1.0:
+        raise ValueError(
+            f"'GUBER_SLO_OBJECTIVE={conf.slo_objective}' is invalid; "
+            "must be a fraction in (0, 1)")
     if conf.fault_spec:
         # a typo'd chaos plan must fail the boot loudly, not inject nothing
         from gubernator_tpu.service.faults import parse_spec

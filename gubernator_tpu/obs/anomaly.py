@@ -1,0 +1,319 @@
+"""Anomaly watchers: detectors over the live subsystem counters plus a
+multi-window SLO burn-rate engine (Google SRE workbook ch. 5).
+
+Each detector reads signals the node already maintains — nothing here
+adds work to the serving path beyond one `observe()` call per client
+batch. Detections are edge-triggered: a rising edge emits a
+flight-recorder event, flips the `anomaly_active{detector}` gauge,
+annotates health_check, and (when a BundleWriter is wired) captures a
+diagnostic bundle so the incident state survives the incident.
+
+Detectors:
+
+- ``deadline_burst``     deadline-expired drops per second over threshold
+- ``shed_spike``         admission sheds per second over threshold
+- ``circuit_open``       any peer circuit currently open
+- ``stall_regression``   peerlink pull-boundary stalls per second over
+                         threshold while wire v2 is negotiated (v2's whole
+                         win is making these ~0; a regression means the
+                         cross-pull pipeline stopped overlapping)
+- ``lease_fail_close``   lease fail-close (expired_held) per second over
+                         threshold — owner unreachable AND leases dying
+- ``slo_burn``           decision-latency/error budget burning faster than
+                         `burn_fast_threshold` over the fast window AND
+                         `burn_slow_threshold` over the slow window (the
+                         two-window AND suppresses blips and stale pages)
+
+The engine runs without a thread: ``maybe_check()`` piggybacks on
+health_check and metric scrapes, so in-process harness clusters get live
+detection; daemons also run ``start()``'s background ticker.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+log = logging.getLogger("gubernator_tpu.anomaly")
+
+DETECTORS = ("deadline_burst", "shed_spike", "circuit_open",
+             "stall_regression", "lease_fail_close", "slo_burn")
+
+
+class AnomalyEngine:
+    """Periodic detector sweep + SLO burn-rate accounting for one
+    Instance. Thresholds are rates (events/second) unless noted."""
+
+    def __init__(self, instance, metrics=None, recorder=None,
+                 interval_s: float = 5.0,
+                 slo_target_ms: float = 250.0,
+                 slo_objective: float = 0.999,
+                 burn_fast_window_s: float = 60.0,
+                 burn_slow_window_s: float = 600.0,
+                 burn_fast_threshold: float = 10.0,
+                 burn_slow_threshold: float = 2.0,
+                 deadline_rate: float = 5.0,
+                 shed_rate: float = 10.0,
+                 stall_rate: float = 50.0,
+                 fail_close_rate: float = 5.0):
+        self.instance = instance
+        self.metrics = metrics
+        self.recorder = recorder
+        self.interval_s = max(float(interval_s), 0.05)
+        self.slo_target_ms = float(slo_target_ms)
+        self.slo_objective = float(slo_objective)
+        self.burn_fast_window_s = float(burn_fast_window_s)
+        self.burn_slow_window_s = float(burn_slow_window_s)
+        self.burn_fast_threshold = float(burn_fast_threshold)
+        self.burn_slow_threshold = float(burn_slow_threshold)
+        self.rates = {"deadline_burst": float(deadline_rate),
+                      "shed_spike": float(shed_rate),
+                      "stall_regression": float(stall_rate),
+                      "lease_fail_close": float(fail_close_rate)}
+
+        self._lock = threading.Lock()
+        # SLO accounting fed by the serving path (Instance.get_rate_limits)
+        self._slo_total = 0
+        self._slo_good = 0
+        self._slo_errors = 0
+        # (t, signals) snapshots back one slow window — burn rates and
+        # event rates are deltas between snapshots, never absolute counts
+        self._snaps: List[tuple] = []
+        self.active: Dict[str, bool] = {d: False for d in DETECTORS}
+        self.detail: Dict[str, str] = {}
+        self.trips: Dict[str, int] = {d: 0 for d in DETECTORS}
+        self.burn_fast = 0.0
+        self.burn_slow = 0.0
+        self._last_check = 0.0
+        self.checks = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------ serving feed
+
+    def observe(self, latency_ms: float, error: bool = False) -> None:
+        """One client batch decided: feed the SLO counters. Called on the
+        serving path — two int adds under a lock held for nanoseconds."""
+        with self._lock:
+            self._slo_total += 1
+            if error:
+                self._slo_errors += 1
+            elif latency_ms <= self.slo_target_ms:
+                self._slo_good += 1
+
+    # ---------------------------------------------------------- signals
+
+    def _signals(self) -> Dict[str, float]:
+        """Point-in-time cumulative counters the rate detectors diff."""
+        inst = self.instance
+        sig: Dict[str, float] = {}
+        sig["deadline_expired"] = float(
+            sum(getattr(inst, "deadline_expired_stats", {}).values()))
+        adm = getattr(inst, "admission", None)
+        sig["sheds"] = float(sum(adm.stats.values())) if adm is not None \
+            else 0.0
+        pls = getattr(inst, "peerlink_service", None)
+        sig["pull_boundary_stalls"] = float(
+            pls.stats.get("pull_boundary_stalls", 0)) if pls is not None \
+            else 0.0
+        lm = getattr(inst, "leases", None)
+        sig["lease_fail_close"] = float(
+            lm.stats.get("expired_held", 0)) if lm is not None else 0.0
+        with self._lock:
+            sig["slo_total"] = float(self._slo_total)
+            sig["slo_good"] = float(self._slo_good)
+            sig["slo_errors"] = float(self._slo_errors)
+        return sig
+
+    def _open_circuits(self) -> List[str]:
+        all_peers = getattr(self.instance, "all_peer_clients", None)
+        if not callable(all_peers):
+            return []
+        out = []
+        for p in all_peers():
+            c = getattr(p, "circuit", None)
+            if c is not None and getattr(c, "state_name", "") == "open":
+                out.append(p.info.address)
+        return out
+
+    @staticmethod
+    def _burn(cur: Dict[str, float], old: Dict[str, float],
+              budget: float) -> float:
+        """Error-budget burn multiplier over the snapshot span: observed
+        bad fraction / allowed bad fraction. 1.0 = burning exactly at
+        budget; 0 when no traffic."""
+        total = cur["slo_total"] - old["slo_total"]
+        if total <= 0:
+            return 0.0
+        good = cur["slo_good"] - old["slo_good"]
+        bad_frac = max(total - good, 0.0) / total
+        return bad_frac / max(budget, 1e-9)
+
+    # ------------------------------------------------------------ checks
+
+    def maybe_check(self) -> None:
+        """Piggyback hook (health_check, metric scrape): run a sweep when
+        one interval elapsed since the last, whoever the caller was."""
+        if time.monotonic() - self._last_check >= self.interval_s:
+            self.check()
+
+    def check(self, now: Optional[float] = None) -> Dict[str, bool]:
+        """One detector sweep; returns the active map. Thread-safe but
+        sweeps are serialized — concurrent callers coalesce."""
+        now = time.monotonic() if now is None else now
+        cur = self._signals()
+        with self._lock:
+            if self._last_check and now - self._last_check < 0.01:
+                return dict(self.active)  # coalesced concurrent sweep
+            prev = self._snaps[-1] if self._snaps else None
+            self._snaps.append((now, cur))
+            horizon = now - self.burn_slow_window_s * 1.2
+            while len(self._snaps) > 2 and self._snaps[0][0] < horizon:
+                self._snaps.pop(0)
+            fast_old = self._window_snap(now - self.burn_fast_window_s)
+            slow_old = self._window_snap(now - self.burn_slow_window_s)
+            self._last_check = now
+            self.checks += 1
+
+        budget = 1.0 - self.slo_objective
+        self.burn_fast = self._burn(cur, fast_old, budget)
+        self.burn_slow = self._burn(cur, slow_old, budget)
+
+        found: Dict[str, bool] = {d: False for d in DETECTORS}
+        detail: Dict[str, str] = {}
+        if prev is not None:
+            dt = max(now - prev[0], 1e-6)
+            old = prev[1]
+            for name, key in (("deadline_burst", "deadline_expired"),
+                              ("shed_spike", "sheds"),
+                              ("stall_regression", "pull_boundary_stalls"),
+                              ("lease_fail_close", "lease_fail_close")):
+                rate = (cur[key] - old[key]) / dt
+                if rate > self.rates[name]:
+                    found[name] = True
+                    detail[name] = f"{rate:.1f}/s over {self.rates[name]:g}/s"
+        open_peers = self._open_circuits()
+        if open_peers:
+            found["circuit_open"] = True
+            detail["circuit_open"] = ",".join(sorted(open_peers)[:4])
+        if (self.burn_fast >= self.burn_fast_threshold
+                and self.burn_slow >= self.burn_slow_threshold):
+            found["slo_burn"] = True
+            detail["slo_burn"] = (f"burn {self.burn_fast:.1f}x fast / "
+                                  f"{self.burn_slow:.1f}x slow")
+
+        self._apply(found, detail)
+        return found
+
+    def _window_snap(self, t_floor: float) -> Dict[str, float]:
+        """Newest snapshot at/older than t_floor, else the oldest held —
+        a young engine burns over the history it has (_lock held)."""
+        chosen = self._snaps[0][1]
+        for t, sig in self._snaps:
+            if t <= t_floor:
+                chosen = sig
+            else:
+                break
+        return chosen
+
+    def _apply(self, found: Dict[str, bool], detail: Dict[str, str]) -> None:
+        for name in DETECTORS:
+            was, now_on = self.active[name], found[name]
+            self.active[name] = now_on
+            if now_on:
+                self.detail[name] = detail.get(name, "")
+            else:
+                self.detail.pop(name, None)
+            if now_on and not was:
+                self.trips[name] += 1
+                log.warning("anomaly %s: %s", name, detail.get(name, ""))
+                if self.recorder is not None:
+                    self.recorder.emit(f"anomaly.{name}",
+                                       detail=detail.get(name, ""))
+                self._trigger_bundle(name)
+            elif was and not now_on:
+                log.info("anomaly %s cleared", name)
+                if self.recorder is not None:
+                    self.recorder.emit("anomaly.clear", detector=name)
+        self._export_gauges()
+
+    def _trigger_bundle(self, name: str) -> None:
+        writer = getattr(self.instance, "bundle_writer", None)
+        if writer is None:
+            return
+        try:
+            writer.write_for(self.instance, reason=f"anomaly:{name}",
+                             metrics=self.metrics)
+        except Exception:  # noqa: BLE001 — capture must not break detection
+            log.exception("anomaly bundle capture failed")
+
+    def _export_gauges(self) -> None:
+        m = self.metrics
+        if m is None:
+            return
+        try:
+            for name in DETECTORS:
+                m.anomaly_active.labels(detector=name).set(
+                    1 if self.active[name] else 0)
+            m.slo_burn_rate.labels(window="fast").set(self.burn_fast)
+            m.slo_burn_rate.labels(window="slow").set(self.burn_slow)
+        except Exception:  # noqa: BLE001 — metrics must not break detection
+            pass
+
+    # --------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        """Daemon mode: a background ticker sweeps every interval even
+        with no scrapes or health probes arriving."""
+        if self._thread is not None:
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, name="anomaly",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=2.0)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.check()
+            except Exception:  # noqa: BLE001 — the watcher must survive
+                log.exception("anomaly sweep failed")
+
+    # ------------------------------------------------------- inspection
+
+    def health_note(self) -> str:
+        """Health annotation, "" when quiet — annotation only: anomalies
+        flag investigation-worthy state, they never flip a node unhealthy
+        by themselves (the conditions that should do that already do)."""
+        on = [d for d in DETECTORS if self.active[d]]
+        if not on:
+            return ""
+        parts = [f"{d}({self.detail[d]})" if self.detail.get(d) else d
+                 for d in on]
+        return "anomaly: " + ", ".join(parts)
+
+    def debug(self) -> dict:
+        """The /v1/debug/vars "anomaly" section."""
+        with self._lock:
+            slo = {"target_ms": self.slo_target_ms,
+                   "objective": self.slo_objective,
+                   "total": self._slo_total, "good": self._slo_good,
+                   "errors": self._slo_errors}
+        return {
+            "interval_s": self.interval_s,
+            "checks": self.checks,
+            "active": [d for d in DETECTORS if self.active[d]],
+            "detail": dict(self.detail),
+            "trips": dict(self.trips),
+            "slo": slo,
+            "burn_fast": round(self.burn_fast, 3),
+            "burn_slow": round(self.burn_slow, 3),
+        }

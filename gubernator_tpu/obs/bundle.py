@@ -1,0 +1,261 @@
+"""Diagnostic bundles: one JSON artifact capturing a node's incident
+state, and the cluster-federated debug view.
+
+A bundle is everything an operator would otherwise collect by hand from
+a sick node — /v1/debug/vars, recent traces, the flight-recorder tail,
+a metrics snapshot, the config/env fingerprint, and the ring +
+peer-circuit view — serialized while the state is still hot. Bundles are
+written on demand (/v1/debug/bundle) or by the anomaly engine on a
+rising edge (rate-limited, ``GUBER_BUNDLE_DIR``).
+
+The federated view (/v1/debug/cluster) fans a Debug RPC out over the
+existing peer ring, merges per-node health/vars/anomaly state, and
+stitches cross-node spans by trace id into one causal timeline (span
+timestamps are wall-clock ``time.time_ns()``, so ordering holds to
+cluster clock sync).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import os
+import re
+import threading
+import time
+from typing import Dict, List, Optional
+
+from gubernator_tpu.obs.introspect import debug_vars
+
+log = logging.getLogger("gubernator_tpu.bundle")
+
+BUNDLE_SCHEMA_VERSION = 1
+# env var names carrying credentials never leave the process in a bundle
+_SECRET_PAT = re.compile(r"PASSWORD|SECRET|TOKEN|CREDENTIAL|PRIVATE",
+                         re.IGNORECASE)
+REDACTED = "**redacted**"
+
+
+def env_fingerprint() -> Dict[str, str]:
+    """Every GUBER_*/JAX_* var shaping this process, secrets redacted
+    (GUBER_ETCD_PASSWORD, GUBER_MEMBERLIST_SECRET_KEYS,
+    GUBER_CROSS_HOST_SECRET, and anything else matching the pattern)."""
+    out: Dict[str, str] = {}
+    for k in sorted(os.environ):
+        if not (k.startswith("GUBER_") or k.startswith("JAX_")):
+            continue
+        out[k] = REDACTED if _SECRET_PAT.search(k) else os.environ[k]
+    return out
+
+
+def _health_dict(instance) -> dict:
+    try:
+        h = instance.health_check()
+        return {"status": h.status, "message": h.message,
+                "peer_count": h.peer_count}
+    except Exception as e:  # noqa: BLE001 — a bundle beats a perfect bundle
+        return {"error": str(e)}
+
+
+def _circuit_view(instance) -> List[dict]:
+    out = []
+    all_peers = getattr(instance, "all_peer_clients", None)
+    if callable(all_peers):
+        for p in all_peers():
+            c = getattr(p, "circuit", None)
+            if c is None:
+                continue
+            out.append({"peer": p.info.address,
+                        "state": c.state_name,
+                        "opened_total": c.opened_total})
+    return out
+
+
+def node_report(instance, max_events: int = 512) -> dict:
+    """The federation unit: what one node contributes to the cluster
+    view (also the Debug RPC response body). A strict subset of the full
+    bundle — no metrics text or env fingerprint crosses the wire."""
+    report = {
+        "schema_version": BUNDLE_SCHEMA_VERSION,
+        "node": getattr(instance, "advertise_address", ""),
+        "datacenter": getattr(instance, "data_center", ""),
+        "captured_at": time.time(),
+        "health": _health_dict(instance),
+        "vars": debug_vars(instance),
+        "circuits": _circuit_view(instance),
+    }
+    rec = getattr(instance, "recorder", None)
+    if rec is not None:
+        report["flight_recorder"] = rec.tail(max_events)
+    an = getattr(instance, "anomaly", None)
+    if an is not None:
+        report["anomaly"] = an.debug()
+    tracer = getattr(instance, "tracer", None)
+    if tracer is not None:
+        report["traces"] = tracer.traces()
+    return report
+
+
+def build_bundle(instance, reason: str = "on-demand",
+                 metrics=None) -> dict:
+    """The full single-node artifact: node_report plus the process
+    fingerprint and a metrics-exposition snapshot."""
+    bundle = node_report(instance, max_events=0)  # full recorder tail
+    bundle["kind"] = "gubernator-debug-bundle"
+    bundle["reason"] = reason
+    bundle["env"] = env_fingerprint()
+    conf = getattr(instance, "conf", None)
+    if conf is not None and getattr(conf, "behaviors", None) is not None:
+        try:
+            bundle["behaviors"] = dataclasses.asdict(conf.behaviors)
+        except Exception:  # noqa: BLE001
+            bundle["behaviors"] = repr(conf.behaviors)
+    m = metrics or (getattr(conf, "metrics", None) if conf else None)
+    if m is not None:
+        try:
+            bundle["metrics_text"] = m.render(instance).decode()
+        except Exception as e:  # noqa: BLE001
+            bundle["metrics_text"] = f"render failed: {e}"
+    return bundle
+
+
+class BundleWriter:
+    """Rate-limited, keep-N bundle sink under GUBER_BUNDLE_DIR.
+
+    Anomaly-triggered captures go through `write_for`, which drops
+    writes inside `min_interval_s` of the last (an incident storm must
+    not turn the recorder into a disk-filling anomaly of its own) and
+    prunes the directory to the newest `keep` bundles."""
+
+    def __init__(self, directory: str, min_interval_s: float = 60.0,
+                 keep: int = 20):
+        self.directory = directory
+        self.min_interval_s = float(min_interval_s)
+        self.keep = int(keep)
+        self._lock = threading.Lock()
+        self._last_write = 0.0
+        self.stats = {"written": 0, "suppressed": 0, "errors": 0}
+
+    def write_for(self, instance, reason: str,
+                  metrics=None) -> Optional[str]:
+        """Capture + write, rate-limited; returns the path or None."""
+        now = time.monotonic()
+        with self._lock:
+            if self._last_write and now - self._last_write \
+                    < self.min_interval_s:
+                self.stats["suppressed"] += 1
+                return None
+            self._last_write = now
+        try:
+            return self.write(build_bundle(instance, reason=reason,
+                                           metrics=metrics))
+        except Exception:  # noqa: BLE001 — capture must not break serving
+            self.stats["errors"] += 1
+            log.exception("bundle write failed")
+            return None
+
+    def write(self, bundle: dict) -> str:
+        os.makedirs(self.directory, exist_ok=True)
+        reason = re.sub(r"[^A-Za-z0-9_.-]+", "-",
+                        str(bundle.get("reason", "bundle")))[:48]
+        stamp = time.strftime("%Y%m%dT%H%M%S", time.gmtime())
+        path = os.path.join(
+            self.directory,
+            f"bundle-{stamp}-{os.getpid()}-{reason}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(bundle, f, separators=(",", ":"), default=str)
+        os.replace(tmp, path)
+        self.stats["written"] += 1
+        self._prune()
+        log.warning("diagnostic bundle written: %s (reason=%s)", path,
+                    bundle.get("reason"))
+        return path
+
+    def _prune(self) -> None:
+        try:
+            names = sorted(n for n in os.listdir(self.directory)
+                           if n.startswith("bundle-")
+                           and n.endswith(".json"))
+            for n in names[:-self.keep] if self.keep > 0 else []:
+                os.unlink(os.path.join(self.directory, n))
+        except OSError:
+            pass
+
+    def debug(self) -> dict:
+        return {"dir": self.directory, "keep": self.keep,
+                "min_interval_s": self.min_interval_s, **self.stats}
+
+
+# ------------------------------------------------------------ federation
+
+def cluster_view(instance, timeout_s: float = 5.0,
+                 max_traces: int = 20) -> dict:
+    """Fan a Debug RPC out over the peer ring and merge.
+
+    Every local-region + cross-region member answers with its
+    node_report; this node contributes its own without a hop. Per-node
+    failures degrade to an `errors` entry — a federated view that dies
+    with its sickest member would be useless exactly when needed."""
+    from gubernator_tpu.service.grpc_api import dial_v1
+
+    self_addr = getattr(instance, "advertise_address", "")
+    addresses = [self_addr] if self_addr else []
+    all_peers = getattr(instance, "all_peer_clients", None)
+    if callable(all_peers):
+        for p in all_peers():
+            if p.info.address not in addresses:
+                addresses.append(p.info.address)
+
+    nodes: Dict[str, dict] = {}
+    errors: Dict[str, str] = {}
+    for addr in addresses:
+        if addr == self_addr:
+            nodes[addr] = node_report(instance)
+            continue
+        try:
+            raw = dial_v1(addr).Debug(b"", timeout=timeout_s)
+            nodes[addr] = json.loads(raw.decode("utf-8"))
+        except Exception as e:  # noqa: BLE001 — degrade per node
+            errors[addr] = str(e)
+
+    # merge: which detectors are firing where, and one stitched timeline
+    # per trace id across every node that recorded spans for it
+    anomalies: Dict[str, List[str]] = {}
+    unhealthy: Dict[str, str] = {}
+    spans_by_tid: Dict[str, List[dict]] = {}
+    for addr, rep in nodes.items():
+        for d in (rep.get("anomaly") or {}).get("active", []):
+            anomalies.setdefault(d, []).append(addr)
+        health = rep.get("health") or {}
+        if health.get("status") not in ("healthy", None):
+            unhealthy[addr] = health.get("message", "")
+        for tid, spans in (rep.get("traces") or {}).items():
+            bucket = spans_by_tid.setdefault(tid, [])
+            for s in spans:
+                bucket.append({**s, "node": addr})
+
+    recent = sorted(
+        spans_by_tid,
+        key=lambda tid: max(s["start_ns"] for s in spans_by_tid[tid]),
+        reverse=True)[:max_traces]
+    stitched = {
+        tid: sorted(spans_by_tid[tid], key=lambda s: s["start_ns"])
+        for tid in recent
+    }
+    cross_node = {tid for tid, spans in stitched.items()
+                  if len({s["node"] for s in spans}) > 1}
+
+    return {
+        "schema_version": BUNDLE_SCHEMA_VERSION,
+        "captured_at": time.time(),
+        "coordinator": self_addr,
+        "member_count": len(addresses),
+        "nodes": nodes,
+        "errors": errors,
+        "anomalies": anomalies,
+        "unhealthy": unhealthy,
+        "stitched_traces": stitched,
+        "cross_node_traces": sorted(cross_node),
+    }

@@ -10,6 +10,12 @@ from __future__ import annotations
 
 from typing import Optional
 
+# Version of the snapshot's shape. Bump when a section is renamed or its
+# meaning changes; ADDING a section is not a bump (the schema is
+# subset-stable — consumers must tolerate new sections). Pinned by
+# tests/test_debug_schema.py.
+DEBUG_VARS_SCHEMA_VERSION = 1
+
 
 def _backend_vars(backend) -> dict:
     out: dict = {"type": type(backend).__name__}
@@ -50,6 +56,7 @@ def debug_vars(instance) -> dict:
     from gubernator_tpu.ops.decide import kernel_telemetry
 
     out: dict = {
+        "schema_version": DEBUG_VARS_SCHEMA_VERSION,
         "advertise_address": instance.advertise_address,
         "engine": _backend_vars(instance.backend),
         "combiner": dict(instance.combiner.stats),
@@ -109,4 +116,17 @@ def debug_vars(instance) -> dict:
     mr = getattr(instance, "multiregion_manager", None)
     if mr is not None and getattr(mr, "stats", None):
         out["multiregion"] = dict(mr.stats)
+
+    rec = getattr(instance, "recorder", None)
+    if rec is not None:
+        out["flight_recorder"] = rec.debug()
+    an = getattr(instance, "anomaly", None)
+    if an is not None:
+        out["anomaly"] = an.debug()
+    bw = getattr(instance, "bundle_writer", None)
+    if bw is not None:
+        out["bundles"] = bw.debug()
+    de = getattr(instance, "deadline_expired_stats", None)
+    if de:
+        out["deadline_expired"] = dict(de)
     return out

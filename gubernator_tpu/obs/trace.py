@@ -40,6 +40,30 @@ from typing import Dict, List, Optional
 
 slow_log = logging.getLogger("gubernator_tpu.slow")
 
+
+def install_slow_log_file(path: str, max_mb: float = 64.0,
+                          backups: int = 2) -> Optional[object]:
+    """Attach a size-rotated file sink to the slow-request logger
+    (GUBER_SLOW_LOG_PATH / GUBER_SLOW_LOG_MAX_MB). Without a bound the
+    one-line-per-slow-request log grows without limit on a node that is
+    slow BECAUSE it is sick — exactly when disk is the wrong thing to
+    exhaust. Returns the handler (tests close it), None when disabled or
+    the path is unwritable (stderr logging still works)."""
+    if not path or max_mb <= 0:
+        return None
+    from logging.handlers import RotatingFileHandler
+
+    try:
+        handler = RotatingFileHandler(
+            path, maxBytes=int(max_mb * 1024 * 1024), backupCount=backups)
+    except OSError:
+        logging.getLogger(__name__).exception(
+            "slow-log file sink unavailable: %s", path)
+        return None
+    handler.setFormatter(logging.Formatter("%(message)s"))
+    slow_log.addHandler(handler)
+    return handler
+
 # W3C traceparent: version "00" - 16-byte trace id - 8-byte span id - flags
 _SAMPLED_FLAG = 0x01
 

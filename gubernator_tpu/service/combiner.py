@@ -97,10 +97,12 @@ class BackendCombiner:
     split, or depth == 1)."""
 
     def __init__(self, backend, name: str = "backend-combiner",
-                 metrics=None, tracer=None, depth=None, scan=None):
+                 metrics=None, tracer=None, depth=None, scan=None,
+                 recorder=None):
         self.backend = backend
         self._metrics = metrics
         self._tracer = tracer
+        self._recorder = recorder  # flight recorder (obs/events.py) or None
         self._cond = threading.Condition()
         # pending entry: (reqs, now_ms, future, enqueue time_ns, span|None,
         # deadline|None)
@@ -457,6 +459,11 @@ class BackendCombiner:
         self._flush_windows(windows, now_ms)
 
     def _flush_windows(self, windows, now_ms) -> None:
+        if len(windows) > self._scan and self._recorder is not None:
+            # the scan bound cut this timestamp group into several
+            # launches — the pipeline is running at its coalescing limit
+            self._recorder.emit("combiner.group_cut",
+                                windows=len(windows), scan=self._scan)
         for g0 in range(0, len(windows), self._scan):
             self._launch_group(windows[g0:g0 + self._scan], now_ms)
 
@@ -502,6 +509,10 @@ class BackendCombiner:
             self._fill_stalls += 1
             if m is not None:
                 m.combiner_fill_stalls.inc()
+            if self._recorder is not None:
+                self._recorder.emit("combiner.fill_stall",
+                                    depth=self._depth,
+                                    windows=len(group))
             slots.acquire()
         staging = self._staging[self._launch_seq % len(self._staging)]
         try:

@@ -126,6 +126,15 @@ class InstanceConfig:
     # (GUBER_PIPELINE_SCAN).
     pipeline_depth: Optional[int] = None
     pipeline_scan: Optional[int] = None
+    # obs.events.FlightRecorder; optional — the Instance builds one
+    # (enabled unless GUBER_FLIGHT_RECORDER=0) when omitted
+    recorder: Optional[object] = None
+    # anomaly watchers (obs/anomaly.py): sweep cadence and the decision
+    # SLO the burn-rate engine accounts against (GUBER_ANOMALY_INTERVAL /
+    # GUBER_SLO_TARGET_MS / GUBER_SLO_OBJECTIVE)
+    anomaly_interval_s: float = 5.0
+    slo_target_ms: float = 250.0
+    slo_objective: float = 0.999
 
     def validate(self) -> None:
         if self.behaviors.batch_limit > MAX_BATCH_SIZE:
@@ -155,3 +164,9 @@ class InstanceConfig:
         if not 0.0 < self.behaviors.hot_lease_fraction <= 1.0:
             raise ValueError(
                 "behaviors.hot_lease_fraction must be in (0, 1]")
+        if self.anomaly_interval_s <= 0:
+            raise ValueError("anomaly_interval_s must be positive")
+        if self.slo_target_ms <= 0:
+            raise ValueError("slo_target_ms must be positive")
+        if not 0.0 < self.slo_objective < 1.0:
+            raise ValueError("slo_objective must be in (0, 1)")

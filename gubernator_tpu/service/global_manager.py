@@ -37,10 +37,12 @@ class _Pipeline:
     batching loops, interval.go:26-69 / global.go:73-112)."""
 
     def __init__(self, name: str, wait_s: float, limit: int, flush_fn,
-                 observe=None):
+                 observe=None, recorder=None):
         self._name = name
         self._wait_s = wait_s
         self._limit = limit
+        self._recorder = recorder  # flight recorder (obs/events.py) or None
+        self._hw_flagged = False  # edge state for the high-water event
         if observe is not None:
             # time every flush into a histogram, the reference's defer'd
             # duration observation (global.go:155,238)
@@ -87,6 +89,14 @@ class _Pipeline:
                 self._deadline = time.monotonic() + self._wait_s
         if was_empty or n >= self._limit:
             self._wake.set()
+        if n >= self._limit and not self._hw_flagged:
+            # edge-triggered: the queue filled to its flush cap before the
+            # wait window elapsed — sustained means the flusher is behind
+            self._hw_flagged = True
+            if self._recorder is not None:
+                self._recorder.emit("global.queue_high_water",
+                                    pipeline=self._name, depth=n,
+                                    limit=self._limit)
 
     def depth(self) -> int:
         """Keys currently queued and not yet flushed (scrape-time gauge)."""
@@ -97,6 +107,7 @@ class _Pipeline:
         with self._lock:
             out, self._pending = self._pending, {}
             self._deadline = None
+        self._hw_flagged = False  # re-arm the high-water edge
         return out
 
     def _run(self) -> None:
@@ -151,15 +162,18 @@ class GlobalManager:
         # admission controller (instance.py): under pressure, GLOBAL
         # broadcasts are the FIRST work class to shed — see queue_update
         self.admission = admission
+        recorder = getattr(instance, "recorder", None)
         self._hits = _Pipeline(
             "hits", behaviors.global_sync_wait_s, behaviors.global_batch_limit,
             self._send_hits,
             observe=metrics.async_durations.observe if metrics else None,
+            recorder=recorder,
         )
         self._broadcasts = _Pipeline(
             "broadcast", behaviors.global_sync_wait_s,
             behaviors.global_batch_limit, self._broadcast,
             observe=metrics.broadcast_durations.observe if metrics else None,
+            recorder=recorder,
         )
         self.stats = {"hits_sent": 0, "broadcasts_sent": 0, "broadcast_errors": 0}
 

@@ -93,6 +93,16 @@ def v1_handler(servicer) -> grpc.GenericRpcHandler:
                 request_deserializer=pb.HealthCheckReq.FromString,
                 response_serializer=_serialize,
             ),
+            "Debug": grpc.unary_unary_rpc_method_handler(
+                # the federated debug plane (obs/bundle.py cluster_view):
+                # raw JSON bytes with identity serializers — no protoc run
+                # needed for a diagnostics-only message, and like
+                # HealthCheck it stays unguarded so an overloaded node can
+                # still be inspected
+                servicer.Debug,
+                request_deserializer=lambda b: b,
+                response_serializer=lambda b: b,
+            ),
         },
     )
 
@@ -130,6 +140,11 @@ class V1Stub:
             f"/{V1_SERVICE}/HealthCheck",
             request_serializer=_serialize,
             response_deserializer=pb.HealthCheckResp.FromString,
+        )
+        self.Debug = channel.unary_unary(
+            f"/{V1_SERVICE}/Debug",
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
         )
 
 

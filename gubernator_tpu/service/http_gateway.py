@@ -13,6 +13,11 @@ reference daemon's expvar/pprof handlers):
 - GET /v1/debug/vars    — live pipeline snapshot (obs/introspect.py)
 - GET /v1/debug/traces  — recent-trace ring buffer, grouped by trace id
   (?id=<trace_id> filters to one trace)
+- GET /v1/debug/events  — flight-recorder tail (?n=<count>, ?kind=<prefix>)
+- GET /v1/debug/bundle  — full diagnostic bundle (obs/bundle.py;
+  ?write=1 also persists it to GUBER_BUNDLE_DIR when configured)
+- GET /v1/debug/cluster — federated view: every peer's node report merged,
+  cross-node traces stitched by traceparent (?timeout=<seconds>)
 """
 
 from __future__ import annotations
@@ -120,6 +125,36 @@ class HttpGateway:
                         q = parse_qs(url.query)
                         body = {"traces": gateway.instance.tracer.traces(
                             q.get("id", [""])[0])}
+                    elif url.path == "/v1/debug/events":
+                        q = parse_qs(url.query)
+                        rec = getattr(gateway.instance, "recorder", None)
+                        body = {
+                            "recorder": rec.debug() if rec else None,
+                            "events": rec.tail(
+                                int(q.get("n", ["0"])[0] or 0),
+                                kind=q.get("kind", [""])[0],
+                            ) if rec else [],
+                        }
+                    elif url.path == "/v1/debug/bundle":
+                        from gubernator_tpu.obs.bundle import build_bundle
+
+                        q = parse_qs(url.query)
+                        body = build_bundle(gateway.instance,
+                                            reason="on-demand",
+                                            metrics=gateway.metrics)
+                        writer = getattr(
+                            gateway.instance, "bundle_writer", None)
+                        if q.get("write", ["0"])[0] == "1" \
+                                and writer is not None:
+                            body["written_to"] = writer.write(body)
+                    elif url.path == "/v1/debug/cluster":
+                        from gubernator_tpu.obs.bundle import cluster_view
+
+                        q = parse_qs(url.query)
+                        body = cluster_view(
+                            gateway.instance,
+                            timeout_s=float(
+                                q.get("timeout", ["5"])[0] or 5))
                     else:
                         self._reply_error(404, "not found")
                         return

@@ -30,6 +30,8 @@ from gubernator_tpu.cluster.pickers import (
     ReplicatedConsistentHashPicker,
 )
 from gubernator_tpu.obs import trace
+from gubernator_tpu.obs.anomaly import AnomalyEngine
+from gubernator_tpu.obs.events import FlightRecorder
 from gubernator_tpu.obs.trace import Tracer
 from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.combiner import BackendCombiner
@@ -115,11 +117,17 @@ class AdmissionController:
     BROWNOUT_FRACTION = 0.75
     RETRY_AFTER_S = 1.0
 
+    _LEVEL_NAMES = {0: "admit", 1: "brownout", 2: "saturated"}
+
     def __init__(self, instance: "Instance", metrics=None):
         self.instance = instance
         self.metrics = metrics
         self.stats = {"shed_forward": 0, "shed_broadcast": 0,
                       "shed_ingress": 0, "shed_peer": 0}
+        # last level seen by level() — the brownout enter/exit edge the
+        # flight recorder timestamps (racy reads lose nothing: a lost
+        # edge re-fires on the next level() call)
+        self._last_level = self.ADMIT
 
     @property
     def max_pending(self) -> int:
@@ -148,10 +156,19 @@ class AdmissionController:
             return self.ADMIT
         pending = self.pending()
         if pending >= cap:
-            return self.SATURATED
-        if pending >= cap * self.BROWNOUT_FRACTION:
-            return self.BROWNOUT
-        return self.ADMIT
+            lvl = self.SATURATED
+        elif pending >= cap * self.BROWNOUT_FRACTION:
+            lvl = self.BROWNOUT
+        else:
+            lvl = self.ADMIT
+        if lvl != self._last_level:
+            prev, self._last_level = self._last_level, lvl
+            rec = getattr(self.instance, "recorder", None)
+            if rec is not None:
+                rec.emit(f"admission.{self._LEVEL_NAMES[lvl]}",
+                         prev=self._LEVEL_NAMES[prev], pending=pending,
+                         max_pending=cap)
+        return lvl
 
     def check_ingress(self, priority: str = "ingress") -> int:
         """The whole-call gate: raises RESOURCE_EXHAUSTED at SATURATED,
@@ -217,12 +234,17 @@ class Instance:
         # always present; sample 0 (the default) keeps every trace site a
         # guarded no-op — daemons wire GUBER_TRACE_SAMPLE through here
         self.tracer = conf.tracer or Tracer()
+        # flight recorder (obs/events.py): always constructed so every
+        # subsystem hook is one attribute test; GUBER_FLIGHT_RECORDER=0
+        # turns each emit into a single bool read
+        self.recorder = conf.recorder or FlightRecorder()
         # concurrent callers merge into pipelined kernel launches: up to
         # GUBER_PIPELINE_DEPTH window groups ride the link/device while
         # further windows pool up and pack (service/combiner.py)
         self.combiner = BackendCombiner(
             self.backend, metrics=conf.metrics, tracer=self.tracer,
-            depth=conf.pipeline_depth, scan=conf.pipeline_scan)
+            depth=conf.pipeline_depth, scan=conf.pipeline_scan,
+            recorder=self.recorder)
 
         self.local_picker = conf.local_picker or ReplicatedConsistentHashPicker()
         # The cross-region picker must route exactly like the DESTINATION
@@ -272,6 +294,19 @@ class Instance:
         self._collective_group = None  # None = every peer is in the group
         self._collective_covers = True
         self._peer_listeners = []
+        # per-stage deadline-expired counts: the metrics-independent
+        # signal the anomaly engine's deadline_burst detector diffs
+        self.deadline_expired_stats: Dict[str, int] = {}
+        # anomaly watchers (obs/anomaly.py): always constructed; sweeps
+        # run from health_check/scrape piggybacks (maybe_check) and, in
+        # daemons, a background ticker the daemon starts. The daemon also
+        # wires bundle_writer so rising edges capture diagnostic bundles.
+        self.bundle_writer = None
+        self.anomaly = AnomalyEngine(
+            self, metrics=conf.metrics, recorder=self.recorder,
+            interval_s=conf.anomaly_interval_s,
+            slo_target_ms=conf.slo_target_ms,
+            slo_objective=conf.slo_objective)
         self._closed = False
 
     def attach_collective(self, sync, group_peers=None) -> None:
@@ -339,7 +374,24 @@ class Instance:
     def get_rate_limits(
         self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None
     ) -> List[RateLimitResp]:
-        """Route one client batch (reference: gubernator.go:110-224)."""
+        """Route one client batch (reference: gubernator.go:110-224).
+
+        Timed end to end as one decision-latency observation for the SLO
+        burn-rate engine (obs/anomaly.py); rejections (saturation,
+        expired deadlines) burn error budget."""
+        t0 = time.perf_counter()
+        ok = False
+        try:
+            out = self._route_batch(requests, now_ms=now_ms)
+            ok = True
+            return out
+        finally:
+            self.anomaly.observe((time.perf_counter() - t0) * 1e3,
+                                 error=not ok)
+
+    def _route_batch(
+        self, requests: Sequence[RateLimitReq], now_ms: Optional[int] = None
+    ) -> List[RateLimitResp]:
         if len(requests) > MAX_BATCH_SIZE:
             raise ApiError(
                 "OUT_OF_RANGE",
@@ -538,9 +590,15 @@ class Instance:
             if samples:
                 line += f" ({samples})"
             parts.append(line)
-        # lease-tier state is annotation only: the tier degrades to strict
-        # forwarding on its own, so it must never flip a node unhealthy
+        # lease-tier and anomaly state are annotation only: both flag
+        # conditions worth investigating, and neither may flip a node
+        # unhealthy by itself (the underlying failures already do)
         lease_note = self.leases.health_note()
+        self.anomaly.maybe_check()  # health probes keep detection fresh
+        anomaly_note = self.anomaly.health_note()
+        if anomaly_note:
+            lease_note = (f"{lease_note} | {anomaly_note}" if lease_note
+                          else anomaly_note)
         if parts:
             message = " | ".join(parts)
             if len(message) > self.HEALTH_MESSAGE_CHARS:
@@ -572,13 +630,15 @@ class Instance:
                     peer = self.region_picker.get_by_peer_info(info)
                     if peer is None:
                         peer = PeerClient(self.conf.behaviors, info,
-                                          metrics=self.conf.metrics)
+                                          metrics=self.conf.metrics,
+                                          recorder=self.recorder)
                     new_region.add(peer)
                     continue
                 peer = self.local_picker.get_by_peer_info(info)
                 if peer is None:
                     peer = PeerClient(self.conf.behaviors, info,
-                                      metrics=self.conf.metrics)
+                                      metrics=self.conf.metrics,
+                                      recorder=self.recorder)
                     # the micro-batched per-request path flushes inside the
                     # client's worker thread, out of Instance's sight — the
                     # advisor lets that flush attach a hot-key lease ask to
@@ -618,6 +678,7 @@ class Instance:
         if self._closed:
             return
         self._closed = True
+        self.anomaly.stop()
         if self.collective_global is not None:
             self.collective_global.close()
         self.global_manager.close()
@@ -653,6 +714,8 @@ class Instance:
         fut.add_done_callback(_untrack)
 
     def _count_expired(self, stage: str) -> None:
+        self.deadline_expired_stats[stage] = \
+            self.deadline_expired_stats.get(stage, 0) + 1
         if self.conf.metrics is not None:
             try:
                 self.conf.metrics.deadline_expired.labels(stage=stage).inc()

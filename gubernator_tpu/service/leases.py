@@ -250,6 +250,14 @@ class LeaseManager:
         except Exception:  # noqa: BLE001 — metrics must not break serving
             pass
 
+    def _emit(self, kind: str, **fields) -> None:
+        """Flight-recorder hook (obs/events.py). Cold-key denials are NOT
+        emitted — they are the steady state of every non-hot ask, not a
+        state transition worth a ring slot."""
+        rec = getattr(self.instance, "recorder", None)
+        if rec is not None:
+            rec.emit(kind, **fields)
+
     def arm(self) -> None:
         """Build the hot-key detector and attach it to the backend.
 
@@ -296,6 +304,7 @@ class LeaseManager:
         if adm is not None and adm.enabled and adm.level() >= adm.BROWNOUT:
             self.stats["shed_brownout"] += 1
             self._count("lease_shed", reason="brownout")
+            self._emit("lease.deny", key=key, reason="brownout")
             return None
         t = self.tracker()
         if t is None or not t.is_hot(key):
@@ -325,11 +334,15 @@ class LeaseManager:
                 grants = live
             if grants and grants[-1].minted + ttl_ms / 2000.0 > now:
                 self.stats["denied_throttled"] += 1
+                self._emit("lease.deny", key=key, reason="throttled")
                 return None
             outstanding = sum(g.budget for g in grants) if grants else 0
             budget = int((int(remaining) - outstanding) * fraction)
             if budget <= 0:
                 self.stats["denied_exhausted"] += 1
+                self._emit("lease.deny", key=key, reason="exhausted",
+                           remaining=int(remaining),
+                           outstanding=outstanding)
                 return None
             self._seq += 1
             seq = self._seq
@@ -339,6 +352,8 @@ class LeaseManager:
             self.stats["grants"] += 1
             self.stats["granted_budget"] += budget
         self._count("lease_grants")
+        self._emit("lease.grant", key=key, budget=budget, ttl_ms=ttl_ms,
+                   seq=seq)
         if log.isEnabledFor(logging.DEBUG):
             log.debug("granted lease key=%s budget=%d ttl=%dms seq=%d",
                       key, budget, ttl_ms, seq)
@@ -460,6 +475,10 @@ class LeaseManager:
                 del self._held[key]
                 self.stats["expired_held"] += 1
                 self._count("lease_expired")
+                # fail-close: the lease died unrenewed (owner unreachable
+                # or renewal channel broken) — serving falls back to a
+                # strict forward, never to minted budget
+                self._emit("lease.fail_close", key=key, owner=h.owner)
                 return None
             if req.hits > h.budget:
                 self.stats["denied_exhausted"] += 1

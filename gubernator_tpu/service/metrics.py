@@ -357,6 +357,49 @@ class Metrics:
             "GUBER_HOT_LEASE_RATE detection threshold.",
             registry=self.registry,
         )
+        # observability plane (obs/events.py flight recorder, obs/anomaly.py
+        # watchers; docs/OPERATIONS.md "Incident response"). Recorder totals
+        # refresh at scrape from the ring's own counters; anomaly gauges are
+        # written by the engine on every check AND refreshed at scrape so a
+        # metrics-only deployment still sees them.
+        self.flight_recorder_events = Counter(
+            "flight_recorder_events_total",
+            "Structured events emitted into the flight-recorder ring since "
+            "boot (the ring itself only retains the newest window).",
+            registry=self.registry,
+        )
+        self.flight_recorder_dropped = Counter(
+            "flight_recorder_dropped_total",
+            "Flight-recorder events evicted by the bounded ring (oldest "
+            "out as newer events arrive).",
+            registry=self.registry,
+        )
+        self.anomaly_active = Gauge(
+            "anomaly_active",
+            "Anomaly watcher state per detector (1 = currently firing). "
+            "Rising edges also write a diagnostic bundle when "
+            "GUBER_BUNDLE_DIR is set.",
+            ["detector"], registry=self.registry,
+        )
+        self.anomaly_trips = Counter(
+            "anomaly_trips_total",
+            "Rising-edge anomaly detections per detector since boot.",
+            ["detector"], registry=self.registry,
+        )
+        self.slo_burn_rate = Gauge(
+            "slo_burn_rate",
+            "Error-budget burn rate of the serving SLO over the fast/slow "
+            "alert windows (1.0 = burning exactly the sustainable rate; "
+            "the slo_burn detector fires when BOTH windows exceed their "
+            "thresholds).",
+            ["window"], registry=self.registry,
+        )
+        self.bundles_written = Counter(
+            "debug_bundles_written_total",
+            "Diagnostic bundles written to GUBER_BUNDLE_DIR (anomaly "
+            "triggers plus explicit /v1/debug/bundle?write=1 requests).",
+            registry=self.registry,
+        )
         self.request_budget_ms = Histogram(
             "request_budget_ms",
             "Deadline budget observed at capture, by surface (public = "
@@ -541,6 +584,39 @@ class Metrics:
         adm = getattr(instance, "admission", None)
         if adm is not None:
             self.admission_pending.set(adm.pending())
+        rec = getattr(instance, "recorder", None)
+        if rec is not None:
+            d = rec.debug()
+            self._set_counter(
+                self.flight_recorder_events,
+                float(sum(d.get("counts", {}).values())))
+            self._set_counter(
+                self.flight_recorder_dropped, float(d.get("dropped", 0)))
+        an = getattr(instance, "anomaly", None)
+        if an is not None:
+            try:
+                # scrapes double as the check tick for threadless
+                # deployments (in-process clusters never call start())
+                an.maybe_check()
+            except Exception:  # noqa: BLE001 — watchers must not break
+                pass           # /metrics
+            d = an.debug()
+            active = set(d.get("active", ()))
+            for det in d.get("trips", {}):
+                self.anomaly_active.labels(detector=det).set(
+                    1.0 if det in active else 0.0)
+                self._set_counter(
+                    self.anomaly_trips.labels(detector=det),
+                    float(d["trips"][det]))
+            self.slo_burn_rate.labels(window="fast").set(
+                d.get("burn_fast", 0.0))
+            self.slo_burn_rate.labels(window="slow").set(
+                d.get("burn_slow", 0.0))
+        bw = getattr(instance, "bundle_writer", None)
+        if bw is not None:
+            self._set_counter(
+                self.bundles_written,
+                float(bw.stats.get("written", 0)))
         gm = getattr(instance, "global_manager", None)
         if gm is not None:
             hits_depth, bcast_depth = gm.depths()

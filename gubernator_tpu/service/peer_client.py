@@ -61,16 +61,22 @@ class CircuitBreaker:
     `circuit_threshold <= 0` disables the breaker entirely — every call
     behaves exactly as before this layer existed."""
 
-    def __init__(self, conf: BehaviorConfig, address: str, metrics=None):
+    def __init__(self, conf: BehaviorConfig, address: str, metrics=None,
+                 recorder=None):
         self.conf = conf
         self.address = address
         self.metrics = metrics
+        self.recorder = recorder  # flight recorder (obs/events.py) or None
         self._lock = threading.Lock()
         self._failures = 0
         self._state = CIRCUIT_CLOSED
         self._opened_at = 0.0
         self._probing = False
         self.opened_total = 0  # lifetime open transitions (health/debug)
+
+    def _record(self, kind: str, **fields) -> None:
+        if self.recorder is not None:
+            self.recorder.emit(kind, peer=self.address, **fields)
 
     @property
     def enabled(self) -> bool:
@@ -108,6 +114,7 @@ class CircuitBreaker:
                     return False
                 self._state = CIRCUIT_HALF_OPEN
                 self._probing = True
+                self._record("circuit.half_open")
                 return True
             if self._probing:  # HALF_OPEN with the probe already in flight
                 return False
@@ -116,34 +123,44 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         with self._lock:
+            closed = self._state != CIRCUIT_CLOSED
             self._failures = 0
             self._probing = False
             self._state = CIRCUIT_CLOSED
+        if closed:
+            self._record("circuit.close")
 
     def record_failure(self) -> None:
         if not self.enabled:
             return
         opened = False
+        probe_failed = False
+        failures = 0
         with self._lock:
             self._failures += 1
+            failures = self._failures
             if self._state == CIRCUIT_HALF_OPEN:
                 # the probe failed: reopen for another cooldown
                 self._state = CIRCUIT_OPEN
                 self._opened_at = time.monotonic()
                 self._probing = False
                 self.opened_total += 1
-                opened = True
+                opened = probe_failed = True
             elif (self._state == CIRCUIT_CLOSED
                   and self._failures >= self.conf.circuit_threshold):
                 self._state = CIRCUIT_OPEN
                 self._opened_at = time.monotonic()
                 self.opened_total += 1
                 opened = True
-        if opened and self.metrics is not None:
-            try:
-                self.metrics.circuit_open.labels(peer=self.address).inc()
-            except Exception:  # noqa: BLE001 — metrics must not break calls
-                pass
+        if opened:
+            self._record("circuit.open", failures=failures,
+                         probe_failed=probe_failed,
+                         cooldown_s=self._open_s())
+            if self.metrics is not None:
+                try:
+                    self.metrics.circuit_open.labels(peer=self.address).inc()
+                except Exception:  # noqa: BLE001 — metrics must not break calls
+                    pass
 
 
 class PeerClient:
@@ -152,13 +169,14 @@ class PeerClient:
     ERR_TTL_MS = 5 * 60 * 1000  # last-error retention (reference: peer_client.go:53)
 
     def __init__(self, behaviors: BehaviorConfig, info: PeerInfo,
-                 metrics=None):
+                 metrics=None, recorder=None):
         self.conf = behaviors
         self.info = info
         self.metrics = metrics
         # one breaker for BOTH transports: peerlink timeouts and gRPC
         # failures feed the same consecutive-failure count
-        self.circuit = CircuitBreaker(behaviors, info.address, metrics)
+        self.circuit = CircuitBreaker(behaviors, info.address, metrics,
+                                      recorder=recorder)
         self._stub: Optional[PeersV1Stub] = None
         self._channel: Optional[grpc.Channel] = None
         self._queue: "queue.Queue" = queue.Queue()
@@ -212,7 +230,8 @@ class PeerClient:
         try:
             link = PeerLinkClient(f"{host}:{int(port) + offset}",
                                   fault_key=self.info.address,
-                                  wire_v2=getattr(self.conf, "wire_v2", None))
+                                  wire_v2=getattr(self.conf, "wire_v2", None),
+                                  recorder=self.circuit.recorder)
         except (OSError, ValueError, PeerLinkError):
             self._link_retry_at = time.monotonic() + self._link_retry_delay()
             return None

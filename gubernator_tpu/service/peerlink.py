@@ -327,9 +327,11 @@ class PeerLinkClient:
     a reader thread demuxes responses by rid into futures."""
 
     def __init__(self, address: str, connect_timeout_s: float = 1.0,
-                 fault_key: str = "", wire_v2: Optional[bool] = None):
+                 fault_key: str = "", wire_v2: Optional[bool] = None,
+                 recorder=None):
         host, _, port = address.rpartition(":")
         self.address = address
+        self._recorder = recorder  # flight recorder (obs/events.py) or None
         # the fault-injection identity of this link (faults.py): PeerClient
         # passes the peer's ADVERTISED address so one GUBER_FAULT_SPEC peer
         # key covers both transports; standalone clients default to the
@@ -476,6 +478,9 @@ class PeerLinkClient:
                     self._sock.sendall(
                         struct.pack("<IQBH", 11, 0, WIRE_HELLO, 2))
                 self.wire_version = 2
+                if self._recorder is not None:
+                    self._recorder.emit("wire.v2_upgrade", peer=self.address,
+                                        server_max=int(server_max))
             return
         if method != WIRE_PARTIAL:
             return
@@ -610,6 +615,9 @@ class PeerLinkService:
                     f"peerlink: cannot bind gRPC port {grpc_port}")
             self.grpc_port = gp
         self.instance = instance
+        # flight recorder (obs/events.py): columnar pipeline cuts and
+        # fill stalls become causal events alongside the stat counters
+        self._recorder = getattr(instance, "recorder", None)
         # /v1/debug/vars "wire" section (obs/introspect.py) reads live
         # wire-contract state off this back-reference
         instance.peerlink_service = self
@@ -776,9 +784,15 @@ class PeerLinkService:
                     out = peers.UpdatePeerGlobals(
                         peers_pb.UpdatePeerGlobalsReq.FromString(body),
                         _RawCtx())
+                elif path == "/pb.gubernator.V1/Debug":
+                    # raw-bytes RPC (identity serializers, no protoc): the
+                    # response is already the wire payload
+                    out = None
+                    resp = v1.Debug(body, _RawCtx())
                 else:
                     raise _RawAbort(12, f"unknown method {path}")
-                resp = out.SerializeToString()
+                if out is not None:
+                    resp = out.SerializeToString()
             except _RawAbort as e:
                 status, msg = e.code, e.details.encode()
             except Exception as e:  # noqa: BLE001
@@ -1426,6 +1440,9 @@ class PeerLinkService:
                         self.stats["columnar_cuts"] += 1
                         if mt is not None:
                             mt.peerlink_columnar_cuts.inc()
+                        if self._recorder is not None:
+                            self._recorder.emit("peerlink.columnar_cut",
+                                                windows=consumed)
                     break
             if not inflight:
                 continue
@@ -1455,6 +1472,9 @@ class PeerLinkService:
                     self.stats["columnar_fill_stalls"] += 1
                     if mt is not None:
                         mt.peerlink_columnar_fill_stalls.inc()
+                    if self._recorder is not None:
+                        self._recorder.emit("peerlink.fill_stall",
+                                            depth=self._col_depth)
                 drain_one()
         return True
 
@@ -1502,6 +1522,9 @@ class PeerLinkService:
                 self.stats["columnar_fill_stalls"] += 1
                 if mt is not None:
                     mt.peerlink_columnar_fill_stalls.inc()
+                if self._recorder is not None:
+                    self._recorder.emit("peerlink.fill_stall",
+                                        depth=self._col_depth)
                 self._drain_one_entry(ws)
                 continue
             gspans = spans[wi:wi + scan]
@@ -1542,6 +1565,9 @@ class PeerLinkService:
                     self.stats["columnar_cuts"] += 1
                     if mt is not None:
                         mt.peerlink_columnar_cuts.inc()
+                    if self._recorder is not None:
+                        self._recorder.emit("peerlink.columnar_cut",
+                                            windows=consumed)
                 # barrier: drain in dispatch order (the cut window's
                 # leftovers retire inside _drain_one_entry), then resume
                 failed_msg = self._drain_all(ws)

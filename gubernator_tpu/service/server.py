@@ -7,6 +7,7 @@ cmd/gubernator/main.go:60-66).
 
 from __future__ import annotations
 
+import json
 import logging
 from concurrent.futures import ThreadPoolExecutor
 from typing import Optional
@@ -150,6 +151,14 @@ class V1Servicer:
 
     def HealthCheck(self, request, context):
         return health_to_pb(self.instance.health_check())
+
+    def Debug(self, request, context):
+        # federated debug plane (obs/bundle.py): one node's health + vars
+        # + circuits + flight-recorder tail + traces as raw JSON bytes.
+        # Unguarded like HealthCheck — diagnostics must survive overload.
+        from gubernator_tpu.obs.bundle import node_report
+
+        return json.dumps(node_report(self.instance)).encode()
 
 
 class PeersV1Servicer:

@@ -1,0 +1,153 @@
+"""Bench regression gate: diff the two newest BENCH_r*.json artifacts.
+
+Every PR round records its bench run as BENCH_r<NN>.json, but nothing
+reads consecutive rounds against each other — a throughput cliff only
+surfaces when a human happens to eyeball the notes. This gate makes the
+comparison mechanical: flatten each round's `parsed` section, intersect
+the numeric keys, and flag
+
+- throughput keys (`value`, `*_decisions_per_sec`, `*_speedup*`) that
+  DROPPED by more than the tolerance, and
+- latency keys (`*_ms`, `*p50*`/`*p99*`) that ROSE by more than the
+  latency tolerance AND by more than 1 ms absolute (relative change on
+  sub-millisecond samples is pure scheduler noise).
+
+Baseline keys (`serial_*`, `lockstep*`, `baseline_*`) are excluded — a
+slower comparison baseline is not a product regression. The overload
+open-loop response keys (`overload.admission.*` etc.) are also excluded:
+each round offers load at 2x its OWN probed capacity, so shed rate,
+goodput, and accepted percentiles are responses at different operating
+points across rounds — only `overload.capacity_decisions_per_sec` is an
+absolute measure (the within-round admission-vs-queueing claim is the
+bench's own acceptance check, not this gate's). Everything else
+overlapping is printed informationally. The default tolerances are
+deliberately loose (25% throughput, 60% latency): these are shared-CPU
+rig numbers whose run-to-run noise band is wide; the gate exists to
+catch cliffs, not to turn scheduler jitter into red builds.
+
+Usage:
+    python scripts/bench_check.py                 # two newest rounds
+    python scripts/bench_check.py --tolerance 0.4
+    python scripts/bench_check.py --base BENCH_r09.json --head BENCH_r11.json
+
+Exit status: 0 clean (or fewer than two artifacts), 1 on regression.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _flatten(obj, prefix=""):
+    """Dotted-path numeric leaves; lists contribute only their length-
+    independent aggregates elsewhere, so they are skipped."""
+    out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            out.update(_flatten(v, f"{prefix}{k}."))
+    elif isinstance(obj, bool):
+        pass
+    elif isinstance(obj, (int, float)):
+        out[prefix[:-1]] = float(obj)
+    return out
+
+
+def _load(path):
+    with open(path) as f:
+        doc = json.load(f)
+    return _flatten(doc.get("parsed", {}))
+
+
+def _is_baseline(key):
+    return any(tag in key for tag in ("serial", "lockstep", "baseline"))
+
+
+def _is_operating_point(key):
+    """Overload responses measured at that round's own 2x-capacity
+    operating point — cross-round deltas reflect the operating point,
+    not the code."""
+    return (key.startswith("overload.")
+            and key != "overload.capacity_decisions_per_sec")
+
+
+def _is_throughput(key):
+    leaf = key.rsplit(".", 1)[-1]
+    return (leaf == "value" or leaf.endswith("_decisions_per_sec")
+            or "speedup" in leaf)
+
+
+def _is_latency(key):
+    leaf = key.rsplit(".", 1)[-1]
+    return leaf.endswith("_ms") or "p50" in leaf or "p99" in leaf
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--base", help="older artifact (default: 2nd newest)")
+    ap.add_argument("--head", help="newer artifact (default: newest)")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="allowed relative throughput drop before "
+                         "failing (default 0.25 — CPU-rig noise band)")
+    ap.add_argument("--latency-tolerance", type=float, default=0.60,
+                    help="allowed relative latency rise (default 0.60; "
+                         "tail latencies are noisier than throughput)")
+    args = ap.parse_args(argv)
+
+    if args.base and args.head:
+        base_path, head_path = args.base, args.head
+    else:
+        rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+        if len(rounds) < 2:
+            print("bench-check: fewer than two BENCH_r*.json artifacts; "
+                  "nothing to compare")
+            return 0
+        base_path, head_path = rounds[-2], rounds[-1]
+
+    base, head = _load(base_path), _load(head_path)
+    shared = sorted(set(base) & set(head))
+    if not shared:
+        print(f"bench-check: no overlapping numeric keys between "
+              f"{os.path.basename(base_path)} and "
+              f"{os.path.basename(head_path)}")
+        return 0
+
+    print(f"bench-check: {os.path.basename(base_path)} -> "
+          f"{os.path.basename(head_path)}  "
+          f"(tolerance {args.tolerance:.0%})")
+    regressions = []
+    for key in shared:
+        b, h = base[key], head[key]
+        if b == 0:
+            continue
+        delta = (h - b) / abs(b)
+        verdict = ""
+        if _is_baseline(key):
+            verdict = "(baseline)"
+        elif _is_operating_point(key):
+            verdict = "(operating-point)"
+        elif _is_throughput(key) and delta < -args.tolerance:
+            verdict = "REGRESSION"
+        elif (_is_latency(key) and delta > args.latency_tolerance
+                and h - b > 1.0):
+            verdict = "REGRESSION"
+        elif not (_is_throughput(key) or _is_latency(key)):
+            verdict = "(info)"
+        if verdict == "REGRESSION":
+            regressions.append(key)
+        print(f"  {key:58s} {b:>14.4g} -> {h:>14.4g}  "
+              f"{delta:+7.1%}  {verdict}")
+
+    if regressions:
+        print(f"\nbench-check FAILED: {len(regressions)} regression(s) "
+              f"beyond {args.tolerance:.0%}: {', '.join(regressions)}")
+        return 1
+    print("\nbench-check OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

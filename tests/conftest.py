@@ -173,6 +173,49 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+# ---------------------------------------------------------------------------
+# Failure bundles: when a test backed by real daemon subprocesses fails,
+# the daemons' in-memory state (flight recorder, anomaly sweep, circuit
+# view, traces) is the post-mortem — and it dies with the fixture teardown
+# an instant later. spawn_daemon registers each daemon's HTTP port; on a
+# failed test the makereport hook snapshots /v1/debug/bundle from every
+# live registered daemon into GUBER_TEST_ARTIFACTS (default
+# tests/artifacts/) before teardown runs. Best-effort by design: a daemon
+# too sick to serve its bundle must not turn one failure into two.
+
+_debug_daemon_ports = set()
+
+
+def _collect_failure_bundles(test_name):
+    import json
+    import re
+    import urllib.request
+
+    if not _debug_daemon_ports:
+        return
+    art_dir = os.environ.get(
+        "GUBER_TEST_ARTIFACTS",
+        os.path.join(os.path.dirname(__file__), "artifacts"))
+    slug = re.sub(r"[^A-Za-z0-9_.-]+", "-", test_name)[:120]
+    for port in sorted(_debug_daemon_ports):
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/v1/debug/bundle", timeout=5).read()
+            json.loads(body)  # only keep well-formed bundles
+            os.makedirs(art_dir, exist_ok=True)
+            path = os.path.join(art_dir, f"{slug}-{port}.json")
+            with open(path, "wb") as f:
+                f.write(body)
+            print(f"\n[failure-bundle] {path}")
+        except Exception:  # noqa: BLE001 — diagnostics never add failures
+            pass
+
+
+def pytest_runtest_makereport(item, call):
+    if call.when == "call" and call.excinfo is not None:
+        _collect_failure_bundles(item.nodeid.split("::", 1)[-1])
+
+
 def spawn_daemon(env_overrides, ready_timeout=240.0, stderr_path=None):
     """Spawn the real daemon subprocess and wait for its Ready sentinel.
 
@@ -214,6 +257,14 @@ def spawn_daemon(env_overrides, ready_timeout=240.0, stderr_path=None):
                 ready.set()
                 return
 
+    # failure-bundle registration: remember where this daemon's debug
+    # plane lives so a failing test can snapshot it (hook above)
+    http_addr = env_overrides.get("GUBER_HTTP_ADDRESS", "")
+    port = http_addr.rpartition(":")[2]
+    if port.isdigit():
+        proc._guber_http_port = int(port)
+        _debug_daemon_ports.add(proc._guber_http_port)
+
     t = threading.Thread(target=wait_ready, daemon=True)
     t.start()
     deadline = time.time() + ready_timeout
@@ -232,6 +283,7 @@ def spawn_daemon(env_overrides, ready_timeout=240.0, stderr_path=None):
 def stop_daemon(proc):
     import subprocess
 
+    _debug_daemon_ports.discard(getattr(proc, "_guber_http_port", None))
     proc.terminate()
     try:
         proc.wait(timeout=10)

