@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast bench bench-skew bench-wire bench-suite bench-check soak chaos proto docker clean native
+.PHONY: test test-fast bench bench-skew bench-wire bench-suite bench-check capacity-report soak chaos proto docker clean native
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -30,6 +30,12 @@ bench-suite:
 # throughput/latency key both rounds measured (see scripts/bench_check.py)
 bench-check:
 	python scripts/bench_check.py
+
+# occupancy, headroom forecast, hit-mass concentration and top-K heavy
+# hitters from a running node's /v1/debug/{keyspace,history} endpoints
+# (docs/OPERATIONS.md "Capacity planning"); ADDR defaults to 127.0.0.1:80
+capacity-report:
+	python scripts/capacity_report.py $(ADDR)
 
 # 30s fault-injection soak: kill/restart chaos under load, invariant-judged
 soak:

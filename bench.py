@@ -249,6 +249,128 @@ def _obs_bench(n_calls: int = 1500, batch: int = 64, reps: int = 3) -> dict:
         inst.close()
 
 
+def _cartography_bench(n_calls: int = 1200, batch: int = 64,
+                       reps: int = 3) -> dict:
+    """Cartography-plane overhead on the serving path: the SAME
+    single-node Instance serving identical batch streams with the
+    metrics-history tick running in-band once per chunk vs the
+    GUBER_HISTORY=0 hatch (which turns the scrape piggyback into one
+    attribute test). One tick per ~5 ms chunk is ~1000x the production
+    5 s cadence, so the interleaved pct is a stress ceiling; the number
+    the <= 2% budget is judged on is amortized_overhead_pct — per-op
+    tick/harvest cost duty-cycled at the production cadence (5 s tick,
+    60 s harvest). The flag alternates every CHUNK calls within one
+    pass, same drift-regime rationale as _obs_bench.
+
+    The keyspace harvest reads the device hit-counter column and
+    resolves top-K off the serving path; it is timed separately
+    (harvest_ms) because even one harvest per chunk would dominate a
+    5 ms chunk and measure cadence, not cost."""
+    from gubernator_tpu.models.engine import Engine
+    from gubernator_tpu.service.config import InstanceConfig
+    from gubernator_tpu.service.instance import Instance
+    from gubernator_tpu.types import PeerInfo, RateLimitReq
+
+    HIST_TICK_PROD_S = 5.0
+    HARVEST_PROD_S = 60.0
+    inst = Instance(InstanceConfig(backend=Engine(capacity=262_144),
+                                   history_tick_s=1e-4,  # every tick records
+                                   keyspace_interval_s=3600.0),
+                    advertise_address="127.0.0.1:1")
+    inst.set_peers([PeerInfo(address="127.0.0.1:1")])  # self-owned: no RPC
+    frames = [
+        [RateLimitReq(name="cartobench", unique_key=f"k{(i * batch + j) % 4096}",
+                      hits=1, limit=1 << 30, duration=3_600_000)
+         for j in range(batch)]
+        for i in range(n_calls)
+    ]
+    try:
+        for f in frames[:100]:  # compile + warm the width bucket
+            inst.get_rate_limits(f)
+
+        import gc
+        import statistics
+
+        CHUNK = 25
+        elapsed = {True: 0.0, False: 0.0}
+        calls = {True: 0, False: 0}
+        pair_overheads = []  # median over adjacent on/off pairs
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            for rep in range(reps):
+                i = 0
+                while i + 2 * CHUNK <= n_calls:
+                    first = len(pair_overheads) % 2 == 0
+                    rate = {}
+                    for ticking in (first, not first):
+                        chunk = frames[i:i + CHUNK]
+                        i += CHUNK
+                        t0 = time.perf_counter()
+                        for f in chunk:
+                            inst.get_rate_limits(f)
+                        if ticking:  # the scrape piggyback's real work
+                            inst.history.tick()
+                        dt = time.perf_counter() - t0
+                        elapsed[ticking] += dt
+                        calls[ticking] += CHUNK
+                        rate[ticking] = CHUNK * batch / dt
+                    pair_overheads.append(
+                        (rate[False] - rate[True]) / rate[False])
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        on = calls[True] * batch / elapsed[True]
+        off = calls[False] * batch / elapsed[False]
+        overhead_pct = statistics.median(pair_overheads) * 100.0
+
+        # per-op costs, timed directly for the production-cadence duty
+        # cycle; synthetic timestamps defeat the tick gate so every
+        # iteration pays the full collect+record path, not the no-op
+        tick_costs = []
+        base = time.monotonic()
+        for j in range(200):
+            t0 = time.perf_counter()
+            s = inst.history.collect(base + float(j))
+            inst.history.record(base + float(j), s)
+            tick_costs.append(time.perf_counter() - t0)
+        tick_us = statistics.median(tick_costs) * 1e6
+        harvest_costs = []
+        for _ in range(10):
+            t0 = time.perf_counter()
+            inst.keyspace.harvest(now=time.monotonic())
+            harvest_costs.append(time.perf_counter() - t0)
+        harvest_ms = statistics.median(harvest_costs) * 1e3
+        amortized_pct = 100.0 * (tick_us * 1e-6 / HIST_TICK_PROD_S
+                                 + harvest_ms * 1e-3 / HARVEST_PROD_S)
+
+        rep_ks = inst.keyspace.last_report() or {}
+        return {
+            "cartography": {
+                "ticker_on_decisions_per_sec": round(on, 1),
+                "ticker_off_decisions_per_sec": round(off, 1),
+                # in-band tick once per chunk (~1000x production cadence):
+                # a stress ceiling, positive = ticking costs throughput
+                "overhead_pct": round(overhead_pct, 2),
+                # per-op cost duty-cycled at 5 s tick / 60 s harvest —
+                # the number judged against the <= 2% budget
+                "amortized_overhead_pct": round(amortized_pct, 4),
+                "tick_us": round(tick_us, 1),
+                "harvest_ms": round(harvest_ms, 3),
+                "table_capacity": 262_144,
+                "keys_harvested": (rep_ks.get("occupancy") or {}).get(
+                    "key_count"),
+                "chunk_pairs": len(pair_overheads),
+                "history_samples": inst.history.sample_count(),
+                "reps": reps,
+                "batch": batch,
+                "calls_per_rep": n_calls,
+            }
+        }
+    finally:
+        inst.close()
+
+
 def _product_combiner_bench(eng, threads: int = 12, scan: int = 8,
                             subs_per_thread: int = 24) -> dict:
     """Serving throughput through the PRODUCT combiner path — not a
@@ -1420,6 +1542,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — report, don't die
         obs_row = {"observability": {"error": str(e)}}
 
+    # ---- capacity cartography: history ticker + keyspace harvest ----------
+    # Single-node serving with the metrics-history tick in-band vs the
+    # GUBER_HISTORY=0 hatch, plus directly-timed tick/harvest costs
+    # duty-cycled at production cadence (acceptance: amortized <= 2%).
+    try:
+        carto_row = _cartography_bench()
+    except Exception as e:  # noqa: BLE001 — report, don't die
+        carto_row = {"cartography": {"error": str(e)}}
+
     # trace-derived serving-stack phase split (never fails the bench)
     try:
         phases = phase_breakdown()
@@ -1438,6 +1569,7 @@ def main() -> None:
                 **skew_row,
                 **wire_row,
                 **obs_row,
+                **carto_row,
                 **_multichip_section(),
                 "phase_breakdown_ms": phases,
                 "unit": UNIT,

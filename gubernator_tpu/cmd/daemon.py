@@ -332,6 +332,13 @@ def main(argv=None) -> int:
             anomaly_interval_s=conf.anomaly_interval_s,
             slo_target_ms=conf.slo_target_ms,
             slo_objective=conf.slo_objective,
+            history_enabled=conf.history,
+            history_tick_s=conf.history_tick_s,
+            history_retention_s=conf.history_retention_s,
+            keyspace_scan=conf.keyspace_scan,
+            keyspace_interval_s=conf.keyspace_interval_s,
+            keyspace_top_k=conf.keyspace_top_k,
+            capacity_horizon_s=conf.capacity_horizon_s,
             pipeline_depth=conf.pipeline_depth or None,  # 0 -> env/auto
             pipeline_scan=conf.pipeline_scan,
         ),
@@ -349,6 +356,23 @@ def main(argv=None) -> int:
     # background detector sweep; in-process/test clusters instead ride
     # the maybe_check() piggyback on health probes and metric scrapes
     instance.anomaly.start()
+    # capacity & keyspace cartography: background tickers for the metrics
+    # ring and the table harvest (in-process clusters ride the scrape
+    # piggybacks instead)
+    if conf.history:
+        instance.history.start()
+        log.info("metrics history ring: tick=%.1fs retention=%.0fs "
+                 "(/v1/debug/history)", conf.history_tick_s,
+                 conf.history_retention_s)
+    else:
+        log.info("metrics history ring OFF (GUBER_HISTORY=0)")
+    if conf.keyspace_scan:
+        instance.keyspace.start()
+        log.info("keyspace cartographer: interval=%.0fs top_k=%d "
+                 "(/v1/debug/keyspace)", conf.keyspace_interval_s,
+                 conf.keyspace_top_k)
+    else:
+        log.info("keyspace scan OFF (GUBER_KEYSPACE_SCAN=0)")
     columnar_pipe = (conf.columnar_pipeline and conf.pipeline_depth != 1
                      and getattr(backend, "supports_columnar",
                                  lambda: False)())

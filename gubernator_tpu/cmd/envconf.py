@@ -202,6 +202,19 @@ class DaemonConfig:
     anomaly_interval_s: float = 5.0
     slo_target_ms: float = 250.0
     slo_objective: float = 0.999
+    # capacity & keyspace cartography (obs/history.py, obs/keyspace.py):
+    # history is the on-node metrics-history ring (=0 keeps only what the
+    # anomaly engine's burn windows need); keyspace_scan is the periodic
+    # device-table harvest behind /v1/debug/keyspace (=0 disables);
+    # capacity_horizon is how far ahead a projected table-full must land
+    # to trip the `capacity` anomaly detector
+    history: bool = True
+    history_tick_s: float = 5.0
+    history_retention_s: float = 7200.0
+    keyspace_scan: bool = True
+    keyspace_interval_s: float = 60.0
+    keyspace_top_k: int = 20
+    capacity_horizon_s: float = 1800.0
     # GLOBAL-sync collective implementation for the sharded backend:
     # "psum" (XLA, default) or "ring" (Pallas ICI ring — TPU-compiled only,
     # single-region meshes; see ops/ring.py)
@@ -342,6 +355,15 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         anomaly_interval_s=_env_dur("GUBER_ANOMALY_INTERVAL", 5.0),
         slo_target_ms=_env_float("GUBER_SLO_TARGET_MS", 250.0),
         slo_objective=_env_float("GUBER_SLO_OBJECTIVE", 0.999),
+        history=_env_str("GUBER_HISTORY", "1") not in
+        ("0", "f", "false", "no", "off"),
+        history_tick_s=_env_dur("GUBER_HISTORY_TICK_S", 5.0),
+        history_retention_s=_env_dur("GUBER_HISTORY_RETENTION", 7200.0),
+        keyspace_scan=_env_str("GUBER_KEYSPACE_SCAN", "1") not in
+        ("0", "f", "false", "no", "off"),
+        keyspace_interval_s=_env_dur("GUBER_KEYSPACE_INTERVAL", 60.0),
+        keyspace_top_k=_env_int("GUBER_KEYSPACE_TOP_K", 20),
+        capacity_horizon_s=_env_dur("GUBER_CAPACITY_HORIZON", 1800.0),
         collectives=_env_str("GUBER_COLLECTIVES", "psum"),
         coordinator_address=_env_str("GUBER_COORDINATOR_ADDRESS"),
         num_hosts=_env_int("GUBER_NUM_HOSTS", 1),
@@ -419,6 +441,26 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         raise ValueError(
             f"'GUBER_SLO_OBJECTIVE={conf.slo_objective}' is invalid; "
             "must be a fraction in (0, 1)")
+    if conf.history_tick_s <= 0:
+        raise ValueError(
+            f"'GUBER_HISTORY_TICK_S={conf.history_tick_s}' is invalid; "
+            "must be a positive duration")
+    if conf.history_retention_s < conf.history_tick_s:
+        raise ValueError(
+            f"'GUBER_HISTORY_RETENTION={conf.history_retention_s}' is "
+            "invalid; must be >= GUBER_HISTORY_TICK_S")
+    if conf.keyspace_interval_s <= 0:
+        raise ValueError(
+            f"'GUBER_KEYSPACE_INTERVAL={conf.keyspace_interval_s}' is "
+            "invalid; must be a positive duration")
+    if conf.keyspace_top_k < 1:
+        raise ValueError(
+            f"'GUBER_KEYSPACE_TOP_K={conf.keyspace_top_k}' is invalid; "
+            "must be >= 1")
+    if conf.capacity_horizon_s <= 0:
+        raise ValueError(
+            f"'GUBER_CAPACITY_HORIZON={conf.capacity_horizon_s}' is "
+            "invalid; must be a positive duration")
     if conf.fault_spec:
         # a typo'd chaos plan must fail the boot loudly, not inject nothing
         from gubernator_tpu.service.faults import parse_spec

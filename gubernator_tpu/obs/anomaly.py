@@ -23,6 +23,17 @@ Detectors:
                          `burn_fast_threshold` over the fast window AND
                          `burn_slow_threshold` over the slow window (the
                          two-window AND suppresses blips and stale pages)
+- ``capacity``           the headroom forecaster (obs/keyspace.py)
+                         projects the key table full within
+                         `capacity_horizon_s`, with the table already past
+                         its occupancy floor — eviction amnesty is coming
+                         and the operator should reshard or tier first
+
+Burn/rate windows are served from the node's metrics history ring
+(obs/history.py): the engine holds only the previous sweep's snapshot
+for rate deltas, everything older is read back from the shared ring —
+one snapshot store per node, and a bundle's history tail shows exactly
+what the detectors saw.
 
 The engine runs without a thread: ``maybe_check()`` piggybacks on
 health_check and metric scrapes, so in-process harness clusters get live
@@ -36,10 +47,13 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from gubernator_tpu.obs.history import MetricsHistory
+
 log = logging.getLogger("gubernator_tpu.anomaly")
 
 DETECTORS = ("deadline_burst", "shed_spike", "circuit_open",
-             "stall_regression", "lease_fail_close", "slo_burn")
+             "stall_regression", "lease_fail_close", "slo_burn",
+             "capacity")
 
 
 class AnomalyEngine:
@@ -57,10 +71,13 @@ class AnomalyEngine:
                  deadline_rate: float = 5.0,
                  shed_rate: float = 10.0,
                  stall_rate: float = 50.0,
-                 fail_close_rate: float = 5.0):
+                 fail_close_rate: float = 5.0,
+                 history: Optional[MetricsHistory] = None,
+                 capacity_horizon_s: float = 1800.0):
         self.instance = instance
         self.metrics = metrics
         self.recorder = recorder
+        self.capacity_horizon_s = float(capacity_horizon_s)
         self.interval_s = max(float(interval_s), 0.05)
         self.slo_target_ms = float(slo_target_ms)
         self.slo_objective = float(slo_objective)
@@ -78,9 +95,17 @@ class AnomalyEngine:
         self._slo_total = 0
         self._slo_good = 0
         self._slo_errors = 0
-        # (t, signals) snapshots back one slow window — burn rates and
-        # event rates are deltas between snapshots, never absolute counts
-        self._snaps: List[tuple] = []
+        # the burn/rate windows read from the node's history ring; a
+        # standalone engine (unit tests, stub instances) grows a private
+        # ring at its own check cadence
+        self.history = history if history is not None else MetricsHistory(
+            instance, tick_s=max(float(interval_s), 0.05),
+            anomaly=self)
+        if self.history.anomaly is None:
+            self.history.anomaly = self
+        # previous sweep's snapshot: event rates are the delta since the
+        # LAST check regardless of the ring's (coarser) tick cadence
+        self._prev: Optional[tuple] = None
         self.active: Dict[str, bool] = {d: False for d in DETECTORS}
         self.detail: Dict[str, str] = {}
         self.trips: Dict[str, int] = {d: 0 for d in DETECTORS}
@@ -105,27 +130,11 @@ class AnomalyEngine:
 
     # ---------------------------------------------------------- signals
 
-    def _signals(self) -> Dict[str, float]:
-        """Point-in-time cumulative counters the rate detectors diff."""
-        inst = self.instance
-        sig: Dict[str, float] = {}
-        sig["deadline_expired"] = float(
-            sum(getattr(inst, "deadline_expired_stats", {}).values()))
-        adm = getattr(inst, "admission", None)
-        sig["sheds"] = float(sum(adm.stats.values())) if adm is not None \
-            else 0.0
-        pls = getattr(inst, "peerlink_service", None)
-        sig["pull_boundary_stalls"] = float(
-            pls.stats.get("pull_boundary_stalls", 0)) if pls is not None \
-            else 0.0
-        lm = getattr(inst, "leases", None)
-        sig["lease_fail_close"] = float(
-            lm.stats.get("expired_held", 0)) if lm is not None else 0.0
+    def slo_snapshot(self) -> tuple:
+        """(total, good, errors) under the lock — the history ring folds
+        these into every sample so burn windows read back from it."""
         with self._lock:
-            sig["slo_total"] = float(self._slo_total)
-            sig["slo_good"] = float(self._slo_good)
-            sig["slo_errors"] = float(self._slo_errors)
-        return sig
+            return self._slo_total, self._slo_good, self._slo_errors
 
     def _open_circuits(self) -> List[str]:
         all_peers = getattr(self.instance, "all_peer_clients", None)
@@ -163,19 +172,21 @@ class AnomalyEngine:
         """One detector sweep; returns the active map. Thread-safe but
         sweeps are serialized — concurrent callers coalesce."""
         now = time.monotonic() if now is None else now
-        cur = self._signals()
+        cur = self.history.collect(now)
         with self._lock:
             if self._last_check and now - self._last_check < 0.01:
                 return dict(self.active)  # coalesced concurrent sweep
-            prev = self._snaps[-1] if self._snaps else None
-            self._snaps.append((now, cur))
-            horizon = now - self.burn_slow_window_s * 1.2
-            while len(self._snaps) > 2 and self._snaps[0][0] < horizon:
-                self._snaps.pop(0)
-            fast_old = self._window_snap(now - self.burn_fast_window_s)
-            slow_old = self._window_snap(now - self.burn_slow_window_s)
+            prev = self._prev
+            self._prev = (now, cur)
             self._last_check = now
             self.checks += 1
+        # the sweep doubles as a ring tick (fixed-interval: the ring
+        # keeps its own cadence when checks run faster than its tick)
+        self.history.record(now, cur)
+        fast_old = self.history.window_snap(
+            now - self.burn_fast_window_s) or cur
+        slow_old = self.history.window_snap(
+            now - self.burn_slow_window_s) or cur
 
         budget = 1.0 - self.slo_objective
         self.burn_fast = self._burn(cur, fast_old, budget)
@@ -203,20 +214,41 @@ class AnomalyEngine:
             found["slo_burn"] = True
             detail["slo_burn"] = (f"burn {self.burn_fast:.1f}x fast / "
                                   f"{self.burn_slow:.1f}x slow")
+        cap_detail = self._capacity_signal()
+        if cap_detail:
+            found["capacity"] = True
+            detail["capacity"] = cap_detail
 
         self._apply(found, detail)
         return found
 
-    def _window_snap(self, t_floor: float) -> Dict[str, float]:
-        """Newest snapshot at/older than t_floor, else the oldest held —
-        a young engine burns over the history it has (_lock held)."""
-        chosen = self._snaps[0][1]
-        for t, sig in self._snaps:
-            if t <= t_floor:
-                chosen = sig
-            else:
-                break
-        return chosen
+    def _capacity_signal(self) -> str:
+        """Headroom check: "" when quiet, else the firing detail. Reads
+        the cartographer's forecast over the history ring — no device
+        work — and stays quiet below the occupancy floor (a young
+        table's first fill slope projects meaningless exhaustion)."""
+        carto = getattr(self.instance, "keyspace", None)
+        if carto is None:
+            return ""
+        try:
+            from gubernator_tpu.obs.keyspace import CAPACITY_OCCUPANCY_FLOOR
+
+            fc = carto.forecast()
+        except Exception:  # noqa: BLE001 — forecasting must not break
+            return ""      # detection
+        if not fc.get("projectable"):
+            return ""
+        ttf = fc.get("time_to_full_s")
+        fill = fc.get("fill_fraction") or 0.0
+        if ttf is None or ttf > self.capacity_horizon_s \
+                or fill < CAPACITY_OCCUPANCY_FLOOR:
+            return ""
+        ttp = fc.get("time_to_pressure_s")
+        return (f"table full in ~{ttf:.0f}s at "
+                f"{fc.get('growth_keys_per_s') or 0.0:.2f} keys/s "
+                f"({fill:.0%} full"
+                + (f", eviction pressure in ~{ttp:.0f}s"
+                   if ttp is not None else "") + ")")
 
     def _apply(self, found: Dict[str, bool], detail: Dict[str, str]) -> None:
         for name in DETECTORS:
@@ -309,6 +341,7 @@ class AnomalyEngine:
                    "errors": self._slo_errors}
         return {
             "interval_s": self.interval_s,
+            "capacity_horizon_s": self.capacity_horizon_s,
             "checks": self.checks,
             "active": [d for d in DETECTORS if self.active[d]],
             "detail": dict(self.detail),

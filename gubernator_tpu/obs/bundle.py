@@ -31,6 +31,8 @@ from gubernator_tpu.obs.introspect import debug_vars
 log = logging.getLogger("gubernator_tpu.bundle")
 
 BUNDLE_SCHEMA_VERSION = 1
+# newest history samples appended to a bundle (~30 min at the 5 s tick)
+HISTORY_TAIL_SAMPLES = 360
 # env var names carrying credentials never leave the process in a bundle
 _SECRET_PAT = re.compile(r"PASSWORD|SECRET|TOKEN|CREDENTIAL|PRIVATE",
                          re.IGNORECASE)
@@ -91,6 +93,13 @@ def node_report(instance, max_events: int = 512) -> dict:
     an = getattr(instance, "anomaly", None)
     if an is not None:
         report["anomaly"] = an.debug()
+    carto = getattr(instance, "keyspace", None)
+    if carto is not None:
+        try:
+            report["keyspace"] = carto.report()
+            report["capacity"] = carto.forecast()
+        except Exception:  # noqa: BLE001 — cartography must not break
+            pass           # the report
     tracer = getattr(instance, "tracer", None)
     if tracer is not None:
         report["traces"] = tracer.traces()
@@ -105,6 +114,11 @@ def build_bundle(instance, reason: str = "on-demand",
     bundle["kind"] = "gubernator-debug-bundle"
     bundle["reason"] = reason
     bundle["env"] = env_fingerprint()
+    # the metrics-history tail: the run-up to the incident, not just the
+    # instant (obs/history.py; ~30 min at the default 5 s tick)
+    hist = getattr(instance, "history", None)
+    if hist is not None and hist.enabled:
+        bundle["history"] = hist.tail(HISTORY_TAIL_SAMPLES)
     conf = getattr(instance, "conf", None)
     if conf is not None and getattr(conf, "behaviors", None) is not None:
         try:
@@ -236,6 +250,51 @@ def cluster_view(instance, timeout_s: float = 5.0,
             for s in spans:
                 bucket.append({**s, "node": addr})
 
+    # capacity & keyspace roll-up: per-peer ownership share vs the ideal
+    # 1/N, a cross-node heavy-hitter merge, and the fleet's tightest
+    # headroom projection — the skew/headroom view the ROADMAP's
+    # resharding and tiering decisions read
+    key_counts: Dict[str, int] = {}
+    merged_top: List[dict] = []
+    capacities: Dict[str, dict] = {}
+    for addr, rep in nodes.items():
+        ks = rep.get("keyspace") or {}
+        occ = ks.get("occupancy") or {}
+        if occ.get("key_count") is not None:
+            key_counts[addr] = int(occ["key_count"])
+        for e in ks.get("top_keys") or []:
+            merged_top.append({**e, "node": addr})
+        fc = rep.get("capacity") or {}
+        if fc:
+            capacities[addr] = {k: fc.get(k) for k in (
+                "projectable", "key_count", "capacity", "fill_fraction",
+                "growth_keys_per_s", "time_to_full_s",
+                "time_to_pressure_s")}
+    total_keys = sum(key_counts.values())
+    ring_balance: dict = {}
+    if total_keys > 0 and key_counts:
+        ideal = 1.0 / len(key_counts)
+        shares = {a: c / total_keys for a, c in key_counts.items()}
+        ring_balance = {
+            "ideal_share": round(ideal, 6),
+            "shares": {a: round(s, 6) for a, s in shares.items()},
+            "skew": {a: round(s / ideal, 3) for a, s in shares.items()},
+            "max_skew": round(max(shares.values()) / ideal, 3),
+        }
+    merged_top.sort(key=lambda e: e.get("hits", 0), reverse=True)
+    ttfs = [c["time_to_full_s"] for c in capacities.values()
+            if c.get("time_to_full_s") is not None]
+    keyspace_roll = {
+        "total_keys": total_keys,
+        "node_key_counts": key_counts,
+        "ring_balance": ring_balance,
+        "top_keys": merged_top[:20],
+    }
+    capacity_roll = {
+        "min_time_to_full_s": min(ttfs) if ttfs else None,
+        "nodes": capacities,
+    }
+
     recent = sorted(
         spans_by_tid,
         key=lambda tid: max(s["start_ns"] for s in spans_by_tid[tid]),
@@ -256,6 +315,8 @@ def cluster_view(instance, timeout_s: float = 5.0,
         "errors": errors,
         "anomalies": anomalies,
         "unhealthy": unhealthy,
+        "keyspace": keyspace_roll,
+        "capacity": capacity_roll,
         "stitched_traces": stitched,
         "cross_node_traces": sorted(cross_node),
     }

@@ -11,10 +11,13 @@ from __future__ import annotations
 from typing import Optional
 
 # Version of the snapshot's shape. Bump when a section is renamed or its
-# meaning changes; ADDING a section is not a bump (the schema is
+# meaning changes; ADDING a section is not normally a bump (the schema is
 # subset-stable — consumers must tolerate new sections). Pinned by
 # tests/test_debug_schema.py.
-DEBUG_VARS_SCHEMA_VERSION = 1
+# v2: always-present "history" and "keyspace" sections (capacity &
+# keyspace cartography plane) — bumped because both are promised on
+# every Instance, not merely tolerated.
+DEBUG_VARS_SCHEMA_VERSION = 2
 
 
 def _backend_vars(backend) -> dict:
@@ -45,6 +48,45 @@ def key_table_size(backend) -> Optional[int]:
         try:
             return int(count())
         except Exception:  # noqa: BLE001 — introspection must not raise
+            return None
+    return None
+
+
+def table_capacity(backend) -> Optional[int]:
+    """Total key-table slot capacity across the backend's device table(s).
+    None when the backend exposes neither a capacity attribute nor a mesh
+    plan (a stub or store-only backend)."""
+    cap = getattr(backend, "capacity", None)
+    if isinstance(cap, int):
+        return cap
+    plan = getattr(backend, "plan", None)
+    if plan is not None:
+        try:
+            return int(plan.n_owners) * int(plan.capacity_per_shard)
+        except Exception:  # noqa: BLE001 — introspection must not raise
+            return None
+    return None
+
+
+def eviction_count(backend) -> Optional[int]:
+    """Cumulative key-table LRU evictions (slots recycled from live keys).
+    None when eviction is not host-countable: the devdir engine evicts
+    on-chip via probe epochs and keeps no host directory."""
+    if getattr(backend, "fps", None) is not None:
+        return None  # on-chip directory: evictions happen device-side
+    d = getattr(backend, "directory", None)
+    if d is not None:
+        ev = getattr(d, "evictions", None)
+        if ev is not None:
+            try:
+                return int(ev)
+            except Exception:  # noqa: BLE001
+                return None
+    dirs = getattr(backend, "directories", None)
+    if dirs:
+        try:
+            return sum(int(d.evictions) for d in dirs)
+        except Exception:  # noqa: BLE001
             return None
     return None
 
@@ -123,6 +165,12 @@ def debug_vars(instance) -> dict:
     an = getattr(instance, "anomaly", None)
     if an is not None:
         out["anomaly"] = an.debug()
+    hist = getattr(instance, "history", None)
+    if hist is not None:
+        out["history"] = hist.debug()
+    carto = getattr(instance, "keyspace", None)
+    if carto is not None:
+        out["keyspace"] = carto.debug()
     bw = getattr(instance, "bundle_writer", None)
     if bw is not None:
         out["bundles"] = bw.debug()

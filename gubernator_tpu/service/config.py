@@ -135,6 +135,22 @@ class InstanceConfig:
     anomaly_interval_s: float = 5.0
     slo_target_ms: float = 250.0
     slo_objective: float = 0.999
+    # capacity & keyspace cartography (obs/history.py, obs/keyspace.py):
+    # the metrics-history ring snapshots curated counters/gauges every
+    # tick into ~2 h of samples (GUBER_HISTORY / GUBER_HISTORY_TICK_S /
+    # GUBER_HISTORY_RETENTION); the cartographer harvests the device
+    # table off the serving path every interval (GUBER_KEYSPACE_SCAN /
+    # GUBER_KEYSPACE_INTERVAL / GUBER_KEYSPACE_TOP_K); the capacity
+    # detector fires when projected time-to-full crosses the horizon
+    # (GUBER_CAPACITY_HORIZON). history_enabled=False clamps the ring to
+    # what the anomaly engine's burn windows need and nothing more.
+    history_enabled: bool = True
+    history_tick_s: float = 5.0
+    history_retention_s: float = 7200.0
+    keyspace_scan: bool = True
+    keyspace_interval_s: float = 60.0
+    keyspace_top_k: int = 20
+    capacity_horizon_s: float = 1800.0
 
     def validate(self) -> None:
         if self.behaviors.batch_limit > MAX_BATCH_SIZE:
@@ -170,3 +186,14 @@ class InstanceConfig:
             raise ValueError("slo_target_ms must be positive")
         if not 0.0 < self.slo_objective < 1.0:
             raise ValueError("slo_objective must be in (0, 1)")
+        if self.history_tick_s <= 0:
+            raise ValueError("history_tick_s must be positive")
+        if self.history_retention_s < self.history_tick_s:
+            raise ValueError(
+                "history_retention_s must be >= history_tick_s")
+        if self.keyspace_interval_s <= 0:
+            raise ValueError("keyspace_interval_s must be positive")
+        if self.keyspace_top_k < 1:
+            raise ValueError("keyspace_top_k must be >= 1")
+        if self.capacity_horizon_s <= 0:
+            raise ValueError("capacity_horizon_s must be positive")

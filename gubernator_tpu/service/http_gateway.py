@@ -17,7 +17,12 @@ reference daemon's expvar/pprof handlers):
 - GET /v1/debug/bundle  — full diagnostic bundle (obs/bundle.py;
   ?write=1 also persists it to GUBER_BUNDLE_DIR when configured)
 - GET /v1/debug/cluster — federated view: every peer's node report merged,
-  cross-node traces stitched by traceparent (?timeout=<seconds>)
+  cross-node traces stitched by traceparent (?timeout=<seconds>), with
+  cluster-wide keyspace/capacity roll-up and ring-balance report
+- GET /v1/debug/history — on-node metrics history ring (obs/history.py;
+  ?n=<count> limits the tail)
+- GET /v1/debug/keyspace — keyspace cartography + headroom forecast
+  (obs/keyspace.py; ?refresh=1 forces a fresh harvest)
 """
 
 from __future__ import annotations
@@ -147,6 +152,23 @@ class HttpGateway:
                         if q.get("write", ["0"])[0] == "1" \
                                 and writer is not None:
                             body["written_to"] = writer.write(body)
+                    elif url.path == "/v1/debug/history":
+                        q = parse_qs(url.query)
+                        hist = getattr(gateway.instance, "history", None)
+                        if hist is None:
+                            self._reply_error(404, "history disabled")
+                            return
+                        body = hist.endpoint_body(
+                            int(q.get("n", ["0"])[0] or 0))
+                    elif url.path == "/v1/debug/keyspace":
+                        q = parse_qs(url.query)
+                        carto = getattr(gateway.instance, "keyspace", None)
+                        if carto is None:
+                            self._reply_error(404, "keyspace scan disabled")
+                            return
+                        if q.get("refresh", ["0"])[0] == "1":
+                            carto.harvest()
+                        body = carto.endpoint_body()
                     elif url.path == "/v1/debug/cluster":
                         from gubernator_tpu.obs.bundle import cluster_view
 

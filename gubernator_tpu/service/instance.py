@@ -32,6 +32,8 @@ from gubernator_tpu.cluster.pickers import (
 from gubernator_tpu.obs import trace
 from gubernator_tpu.obs.anomaly import AnomalyEngine
 from gubernator_tpu.obs.events import FlightRecorder
+from gubernator_tpu.obs.history import MetricsHistory
+from gubernator_tpu.obs.keyspace import KeyspaceCartographer
 from gubernator_tpu.obs.trace import Tracer
 from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.combiner import BackendCombiner
@@ -297,6 +299,19 @@ class Instance:
         # per-stage deadline-expired counts: the metrics-independent
         # signal the anomaly engine's deadline_burst detector diffs
         self.deadline_expired_stats: Dict[str, int] = {}
+        # metrics history ring (obs/history.py): curated counter/gauge
+        # snapshots every tick — serves /v1/debug/history, the bundle
+        # run-up tail, and the anomaly engine's burn/rate windows
+        self.history = MetricsHistory(
+            self, tick_s=conf.history_tick_s,
+            retention_s=conf.history_retention_s,
+            enabled=conf.history_enabled)
+        # keyspace cartographer (obs/keyspace.py): periodic off-path
+        # device-table harvest — heavy hitters, concentration, occupancy,
+        # HBM bytes — plus the headroom forecast over the history ring
+        self.keyspace = KeyspaceCartographer(
+            self, interval_s=conf.keyspace_interval_s,
+            top_k=conf.keyspace_top_k, enabled=conf.keyspace_scan)
         # anomaly watchers (obs/anomaly.py): always constructed; sweeps
         # run from health_check/scrape piggybacks (maybe_check) and, in
         # daemons, a background ticker the daemon starts. The daemon also
@@ -306,7 +321,9 @@ class Instance:
             self, metrics=conf.metrics, recorder=self.recorder,
             interval_s=conf.anomaly_interval_s,
             slo_target_ms=conf.slo_target_ms,
-            slo_objective=conf.slo_objective)
+            slo_objective=conf.slo_objective,
+            history=self.history,
+            capacity_horizon_s=conf.capacity_horizon_s)
         self._closed = False
 
     def attach_collective(self, sync, group_peers=None) -> None:
@@ -679,6 +696,8 @@ class Instance:
             return
         self._closed = True
         self.anomaly.stop()
+        self.history.stop()
+        self.keyspace.stop()
         if self.collective_global is not None:
             self.collective_global.close()
         self.global_manager.close()

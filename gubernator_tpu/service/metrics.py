@@ -400,6 +400,76 @@ class Metrics:
             "triggers plus explicit /v1/debug/bundle?write=1 requests).",
             registry=self.registry,
         )
+        # capacity & keyspace cartography (obs/history.py, obs/keyspace.py;
+        # docs/observability.md "Capacity & keyspace"). The scrape itself
+        # drives the cartographer's piggyback harvest (maybe_harvest), so a
+        # metrics-only deployment still gets fresh cartography; gauges
+        # refresh from the newest harvest + forecast at exposition.
+        self.history_samples = Gauge(
+            "history_samples",
+            "Samples currently held by the on-node metrics-history ring "
+            "(/v1/debug/history).",
+            registry=self.registry,
+        )
+        self.keyspace_harvests = Counter(
+            "keyspace_harvests_total",
+            "Keyspace cartography harvests completed since boot.",
+            registry=self.registry,
+        )
+        self.keyspace_fill_fraction = Gauge(
+            "keyspace_fill_fraction",
+            "Key-table occupancy as a fraction of device-table capacity "
+            "(from the newest keyspace harvest).",
+            registry=self.registry,
+        )
+        self.keyspace_free_slots = Gauge(
+            "keyspace_free_slots",
+            "Device-table slots still unclaimed at the newest harvest.",
+            registry=self.registry,
+        )
+        self.keyspace_evictions = Counter(
+            "keyspace_evictions_total",
+            "Cumulative key-directory LRU evictions (slots recycled "
+            "because the table was full).",
+            registry=self.registry,
+        )
+        self.keyspace_hit_share = Gauge(
+            "keyspace_hit_share",
+            "Share of tracked hit mass concentrated in the hottest keys, "
+            "by bucket (top1/top10/top100).",
+            ["bucket"], registry=self.registry,
+        )
+        self.keyspace_zipf_exponent = Gauge(
+            "keyspace_zipf_exponent",
+            "Zipf exponent fitted over the head of the rank/count curve "
+            "(higher = more skew; ~0 = uniform).",
+            registry=self.registry,
+        )
+        self.hbm_table_bytes = Gauge(
+            "hbm_table_bytes",
+            "Device memory held by the backend's table arrays, by "
+            "component (state; fps/touch on the devdir engine).",
+            ["component"], registry=self.registry,
+        )
+        self.keyspace_growth = Gauge(
+            "keyspace_growth_keys_per_s",
+            "Net key-table growth fitted over the metrics-history ring "
+            "(keys/second; negative while the table drains).",
+            registry=self.registry,
+        )
+        self.capacity_time_to_full = Gauge(
+            "capacity_time_to_full_seconds",
+            "Projected seconds until the key table is full at the fitted "
+            "growth rate (-1 = not projectable / not growing).",
+            registry=self.registry,
+        )
+        self.capacity_time_to_pressure = Gauge(
+            "capacity_time_to_pressure_seconds",
+            "Projected seconds until the table crosses the eviction-"
+            "pressure watermark (0 = already there or actively evicting; "
+            "-1 = not projectable / not growing).",
+            registry=self.registry,
+        )
         self.request_budget_ms = Histogram(
             "request_budget_ms",
             "Deadline budget observed at capture, by surface (public = "
@@ -617,6 +687,54 @@ class Metrics:
             self._set_counter(
                 self.bundles_written,
                 float(bw.stats.get("written", 0)))
+        hist = getattr(instance, "history", None)
+        if hist is not None:
+            try:
+                # scrapes double as the history tick for threadless
+                # deployments (same contract as anomaly.maybe_check)
+                if hist.enabled:
+                    hist.tick()
+                self.history_samples.set(hist.sample_count())
+            except Exception:  # noqa: BLE001 — the ring must not break
+                pass           # /metrics
+        carto = getattr(instance, "keyspace", None)
+        if carto is not None:
+            try:
+                carto.maybe_harvest()
+            except Exception:  # noqa: BLE001 — cartography must not
+                pass           # break /metrics
+            self._set_counter(self.keyspace_harvests,
+                              float(carto.harvests))
+            rep = carto.last_report()
+            if rep is not None:
+                occ = rep.get("occupancy") or {}
+                if occ.get("fill_fraction") is not None:
+                    self.keyspace_fill_fraction.set(occ["fill_fraction"])
+                if occ.get("free_slots") is not None:
+                    self.keyspace_free_slots.set(occ["free_slots"])
+                ev = (rep.get("evictions") or {}).get("total")
+                if ev is not None:
+                    self._set_counter(self.keyspace_evictions, float(ev))
+                hm = rep.get("hit_mass") or {}
+                for bucket in ("top1", "top10", "top100"):
+                    share = hm.get(f"{bucket}_share")
+                    if share is not None:
+                        self.keyspace_hit_share.labels(
+                            bucket=bucket).set(share)
+                if hm.get("zipf_exponent") is not None:
+                    self.keyspace_zipf_exponent.set(hm["zipf_exponent"])
+                for comp, nbytes in ((rep.get("hbm") or {}).get(
+                        "arrays") or {}).items():
+                    self.hbm_table_bytes.labels(component=comp).set(nbytes)
+            fc = carto.forecast()
+            if fc.get("growth_keys_per_s") is not None:
+                self.keyspace_growth.set(fc["growth_keys_per_s"])
+            ttf = fc.get("time_to_full_s")
+            self.capacity_time_to_full.set(
+                ttf if ttf is not None else -1.0)
+            ttp = fc.get("time_to_pressure_s")
+            self.capacity_time_to_pressure.set(
+                ttp if ttp is not None else -1.0)
         gm = getattr(instance, "global_manager", None)
         if gm is not None:
             hits_depth, bcast_depth = gm.depths()

@@ -1,0 +1,182 @@
+"""Render a node's capacity & keyspace cartography as a terminal report.
+
+Fetches /v1/debug/keyspace and /v1/debug/history from a running node's
+HTTP gateway and prints the operator-facing digest: occupancy vs
+capacity, the headroom forecast (time-to-full / time-to-pressure from
+the linear net-growth fit over the metrics-history ring), hit-mass
+concentration, HBM footprint, and the top-K heavy hitters. This is the
+same data the `capacity` anomaly detector reads — the report exists so
+a human can see the run-up BEFORE the detector trips (see
+docs/OPERATIONS.md "Capacity planning").
+
+Usage:
+    python scripts/capacity_report.py [host:port]   # default 127.0.0.1:80
+    make capacity-report [ADDR=host:port]
+
+Rendering is a pure function over the two endpoint bodies
+(render_report), so tests exercise it offline; only main() touches the
+network. Exit status: 0 rendered, 1 on fetch/shape failure.
+"""
+
+import json
+import sys
+import urllib.request
+
+
+def _fmt_secs(s):
+    if s is None:
+        return "n/a"
+    s = float(s)
+    if s >= 86400:
+        return f"{s / 86400:.1f}d"
+    if s >= 3600:
+        return f"{s / 3600:.1f}h"
+    if s >= 60:
+        return f"{s / 60:.1f}m"
+    return f"{s:.0f}s"
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "n/a"
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if n < 1024 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{n:.0f}B"
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def _bar(fraction, width=40):
+    fraction = min(max(float(fraction or 0.0), 0.0), 1.0)
+    filled = int(round(fraction * width))
+    return "[" + "#" * filled + "." * (width - filled) + "]"
+
+
+def render_report(keyspace_body, history_body=None):
+    """Pure renderer: endpoint bodies in, report text out."""
+    lines = []
+    rep = keyspace_body.get("report") or {}
+    fc = keyspace_body.get("forecast") or {}
+    occ = rep.get("occupancy") or {}
+    hm = rep.get("hit_mass") or {}
+    hbm = rep.get("hbm") or {}
+
+    lines.append("capacity & keyspace cartography")
+    lines.append("=" * 47)
+    if not keyspace_body.get("enabled", True):
+        lines.append("keyspace scan DISABLED (GUBER_KEYSPACE_SCAN=0) — "
+                     "report may be stale or absent")
+    if not rep:
+        lines.append("no harvest yet; retry after GUBER_KEYSPACE_INTERVAL "
+                     "or hit /v1/debug/keyspace?refresh=1")
+        return "\n".join(lines) + "\n"
+
+    cap = occ.get("capacity")
+    fill = occ.get("fill_fraction") or 0.0
+    lines.append(f"backend        {rep.get('backend', '?')}   "
+                 f"(harvest {rep.get('harvest_ms', '?')} ms)")
+    lines.append(f"occupancy      {occ.get('key_count')} / {cap} keys  "
+                 f"{_bar(fill)} {fill:.1%}")
+    lines.append(f"free slots     {occ.get('free_slots')}")
+    ev = (rep.get("evictions") or {}).get("total")
+    lines.append(f"evictions      {ev if ev is not None else 'n/a'} lifetime")
+    lines.append(f"hbm table      {_fmt_bytes(hbm.get('total_bytes'))}")
+    lines.append("")
+
+    lines.append("headroom forecast")
+    lines.append("-" * 47)
+    if fc.get("projectable"):
+        g = fc.get("growth_keys_per_s")
+        lines.append(f"net growth     {g:+.2f} keys/s over "
+                     f"{_fmt_secs(fc.get('span_s'))} "
+                     f"({fc.get('samples')} ring samples)")
+        lines.append(f"time to full   {_fmt_secs(fc.get('time_to_full_s'))}")
+        lines.append("time to evict  "
+                     f"{_fmt_secs(fc.get('time_to_pressure_s'))} "
+                     f"(pressure at {fc.get('pressure_fraction', 0.9):.0%})")
+    else:
+        lines.append("not projectable — table shrinking/flat, already "
+                     "evicting, or too few ring samples "
+                     f"({fc.get('samples', 0)} so far)")
+    lines.append("")
+
+    lines.append("hit-mass concentration")
+    lines.append("-" * 47)
+    if hm:
+        for b in ("top1", "top10", "top100"):
+            share = hm.get(f"{b}_share")
+            if share is not None:
+                lines.append(f"{b:<9}      {share:.1%} of lifetime hits")
+        z = hm.get("zipf_exponent")
+        lines.append("zipf exponent  "
+                     + (f"{z:.2f}" if z is not None
+                        else "n/a (too few keys)"))
+    else:
+        lines.append("n/a")
+    lines.append("")
+
+    top = rep.get("top_keys") or []
+    lines.append(f"top {len(top)} heavy hitters"
+                 + ("" if rep.get("keys_resolvable", True)
+                    else "  (keys unresolvable on this backend; "
+                         "fingerprints shown)"))
+    lines.append("-" * 47)
+    for i, e in enumerate(top, 1):
+        name = e.get("key")
+        if name is None:
+            name = f"fp=0x{e.get('fp', 0):x}"
+        lines.append(f"{i:>3}. {name:<32} {e.get('hits')} hits"
+                     + (f"  ({e.get('share'):.1%})"
+                        if e.get("share") is not None else ""))
+    if not top:
+        lines.append("(none)")
+
+    if history_body is not None:
+        lines.append("")
+        lines.append("metrics-history ring")
+        lines.append("-" * 47)
+        if not history_body.get("enabled", True):
+            lines.append("ring DISABLED (GUBER_HISTORY=0) — forecaster "
+                         "is blind; only instantaneous gauges remain")
+        samples = history_body.get("samples") or []
+        lines.append(f"{history_body.get('sample_count', 0)} samples @ "
+                     f"{history_body.get('tick_s')}s tick, "
+                     f"{_fmt_secs(history_body.get('retention_s'))} "
+                     "retention")
+        if len(samples) >= 2:
+            first, last = samples[0], samples[-1]
+            span = last["t"] - first["t"]
+            lines.append(f"tail window    {_fmt_secs(span)}: key_count "
+                         f"{first.get('key_count')} -> "
+                         f"{last.get('key_count')}, decisions "
+                         f"+{last.get('decisions', 0) - first.get('decisions', 0)}")
+    return "\n".join(lines) + "\n"
+
+
+def _fetch(addr, path, timeout=5.0):
+    return json.loads(urllib.request.urlopen(
+        f"http://{addr}{path}", timeout=timeout).read())
+
+
+def main(argv):
+    addr = argv[1] if len(argv) > 1 else "127.0.0.1:80"
+    try:
+        ks = _fetch(addr, "/v1/debug/keyspace")
+        # n=24 keeps the tail line cheap; the ring itself holds ~2h
+        hist = _fetch(addr, "/v1/debug/history?n=24")
+    except Exception as e:  # noqa: BLE001 — operator tool, report and exit
+        print(f"capacity_report: fetch from {addr} failed: {e}",
+              file=sys.stderr)
+        return 1
+    try:
+        sys.stdout.write(render_report(ks, hist))
+    except Exception as e:  # noqa: BLE001
+        print(f"capacity_report: unexpected endpoint shape: {e}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

@@ -262,7 +262,7 @@ class TestBundles:
             b = build_bundle(inst, reason="unit", metrics=Metrics())
             assert b["kind"] == "gubernator-debug-bundle"
             assert b["schema_version"] == 1
-            assert b["vars"]["schema_version"] == 1
+            assert b["vars"]["schema_version"] == 2
             assert any(e["kind"] == "circuit.open"
                        for e in b["flight_recorder"])
             assert "# HELP" in b["metrics_text"]

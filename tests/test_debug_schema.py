@@ -7,19 +7,27 @@ enforces: (a) the version field exists and matches the source constant;
 (b) no known section silently disappears or gets renamed without the
 version moving. Renaming a section => bump DEBUG_VARS_SCHEMA_VERSION and
 update SECTIONS here, consciously.
+
+v2 additionally promises the "history" and "keyspace" sections on every
+Instance (the cartography plane is always constructed, even when its
+tickers are disabled), and pins the /v1/debug/history and
+/v1/debug/keyspace endpoint bodies.
 """
 
 import pytest
 
 from gubernator_tpu.models.engine import Engine
+from gubernator_tpu.obs.history import HISTORY_SCHEMA_VERSION
 from gubernator_tpu.obs.introspect import DEBUG_VARS_SCHEMA_VERSION, debug_vars
+from gubernator_tpu.obs.keyspace import KEYSPACE_SCHEMA_VERSION
 from gubernator_tpu.service.config import InstanceConfig
 from gubernator_tpu.service.instance import Instance
 from gubernator_tpu.types import PeerInfo
 
 # every section name the snapshot may carry, by wiring condition
 ALWAYS = {"schema_version", "advertise_address", "engine", "combiner",
-          "kernel", "peers", "global", "flight_recorder", "anomaly"}
+          "kernel", "peers", "global", "flight_recorder", "anomaly",
+          "history", "keyspace"}
 OPTIONAL = {"wire", "trace", "leases", "collective_global", "multiregion",
             "bundles", "deadline_expired"}
 SECTIONS = ALWAYS | OPTIONAL
@@ -36,7 +44,7 @@ def instance():
 
 def test_schema_version_pinned(instance):
     dv = debug_vars(instance)
-    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 1
+    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 2
 
 
 def test_always_sections_present(instance):
@@ -63,3 +71,47 @@ def test_flight_recorder_and_anomaly_shapes(instance):
             "counts"} <= set(dv["flight_recorder"])
     assert {"interval_s", "checks", "active", "trips", "slo", "burn_fast",
             "burn_slow"} <= set(dv["anomaly"])
+
+
+def test_history_and_keyspace_var_shapes(instance):
+    dv = debug_vars(instance)
+    assert {"enabled", "tick_s", "retention_s", "samples", "span_s",
+            "ticks"} <= set(dv["history"])
+    assert {"enabled", "interval_s", "top_k", "harvests",
+            "errors"} <= set(dv["keyspace"])
+
+
+def test_history_endpoint_schema_pinned(instance):
+    body = instance.history.endpoint_body()
+    assert body["schema_version"] == HISTORY_SCHEMA_VERSION == 1
+    assert set(body) == {"schema_version", "enabled", "tick_s",
+                         "retention_s", "sample_count", "samples"}
+    instance.history.tick()
+    sample = instance.history.endpoint_body()["samples"][-1]
+    # the signal set consumers plot; adding a signal is fine, losing or
+    # renaming one breaks every dashboard reading the ring
+    assert {"t", "wall", "decisions", "over_limit", "deadline_expired",
+            "sheds", "admission_pending", "pull_boundary_stalls",
+            "lease_fail_close", "lease_outstanding", "lease_held_keys",
+            "key_count", "evictions", "global_hits_depth",
+            "global_broadcast_depth", "circuits_open", "slo_total",
+            "slo_good", "slo_errors"} <= set(sample)
+
+
+def test_keyspace_endpoint_schema_pinned(instance):
+    body = instance.keyspace.endpoint_body()
+    assert body["schema_version"] == KEYSPACE_SCHEMA_VERSION == 1
+    assert set(body) == {"schema_version", "enabled", "interval_s",
+                         "top_k", "report", "forecast"}
+    rep = body["report"]
+    assert rep is not None  # first endpoint_body triggers a harvest
+    assert {"schema_version", "captured_at", "backend", "keys_resolvable",
+            "occupancy", "evictions", "hbm", "hit_mass", "top_keys",
+            "harvest_ms"} <= set(rep)
+    assert {"key_count", "capacity", "fill_fraction",
+            "free_slots"} == set(rep["occupancy"])
+    fc = body["forecast"]
+    assert {"projectable", "capacity", "pressure_fraction", "samples",
+            "span_s", "key_count", "fill_fraction", "growth_keys_per_s",
+            "eviction_rate_per_s", "time_to_full_s",
+            "time_to_pressure_s"} == set(fc)
