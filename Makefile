@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast bench bench-skew bench-wire bench-suite bench-check capacity-report soak chaos proto docker clean native
+.PHONY: test test-fast bench bench-skew bench-wire bench-reshard bench-suite bench-check capacity-report soak chaos proto docker clean native
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -22,6 +22,11 @@ bench-skew:
 # link-emulated (BENCH_r05-class tunnel latency) regime (BENCH_r10)
 bench-wire:
 	python bench.py --wire
+
+# live resharding at scale: 1M-row evacuate() handoff duration plus the
+# importer's foreground p50/p99 quiet vs mid-handoff (BENCH_r13)
+bench-reshard:
+	python bench.py --reshard
 
 bench-suite:
 	python scripts/bench_suite.py

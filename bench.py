@@ -1090,6 +1090,164 @@ def _wire_bench(n_frames: int = 48, frame_w: int = 1024,
     }
 
 
+def _reshard_bench(n_resident: int = 1_000_000,
+                   fg_keys: int = 120) -> dict:
+    """Live resharding at scale: handoff duration + serving-path impact
+    with 1M resident counter rows on the departing owner (BENCH_r13).
+
+    A real 2-node loopback cluster, reshard armed. The donor node is
+    staged with `n_resident` donor-owned rows through the engine's
+    snapshot-slab inject path (the same path transfer frames use), then
+    `evacuate()` streams every row to the survivor over the debug RPC —
+    plan, chunk-cut, stream, commit, measured wall-clock end to end.
+    A foreground client meanwhile drives survivor-owned keys through
+    the survivor (the importer: its serving path carries the intercept
+    checks AND the frame injections), sampled per-call before and
+    during the handoff — the serving-impact row.
+
+    The claims under test: handoff duration scales with rows at
+    wire+inject cost (no quadratic planning), and the importer's
+    foreground p99 stays in the same regime while 1M rows stream in."""
+    import dataclasses
+    import threading
+
+    from gubernator_tpu.cluster.harness import LocalCluster, test_behaviors
+    from gubernator_tpu.types import RateLimitReq
+
+    beh = dataclasses.replace(test_behaviors(), reshard=True,
+                              reshard_ttl_s=10.0, reshard_grace_s=0.5)
+    # table capacity: donor residents + foreground keys + slack, on
+    # BOTH nodes (the survivor absorbs the whole donor set)
+    c = LocalCluster().start(2, capacity=1 << 21, behaviors=beh)
+    try:
+        time.sleep(0.7)  # boot grace
+        survivor, donor = c.instances[0], c.instances[1]
+
+        # ---- stage: n_resident donor-OWNED rows via the slab inject
+        # path. Ownership is the single-point ring's call, so candidate
+        # keys are partitioned by the live picker and the donor takes
+        # the majority side (re-rolling ports for a balanced ring at 1M
+        # keys costs more than over-generating candidates).
+        get_peer = survivor.instance.get_peer
+        probe = [f"reshard_rk{i:07d}" for i in range(50_000)]
+        donor_share = sum(get_peer(k).info.address == donor.address
+                          for k in probe) / len(probe)
+        if donor_share < 0.5:
+            survivor, donor = donor, survivor
+            donor_share = 1.0 - donor_share
+        donor_keys: list = []
+        i = 0
+        cap = max(4 * n_resident, 200_000)
+        while len(donor_keys) < n_resident and i < cap:
+            k = f"reshard_rk{i:07d}"
+            if get_peer(k).info.address == donor.address:
+                donor_keys.append(k)
+            i += 1
+        now_ms = int(time.time() * 1000)
+        chunk = 8192
+        t0 = time.perf_counter()
+
+        def slabs():
+            for lo in range(0, len(donor_keys), chunk):
+                ks = [k.encode() for k in donor_keys[lo:lo + chunk]]
+                m = len(ks)
+                off = np.zeros(m + 1, np.int64)
+                np.cumsum([len(b) for b in ks], out=off[1:])
+                rows = np.zeros((m, 7), np.int64)
+                rows[:, 0] = 0  # TOKEN_BUCKET
+                rows[:, 1] = 1 << 20  # limit
+                rows[:, 2] = np.arange(lo, lo + m) % (1 << 20)  # remaining
+                rows[:, 3] = 3_600_000  # duration
+                rows[:, 4] = now_ms
+                rows[:, 5] = now_ms + 3_600_000  # expire_at
+                yield b"".join(ks), off, rows
+
+        donor.instance.backend.load_snapshot_slabs(slabs())
+        stage_s = time.perf_counter() - t0
+
+        # ---- foreground load on the IMPORTER, sampled per call.
+        # Leading digits vary: trailing-suffix keys can collapse onto
+        # one fnv ring arc (the _skew_bench ownership-probe caveat), and
+        # a draw where every foreground key lands on the DONOR measures
+        # nothing — over-generate and keep the survivor-owned ones.
+        fg = [r for r in
+              (RateLimitReq(name="rfg", unique_key=f"{j:04d}fg", hits=1,
+                            limit=1 << 30, duration=3_600_000)
+               for j in range(20 * fg_keys))
+              if get_peer(r.hash_key()).info.address == survivor.address
+              ][:fg_keys]
+        lat, marks, fg_errors = [], [], []
+        stop = threading.Event()
+
+        def drive():
+            while not stop.is_set():
+                for r in fg:
+                    t1 = time.perf_counter()
+                    try:
+                        resp = survivor.instance.get_rate_limits([r])[0]
+                    except Exception as e:  # noqa: BLE001
+                        fg_errors.append(repr(e))
+                        continue
+                    lat.append(time.perf_counter() - t1)
+                    if resp.error:
+                        fg_errors.append(resp.error)
+                time.sleep(0.005)
+
+        th = threading.Thread(target=drive, daemon=True)
+        th.start()
+        time.sleep(1.5)  # quiet-window baseline
+        marks.append(len(lat))
+
+        # ---- the handoff: evacuate() returns once every export commits
+        t0 = time.perf_counter()
+        drained = donor.instance.reshard.evacuate(timeout_s=300)
+        handoff_s = time.perf_counter() - t0
+        marks.append(len(lat))
+        time.sleep(1.0)  # post-handoff window
+        stop.set()
+        th.join(timeout=10)
+
+        stats = donor.instance.reshard.debug()["stats"]
+        quiet = np.asarray(lat[:marks[0]])
+        during = np.asarray(lat[marks[0]:marks[1]])
+        after = np.asarray(lat[marks[1]:])
+
+        def pcts(a):
+            if not a.size:
+                return {}
+            return {"calls": int(a.size),
+                    "p50_ms": round(float(np.percentile(a, 50) * 1e3), 3),
+                    "p99_ms": round(float(np.percentile(a, 99) * 1e3), 3)}
+
+        return {"reshard": {
+            "scope": "2-node loopback cluster, evacuate() streaming the "
+                     "donor's whole resident set to the survivor over "
+                     "the debug RPC (plan + chunk-cut + stream + "
+                     "commit), foreground client on the importer",
+            "resident_rows": len(donor_keys),
+            "donor_ring_share": round(donor_share, 3),
+            "stage_seconds": round(stage_s, 2),
+            "drained": bool(drained),
+            "handoff_seconds": round(handoff_s, 2),
+            "rows_moved": int(stats["rows_out"]),
+            "rows_per_sec": round(stats["rows_out"] / max(handoff_s, 1e-6), 1),
+            "transfer_MBps": round(
+                stats["bytes_out"] / max(handoff_s, 1e-6) / 1e6, 2),
+            "export_commits": int(stats["export_commits"]),
+            "export_aborts": int(stats["export_aborts"]),
+            "chunk_rows": beh.reshard_chunk_rows,
+            "importer_foreground": {
+                "keys": len(fg),
+                "errors": len(fg_errors),
+                "quiet": pcts(quiet),
+                "during_handoff": pcts(during),
+                "after": pcts(after),
+            },
+        }}
+    finally:
+        c.stop()
+
+
 def main() -> None:
     watchdog = _init_watchdog()
     import jax
@@ -1533,6 +1691,17 @@ def main() -> None:
         except Exception as e:  # noqa: BLE001 — report, don't die
             wire_row = {"wire": {"error": str(e)}}
 
+    # ---- live resharding: 1M-row handoff duration + importer impact ----
+    # A real 2-node loopback cluster; BENCH_r13 records evacuate() wall
+    # clock, rows/s, and the importer's foreground p50/p99 quiet vs
+    # mid-handoff (opt-in via --reshard: staging 1M rows costs ~a minute).
+    reshard_row = {}
+    if "--reshard" in sys.argv:
+        try:
+            reshard_row = _reshard_bench()
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            reshard_row = {"reshard": {"error": str(e)}}
+
     # ---- observability plane: flight recorder on vs the escape hatch ------
     # Single-node serving with the recorder enabled vs disabled on the same
     # Instance; BENCH_r11 records the overhead (acceptance <= 2%) plus the
@@ -1568,6 +1737,7 @@ def main() -> None:
                 **overload_row,
                 **skew_row,
                 **wire_row,
+                **reshard_row,
                 **obs_row,
                 **carto_row,
                 **_multichip_section(),

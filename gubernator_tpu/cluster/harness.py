@@ -108,17 +108,21 @@ class LocalCluster:
     # ------------------------------------------------------------ lifecycle
 
     def start(self, n: int, datacenters: Optional[Sequence[str]] = None,
-              capacity: int = 4096) -> "LocalCluster":
+              capacity: int = 4096,
+              behaviors: Optional[BehaviorConfig] = None) -> "LocalCluster":
         """Boot n instances on dynamic loopback ports and wire full peer
         lists (reference: cluster/cluster.go:104-128)."""
         datacenters = list(datacenters or [""] * n)
         for i in range(n):
-            self.start_instance(datacenter=datacenters[i], capacity=capacity)
+            self.start_instance(datacenter=datacenters[i], capacity=capacity,
+                                behaviors=behaviors)
         self.sync_peers()
         return self
 
     def start_instance(self, datacenter: str = "", capacity: int = 4096,
-                       fixed_port: int = 0) -> ClusterInstance:
+                       fixed_port: int = 0,
+                       behaviors: Optional[BehaviorConfig] = None
+                       ) -> ClusterInstance:
         """(reference: cluster/cluster.go:138-165)"""
         backend = Engine(capacity=capacity, min_width=32, max_width=256)
         backend.warmup()  # compile all width buckets before serving
@@ -126,7 +130,8 @@ class LocalCluster:
         backend.metrics = metrics  # engine phase histograms, as the daemon
         inst = Instance(
             InstanceConfig(
-                behaviors=test_behaviors(),
+                behaviors=dataclasses.replace(behaviors) if behaviors
+                else test_behaviors(),
                 data_center=datacenter,
                 backend=backend,
                 metrics=metrics,

@@ -293,6 +293,13 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
     b.hot_lease_fraction = _env_float("GUBER_HOT_LEASE_FRACTION",
                                       b.hot_lease_fraction)
 
+    # live resharding (service/reshard.py)
+    b.reshard = _env_bool("GUBER_RESHARD")
+    b.reshard_ttl_s = _env_dur("GUBER_RESHARD_TTL", b.reshard_ttl_s)
+    b.reshard_chunk_rows = _env_int("GUBER_RESHARD_CHUNK_ROWS",
+                                    b.reshard_chunk_rows)
+    b.reshard_grace_s = _env_dur("GUBER_RESHARD_GRACE", b.reshard_grace_s)
+
     conf = DaemonConfig(
         grpc_address=_env_str("GUBER_GRPC_ADDRESS", "0.0.0.0:81"),
         grpc_native=_env_str("GUBER_GRPC_NATIVE", "1") != "0",

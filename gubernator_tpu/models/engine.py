@@ -1086,6 +1086,41 @@ class Engine:
                 self.state[jnp.asarray([s for _, s in pairs], I32)])
         return {key: int(rows[i, 7]) for i, (key, _) in enumerate(pairs)}
 
+    def rows_for_keys(self, keys):
+        """Point-read the named keys' live rows -> (found_keys,
+        rows i64[len(found), 7]) in BucketSnapshot field order — the
+        reshard exporter's settle read (service/reshard.py): called under
+        its authority fence, so the rows ARE the keys' final state on
+        this node. Reconciles the native lone-path mirror first (like
+        snapshot_slabs) so fast-path decisions newer than the device
+        rows are included; keys that are absent, vacant, or expired are
+        simply not in found_keys (the exporter sends them as vacant)."""
+        now = millisecond_now()
+        d = self.directory
+        peek = getattr(d, "peek_slot", None)
+        with self._lock:
+            if hasattr(d, "mirror_flush"):
+                while True:
+                    inj = d.mirror_flush()
+                    if not len(inj):
+                        break
+                    self._apply_inject_rows(inj)
+            table = None if peek is not None else dict(d.items())
+            pairs = []
+            for key in keys:
+                slot = peek(key) if peek is not None \
+                    else table.get(key, -1)
+                if slot >= 0:
+                    pairs.append((key, int(slot)))
+            if not pairs:
+                return [], np.zeros((0, 7), np.int64)
+            rows = np.asarray(
+                self.state[jnp.asarray([s for _, s in pairs], I32)],
+                np.int64)[:, :7]
+        live = (rows[:, 0] >= 0) & (rows[:, 5] >= now)
+        found = [key for (key, _), ok in zip(pairs, live) if ok]
+        return found, np.ascontiguousarray(rows[live])
+
     # ------------------------------------------------------- persistence SPI
 
     def load_snapshot(self, items) -> int:

@@ -295,6 +295,25 @@ def cluster_view(instance, timeout_s: float = 5.0,
         "nodes": capacities,
     }
 
+    # handoff roll-up: every in-flight transfer across the ring, both
+    # sides merged per transfer id — the mid-deploy "where are my keys"
+    # view (docs/OPERATIONS.md "Deploys & resharding")
+    handoffs: Dict[str, dict] = {}
+    reshard_enabled: List[str] = []
+    for addr, rep in nodes.items():
+        rs = (rep.get("vars") or {}).get("reshard") or {}
+        if rs.get("enabled"):
+            reshard_enabled.append(addr)
+        for sess in rs.get("sessions") or []:
+            xfer = sess.get("xfer", "?")
+            entry = handoffs.setdefault(xfer, {"xfer": xfer})
+            entry[sess.get("role", "?")] = {**sess, "node": addr}
+    reshard_roll = {
+        "enabled_nodes": sorted(reshard_enabled),
+        "in_flight": sorted(handoffs.values(),
+                            key=lambda e: e.get("xfer", "")),
+    }
+
     recent = sorted(
         spans_by_tid,
         key=lambda tid: max(s["start_ns"] for s in spans_by_tid[tid]),
@@ -317,6 +336,7 @@ def cluster_view(instance, timeout_s: float = 5.0,
         "unhealthy": unhealthy,
         "keyspace": keyspace_roll,
         "capacity": capacity_roll,
+        "reshard": reshard_roll,
         "stitched_traces": stitched,
         "cross_node_traces": sorted(cross_node),
     }

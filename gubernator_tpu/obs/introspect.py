@@ -17,7 +17,9 @@ from typing import Optional
 # v2: always-present "history" and "keyspace" sections (capacity &
 # keyspace cartography plane) — bumped because both are promised on
 # every Instance, not merely tolerated.
-DEBUG_VARS_SCHEMA_VERSION = 2
+# v3: always-present "reshard" section (live-resharding handoff plane) —
+# promised on every Instance; "enabled" inside it tracks GUBER_RESHARD.
+DEBUG_VARS_SCHEMA_VERSION = 3
 
 
 def _backend_vars(backend) -> dict:
@@ -151,6 +153,10 @@ def debug_vars(instance) -> dict:
     lm = getattr(instance, "leases", None)
     if lm is not None and lm.enabled:
         out["leases"] = lm.debug()
+
+    rm = getattr(instance, "reshard", None)
+    if rm is not None:
+        out["reshard"] = rm.debug()
 
     cg = getattr(instance, "collective_global", None)
     if cg is not None:

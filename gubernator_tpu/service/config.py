@@ -101,6 +101,26 @@ class BehaviorConfig:
     # over-admission.
     hot_lease_fraction: float = 0.2
 
+    # live resharding (service/reshard.py; docs/OPERATIONS.md "Deploys &
+    # resharding"). GUBER_RESHARD arms counter-continuous ownership
+    # handoff on membership change; off (default) keeps every hook one
+    # attribute test and membership changes bit-identical to the
+    # pre-reshard amnesty behavior.
+    reshard: bool = False
+    # GUBER_RESHARD_TTL: transfer-lease lifetime, seconds. Renewed by
+    # every streamed frame; at expiry both sides fail-close — the
+    # importer serves fresh (amnesty), the exporter aborts — so a wedged
+    # transfer can never wedge serving or mint budget.
+    reshard_ttl_s: float = 5.0
+    # GUBER_RESHARD_CHUNK_ROWS: rows per transfer frame (also split at
+    # ~512 KB of key bytes to stay under the 1 MB RPC frame cap).
+    reshard_chunk_rows: int = 2048
+    # GUBER_RESHARD_GRACE: how long a new owner keeps proxying gained
+    # keys to a previous owner that has not opened a transfer session
+    # yet (it may still be planning); after it, gained keys without a
+    # session serve fresh.
+    reshard_grace_s: float = 1.0
+
 
 @dataclasses.dataclass
 class InstanceConfig:
@@ -180,6 +200,14 @@ class InstanceConfig:
         if not 0.0 < self.behaviors.hot_lease_fraction <= 1.0:
             raise ValueError(
                 "behaviors.hot_lease_fraction must be in (0, 1]")
+        if self.behaviors.reshard_ttl_s <= 0:
+            raise ValueError("behaviors.reshard_ttl_s must be positive")
+        if not 0 < self.behaviors.reshard_chunk_rows <= 8192:
+            raise ValueError(
+                "behaviors.reshard_chunk_rows must be in [1, 8192]")
+        if self.behaviors.reshard_grace_s < 0:
+            raise ValueError(
+                "behaviors.reshard_grace_s cannot be negative")
         if self.anomaly_interval_s <= 0:
             raise ValueError("anomaly_interval_s must be positive")
         if self.slo_target_ms <= 0:

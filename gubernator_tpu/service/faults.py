@@ -2,9 +2,11 @@
 
 Peer-failure behavior must be provable in milliseconds, not by killing
 processes and waiting out real timeouts: an injectable fault *plan* sits at
-the two transport choke points — the gRPC stub wrapper inside PeerClient and
-PeerLinkClient.call_async — and fails, delays, or "times out" exactly the
-Nth call to a given peer over a given transport. Counters are per
+the three transport choke points — the gRPC stub wrapper inside PeerClient,
+PeerLinkClient.call_async, and the reshard session sender (every
+begin/frame/commit RPC in service/reshard.py, transport ``reshard``) — and
+fails, delays, or "times out" exactly the Nth call to a given peer over a
+given transport. Counters are per
 (peer, transport), incremented under a lock, so a plan replays
 bit-identically run after run; that is what lets the circuit-breaker tests
 (tests/test_resilience.py) prove open/half-open/recover transitions inside
@@ -41,7 +43,7 @@ import threading
 import time
 from typing import List, Optional, Sequence, Tuple
 
-TRANSPORTS = ("grpc", "peerlink")
+TRANSPORTS = ("grpc", "peerlink", "reshard")
 ACTIONS = ("error", "timeout", "drop", "delay")
 
 

@@ -45,6 +45,7 @@ from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
 from gubernator_tpu.service.global_manager import GlobalManager
 from gubernator_tpu.service.leases import LeaseManager
 from gubernator_tpu.service.multiregion import MultiRegionManager
+from gubernator_tpu.service.reshard import ReshardManager
 from gubernator_tpu.service.peer_client import (
     CIRCUIT_CLOSED,
     CircuitOpenError,
@@ -278,6 +279,12 @@ class Instance:
         self.leases = LeaseManager(self)
         if getattr(conf.behaviors, "hot_leases", False):
             self.leases.arm()
+
+        # live-resharding handoff plane (service/reshard.py): always
+        # constructed so every serving hook is one `active` bool test;
+        # GUBER_RESHARD enables it, and with it off membership changes
+        # keep today's counter-amnesty semantics bit-identical
+        self.reshard = ReshardManager(self)
 
         self.global_manager = GlobalManager(
             self, conf.behaviors, metrics=conf.metrics,
@@ -671,6 +678,11 @@ class Instance:
                 "peers updated: %d local, %d region, self=%s",
                 new_local.size(), new_region.size(),
                 self.advertise_address or "?")
+            # handoff plane: capture the ring diff synchronously (fast —
+            # no RPC under the lock; planning + streaming happen on the
+            # manager's own thread) so the first request routed under the
+            # new ring already sees the planning/grace window
+            self.reshard.on_peers_changed(old_local, new_local)
         self._recompute_collective_coverage()
         for cb in self._peer_listeners:
             try:
@@ -695,6 +707,7 @@ class Instance:
         if self._closed:
             return
         self._closed = True
+        self.reshard.stop()
         self.anomaly.stop()
         self.history.stop()
         self.keyspace.stop()
@@ -774,8 +787,29 @@ class Instance:
         """Apply requests we own to the TPU backend in one batched call,
         queueing GLOBAL broadcasts / multi-region replication first
         (reference: gubernator.go:327-347)."""
-        return self.combiner.submit(
-            self._strip_owner_batch(requests, from_peer_rpc), now_ms=now_ms)
+        rm = self.reshard
+        if not rm.active:
+            return self.combiner.submit(
+                self._strip_owner_batch(requests, from_peer_rpc),
+                now_ms=now_ms)
+        # handoff window: enter the apply gate FIRST so the exporter's cut
+        # settle (fence + barrier) can never interleave with a batch that
+        # already passed the intercept; the plan's network legs (redirect/
+        # proxy) resolve in finish(), outside the gate
+        rm.apply_enter()
+        try:
+            plan = rm.intercept_owner_batch(requests, from_peer_rpc)
+            if plan is None:
+                return self.combiner.submit(
+                    self._strip_owner_batch(requests, from_peer_rpc),
+                    now_ms=now_ms)
+            local = [requests[i] for i in plan.local_idx]
+            local_out = self.combiner.submit(
+                self._strip_owner_batch(local, from_peer_rpc),
+                now_ms=now_ms) if local else []
+        finally:
+            rm.apply_exit()
+        return plan.finish(local_out, now_ms)
 
     def apply_owner_batch_direct(
         self, requests: List[RateLimitReq], now_ms: Optional[int] = None,
@@ -790,8 +824,25 @@ class Instance:
             # get_peer_rate_limits): shed at saturation only — owner work
             # goes last in the brownout order
             self.admission.check_ingress(priority="peer")
-        return self.backend.get_rate_limits(
-            self._strip_owner_batch(requests, from_peer_rpc), now_ms=now_ms)
+        rm = self.reshard
+        if not rm.active:
+            return self.backend.get_rate_limits(
+                self._strip_owner_batch(requests, from_peer_rpc),
+                now_ms=now_ms)
+        rm.apply_enter()
+        try:
+            plan = rm.intercept_owner_batch(requests, from_peer_rpc)
+            if plan is None:
+                return self.backend.get_rate_limits(
+                    self._strip_owner_batch(requests, from_peer_rpc),
+                    now_ms=now_ms)
+            local = [requests[i] for i in plan.local_idx]
+            local_out = self.backend.get_rate_limits(
+                self._strip_owner_batch(local, from_peer_rpc),
+                now_ms=now_ms) if local else []
+        finally:
+            rm.apply_exit()
+        return plan.finish(local_out, now_ms)
 
     def _strip_owner_batch(
         self, requests: List[RateLimitReq], from_peer_rpc: bool = False
@@ -928,10 +979,13 @@ class Instance:
         path so lone callers still amortize into the 500 µs peer window.
 
         Failure handling mirrors _forward's: not-ready means the RPC was
-        never sent, so re-forwarding per request (with owner re-picks) is
-        safe and fails fast; any OTHER error may mean the owner already
-        applied the batch, so re-sending would double-count hits — those
-        surface as error responses, exactly like the per-request path."""
+        never sent — or was cancelled by our own shutdown() when a
+        membership change removed the peer, where a re-forward at worst
+        over-counts one in-flight batch — so re-forwarding per request
+        (with owner re-picks) is safe and fails fast; any OTHER error may
+        mean the owner already applied the batch, so re-sending would
+        double-count hits — those surface as error responses, exactly
+        like the per-request path."""
         t0 = time.time_ns() if span is not None else 0
         lease_want = None
         if self.leases.enabled:

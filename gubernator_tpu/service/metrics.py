@@ -357,6 +357,78 @@ class Metrics:
             "GUBER_HOT_LEASE_RATE detection threshold.",
             registry=self.registry,
         )
+        # live-resharding handoff plane (service/reshard.py;
+        # docs/OPERATIONS.md "Deploys & resharding"). Counters increment
+        # live at the reshard manager; the gauge refreshes at scrape.
+        self.reshard_transfers = Counter(
+            "reshard_transfers_total",
+            "Handoff sessions opened, by role (export = this node is the "
+            "departing owner streaming rows out; import = receiving).",
+            ["role"], registry=self.registry,
+        )
+        self.reshard_committed = Counter(
+            "reshard_committed_total",
+            "Handoff sessions that completed: every planned key streamed "
+            "and acknowledged, ownership fully transferred.",
+            ["role"], registry=self.registry,
+        )
+        self.reshard_aborted = Counter(
+            "reshard_aborted_total",
+            "Handoff sessions that failed-closed, by reason (ttl_expired, "
+            "frame_failed, superseded, shutdown, ...). Aborted keys "
+            "degrade to the pre-reshard amnesty, never to over-admission.",
+            ["role", "reason"], registry=self.registry,
+        )
+        self.reshard_rows_moved = Counter(
+            "reshard_rows_moved_total",
+            "Counter rows carried across handoff transfer frames.",
+            ["role"], registry=self.registry,
+        )
+        self.reshard_transfer_bytes = Counter(
+            "reshard_transfer_bytes_total",
+            "Transfer-frame payload bytes moved by the handoff plane.",
+            ["role"], registry=self.registry,
+        )
+        self.reshard_frames = Counter(
+            "reshard_frames_total",
+            "Sequence-numbered transfer frames sent (export) or accepted "
+            "(import); each accepted frame renews the transfer lease.",
+            ["role"], registry=self.registry,
+        )
+        self.reshard_proxied = Counter(
+            "reshard_proxied_total",
+            "Requests resolved over the handoff double-write window: "
+            "import = a new owner asked the previous owner to decide a "
+            "not-yet-transferred key; export = a departing owner forwarded "
+            "a stale arrival to the new owner.",
+            ["role"], registry=self.registry,
+        )
+        self.reshard_fresh_serves = Counter(
+            "reshard_fresh_serves_total",
+            "Moving keys served from a fresh bucket because the handoff "
+            "protocol was dead for them, by reason — the bounded amnesty "
+            "the protocol fail-closes to, never over-admission.",
+            ["reason"], registry=self.registry,
+        )
+        self.reshard_cut_wait_timeouts = Counter(
+            "reshard_cut_wait_timeouts_total",
+            "Requests that waited out the in-flight-chunk cap before the "
+            "key's transferred row landed and served fresh instead.",
+            registry=self.registry,
+        )
+        self.reshard_double_write_window_s = Histogram(
+            "reshard_double_write_window_seconds",
+            "Wall-clock length of each handoff session's double-write "
+            "window (begin to commit/abort).",
+            ["role"], registry=self.registry,
+            buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0),
+        )
+        self.reshard_active = Gauge(
+            "reshard_active",
+            "1 while this node has a handoff in flight (planning, "
+            "streaming, lingering, or inside the importer grace window).",
+            registry=self.registry,
+        )
         # observability plane (obs/events.py flight recorder, obs/anomaly.py
         # watchers; docs/OPERATIONS.md "Incident response"). Recorder totals
         # refresh at scrape from the ring's own counters; anomaly gauges are
@@ -764,6 +836,9 @@ class Metrics:
             tracker = lm.tracker()
             if tracker is not None:
                 self.lease_hot_keys.set(len(tracker.snapshot()))
+        rm = getattr(instance, "reshard", None)
+        if rm is not None:
+            self.reshard_active.set(1 if rm.poll_active() else 0)
         cache = getattr(instance, "_global_cache", None)
         if cache is not None:
             self.global_cache_size.set(len(cache))

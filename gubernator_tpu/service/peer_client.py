@@ -532,6 +532,17 @@ class PeerClient:
                 wait_for_ready=wait_for_ready,
                 metadata=tuple(metadata) or None)
         except grpc.RpcError as e:
+            if self._closing and e.code() == grpc.StatusCode.CANCELLED:
+                # shutdown() closed the channel under this in-flight call:
+                # a membership change removed the peer while the batch was
+                # on the wire. Locally cancelled, not a peer failure — no
+                # breaker charge, and the not-ready signal sends the caller
+                # back through GetPeer() for a re-pick under the new ring.
+                # Delivery is uncertain (the old owner may have applied and
+                # redirected the hits before the cancel landed), so the
+                # retry can over-count this one batch — the conservative
+                # direction; it can never mint budget.
+                raise PeerNotReadyError(self.info.address) from e
             self._record_err(str(e.code()))
             if e.code() == grpc.StatusCode.RESOURCE_EXHAUSTED:
                 # an admission shed: the peer is ALIVE and answering fast
@@ -578,6 +589,16 @@ class PeerClient:
         except ValueError as e:
             raise PeerNotReadyError(self.info.address) from e
         self.circuit.record_success()
+
+    def reshard_call(self, payload: bytes, timeout_s: float = 5.0) -> bytes:
+        """One reshard-plane message over the raw Debug bytes RPC
+        (service/reshard.py). Deliberately outside the serving circuit
+        breaker: a handoff probe to a peer whose serving path is shedding
+        is exactly when moving keys matters, and the reshard protocol has
+        its own lease-TTL fail-close."""
+        from gubernator_tpu.service.grpc_api import dial_v1
+
+        return dial_v1(self.info.address).Debug(payload, timeout=timeout_s)
 
     def get_last_err(self) -> List[str]:
         """Recent errors for HealthCheck (reference: peer_client.go:198-213)."""

@@ -273,6 +273,26 @@ def decode_partial_frame(payload: memoryview):
     return rid, seq, base, bool(fin), _decode_resp_items(payload, count, 16)
 
 
+def encode_reshard_frame(rid: int, seq: int, count: int, final: bool,
+                         payload: bytes) -> bytes:
+    """Reshard bulk-transfer frames reuse the v2 partial-frame header
+    verbatim (rid = transfer id, count = rows in this chunk, seq-numbered,
+    final-flagged) so the handoff stream inherits the same
+    sequencing/termination contract as a streamed response — but they
+    travel inside the raw Debug RPC body (service/reshard.py), never on a
+    serving link, so v1-only peers take them too."""
+    return _PARTIAL_HDR.pack(rid, WIRE_PARTIAL, count, seq, seq,
+                             1 if final else 0) + payload
+
+
+def decode_reshard_frame(buf):
+    """Inverse of encode_reshard_frame: (rid, seq, count, final, payload)."""
+    rid, method, count, seq, _base, fin = _PARTIAL_HDR.unpack_from(buf, 0)
+    if method != WIRE_PARTIAL:
+        raise PeerLinkError(f"not a reshard frame (method {method:#x})")
+    return rid, seq, count, bool(fin), bytes(buf[_PARTIAL_HDR.size:])
+
+
 def _decode_resp_items(payload: memoryview, count: int,
                        off: int) -> List[RateLimitResp]:
     """The response columns shared by the v1 whole frame and the v2

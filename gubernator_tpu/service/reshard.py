@@ -1,0 +1,1253 @@
+"""Live resharding: ownership handoff without counter amnesty.
+
+The reference accepts losing every counter on membership change (state
+lives only in the in-memory cache and ownership moves with the ring) —
+at millions of users that is a thundering-herd amnesty on every deploy.
+This plane makes ownership transfer counter-continuous by converging
+three existing subsystems:
+
+- **bulk channel** — the departing owner streams each moving key's row
+  as wire-v2 sequence-numbered partial frames (peerlink's ``_PARTIAL_HDR``
+  contract from the streaming-response work), carried inside the raw
+  Debug bytes RPC so every peer — including v1-only link peers — takes
+  them over gRPC. Chunks are packed with ``store.pack_rows_chunk`` (the
+  in-memory sibling of the GTSLAB snapshot framing) and injected with the
+  engine's ``load_snapshot_slabs`` keydir inject-row path.
+- **transfer lease** — the importer's ack to ``begin`` and to every frame
+  is a short TTL grant (generalizing the hot-key lease grant/TTL/seq
+  semantics): the exporter renews by streaming; either side fail-closes
+  at TTL, degrading to today's amnesty rather than ever minting budget
+  or wedging serving.
+- **move set** — a pure deterministic planner diffs the old and new ring
+  over the resident keys, so only ranges whose owner actually changed
+  move (tested minimal + stable in tests/test_reshard.py).
+
+Counter-continuity protocol (exporter P -> importer D), per chunk:
+
+1. P adds the chunk's keys to its **cut set** (the authority fence) and
+   *settles*: drains in-flight owner applies (a brief writer-preferring
+   fence over the apply gate plus one combiner barrier), so every hit
+   admitted before the cut is in the device rows.
+2. P reads the rows (``Engine.rows_for_keys``, which reconciles the
+   native lone-path mirror first) and streams them; D injects and only
+   THEN marks the keys resolved, acks the sequence number, and renews
+   the lease.
+3. Requests during the window are never served from two places at once:
+   D proxies not-yet-resolved gained keys back to P (``apply`` messages,
+   origin-marked so they can never ping-pong), and P redirects post-cut
+   arrivals (stale senders) forward to D, which waits briefly for the
+   in-flight chunk. Fresh local serving — the amnesty of today — happens
+   only when the protocol is already dead (TTL expiry, abort, departed
+   or pre-reshard peer), and every such serve is counted.
+
+``GUBER_RESHARD`` defaults off: with the knob unset the manager never
+arms, every hook is a single attribute test, and membership changes are
+bit-identical to the pre-reshard tree (tests/test_reshard.py proves it).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import struct
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from gubernator_tpu.service import faults
+from gubernator_tpu.service.peerlink import (
+    decode_reshard_frame,
+    encode_reshard_frame,
+)
+from gubernator_tpu.store import pack_rows_chunk, unpack_rows_chunk
+from gubernator_tpu.types import RateLimitReq, RateLimitResp
+
+log = logging.getLogger("gubernator_tpu.reshard")
+
+# Debug-RPC payload magics: control envelope (JSON) and row frame (the
+# wire-v2 partial header + a packed row chunk). A pre-reshard node's
+# Debug handler ignores the request body and answers its node report —
+# the sender detects the non-GRSH reply and degrades to amnesty.
+MAGIC_CTL = b"GRSH1"
+MAGIC_ROWS = b"GRSH2"
+
+# keys under this prefix are plumbing (the settle barrier), never planned
+_INTERNAL_PREFIX = "__guber_reshard"
+
+# per-key control verdicts the apply handler can answer instead of a row
+CTL_CUT = "CUT"            # chunk in flight: wait for the injection
+CTL_STREAMED = "STREAMED"  # already handed over: you have it
+CTL_PLANNING = "PLANNING"  # move set not built yet: retry shortly
+CTL_NOT_MINE = "NOT_MINE"  # no plan covers this key: serve it fresh
+
+_U32 = struct.Struct("<I")
+
+
+class ReshardError(RuntimeError):
+    """Protocol-level transfer failure (aborts the session, never serving)."""
+
+
+# ---------------------------------------------------------------- planning
+
+
+def plan_move_set(keys, old_picker, new_picker, self_addr: str):
+    """Deterministic minimal move set: a key moves iff this node owned it
+    under the old ring and a DIFFERENT node owns it under the new ring.
+    Pure — iteration (and so chunk) order follows the input key order, so
+    recomputation over the same inputs is bit-identical, and an unchanged
+    ring plans an empty move set (tests/test_reshard.py)."""
+    moves: Dict[str, List[str]] = {}
+    for key in keys:
+        if key.startswith(_INTERNAL_PREFIX):
+            continue
+        try:
+            old = old_picker.get(key)
+            new = new_picker.get(key)
+        except Exception:  # noqa: BLE001 — empty ring plans nothing
+            continue
+        old_a = old.info.address
+        new_a = new.info.address
+        old_mine = old.info.is_owner or (bool(self_addr) and old_a == self_addr)
+        new_mine = new.info.is_owner or (bool(self_addr) and new_a == self_addr)
+        if old_mine and not new_mine and new_a:
+            moves.setdefault(new_a, []).append(key)
+    return moves
+
+
+# ------------------------------------------------------------------ codec
+
+
+def encode_ctl(msg: dict) -> bytes:
+    return MAGIC_CTL + json.dumps(msg, separators=(",", ":")).encode()
+
+
+def encode_rows_msg(xfer: int, seq: int, final: bool,
+                    keys: Sequence[str], rows, vacant: Sequence[str]) -> bytes:
+    """One transfer frame: GRSH2 + the wire-v2 partial header + a JSON
+    meta block (vacant keys resolve with no inject) + the packed chunk."""
+    meta = json.dumps({"vacant": list(vacant)},
+                      separators=(",", ":")).encode()
+    chunk = pack_rows_chunk([k.encode("utf-8") for k in keys], rows)
+    return (MAGIC_ROWS +
+            encode_reshard_frame(xfer, seq, len(keys), final,
+                                 _U32.pack(len(meta)) + meta + chunk))
+
+
+def decode_msg(body: bytes):
+    """Debug request body -> ("ctl", dict) | ("rows", parts) | None."""
+    if body.startswith(MAGIC_CTL):
+        return "ctl", json.loads(body[len(MAGIC_CTL):].decode())
+    if body.startswith(MAGIC_ROWS):
+        rid, seq, count, final, payload = decode_reshard_frame(
+            body[len(MAGIC_ROWS):])
+        (mlen,) = _U32.unpack_from(payload, 0)
+        meta = json.loads(payload[4:4 + mlen].decode())
+        blob, off, rows = unpack_rows_chunk(payload[4 + mlen:])
+        keys = [blob[off[i]:off[i + 1]].decode("utf-8")
+                for i in range(len(off) - 1)]
+        if len(keys) != count:
+            raise ReshardError(f"frame count {count} != {len(keys)} keys")
+        return "rows", (rid, seq, final, keys, (blob, off, rows),
+                        meta.get("vacant", ()))
+    return None
+
+
+def _req_to_dict(r: RateLimitReq) -> dict:
+    return {"n": r.name, "u": r.unique_key, "h": r.hits, "l": r.limit,
+            "d": r.duration, "a": r.algorithm, "b": r.behavior}
+
+
+def _req_from_dict(d: dict) -> RateLimitReq:
+    return RateLimitReq(name=d["n"], unique_key=d["u"], hits=d["h"],
+                        limit=d["l"], duration=d["d"], algorithm=d["a"],
+                        behavior=d["b"])
+
+
+def _resp_to_dict(r: RateLimitResp) -> dict:
+    return {"s": r.status, "l": r.limit, "r": r.remaining,
+            "t": r.reset_time, "e": r.error}
+
+
+def _resp_from_dict(d: dict) -> RateLimitResp:
+    return RateLimitResp(status=d["s"], limit=d["l"], remaining=d["r"],
+                         reset_time=d["t"], error=d.get("e", ""))
+
+
+# --------------------------------------------------------------- sessions
+
+
+class _Export:
+    """Outbound handoff to one destination (exporter side)."""
+
+    __slots__ = ("xfer", "dest", "planned", "cut", "streamed", "state",
+                 "reason", "t_begin", "t_done", "rows", "bytes", "frames",
+                 "linger_until", "ttl_s")
+
+    def __init__(self, xfer: int, dest: str, planned: List[str],
+                 ttl_s: float):
+        self.xfer = xfer
+        self.dest = dest
+        self.planned = planned
+        self.cut = set()
+        self.streamed = set()
+        self.state = "begin"   # begin -> streaming -> committed | aborted
+        self.reason = ""
+        self.t_begin = time.monotonic()
+        self.t_done = 0.0
+        self.rows = 0
+        self.bytes = 0
+        self.frames = 0
+        self.linger_until = 0.0
+        self.ttl_s = ttl_s
+
+    def summary(self) -> dict:
+        now = time.monotonic()
+        return {"xfer": f"{self.xfer:016x}", "role": "export",
+                "peer": self.dest, "state": self.state,
+                "reason": self.reason, "planned": len(self.planned),
+                "moved": len(self.streamed), "rows": self.rows,
+                "bytes": self.bytes, "frames": self.frames,
+                "age_s": round(now - self.t_begin, 3)}
+
+
+class _Import:
+    """Inbound handoff from one source (importer side). The session IS
+    the transfer lease: ``deadline`` is the grant, renewed by every
+    accepted frame, and expiry fail-closes to fresh (amnesty) serving."""
+
+    __slots__ = ("xfer", "src", "planned", "resolved", "state", "reason",
+                 "deadline", "next_seq", "t_begin", "t_done", "rows",
+                 "bytes", "ttl_s")
+
+    def __init__(self, xfer: int, src: str, planned: int, ttl_s: float):
+        self.xfer = xfer
+        self.src = src
+        self.planned = planned
+        self.resolved = set()
+        self.state = "streaming"   # streaming -> committed | aborted
+        self.reason = ""
+        self.deadline = time.monotonic() + ttl_s
+        self.next_seq = 0
+        self.t_begin = time.monotonic()
+        self.t_done = 0.0
+        self.rows = 0
+        self.bytes = 0
+        self.ttl_s = ttl_s
+
+    def expired(self) -> bool:
+        return self.state == "streaming" and \
+            time.monotonic() > self.deadline
+
+    def summary(self) -> dict:
+        now = time.monotonic()
+        return {"xfer": f"{self.xfer:016x}", "role": "import",
+                "peer": self.src, "state": self.state,
+                "reason": self.reason, "planned": self.planned,
+                "resolved": len(self.resolved), "rows": self.rows,
+                "bytes": self.bytes, "age_s": round(now - self.t_begin, 3),
+                "ttl_remaining_s": round(max(0.0, self.deadline - now), 3)
+                if self.state == "streaming" else 0.0}
+
+
+class _ApplyPlan:
+    """Classification of one owner batch under an active handoff: which
+    indices apply locally, which resolve over the reshard plane."""
+
+    __slots__ = ("rm", "requests", "from_peer_rpc", "local_idx",
+                 "redirects", "proxies")
+
+    def __init__(self, rm, requests, from_peer_rpc):
+        self.rm = rm
+        self.requests = requests
+        self.from_peer_rpc = from_peer_rpc
+        self.local_idx: List[int] = []
+        self.redirects: Dict[str, List[int]] = {}  # dest -> idx (export)
+        self.proxies: Dict[str, List[int]] = {}    # src  -> idx (import)
+
+    def finish(self, local_out, now_ms) -> List[RateLimitResp]:
+        responses: List[Optional[RateLimitResp]] = \
+            [None] * len(self.requests)
+        for i, resp in zip(self.local_idx, local_out):
+            responses[i] = resp
+        rm = self.rm
+        for dest, idxs in self.redirects.items():
+            out = rm._redirect_to_dest(
+                dest, [self.requests[i] for i in idxs], now_ms,
+                self.from_peer_rpc)
+            for i, resp in zip(idxs, out):
+                responses[i] = resp
+        for src, idxs in self.proxies.items():
+            out = rm._proxy_to_src(
+                src, [self.requests[i] for i in idxs], now_ms,
+                self.from_peer_rpc)
+            for i, resp in zip(idxs, out):
+                responses[i] = resp
+        return responses  # type: ignore[return-value]
+
+
+# ---------------------------------------------------------------- manager
+
+
+class ReshardManager:
+    """Per-instance handoff coordinator: exporter move-set planning and
+    streaming, importer lease/inject/proxy state, and the Debug-plane
+    message handler. Constructed on every Instance; with GUBER_RESHARD
+    unset ``enabled`` is False, ``active`` never flips True, and every
+    hot-path hook is one attribute test."""
+
+    # bound on how long a request waits for an in-flight chunk before
+    # degrading to a fresh (amnesty) answer — never minting, only losing
+    CUT_WAIT_CAP_S = 0.5
+    PLANNING_RETRY_S = 0.02
+    MAX_FRAME_BYTES = 512 * 1024  # stay clearly under the 1 MB RPC cap
+
+    def __init__(self, instance):
+        self.instance = instance
+        b = instance.conf.behaviors
+        self.enabled = bool(getattr(b, "reshard", False))
+        self.ttl_s = float(getattr(b, "reshard_ttl_s", 5.0))
+        self.chunk_rows = int(getattr(b, "reshard_chunk_rows", 2048))
+        self.grace_s = float(getattr(b, "reshard_grace_s", 1.0))
+        # Boot grace: a replacement node in a rolling restart takes
+        # forwarded traffic BEFORE its own membership push arrives (the
+        # survivors flip their rings first). Arming the grace window at
+        # construction makes those early gained keys wait briefly for the
+        # inbound transfer instead of serving fresh. On a genuinely cold
+        # cluster nothing streams in and the same window lapses into
+        # today's fresh behavior, one bounded wait per batch.
+        self.active = self.enabled
+
+        self._lock = threading.RLock()
+        self._cond = threading.Condition(self._lock)
+        self._tls = threading.local()
+        self._generation = 0
+        self._planning = False
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+        # exporter state
+        self._exports: List[_Export] = []
+        self._export_by_key: Dict[str, _Export] = {}
+        # importer state
+        self._imports_by_xfer: Dict[int, _Import] = {}
+        self._imports_by_src: Dict[str, _Import] = {}
+        self._dead_srcs: set = set()
+        self._prev_picker = None
+        self._grace_until = \
+            time.monotonic() + self.grace_s if self.enabled else 0.0
+
+        # the apply gate: owner applies enter/exit; the exporter's settle
+        # fences it (writer-preferring) so a cut is never concurrent with
+        # an apply that already passed the intercept
+        self._gate = threading.Condition(threading.Lock())
+        self._appliers = 0
+        self._fenced = False
+
+        # counters surfaced by debug()/metrics (under self._lock)
+        self.stats = {"plans": 0, "export_commits": 0, "export_aborts": 0,
+                      "import_commits": 0, "import_aborts": 0,
+                      "proxied": 0, "redirected": 0, "fresh_serves": 0,
+                      "cut_wait_timeouts": 0, "rows_out": 0, "rows_in": 0,
+                      "bytes_out": 0, "bytes_in": 0}
+        self._done: List[dict] = []  # last few finished session summaries
+
+    # ------------------------------------------------------------ plumbing
+
+    @property
+    def _metrics(self):
+        return self.instance.conf.metrics
+
+    def _count(self, family: str, n: int = 1, **labels) -> None:
+        m = self._metrics
+        if m is None:
+            return
+        try:
+            fam = getattr(m, family, None)
+            if fam is None:
+                return
+            (fam.labels(**labels) if labels else fam).inc(n)
+        except Exception:  # noqa: BLE001 — metrics must not break serving
+            pass
+
+    def _emit(self, kind: str, **fields) -> None:
+        try:
+            self.instance.recorder.emit(kind, **fields)
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _self_addr(self) -> str:
+        return self.instance.advertise_address
+
+    def _recompute_active(self) -> None:
+        # called under self._lock
+        self.active = self.enabled and not self._closed and (
+            self._planning
+            or any(e.state in ("begin", "streaming") or
+                   (e.state in ("committed", "aborted") and
+                    time.monotonic() < e.linger_until)
+                   for e in self._exports)
+            or any(s.state == "streaming"
+                   for s in self._imports_by_src.values())
+            or time.monotonic() < self._grace_until)
+
+    # ------------------------------------------------------ the apply gate
+
+    def apply_enter(self) -> None:
+        with self._gate:
+            while self._fenced:
+                self._gate.wait(timeout=1.0)
+            self._appliers += 1
+
+    def apply_exit(self) -> None:
+        with self._gate:
+            self._appliers -= 1
+            if self._appliers == 0:
+                self._gate.notify_all()
+
+    def _fence(self) -> None:
+        with self._gate:
+            self._fenced = True
+            while self._appliers:
+                self._gate.wait(timeout=1.0)
+
+    def _unfence(self) -> None:
+        with self._gate:
+            self._fenced = False
+            self._gate.notify_all()
+
+    def _settle(self) -> None:
+        """Drain every owner apply that passed the intercept before the
+        cut: fence new appliers, wait out in-flight ones, then push one
+        barrier request through the combiner so queued windows retire.
+        Caller MUST pair with _unfence()."""
+        self._fence()
+        barrier = RateLimitReq(name=_INTERNAL_PREFIX, unique_key="barrier",
+                               hits=0, limit=1, duration=60_000)
+        try:
+            self.instance.combiner.submit([barrier])
+        except Exception:  # noqa: BLE001 — a dying combiner aborts later
+            pass
+
+    # ------------------------------------------------------ peers changed
+
+    def on_peers_changed(self, old_local, new_local) -> None:
+        """set_peers hook (called under the instance peer lock): capture
+        the ring diff synchronously — the planning flag and importer grace
+        must be visible before the first post-flip request routes — then
+        plan + stream on a background thread."""
+        if not self.enabled or self._closed:
+            return
+        with self._lock:
+            self._generation += 1
+            gen = self._generation
+            self._planning = True
+            self._prev_picker = old_local
+            self._grace_until = time.monotonic() + self.grace_s
+            self._dead_srcs.clear()
+            # a superseding membership change aborts in-flight exports;
+            # the new plan re-covers whatever still needs to move
+            for e in self._exports:
+                if e.state in ("begin", "streaming"):
+                    self._finish_export(e, "aborted", "superseded")
+            self._recompute_active()
+        t = threading.Thread(
+            target=self._plan_and_stream, args=(gen, old_local, new_local),
+            name="guber-reshard", daemon=True)
+        with self._lock:
+            self._thread = t
+        t.start()
+
+    def _resident_keys(self) -> List[str]:
+        keys: List[str] = []
+        for blob, off, _rows in self.instance.backend.snapshot_slabs():
+            off = np.asarray(off, np.int64)
+            for i in range(len(off) - 1):
+                try:
+                    keys.append(blob[off[i]:off[i + 1]].decode("utf-8"))
+                except UnicodeDecodeError:
+                    continue
+        return keys
+
+    def _plan_and_stream(self, gen: int, old_local, new_local) -> None:
+        try:
+            keys = self._resident_keys()
+            moves = plan_move_set(keys, old_local, new_local,
+                                  self._self_addr())
+            sessions = []
+            with self._lock:
+                if gen != self._generation or self._closed:
+                    return
+                for dest in sorted(moves):
+                    xfer = int.from_bytes(os.urandom(8), "big") or 1
+                    sess = _Export(xfer, dest, moves[dest], self.ttl_s)
+                    sessions.append(sess)
+                    self._exports.append(sess)
+                    for k in sess.planned:
+                        self._export_by_key[k] = sess
+                self.stats["plans"] += 1
+                self._planning = False
+                self._recompute_active()
+            self._emit("reshard.plan", generation=gen,
+                       resident=len(keys), dests=len(moves),
+                       moving=sum(len(v) for v in moves.values()))
+            for sess in sessions:
+                if gen != self._generation or self._closed:
+                    self._abort_export(sess, "superseded")
+                    continue
+                self._run_export(sess, gen)
+        except Exception:  # noqa: BLE001 — planner death = amnesty, not a wedge
+            log.exception("reshard plan/stream failed")
+            with self._lock:
+                self._planning = False
+                for e in self._exports:
+                    if e.state in ("begin", "streaming"):
+                        self._finish_export(e, "aborted", "internal_error")
+                self._recompute_active()
+        finally:
+            with self._lock:
+                self._recompute_active()
+
+    # --------------------------------------------------------- export side
+
+    def _rpc(self, addr: str, payload: bytes, timeout_s: float) -> dict:
+        """One reshard-plane RPC. Prefers the live PeerClient hook (ring
+        members); falls back to a direct dial for departed peers. A reply
+        that is not a reshard envelope means the peer pre-dates (or has
+        disabled) the reshard plane — surfaced as ReshardError so callers
+        degrade to amnesty."""
+        peer = None
+        inst = self.instance
+        with inst._peer_lock:  # noqa: SLF001
+            for p in inst.local_picker.peers():
+                if p.info.address == addr:
+                    peer = p
+                    break
+        if peer is not None:
+            body = peer.reshard_call(payload, timeout_s=timeout_s)
+        else:
+            from gubernator_tpu.service.grpc_api import dial_v1
+            body = dial_v1(addr).Debug(payload, timeout=timeout_s)
+        decoded = decode_msg(body)
+        if decoded is None or decoded[0] != "ctl":
+            raise ReshardError(f"peer {addr} has no reshard plane")
+        msg = decoded[1]
+        if msg.get("error"):
+            raise ReshardError(f"peer {addr}: {msg['error']}")
+        return msg
+
+    def _send_session(self, sess: _Export, payload: bytes) -> dict:
+        """Session RPC with the handoff fault point and one retry — safe
+        because begin/commit are idempotent and row frames are
+        seq-deduplicated by the importer."""
+        last: Optional[Exception] = None
+        for _ in range(2):
+            try:
+                faults.on_call(sess.dest, "reshard")
+                return self._rpc(sess.dest, payload, timeout_s=sess.ttl_s)
+            except Exception as e:  # noqa: BLE001
+                last = e
+        raise last  # type: ignore[misc]
+
+    def _chunks(self, keys: List[str]):
+        """Split the planned key list by rows AND bytes (frames must stay
+        under the RPC message cap even with long keys)."""
+        chunk: List[str] = []
+        size = 0
+        for k in keys:
+            chunk.append(k)
+            size += len(k) + 64
+            if len(chunk) >= self.chunk_rows or size >= self.MAX_FRAME_BYTES:
+                yield chunk
+                chunk, size = [], 0
+        if chunk:
+            yield chunk
+
+    def _run_export(self, sess: _Export, gen: int) -> None:
+        inst = self.instance
+        self._count("reshard_transfers", role="export")
+        self._emit("reshard.begin", xfer=f"{sess.xfer:016x}",
+                   dest=sess.dest, planned=len(sess.planned))
+        try:
+            ack = self._send_session(sess, encode_ctl({
+                "op": "begin", "xfer": sess.xfer, "src": self._self_addr(),
+                "ttl_ms": int(self.ttl_s * 1000),
+                "planned": len(sess.planned)}))
+        except Exception as e:  # noqa: BLE001
+            self._abort_export(sess, f"begin_failed:{type(e).__name__}")
+            return
+        # the importer's grant may clamp our TTL (PR 6 lease semantics:
+        # the grantor owns the budget)
+        sess.ttl_s = max(0.05, min(self.ttl_s,
+                                   ack.get("ttl_ms", 1e9) / 1000.0))
+        self._emit("reshard.leased", xfer=f"{sess.xfer:016x}",
+                   dest=sess.dest, ttl_ms=int(sess.ttl_s * 1000))
+        with self._lock:
+            sess.state = "streaming"
+        chunks = list(self._chunks(sess.planned))
+        if len(chunks) > 0xFFFF:
+            self._abort_export(sess, "too_many_frames")
+            return
+        for seq, chunk in enumerate(chunks):
+            if gen != self._generation or self._closed:
+                self._abort_export(sess, "superseded")
+                return
+            # 1. authority fence: from here, arrivals for these keys are
+            #    redirected to the importer, never applied locally
+            sess.cut.update(chunk)
+            # 2. settle: every apply that pre-dates the cut is in the rows
+            self._settle()
+            try:
+                found, rows = inst.backend.rows_for_keys(chunk)
+            finally:
+                self._unfence()
+            vacant = sorted(set(chunk) - set(found))
+            frame = encode_rows_msg(sess.xfer, seq,
+                                    seq == len(chunks) - 1,
+                                    found, rows, vacant)
+            try:
+                self._send_session(sess, frame)
+            except Exception as e:  # noqa: BLE001
+                self._abort_export(sess, f"frame_failed:{type(e).__name__}")
+                return
+            with self._lock:
+                sess.streamed.update(chunk)
+                sess.rows += len(found)
+                sess.bytes += len(frame)
+                sess.frames += 1
+                self.stats["rows_out"] += len(found)
+                self.stats["bytes_out"] += len(frame)
+            self._count("reshard_rows_moved", len(found), role="export")
+            self._count("reshard_transfer_bytes", len(frame), role="export")
+            self._count("reshard_frames", role="export")
+            if seq % 32 == 0 or seq == len(chunks) - 1:
+                self._emit("reshard.stream", xfer=f"{sess.xfer:016x}",
+                           dest=sess.dest, seq=seq, rows=sess.rows,
+                           bytes=sess.bytes)
+        try:
+            self._send_session(sess, encode_ctl(
+                {"op": "commit", "xfer": sess.xfer}))
+        except Exception as e:  # noqa: BLE001
+            # the full stream is across: even if the commit raced, every
+            # key redirects to the importer during linger, so an abort
+            # here converges to the same ownership as a commit
+            self._abort_export(sess, f"commit_failed:{type(e).__name__}")
+            return
+        with self._lock:
+            self._finish_export(sess, "committed", "")
+
+    def _abort_export(self, sess: _Export, reason: str) -> None:
+        try:
+            self._rpc(sess.dest, encode_ctl(
+                {"op": "abort", "xfer": sess.xfer, "reason": reason}),
+                timeout_s=1.0)
+        except Exception:  # noqa: BLE001 — best effort
+            pass
+        with self._lock:
+            self._finish_export(sess, "aborted", reason)
+
+    def _finish_export(self, sess: _Export, state: str,
+                       reason: str) -> None:
+        # under self._lock
+        if sess.state in ("committed", "aborted"):
+            return
+        sess.state = state
+        sess.reason = reason
+        sess.t_done = time.monotonic()
+        # linger: keep redirecting stale arrivals for streamed keys to
+        # the new owner for one TTL, then fall back to ring routing
+        sess.linger_until = sess.t_done + sess.ttl_s
+        window = sess.t_done - sess.t_begin
+        if state == "committed":
+            self.stats["export_commits"] += 1
+            self._count("reshard_committed", role="export")
+            self._emit("reshard.committed", xfer=f"{sess.xfer:016x}",
+                       role="export", dest=sess.dest, rows=sess.rows,
+                       bytes=sess.bytes, window_ms=int(window * 1000))
+        else:
+            self.stats["export_aborts"] += 1
+            self._count("reshard_aborted", role="export",
+                        reason=reason.split(":", 1)[0] or "unknown")
+            self._emit("reshard.aborted", xfer=f"{sess.xfer:016x}",
+                       role="export", dest=sess.dest, reason=reason,
+                       moved=len(sess.streamed), planned=len(sess.planned))
+        m = self._metrics
+        if m is not None:
+            try:
+                m.reshard_double_write_window_s.labels(
+                    role="export").observe(window)
+            except Exception:  # noqa: BLE001
+                pass
+        self._done.append(sess.summary())
+        del self._done[:-16]
+        self._gc_exports()
+        self._recompute_active()
+        with self._cond:
+            self._cond.notify_all()
+
+    def _gc_exports(self) -> None:
+        # under self._lock: drop sessions past linger and their key map
+        now = time.monotonic()
+        dead = [e for e in self._exports
+                if e.state in ("committed", "aborted")
+                and now >= e.linger_until]
+        for e in dead:
+            self._exports.remove(e)
+            for k in e.planned:
+                if self._export_by_key.get(k) is e:
+                    del self._export_by_key[k]
+
+    # ------------------------------------------------- the intercept hook
+
+    def intercept_owner_batch(self, requests, from_peer_rpc
+                              ) -> Optional[_ApplyPlan]:
+        """Classify an owner batch under active handoffs. Returns None
+        when no request is involved (the overwhelmingly common case) —
+        the caller then applies the whole batch locally as before.
+
+        Lock discipline: runs under the manager lock ONLY — it must never
+        touch the instance peer lock (set_peers holds the peer lock while
+        calling on_peers_changed, so peer-lock-after-manager-lock would
+        deadlock). Everything routed away resolves in plan.finish(),
+        outside both the lock and the apply gate."""
+        if getattr(self._tls, "bypass", False):
+            return None
+        plan: Optional[_ApplyPlan] = None
+        self_addr = self._self_addr()
+        with self._lock:
+            prev = self._prev_picker
+            grace = time.monotonic() < self._grace_until
+            for i, req in enumerate(requests):
+                key = req.hash_key()
+                verdict = self._classify(key, prev, grace, self_addr)
+                if verdict is not None:
+                    if plan is None:
+                        plan = _ApplyPlan(self, requests, from_peer_rpc)
+                        plan.local_idx.extend(range(i))
+                    kind, addr = verdict
+                    bucket = plan.redirects if kind == "redirect" \
+                        else plan.proxies
+                    bucket.setdefault(addr, []).append(i)
+                elif plan is not None:
+                    plan.local_idx.append(i)
+            if plan is None:
+                # nothing routed: cheap chance to notice the window ended
+                # (grace/linger expiry has no timer — it heals here)
+                self._recompute_active()
+        return plan
+
+    def _classify(self, key: str, prev, grace: bool, self_addr: str):
+        """Per-key handoff verdict (under the manager lock):
+        ("redirect", dest) | ("proxy", src) | ("proxy", "") (no known
+        source yet — finish() waits for a session) | None (local)."""
+        sess = self._export_by_key.get(key)
+        if sess is not None:  # exporter side: this key is moving out
+            if sess.state in ("begin", "streaming"):
+                if key in sess.cut or key in sess.streamed:
+                    return ("redirect", sess.dest)
+            elif time.monotonic() < sess.linger_until and \
+                    key in sess.streamed:
+                return ("redirect", sess.dest)
+            return None
+        # importer side: a key another node may have owned pre-change.
+        # Resolved by any session (streamed in, or declared vacant) →
+        # serve from the transferred row.
+        streaming = None
+        for s in self._imports_by_src.values():
+            if key in s.resolved:
+                return None
+            if s.state == "streaming" and not s.expired():
+                streaming = s
+        # the previous ring names the old owner when this node saw the
+        # old membership; a FRESHLY STARTED node has an empty prev ring
+        # and falls back to the live sessions' exporters
+        src = None
+        if prev is not None:
+            try:
+                owner = prev.get(key)
+                src = owner.info.address
+                if owner.info.is_owner or src == self_addr:
+                    return None  # we owned it before too: no handoff
+            except Exception:  # noqa: BLE001 — empty prev ring
+                src = None
+        if src is not None:
+            if src in self._dead_srcs:
+                return None
+            imp = self._imports_by_src.get(src)
+            if imp is not None:
+                if imp.state == "streaming":
+                    if imp.expired():
+                        self._finish_import(imp, "aborted", "ttl_expired")
+                        return None
+                    return ("proxy", src)
+                return None  # committed/aborted and not resolved: local
+            if grace:
+                # no session yet — the old owner may still be planning
+                return ("proxy", src)
+            return None
+        if streaming is not None:
+            # fresh node: no prev ring, but a live transfer is inbound —
+            # its exporter is the only candidate authority (it answers
+            # NOT_MINE for keys outside its plan, which then serve local)
+            return ("proxy", streaming.src)
+        if grace and not self._imports_by_src:
+            # fresh node inside the grace window with no session yet:
+            # finish() waits briefly for the first begin to arrive
+            return ("proxy", "")
+        return None
+
+    def _apply_local(self, reqs, now_ms, from_peer_rpc
+                     ) -> List[RateLimitResp]:
+        """Bypass apply: serve locally without re-entering the intercept
+        (the loop breaker for every degraded/resolved path)."""
+        self._tls.bypass = True
+        try:
+            return self.instance.apply_owner_batch(
+                reqs, now_ms=now_ms, from_peer_rpc=from_peer_rpc)
+        finally:
+            self._tls.bypass = False
+
+    def _fresh(self, reqs, now_ms, from_peer_rpc, reason: str
+               ) -> List[RateLimitResp]:
+        """Amnesty fallback: the protocol is dead for these keys, so serve
+        them fresh — exactly the pre-reshard membership-change behavior —
+        and make every such serve observable."""
+        with self._lock:
+            self.stats["fresh_serves"] += len(reqs)
+        self._count("reshard_fresh_serves", len(reqs), reason=reason)
+        self._emit("reshard.fresh", reason=reason, n=len(reqs))
+        return self._apply_local(reqs, now_ms, from_peer_rpc)
+
+    def _redirect_to_dest(self, dest: str, reqs, now_ms, from_peer_rpc
+                          ) -> List[RateLimitResp]:
+        """Exporter side: a stale sender delivered hits for keys already
+        handed over — forward them to the new owner (origin-marked so
+        the importer never bounces them back)."""
+        with self._lock:
+            self.stats["redirected"] += len(reqs)
+        self._count("reshard_proxied", len(reqs), role="export")
+        try:
+            msg = self._rpc(dest, encode_ctl({
+                "op": "apply", "origin": "exporter",
+                "src": self._self_addr(),
+                "reqs": [_req_to_dict(r) for r in reqs]}),
+                timeout_s=max(1.0, self.ttl_s))
+            return [_resp_from_dict(d) for d in msg["resps"]]
+        except Exception:  # noqa: BLE001
+            return self._fresh(reqs, now_ms, from_peer_rpc,
+                               "redirect_failed")
+
+    def _wait_for_session(self) -> str:
+        """Fresh-node pre-begin window: no previous ring and no session
+        yet — wait briefly for the first exporter's begin, and return its
+        address ("" if none arrives inside the grace window)."""
+        with self._cond:
+            while True:
+                for s in self._imports_by_src.values():
+                    if s.state == "streaming" and not s.expired():
+                        return s.src
+                left = self._grace_until - time.monotonic()
+                if left <= 0:
+                    return ""
+                self._cond.wait(timeout=min(left, 0.05))
+
+    def _proxy_to_src(self, src: str, reqs, now_ms, from_peer_rpc
+                      ) -> List[RateLimitResp]:
+        """Importer side: gained keys whose rows have not arrived are
+        decided by the previous owner until their chunk lands — the
+        double-write window that makes the handoff hit-continuous."""
+        if not src:
+            src = self._wait_for_session()
+            if not src:
+                return self._fresh(reqs, now_ms, from_peer_rpc,
+                                   "no_session")
+        with self._lock:
+            self.stats["proxied"] += len(reqs)
+        self._count("reshard_proxied", len(reqs), role="import")
+        pending = list(range(len(reqs)))
+        responses: List[Optional[RateLimitResp]] = [None] * len(reqs)
+        deadline = time.monotonic() + min(self.grace_s + self.ttl_s, 5.0)
+        tried = {src}
+        while pending:
+            try:
+                msg = self._rpc(src, encode_ctl({
+                    "op": "apply", "origin": "importer",
+                    "src": self._self_addr(),
+                    "reqs": [_req_to_dict(reqs[i]) for i in pending]}),
+                    timeout_s=max(1.0, self.ttl_s))
+                items = msg["resps"]
+            except Exception:  # noqa: BLE001
+                with self._lock:
+                    self._dead_srcs.add(src)
+                    self._recompute_active()
+                out = self._fresh([reqs[i] for i in pending], now_ms,
+                                  from_peer_rpc, "source_dead")
+                for i, resp in zip(pending, out):
+                    responses[i] = resp
+                return responses  # type: ignore[return-value]
+            retry: List[int] = []
+            waiters: List[int] = []
+            unclaimed: List[int] = []
+            for i, item in zip(pending, items):
+                ctl = item.get("ctl") if isinstance(item, dict) else None
+                if ctl is None:
+                    responses[i] = _resp_from_dict(item)
+                elif ctl == CTL_PLANNING:
+                    retry.append(i)
+                elif ctl in (CTL_CUT, CTL_STREAMED):
+                    waiters.append(i)
+                else:  # NOT_MINE: this source's plan does not cover the key
+                    unclaimed.append(i)
+            if waiters:
+                out = [self._wait_then_apply(reqs[i], now_ms, from_peer_rpc)
+                       for i in waiters]
+                for i, resp in zip(waiters, out):
+                    responses[i] = resp
+            if unclaimed:
+                # several exporters can stream to a (re)joining node at
+                # once; a key NOT_MINE at one may be another's to hand
+                # over — only once every live source disowns it is a
+                # fresh local serve actually continuous
+                nxt = None
+                with self._lock:
+                    for s2 in self._imports_by_src.values():
+                        if s2.state == "streaming" and not s2.expired() \
+                                and s2.src not in tried:
+                            nxt = s2.src
+                            break
+                if nxt is not None and time.monotonic() < deadline:
+                    tried.add(nxt)
+                    src = nxt
+                    pending = retry + unclaimed
+                    continue
+                out = self._apply_local([reqs[i] for i in unclaimed],
+                                        now_ms, from_peer_rpc)
+                for i, resp in zip(unclaimed, out):
+                    responses[i] = resp
+            pending = retry
+            if pending:
+                if time.monotonic() > deadline:
+                    out = self._fresh([reqs[i] for i in pending], now_ms,
+                                      from_peer_rpc, "planning_timeout")
+                    for i, resp in zip(pending, out):
+                        responses[i] = resp
+                    break
+                time.sleep(self.PLANNING_RETRY_S)
+        return responses  # type: ignore[return-value]
+
+    def _wait_then_apply(self, req: RateLimitReq, now_ms, from_peer_rpc
+                         ) -> RateLimitResp:
+        """The key's chunk is in flight: wait for the injection (normally
+        one frame RTT), then serve locally from the transferred row."""
+        key = req.hash_key()
+        deadline = time.monotonic() + self.CUT_WAIT_CAP_S
+        with self._cond:
+            while time.monotonic() < deadline:
+                imp = None
+                for s in self._imports_by_src.values():
+                    if key in s.resolved:
+                        imp = s
+                        break
+                if imp is not None or not any(
+                        s.state == "streaming"
+                        for s in self._imports_by_src.values()):
+                    break
+                self._cond.wait(timeout=0.02)
+            else:
+                self.stats["cut_wait_timeouts"] += 1
+                self._count("reshard_cut_wait_timeouts")
+        return self._apply_local([req], now_ms, from_peer_rpc)[0]
+
+    # --------------------------------------------------------- import side
+
+    def handle_message(self, body: bytes) -> Optional[bytes]:
+        """Debug-RPC dispatch: None when the body is not a reshard
+        envelope (the servicer then answers its node report as before)."""
+        try:
+            decoded = decode_msg(body)
+        except Exception as e:  # noqa: BLE001
+            return encode_ctl({"error": f"bad reshard message: {e}"})
+        if decoded is None:
+            return None
+        if not self.enabled or self._closed:
+            return encode_ctl({"error": "reshard disabled"})
+        try:
+            kind, msg = decoded
+            if kind == "rows":
+                return self._handle_rows(*msg)
+            op = msg.get("op")
+            if op == "begin":
+                return self._handle_begin(msg)
+            if op == "commit":
+                return self._handle_commit(msg)
+            if op == "abort":
+                return self._handle_abort(msg)
+            if op == "apply":
+                return self._handle_apply(msg)
+            if op == "evacuate":
+                threading.Thread(target=self.evacuate,
+                                 name="guber-evacuate", daemon=True).start()
+                return encode_ctl({"ok": True})
+            return encode_ctl({"error": f"unknown reshard op {op!r}"})
+        except Exception as e:  # noqa: BLE001
+            log.exception("reshard message failed")
+            return encode_ctl({"error": f"{type(e).__name__}: {e}"})
+
+    def _handle_begin(self, msg: dict) -> bytes:
+        src = msg["src"]
+        xfer = int(msg["xfer"])
+        ttl_s = max(0.05, min(self.ttl_s, msg.get("ttl_ms", 5000) / 1000.0))
+        with self._lock:
+            cur = self._imports_by_src.get(src)
+            if cur is not None and cur.xfer == xfer and \
+                    cur.state == "streaming":
+                pass  # idempotent re-begin (retried RPC)
+            else:
+                if cur is not None and cur.state == "streaming":
+                    self._finish_import(cur, "aborted", "superseded")
+                sess = _Import(xfer, src, int(msg.get("planned", 0)), ttl_s)
+                self._imports_by_xfer[xfer] = sess
+                self._imports_by_src[src] = sess
+                self._dead_srcs.discard(src)
+                self._recompute_active()
+                self._count("reshard_transfers", role="import")
+                self._emit("reshard.begin", xfer=f"{xfer:016x}", src=src,
+                           planned=sess.planned, role="import")
+                self._emit("reshard.leased", xfer=f"{xfer:016x}", src=src,
+                           ttl_ms=int(ttl_s * 1000), role="import")
+            self._cond.notify_all()  # wake pre-begin session waiters
+        return encode_ctl({"ok": True, "ttl_ms": int(ttl_s * 1000)})
+
+    def _session_for(self, xfer: int) -> Optional[_Import]:
+        sess = self._imports_by_xfer.get(xfer)
+        if sess is not None and sess.expired():
+            with self._lock:
+                self._finish_import(sess, "aborted", "ttl_expired")
+            return None
+        return sess
+
+    def _handle_rows(self, xfer, seq, final, keys, slab, vacant) -> bytes:
+        sess = self._session_for(int(xfer))
+        if sess is None or sess.state != "streaming":
+            return encode_ctl({"error": f"unknown transfer {xfer:x}"})
+        with self._lock:
+            if seq < sess.next_seq:  # duplicate of an acked frame: re-ack
+                return encode_ctl({"ok": True, "ack": seq,
+                                   "ttl_ms": int(sess.ttl_s * 1000)})
+            if seq > sess.next_seq:
+                self._finish_import(sess, "aborted", "sequence_gap")
+                return encode_ctl(
+                    {"error": f"sequence gap: want {sess.next_seq}, "
+                              f"got {seq}"})
+        blob, off, rows = slab
+        if len(keys):
+            self.instance.backend.load_snapshot_slabs([(blob, off, rows)])
+        with self._lock:
+            if sess.state != "streaming":
+                return encode_ctl({"error": "transfer no longer live"})
+            sess.resolved.update(keys)
+            sess.resolved.update(vacant)
+            # a key streaming IN retires any outbound bookkeeping for it:
+            # ownership has come back (scale-up then scale-down), and the
+            # old export's lingering redirect would point at a peer that
+            # has since handed the key away (or died)
+            for k in itertools.chain(keys, vacant):
+                e = self._export_by_key.pop(k, None)
+                if e is not None:
+                    e.cut.discard(k)
+                    e.streamed.discard(k)
+            sess.next_seq = seq + 1
+            sess.deadline = time.monotonic() + sess.ttl_s  # lease renewal
+            sess.rows += len(keys)
+            sess.bytes += len(blob) + len(rows) * 56
+            self.stats["rows_in"] += len(keys)
+            self.stats["bytes_in"] += len(blob) + len(rows) * 56
+            self._cond.notify_all()
+        self._count("reshard_rows_moved", len(keys), role="import")
+        self._count("reshard_transfer_bytes",
+                    len(blob) + len(rows) * 56, role="import")
+        self._count("reshard_frames", role="import")
+        return encode_ctl({"ok": True, "ack": seq,
+                           "ttl_ms": int(sess.ttl_s * 1000)})
+
+    def _handle_commit(self, msg: dict) -> bytes:
+        sess = self._imports_by_xfer.get(int(msg["xfer"]))
+        if sess is None:
+            return encode_ctl({"error": "unknown transfer"})
+        with self._lock:
+            self._finish_import(sess, "committed", "")
+        return encode_ctl({"ok": True})
+
+    def _handle_abort(self, msg: dict) -> bytes:
+        sess = self._imports_by_xfer.get(int(msg["xfer"]))
+        if sess is not None:
+            with self._lock:
+                self._finish_import(sess, "aborted",
+                                    msg.get("reason", "peer_abort"))
+        return encode_ctl({"ok": True})
+
+    def _finish_import(self, sess: _Import, state: str,
+                       reason: str) -> None:
+        # under self._lock
+        if sess.state in ("committed", "aborted"):
+            return
+        sess.state = state
+        sess.reason = reason
+        sess.t_done = time.monotonic()
+        window = sess.t_done - sess.t_begin
+        if state == "committed":
+            self.stats["import_commits"] += 1
+            self._count("reshard_committed", role="import")
+            self._emit("reshard.committed", xfer=f"{sess.xfer:016x}",
+                       role="import", src=sess.src, rows=sess.rows,
+                       window_ms=int(window * 1000))
+        else:
+            self.stats["import_aborts"] += 1
+            self._count("reshard_aborted", role="import",
+                        reason=reason.split(":", 1)[0] or "unknown")
+            self._emit("reshard.aborted", xfer=f"{sess.xfer:016x}",
+                       role="import", src=sess.src, reason=reason,
+                       resolved=len(sess.resolved), planned=sess.planned)
+        m = self._metrics
+        if m is not None:
+            try:
+                m.reshard_double_write_window_s.labels(
+                    role="import").observe(window)
+            except Exception:  # noqa: BLE001
+                pass
+        self._done.append(sess.summary())
+        del self._done[:-16]
+        self._recompute_active()
+        with self._cond:
+            self._cond.notify_all()
+
+    def _handle_apply(self, msg: dict) -> bytes:
+        reqs = [_req_from_dict(d) for d in msg["reqs"]]
+        if msg.get("origin") == "importer":
+            items = self._answer_importer(msg["src"], reqs)
+        else:
+            items = self._answer_exporter(reqs)
+        return encode_ctl({"ok": True, "resps": items})
+
+    def _answer_importer(self, src: str, reqs) -> List[dict]:
+        """We are the PREVIOUS owner: decide authoritatively for keys not
+        yet cut; answer control verdicts for keys already handed over."""
+        items: List[Optional[dict]] = [None] * len(reqs)
+        local: List[int] = []
+        with self._lock:
+            planning = self._planning
+            for i, req in enumerate(reqs):
+                key = req.hash_key()
+                sess = self._export_by_key.get(key)
+                if sess is None:
+                    items[i] = {"ctl": CTL_PLANNING if planning
+                                else CTL_NOT_MINE}
+                elif sess.dest != src:
+                    items[i] = {"ctl": CTL_NOT_MINE}
+                elif key in sess.streamed:
+                    items[i] = {"ctl": CTL_STREAMED}
+                elif key in sess.cut:
+                    items[i] = {"ctl": CTL_CUT}
+                elif sess.state in ("begin", "streaming"):
+                    local.append(i)  # still ours: decide here
+                else:
+                    items[i] = {"ctl": CTL_NOT_MINE}
+        if local:
+            # NORMAL path (not bypass): if a key is cut between the
+            # verdict above and this apply, the intercept redirects it
+            # forward — it converges at the importer either way
+            out = self.instance.apply_owner_batch(
+                [reqs[i] for i in local], from_peer_rpc=True)
+            for i, resp in zip(local, out):
+                items[i] = _resp_to_dict(resp)
+        return items  # type: ignore[return-value]
+
+    def _answer_exporter(self, reqs) -> List[dict]:
+        """We are the NEW owner: the previous owner redirected stale
+        arrivals here. Wait briefly for in-flight chunks, then serve from
+        the transferred rows (fresh only if the transfer died)."""
+        out = [self._wait_then_apply(r, None, True) for r in reqs]
+        return [_resp_to_dict(r) for r in out]
+
+    # ------------------------------------------------------ operator plane
+
+    def evacuate(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain: hand every resident key to its owner under a
+        ring WITHOUT this node, then wait for the exports to finish —
+        the rolling-restart/scale-down runbook step
+        (docs/OPERATIONS.md "Deploys & resharding")."""
+        inst = self.instance
+        with inst._peer_lock:  # noqa: SLF001
+            infos = [p.info for p in inst.local_picker.peers()
+                     if p.info.address != self._self_addr()]
+        if not infos:
+            return True
+        inst.set_peers(infos)
+        return self.drain(timeout_s)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until no export is planning/streaming (True) or the
+        timeout passes (False)."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while time.monotonic() < deadline:
+                busy = self._planning or any(
+                    e.state in ("begin", "streaming") for e in self._exports)
+                if not busy:
+                    return True
+                self._cond.wait(timeout=0.05)
+        return False
+
+    def stop(self) -> None:
+        """Instance.close hook: abort live sessions and detach."""
+        with self._lock:
+            self._closed = True
+            self._generation += 1
+            for e in self._exports:
+                if e.state in ("begin", "streaming"):
+                    self._finish_export(e, "aborted", "shutdown")
+            for s in list(self._imports_by_src.values()):
+                if s.state == "streaming":
+                    self._finish_import(s, "aborted", "shutdown")
+            self.active = False
+        self._unfence()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=2.0)
+
+    # -------------------------------------------------------- observability
+
+    def poll_active(self) -> bool:
+        """Recompute-and-read `active` — for observers (debug vars, the
+        metrics gauge, drill harnesses). The apply path reads the plain
+        bool instead; a stale True there self-heals at the next
+        intercept, a stale False cannot happen (events recompute)."""
+        with self._lock:
+            for s in list(self._imports_by_src.values()):
+                if s.state == "streaming" and s.expired():
+                    self._finish_import(s, "aborted", "ttl_expired")
+            self._recompute_active()
+            self._gc_exports()
+            return self.active
+
+    def debug(self) -> dict:
+        """The /v1/debug/vars "reshard" section (schema v3)."""
+        self.poll_active()
+        with self._lock:
+            sessions = [e.summary() for e in self._exports] + \
+                [s.summary() for s in self._imports_by_src.values()
+                 if s.state == "streaming"]
+            return {
+                "enabled": self.enabled,
+                "active": self.active,
+                "ttl_s": self.ttl_s,
+                "chunk_rows": self.chunk_rows,
+                "grace_s": self.grace_s,
+                "planning": self._planning,
+                "stats": dict(self.stats),
+                "sessions": sessions,
+                "recent": list(self._done),
+            }

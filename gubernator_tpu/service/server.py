@@ -156,6 +156,17 @@ class V1Servicer:
         # federated debug plane (obs/bundle.py): one node's health + vars
         # + circuits + flight-recorder tail + traces as raw JSON bytes.
         # Unguarded like HealthCheck — diagnostics must survive overload.
+        # A non-empty request body is a reshard-plane message (the bytes
+        # channel reuses this RPC so v1-only link peers take handoffs over
+        # gRPC); anything else — including all pre-reshard callers, which
+        # send an empty body — still gets the node report, and a reshard
+        # sender talking to a pre-reshard node detects the JSON reply.
+        if request:
+            rm = getattr(self.instance, "reshard", None)
+            if rm is not None:
+                answer = rm.handle_message(bytes(request))
+                if answer is not None:
+                    return answer
         from gubernator_tpu.obs.bundle import node_report
 
         return json.dumps(node_report(self.instance)).encode()

@@ -336,6 +336,50 @@ class BinarySnapshotLoader(Loader):
         self.save_slabs(_snapshots_to_slabs(items))
 
 
+def pack_rows_chunk(keys_b: List[bytes], rows) -> bytes:
+    """In-memory sibling of the GTSLAB chunk framing, for the reshard
+    transfer wire (service/reshard.py): [u32 m][u32 key_len * m]
+    [key blob][i64 rows m*7]. No magic/terminator — the enclosing frame
+    carries identity and completeness."""
+    import numpy as np
+
+    m = len(keys_b)
+    lens = np.asarray([len(b) for b in keys_b], np.uint32)
+    rows = np.ascontiguousarray(np.asarray(rows, np.int64))
+    rows = rows.reshape(m, _SLAB_FIELDS) if m else \
+        np.zeros((0, _SLAB_FIELDS), np.int64)
+    return (struct.pack("<I", m) + lens.tobytes() + b"".join(keys_b)
+            + rows.tobytes())
+
+
+def unpack_rows_chunk(buf: bytes):
+    """Inverse of pack_rows_chunk -> (key_blob, offsets i64[m+1],
+    rows i64[m, 7]) — a slab triple ready for Engine.load_snapshot_slabs.
+    Raises ValueError on truncation or implausible counts (a corrupt
+    transfer frame must abort the handoff, never inject garbage rows)."""
+    import numpy as np
+
+    if len(buf) < 4:
+        raise ValueError("rows chunk truncated before count")
+    (m,) = struct.unpack_from("<I", buf, 0)
+    if m > _SLAB_MAX_ROWS:
+        raise ValueError(f"implausible rows chunk ({m} rows)")
+    lens_end = 4 + 4 * m
+    if len(buf) < lens_end:
+        raise ValueError("rows chunk truncated in key lengths")
+    lens = np.frombuffer(buf, np.uint32, m, 4)
+    blob_len = int(lens.sum())
+    rows_end = lens_end + blob_len + 8 * m * _SLAB_FIELDS
+    if len(buf) < rows_end:
+        raise ValueError("rows chunk truncated in keys/rows")
+    blob = bytes(buf[lens_end:lens_end + blob_len])
+    rows = np.frombuffer(buf, np.int64, m * _SLAB_FIELDS,
+                         lens_end + blob_len).reshape(m, _SLAB_FIELDS)
+    off = np.zeros(m + 1, np.int64)
+    np.cumsum(lens, out=off[1:])
+    return blob, off, rows
+
+
 def _snapshots_to_slabs(items: Iterable[BucketSnapshot],
                         chunk_rows: int = 8192):
     """BucketSnapshot stream -> (key_blob, offsets, rows) slab chunks —

@@ -16,7 +16,7 @@ import urllib.request
 import pytest
 
 from gubernator_tpu.cluster.harness import LocalCluster
-from gubernator_tpu.obs import trace
+from gubernator_tpu.obs import introspect, trace
 from gubernator_tpu.obs.anomaly import DETECTORS, AnomalyEngine
 from gubernator_tpu.obs.bundle import (
     REDACTED,
@@ -262,7 +262,8 @@ class TestBundles:
             b = build_bundle(inst, reason="unit", metrics=Metrics())
             assert b["kind"] == "gubernator-debug-bundle"
             assert b["schema_version"] == 1
-            assert b["vars"]["schema_version"] == 2
+            assert (b["vars"]["schema_version"]
+                    == introspect.DEBUG_VARS_SCHEMA_VERSION)
             assert any(e["kind"] == "circuit.open"
                        for e in b["flight_recorder"])
             assert "# HELP" in b["metrics_text"]

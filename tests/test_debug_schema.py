@@ -12,6 +12,9 @@ v2 additionally promises the "history" and "keyspace" sections on every
 Instance (the cartography plane is always constructed, even when its
 tickers are disabled), and pins the /v1/debug/history and
 /v1/debug/keyspace endpoint bodies.
+
+v3 promises the "reshard" section on every Instance (the handoff plane
+is always constructed; its "enabled" flag tracks GUBER_RESHARD).
 """
 
 import pytest
@@ -27,7 +30,7 @@ from gubernator_tpu.types import PeerInfo
 # every section name the snapshot may carry, by wiring condition
 ALWAYS = {"schema_version", "advertise_address", "engine", "combiner",
           "kernel", "peers", "global", "flight_recorder", "anomaly",
-          "history", "keyspace"}
+          "history", "keyspace", "reshard"}
 OPTIONAL = {"wire", "trace", "leases", "collective_global", "multiregion",
             "bundles", "deadline_expired"}
 SECTIONS = ALWAYS | OPTIONAL
@@ -44,7 +47,7 @@ def instance():
 
 def test_schema_version_pinned(instance):
     dv = debug_vars(instance)
-    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 2
+    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 3
 
 
 def test_always_sections_present(instance):
@@ -71,6 +74,16 @@ def test_flight_recorder_and_anomaly_shapes(instance):
             "counts"} <= set(dv["flight_recorder"])
     assert {"interval_s", "checks", "active", "trips", "slo", "burn_fast",
             "burn_slow"} <= set(dv["anomaly"])
+
+
+def test_reshard_var_shape(instance):
+    dv = debug_vars(instance)
+    rs = dv["reshard"]
+    assert {"enabled", "active", "ttl_s", "chunk_rows", "grace_s",
+            "planning", "stats", "sessions", "recent"} <= set(rs)
+    assert rs["enabled"] is False  # GUBER_RESHARD unset in tier-1
+    assert rs["active"] is False
+    assert rs["sessions"] == []
 
 
 def test_history_and_keyspace_var_shapes(instance):

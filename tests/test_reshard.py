@@ -1,0 +1,439 @@
+"""Live resharding (service/reshard.py): move-set planning, the transfer
+codec, the off-switch differential, and the two-node handoff protocol —
+commit bit-identity, retry safety, and TTL fail-close under injected
+transport faults.
+
+The multi-node continuity drills (sustained load across scale-up,
+evacuate, kill, rolling restart) live in tests/test_reshard_drills.py.
+"""
+
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.cluster.harness import LocalCluster
+from gubernator_tpu.cluster.harness import test_behaviors as _behaviors
+from gubernator_tpu.cluster.pickers import ConsistentHashPicker
+from gubernator_tpu.service import faults
+from gubernator_tpu.service.reshard import (
+    decode_msg,
+    encode_ctl,
+    encode_rows_msg,
+    plan_move_set,
+)
+from gubernator_tpu.store import pack_rows_chunk, unpack_rows_chunk
+from gubernator_tpu.types import PeerInfo, RateLimitReq
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.clear()
+
+
+def _rl(i, hits=1, limit=1000, duration=600_000):
+    return RateLimitReq(name=f"svc{i % 7}", unique_key=f"user-{i:04d}",
+                       hits=hits, limit=limit, duration=duration)
+
+
+def _drive(inst, n, hits=1):
+    """Apply one hit batch per 50 keys; return {hash_key: remaining}."""
+    out = {}
+    for lo in range(0, n, 50):
+        batch = [_rl(i, hits) for i in range(lo, min(lo + 50, n))]
+        for resp, req in zip(inst.get_rate_limits(batch), batch):
+            assert not resp.error, (req.unique_key, resp.error)
+            out[req.hash_key()] = resp.remaining
+    return out
+
+
+def _reshard_behaviors(**kw):
+    kw.setdefault("reshard", True)
+    kw.setdefault("reshard_ttl_s", 5.0)
+    kw.setdefault("reshard_grace_s", 0.5)
+    return dataclasses.replace(_behaviors(), **kw)
+
+
+def _quiesce(cluster, timeout=20.0):
+    """Wait until no node is planning or mid-session."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        busy = False
+        for ci in cluster.instances:
+            d = ci.instance.reshard.debug()
+            if d["planning"] or any(s["state"] in ("begin", "streaming")
+                                    for s in d["sessions"]):
+                busy = True
+        if not busy:
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _agg_stats(cluster):
+    agg = {}
+    for ci in cluster.instances:
+        for k, v in ci.instance.reshard.debug()["stats"].items():
+            agg[k] = agg.get(k, 0) + v
+    return agg
+
+
+# ------------------------------------------------------------- move set
+
+
+class _Peer:
+    def __init__(self, address, is_owner=False):
+        self.info = PeerInfo(address=address, is_owner=is_owner)
+
+
+def _ring(addrs, self_addr=None):
+    p = ConsistentHashPicker()
+    for a in addrs:
+        p.add(_Peer(a, is_owner=(a == self_addr)))
+    return p
+
+
+KEYS = [f"svc{i % 5}_user-{i:03d}" for i in range(400)]
+A, B, C = "10.0.0.1:81", "10.0.0.2:81", "10.0.0.3:81"
+
+
+class TestPlanMoveSet:
+    def test_minimal_only_changed_owners_move(self):
+        old = _ring([A, B], self_addr=A)
+        new = _ring([A, B, C], self_addr=A)
+        moves = plan_move_set(KEYS, old, new, A)
+        moved = {k for ks in moves.values() for k in ks}
+        for key in KEYS:
+            was_mine = old.get(key).info.address == A
+            now_addr = new.get(key).info.address
+            should_move = was_mine and now_addr != A
+            assert (key in moved) == should_move, key
+            if should_move:
+                assert key in moves[now_addr]
+        # a self-owned-then-and-now key never appears; no empty dest lists
+        assert all(moves.values())
+
+    def test_unchanged_ring_plans_nothing(self):
+        ring = _ring([A, B], self_addr=A)
+        assert plan_move_set(KEYS, ring, _ring([A, B], self_addr=A), A) == {}
+
+    def test_stable_across_recomputation(self):
+        old = _ring([A, B], self_addr=B)
+        new = _ring([A, B, C], self_addr=B)
+        first = plan_move_set(KEYS, old, new, B)
+        for _ in range(3):
+            again = plan_move_set(KEYS, old, new, B)
+            assert again == first  # same dests, same keys, same ORDER
+            assert list(again) == list(first)
+
+    def test_only_self_owned_keys_move(self):
+        old = _ring([A, B], self_addr=A)
+        new = _ring([A, B, C], self_addr=A)
+        moves = plan_move_set(KEYS, old, new, A)
+        for ks in moves.values():
+            for k in ks:
+                assert old.get(k).info.address == A
+
+    def test_internal_prefix_never_planned(self):
+        old = _ring([A], self_addr=A)
+        new = _ring([B], self_addr=A)
+        keys = ["__guber_reshard_barrier", "real_key"]
+        moves = plan_move_set(keys, old, new, A)
+        assert moves == {B: ["real_key"]}
+
+    def test_empty_old_ring_plans_nothing(self):
+        # a freshly started node diffing from nothing must not plan
+        assert plan_move_set(KEYS, _ring([]), _ring([A, B]), A) == {}
+
+
+# ---------------------------------------------------------------- codec
+
+
+class TestCodec:
+    def test_ctl_roundtrip(self):
+        msg = {"op": "begin", "xfer": 123456789, "src": A, "ttl_ms": 5000}
+        kind, decoded = decode_msg(encode_ctl(msg))
+        assert kind == "ctl" and decoded == msg
+
+    def test_rows_roundtrip(self):
+        rows = np.arange(21, dtype=np.int64).reshape(3, 7)
+        keys = ["a_1", "b_22", "c_333"]
+        body = encode_rows_msg(0xDEAD, 7, True, keys, rows, ["gone_1"])
+        kind, (rid, seq, final, got_keys, slab, vacant) = decode_msg(body)
+        assert kind == "rows"
+        assert (rid, seq, final) == (0xDEAD, 7, True)
+        assert got_keys == keys
+        assert list(vacant) == ["gone_1"]
+        _blob, _off, got_rows = slab
+        np.testing.assert_array_equal(np.asarray(got_rows), rows)
+
+    def test_rows_empty_chunk(self):
+        body = encode_rows_msg(1, 0, True, [], np.zeros((0, 7), np.int64),
+                               ["only_vacant"])
+        kind, (_rid, _seq, _final, keys, slab, vacant) = decode_msg(body)
+        assert kind == "rows" and keys == [] and list(vacant) == ["only_vacant"]
+        assert np.asarray(slab[2]).shape == (0, 7)
+
+    def test_foreign_body_is_none(self):
+        # a pre-reshard peer's JSON node report must not decode
+        assert decode_msg(b'{"advertise_address": "x"}') is None
+        assert decode_msg(b"") is None
+
+    def test_pack_unpack_chunk_bit_identical(self):
+        keys = [f"key_{i}".encode() for i in range(100)]
+        rows = np.arange(700, dtype=np.int64).reshape(100, 7)
+        buf = pack_rows_chunk(keys, rows)
+        blob, off, got = unpack_rows_chunk(buf)
+        assert [blob[off[i]:off[i + 1]] for i in range(100)] == keys
+        np.testing.assert_array_equal(got, rows)
+        assert pack_rows_chunk(keys, rows) == buf  # deterministic bytes
+
+    def test_unpack_truncation_fails_loudly(self):
+        buf = pack_rows_chunk([b"k1", b"k2"],
+                              np.ones((2, 7), np.int64))
+        for cut in (1, 5, len(buf) - 3):
+            with pytest.raises(ValueError):
+                unpack_rows_chunk(buf[:cut])
+
+
+# -------------------------------------------------- the off differential
+
+
+class TestReshardOff:
+    def test_membership_change_bit_identical_with_knob_unset(self):
+        """GUBER_RESHARD=0 (the default): a membership change leaves the
+        engine rows byte-identical and the handoff plane dormant."""
+        c = LocalCluster().start(1)  # plain test behaviors: reshard off
+        try:
+            inst = c.instances[0].instance
+            _drive(inst, 120, hits=3)
+            before = [
+                (bytes(blob), np.asarray(off).tobytes(),
+                 np.asarray(rows).tobytes())
+                for blob, off, rows in inst.backend.snapshot_slabs()]
+            # ring change: add a peer that does not even exist
+            inst.set_peers([PeerInfo(address=inst.advertise_address),
+                            PeerInfo(address="127.0.0.1:1")])
+            time.sleep(0.2)
+            after = [
+                (bytes(blob), np.asarray(off).tobytes(),
+                 np.asarray(rows).tobytes())
+                for blob, off, rows in inst.backend.snapshot_slabs()]
+            assert before == after
+            d = inst.reshard.debug()
+            assert d["enabled"] is False and d["active"] is False
+            assert d["stats"]["plans"] == 0
+            assert d["sessions"] == [] and d["recent"] == []
+        finally:
+            c.stop()
+
+
+# --------------------------------------------------- two-node transfers
+
+
+def _scale_up_with_moves(behaviors, n_keys=200, max_adds=4):
+    """Boot 2 nodes, load n_keys, then add nodes until the ring diff
+    actually moves keys (the single-point crc32 ring can add a node into
+    an arc no key hashes to). Returns (cluster, moved_keys: {key: dest},
+    pre_move_rows: {key: row_bytes})."""
+    cluster = LocalCluster().start(2, behaviors=behaviors)
+    ok = False
+    try:
+        time.sleep(behaviors.reshard_grace_s + 0.2)  # boot grace
+        _drive(cluster.instances[0].instance, n_keys, hits=5)
+        pre_rows = {}
+        for ci in cluster.instances:
+            for blob, off, rows in ci.instance.backend.snapshot_slabs():
+                off = np.asarray(off)
+                rows = np.asarray(rows)
+                for i in range(len(off) - 1):
+                    key = bytes(blob[off[i]:off[i + 1]]).decode()
+                    pre_rows[key] = rows[i].tobytes()
+        moved = {}
+        for _ in range(max_adds):
+            olds = {ci.address: ci.instance.local_picker
+                    for ci in cluster.instances}
+            cluster.start_instance(behaviors=behaviors)
+            cluster.sync_peers()
+            for ci in cluster.instances[:-1]:
+                rm = ci.instance.reshard
+                mv = plan_move_set(
+                    rm._resident_keys(), olds[ci.address],
+                    ci.instance.local_picker, ci.instance.advertise_address)
+                for dest, ks in mv.items():
+                    for k in ks:
+                        moved[k] = dest
+            if moved:
+                break
+        assert moved, "ring never moved a key"
+        ok = True
+        return cluster, moved, pre_rows
+    finally:
+        if not ok:
+            cluster.stop()
+
+
+@pytest.mark.chaos
+class TestHandoffProtocol:
+    def test_committed_handoff_rows_bit_identical(self):
+        """With no load during the transfer, the new owner's rows for the
+        moved keys are byte-for-byte the old owner's pre-move rows."""
+        cluster, moved, pre_rows = _scale_up_with_moves(_reshard_behaviors())
+        try:
+            assert _quiesce(cluster)
+            stats = _agg_stats(cluster)
+            assert stats["export_commits"] >= 1
+            assert stats["import_commits"] >= 1
+            assert stats["export_aborts"] == 0, stats
+            assert stats["fresh_serves"] == 0, stats
+            assert stats["rows_out"] == stats["rows_in"] == len(moved)
+            for key, dest in moved.items():
+                owner = cluster.instance_for_host(dest).instance
+                found, rows = owner.backend.rows_for_keys([key])
+                assert found == [key], f"{key} missing on new owner"
+                assert np.asarray(rows)[0].tobytes() == pre_rows[key], key
+        finally:
+            cluster.stop()
+
+    def test_one_dropped_frame_is_retried_not_fatal(self):
+        """A single faulted transfer RPC per peer is retried (begin and
+        commit are idempotent, frames are seq-deduplicated) and the
+        handoff still commits."""
+        faults.install("transport=reshard;calls=1;action=error")
+        cluster, moved, _ = _scale_up_with_moves(_reshard_behaviors())
+        try:
+            assert _quiesce(cluster)
+            stats = _agg_stats(cluster)
+            assert stats["export_commits"] >= 1, stats
+            assert stats["export_aborts"] == 0, stats
+            assert stats["fresh_serves"] == 0, stats
+        finally:
+            cluster.stop()
+
+    def test_dead_transfer_plane_fails_closed_to_amnesty(self):
+        """Every transfer RPC erroring = the handoff aborts fail-closed;
+        serving continues, moved keys restart fresh (counted amnesty),
+        and nothing wedges or over-admits."""
+        faults.install("transport=reshard;action=error")
+        behaviors = _reshard_behaviors(reshard_ttl_s=1.0,
+                                       reshard_grace_s=0.3)
+        cluster, moved, _ = _scale_up_with_moves(behaviors)
+        try:
+            assert _quiesce(cluster, timeout=25)
+            stats = _agg_stats(cluster)
+            assert stats["export_commits"] == 0
+            assert stats["export_aborts"] >= 1, stats
+            assert stats["rows_in"] == 0
+            # serving keeps working THROUGH the dead plane: hit every key
+            # once; no request may error or hang
+            t0 = time.monotonic()
+            after = _drive(cluster.instances[0].instance, 200, hits=1)
+            assert time.monotonic() - t0 < 30.0
+            assert len(after) == 200
+            # no over-admission: a fresh serve can only LOWER admitted
+            # budget (remaining resets up, but hits are still counted)
+            for key in moved:
+                assert after[key] >= 0
+            sessions = [s for ci in cluster.instances
+                        for s in ci.instance.reshard.debug()["recent"]]
+            reasons = {s["reason"].split(":")[0] for s in sessions
+                       if s["state"] == "aborted"}
+            assert reasons & {"begin_failed", "frame_failed",
+                              "commit_failed"}, reasons
+        finally:
+            cluster.stop()
+
+    def test_importer_lease_expires_at_ttl(self):
+        """An importer whose exporter goes silent after `begin` drops the
+        session at the lease TTL (reason ttl_expired) and serves fresh —
+        it must not wait for a commit that will never come."""
+        behaviors = _reshard_behaviors(reshard_ttl_s=0.3,
+                                       reshard_grace_s=0.2)
+        c = LocalCluster().start(1, behaviors=behaviors)
+        try:
+            rm = c.instances[0].instance.reshard
+            ack = decode_msg(rm.handle_message(encode_ctl(
+                {"op": "begin", "xfer": 42, "src": "10.9.9.9:81",
+                 "ttl_ms": 300, "planned": 10})))[1]
+            assert ack.get("ok") and ack["ttl_ms"] <= 300
+            assert any(s["state"] == "streaming"
+                       for s in rm.debug()["sessions"])
+            time.sleep(0.45)  # one TTL + slack, no renewal
+            # the expired lease surfaces on the next touch
+            body = encode_rows_msg(42, 0, False, ["x_y"],
+                                   np.ones((1, 7), np.int64), [])
+            kind, reply = decode_msg(rm.handle_message(body))
+            assert kind == "ctl" and "unknown transfer" in reply["error"]
+            d = rm.debug()
+            assert d["stats"]["import_aborts"] == 1
+            assert any(s["reason"] == "ttl_expired" for s in d["recent"])
+            assert not d["active"] or d["sessions"] == []
+        finally:
+            c.stop()
+
+    def test_pre_reshard_peer_degrades_not_wedges(self):
+        """A peer whose Debug handler answers the legacy node report (no
+        reshard plane) aborts the session cleanly — detected from the
+        non-GRSH reply, degraded to amnesty."""
+        old_style = _behaviors()  # reshard off: Debug answers node report
+        cluster = LocalCluster().start(2, behaviors=old_style)
+        try:
+            # flip ONE node on; its exports must fail gracefully
+            src = cluster.instances[0].instance
+            src.reshard.enabled = True
+            src.conf.behaviors.reshard = True
+            _drive(src, 100, hits=2)
+            cluster.start_instance(behaviors=old_style)
+            cluster.sync_peers()
+            assert _quiesce(cluster, timeout=15)
+            d = src.reshard.debug()
+            if d["stats"]["plans"] and d["recent"]:
+                assert all(s["state"] in ("committed", "aborted")
+                           for s in d["recent"])
+            # serving never wedged
+            assert len(_drive(src, 100, hits=1)) == 100
+        finally:
+            cluster.stop()
+
+
+# ------------------------------------------------------------ env knobs
+
+
+class TestEnvKnobs:
+    def test_defaults(self, monkeypatch):
+        for var in ("GUBER_RESHARD", "GUBER_RESHARD_TTL",
+                    "GUBER_RESHARD_CHUNK_ROWS", "GUBER_RESHARD_GRACE"):
+            monkeypatch.delenv(var, raising=False)
+        from gubernator_tpu.cmd.envconf import config_from_env
+        b = config_from_env([]).behaviors
+        assert b.reshard is False
+        assert b.reshard_ttl_s == 5.0
+        assert b.reshard_chunk_rows == 2048
+        assert b.reshard_grace_s == 1.0
+
+    def test_round_trip(self, monkeypatch):
+        monkeypatch.setenv("GUBER_RESHARD", "1")
+        monkeypatch.setenv("GUBER_RESHARD_TTL", "2s")
+        monkeypatch.setenv("GUBER_RESHARD_CHUNK_ROWS", "512")
+        monkeypatch.setenv("GUBER_RESHARD_GRACE", "250ms")
+        from gubernator_tpu.cmd.envconf import config_from_env
+        b = config_from_env([]).behaviors
+        assert b.reshard is True
+        assert b.reshard_ttl_s == 2.0
+        assert b.reshard_chunk_rows == 512
+        assert b.reshard_grace_s == 0.25
+
+    def test_validation_rejects_bad_values(self):
+        from gubernator_tpu.service.config import (
+            BehaviorConfig,
+            InstanceConfig,
+        )
+        for field, bad in (("reshard_ttl_s", 0.0),
+                           ("reshard_chunk_rows", 0),
+                           ("reshard_chunk_rows", 9000),
+                           ("reshard_grace_s", -1.0)):
+            behaviors = dataclasses.replace(BehaviorConfig(), **{field: bad})
+            with pytest.raises(ValueError, match=field):
+                InstanceConfig(behaviors=behaviors).validate()
