@@ -1,14 +1,20 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast bench bench-skew bench-wire bench-reshard bench-suite bench-check capacity-report soak chaos proto docker clean native
+.PHONY: test test-fast lint bench bench-skew bench-wire bench-reshard bench-suite bench-check capacity-report soak chaos proto docker clean native
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
 	python -m pytest tests/ -q
 
-test-fast:
+test-fast: lint
 	python -m pytest tests/ -q -x -m "not slow"
+
+# guberlint: AST-driven invariant analyzer (docs/static-analysis.md).
+# Zero unwaived findings is a tier-1 gate (tests/test_lint.py runs the
+# same check in-process).
+lint:
+	python -m gubernator_tpu.analysis
 
 bench:
 	python bench.py

@@ -25,6 +25,7 @@ import os as _os
 
 import jax as _jax
 
+# guberlint: disable=knob-drift -- import-time switch: runs before envconf exists, dev/test only (x64 off breaks the i64 lane contract)
 if not _os.environ.get("GUBER_TPU_NO_X64"):
     _jax.config.update("jax_enable_x64", True)
 

@@ -1,0 +1,16 @@
+"""guberlint: AST-driven invariant analysis for gubernator_tpu.
+
+The repo's load-bearing disciplines as tier-1 gates — see
+docs/static-analysis.md for the rule catalogue and the historical bug
+each rule guards against. Run via `make lint` or
+`python -m gubernator_tpu.analysis`.
+"""
+
+from gubernator_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    RepoIndex,
+    Rule,
+    all_rules,
+    register,
+    run,
+)

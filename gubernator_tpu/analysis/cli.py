@@ -1,0 +1,64 @@
+"""guberlint CLI: `make lint` / `python -m gubernator_tpu.analysis`.
+
+Exit 0 on a clean tree, 1 when any unwaived finding exists. The output
+format is one `path:line: [rule] message` per finding — editor- and
+grep-friendly, same shape as the compiler diagnostics it complements.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from gubernator_tpu.analysis import core
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        "guberlint",
+        description="AST-driven invariant analyzer for gubernator_tpu")
+    parser.add_argument("--root", default=REPO_ROOT,
+                        help="repo checkout to analyze (default: this one)")
+    parser.add_argument("--only", default="",
+                        help="comma-separated rule ids to run")
+    parser.add_argument("--list", action="store_true", dest="list_rules",
+                        help="print the rule catalogue and exit")
+    parser.add_argument("--show-waived", action="store_true",
+                        help="also print suppressed findings + waivers")
+    opts = parser.parse_args(argv)
+
+    rules = core.all_rules()
+    if opts.list_rules:
+        for rid in sorted(rules):
+            print(f"{rid:24s} {rules[rid].doc}")
+        return 0
+
+    only = [r for r in opts.only.split(",") if r]
+    try:
+        findings, suppressed = core.run(opts.root, only=only)
+    except ValueError as e:
+        print(f"guberlint: {e}", file=sys.stderr)
+        return 2
+
+    for f in findings:
+        print(f.render())
+    if opts.show_waived:
+        for f, w in suppressed:
+            print(f"WAIVED {f.render()}  [-- {w.justification}]")
+    ran = ", ".join(sorted(only or rules))
+    if findings:
+        print(f"\nguberlint: {len(findings)} finding(s) "
+              f"({len(suppressed)} waived) across rules: {ran}")
+        return 1
+    print(f"guberlint: clean ({len(suppressed)} waived) "
+          f"across rules: {ran}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,9 @@
+"""Rule modules self-register on import (analysis/core.py register)."""
+
+from gubernator_tpu.analysis.rules import (  # noqa: F401
+    hatches,
+    knobs,
+    locks,
+    native,
+    registries,
+)

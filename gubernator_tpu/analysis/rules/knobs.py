@@ -1,0 +1,112 @@
+"""`knob-drift` — every GUBER_* knob flows through the full surface.
+
+The configuration contract this repo has kept since PR 1: a knob that
+exists in code must be (a) visible in `cmd/envconf.py` (the one place
+the daemon resolves configuration, so `--config` files and the env stay
+equivalent), (b) present in `example.conf` (the operator's discovery
+surface), and (c) mentioned somewhere under `docs/` (the meaning).
+Conversely a knob in `example.conf` that no code reads is a dead
+promise. This rule fired for 20+ knobs when it was first written —
+observability-plane knobs (PR 9/10) had envconf parsing but never made
+the example conf.
+
+Dev-only knobs read before configuration exists (import-time switches
+like GUBER_TPU_NO_X64) carry inline waivers at their read site — the
+waiver justification documents why they bypass envconf.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Iterable, List, Set, Tuple
+
+from gubernator_tpu.analysis.core import Finding, RepoIndex, Rule, register
+
+# a knob literal: GUBER_ followed by caps; the lookahead rejects prose
+# prefix mentions (patterns such as GUBER_ETCD_TLS_* name a family, not
+# a knob, and must not backtrack into a shorter false match)
+KNOB_RE = re.compile(r"GUBER_[A-Z0-9_]*[A-Z0-9](?![A-Z0-9_*])")
+
+ENVCONF = "gubernator_tpu/cmd/envconf.py"
+CONF = "example.conf"
+DOCS_DIR = "docs"
+
+
+def _knob_sites(sf) -> Dict[str, List[int]]:
+    """knob name -> lines referencing it in one file."""
+    out: Dict[str, List[int]] = {}
+    for i, line in enumerate(sf.lines, 1):
+        for m in KNOB_RE.finditer(line):
+            out.setdefault(m.group(0), []).append(i)
+    return out
+
+
+@register
+class KnobDriftRule(Rule):
+    id = "knob-drift"
+    doc = ("every GUBER_* knob in code must be resolved in cmd/envconf.py, "
+           "listed in example.conf, and documented under docs/; every "
+           "example.conf knob must still be read by code")
+
+    def check(self, repo: RepoIndex) -> Iterable[Finding]:
+        # knob -> [(path, line), ...] across all scanned code
+        code_sites: Dict[str, List[Tuple[str, int]]] = {}
+        for relpath in repo.python_files():
+            sf = repo.get(relpath)
+            for knob, lines in _knob_sites(sf).items():
+                code_sites.setdefault(knob, []).extend(
+                    (relpath, ln) for ln in lines)
+
+        conf_sf = repo.get(CONF)
+        conf_knobs: Dict[str, int] = {}
+        if conf_sf is not None:
+            for knob, lines in _knob_sites(conf_sf).items():
+                conf_knobs.setdefault(knob, lines[0])
+
+        envconf_knobs: Set[str] = set()
+        env_sf = repo.get(ENVCONF)
+        if env_sf is not None:
+            envconf_knobs = set(_knob_sites(env_sf))
+
+        doc_knobs: Set[str] = set()
+        for doc in repo.walk(DOCS_DIR, ".md"):
+            doc_knobs |= set(_knob_sites(repo.get(doc)))
+
+        for knob in sorted(code_sites):
+            sites = sorted(code_sites[knob])
+            missing = []
+            if env_sf is not None and knob not in envconf_knobs:
+                missing.append("cmd/envconf.py")
+            if conf_sf is not None and knob not in conf_knobs:
+                missing.append("example.conf")
+            if repo.exists(DOCS_DIR) and knob not in doc_knobs:
+                missing.append("docs/")
+            if not missing:
+                continue
+            path, line = _waived_or_first(repo, self.id, sites)
+            yield Finding(
+                self.id, path, line,
+                f"{knob} is referenced in code but absent from "
+                f"{', '.join(missing)} — add it to the full knob surface "
+                "or waive the dev-only read with a justification")
+
+        # dead knobs: promised to operators, read by nothing
+        if conf_sf is not None:
+            for knob, line in sorted(conf_knobs.items()):
+                if knob not in code_sites:
+                    yield Finding(
+                        self.id, CONF, line,
+                        f"{knob} appears in example.conf but no code "
+                        "reads it — delete the dead knob or wire it up")
+
+
+def _waived_or_first(repo: RepoIndex, rule_id: str,
+                     sites: List[Tuple[str, int]]) -> Tuple[str, int]:
+    """Attach the finding to a waived reference site when one exists
+    (so one inline waiver at any read site covers the knob), else to
+    the first reference."""
+    for path, line in sites:
+        sf = repo.get(path)
+        if sf is not None and sf.waived(rule_id, line) is not None:
+            return path, line
+    return sites[0]

@@ -1,0 +1,272 @@
+"""Lock-discipline rules.
+
+`lock-discipline` — the PR 10 cartographer race, generalized. The
+serving path donates the engine's device arrays (`state`, and the devdir
+engine's `fps`/`touch`) to XLA each dispatch and rebinds the attribute;
+any reader holding a stale reference sees a deleted array by readback
+time. So every read of those attributes in `models/`, `obs/`, `service/`
+must happen lexically inside a `with <lock>` scope — or inside a
+function that declares the caller-holds-the-lock contract (name ends in
+`_locked`, or docstring says so), which is this repo's equivalent of a
+clang thread-safety REQUIRES annotation.
+
+`blocking-under-lock` — the converse discipline: the engine/store lock
+serializes every decision window, so an RPC, socket op, `time.sleep`, or
+subprocess call made while holding it stalls the entire serving spine
+(one slow peer would become a global outage). No blocking call may sit
+lexically inside a lock scope; deferred work (closures defined under the
+lock) is exempt because definition is not execution.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, List, Set
+
+from gubernator_tpu.analysis.core import (
+    Finding,
+    RepoIndex,
+    Rule,
+    iter_lock_withs,
+    register,
+)
+
+# directories the donated-buffer discipline governs (repo-relative)
+LOCK_SCOPE_DIRS = (
+    "gubernator_tpu/models",
+    "gubernator_tpu/obs",
+    "gubernator_tpu/service",
+)
+
+# attributes holding donated device arrays
+DONATED_ATTRS = frozenset({"state", "fps", "touch"})
+
+# a function whose docstring states the caller already holds the lock is
+# a declared contract, not a violation (the call sites are checked where
+# they take the lock)
+_HOLDS_RE = re.compile(
+    r"caller(s)?\s+(must\s+)?(already\s+)?hold|lock\s+(is\s+)?held"
+    r"|under\s+the\s+\w*\s*lock|with\s+the\s+\w*\s*lock\s+held",
+    re.IGNORECASE)
+
+
+def _declares_lock_held(fn: ast.AST) -> bool:
+    name = getattr(fn, "name", "")
+    if name.endswith("_locked"):
+        return True
+    doc = ast.get_docstring(fn) or ""
+    return bool(_HOLDS_RE.search(doc))
+
+
+def _function_nodes(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def _in_scope(repo: RepoIndex, relpath: str) -> bool:
+    return any(relpath.startswith(d + "/") or relpath.startswith(d + "\\")
+               for d in LOCK_SCOPE_DIRS)
+
+
+# receivers other than `self` that plausibly hold an engine: `backend`,
+# `eng`, `self._engine`, `inst.backend` — but not `sess`, `circuit`, `s`
+# (reshard session status strings and circuit-breaker enums also use the
+# attribute name `state` and are plain python ints/strings, not arrays)
+_ENGINEISH_RE = re.compile(r"(^|\.)_?(backend|engine|eng)$")
+
+
+def _donated_classes(tree: ast.Module) -> Set[ast.ClassDef]:
+    """Classes that actually bind donated device arrays: some method
+    assigns `self.state`/`self.fps`/`self.touch` from a *call* (array
+    constructors / jit dispatch results). Classes that assign these
+    names from constants or plain names (circuit-breaker enums, reshard
+    session status strings) are not array holders."""
+    out: Set[ast.ClassDef] = set()
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not isinstance(node.value, ast.Call):
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and tgt.attr in DONATED_ATTRS
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    out.add(cls)
+    return out
+
+
+@register
+class LockDisciplineRule(Rule):
+    id = "lock-discipline"
+    doc = ("reads of donated device arrays (.state/.fps/.touch) in "
+           "models/, obs/, service/ must sit inside a `with <lock>` "
+           "scope or a declared caller-holds-lock function")
+
+    def check(self, repo: RepoIndex) -> Iterable[Finding]:
+        for relpath in repo.python_files():
+            if not _in_scope(repo, relpath):
+                continue
+            sf = repo.get(relpath)
+            tree = sf.tree
+            if tree is None:
+                continue
+            lock_withs = {w for w, _ in iter_lock_withs(tree)}
+            parents = _parent_map(tree)
+            donated = _donated_classes(tree)
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Attribute)
+                        and node.attr in DONATED_ATTRS):
+                    continue
+                recv = node.value
+                if isinstance(recv, ast.Name) and recv.id == "self":
+                    cls = _enclosing_class(node, parents)
+                    if cls is None or cls not in donated:
+                        continue
+                elif not _ENGINEISH_RE.search(ast.unparse(recv)):
+                    continue
+                verdict = _lock_verdict(node, parents, lock_withs)
+                if verdict == "ok":
+                    continue
+                obj = ast.unparse(node.value)
+                yield Finding(
+                    self.id, relpath, node.lineno,
+                    f"`{obj}.{node.attr}` read outside a lock scope — the "
+                    "serving path donates this array and rebinds the "
+                    "attribute; hold the engine lock (or declare the "
+                    "caller-holds-lock contract) to avoid the "
+                    "deleted-array race")
+
+
+def _enclosing_class(node: ast.AST, parents) -> ast.AST:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _parent_map(tree: ast.Module):
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _lock_verdict(node: ast.AST, parents, lock_withs: Set[ast.AST]) -> str:
+    """Climb lexically outward: a lock `with` before the enclosing
+    function means locked; construction scopes (`__init__`, module
+    setup at class body level) and declared-contract functions pass."""
+    cur = parents.get(node)
+    while cur is not None:
+        if cur in lock_withs:
+            return "ok"
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if cur.name == "__init__" or _declares_lock_held(cur):
+                # __init__ builds the arrays before the object is shared
+                return "ok"
+            return "violation"
+        if isinstance(cur, ast.Lambda):
+            return "ok"  # deferred execution: checked at the call site
+        cur = parents.get(cur)
+    return "ok"  # module level: import-time, single-threaded
+
+
+# ------------------------------------------------------------- blocking
+
+# calls that block on external progress: never inside a lock scope
+_BLOCKING_MODULES = frozenset({"subprocess", "requests"})
+_BLOCKING_SOCKET_METHODS = frozenset({
+    "connect", "connect_ex", "accept", "recv", "recvfrom", "sendall",
+    "makefile",
+})
+_RPC_METHODS = frozenset({
+    # gRPC stub surface (service/pb/*_pb2_grpc): a peer RPC under the
+    # engine lock serializes the cluster behind one peer's latency
+    "GetRateLimits", "GetPeerRateLimits", "UpdatePeerGlobals",
+    "HealthCheck", "Debug",
+})
+
+
+def _blocking_reason(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        if fn.id == "sleep":
+            return "time.sleep"
+        return ""
+    if not isinstance(fn, ast.Attribute):
+        return ""
+    if fn.attr == "sleep" and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "time":
+        return "time.sleep"
+    if isinstance(fn.value, ast.Name) and fn.value.id in _BLOCKING_MODULES:
+        return f"{fn.value.id}.{fn.attr}"
+    if fn.attr in _BLOCKING_SOCKET_METHODS:
+        return f"socket .{fn.attr}()"
+    if fn.attr in _RPC_METHODS:
+        return f"peer RPC .{fn.attr}()"
+    if fn.attr == "create_connection" and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "socket":
+        return "socket.create_connection"
+    return ""
+
+
+# locks whose PURPOSE is serializing socket IO (peerlink's `_wlock`
+# write-serialization lock): a blocking send is their job, and they are
+# never held across engine state
+_IO_LOCK_RE = re.compile(r"wlock|write|sock|io_?lock", re.IGNORECASE)
+
+
+@register
+class BlockingUnderLockRule(Rule):
+    id = "blocking-under-lock"
+    doc = ("no RPC, socket op, time.sleep, or subprocess call lexically "
+           "inside an engine/store lock scope")
+
+    def check(self, repo: RepoIndex) -> Iterable[Finding]:
+        for relpath in repo.python_files():
+            if not _in_scope(repo, relpath):
+                continue
+            sf = repo.get(relpath)
+            tree = sf.tree
+            if tree is None:
+                continue
+            for with_node, lock_expr in iter_lock_withs(tree):
+                lock_src = ast.unparse(lock_expr)
+                if _IO_LOCK_RE.search(lock_src):
+                    continue
+                for call in _calls_in_scope(with_node):
+                    reason = _blocking_reason(call)
+                    if reason:
+                        yield Finding(
+                            self.id, relpath, call.lineno,
+                            f"{reason} while holding `{lock_src}` — a "
+                            "blocking call under the lock stalls every "
+                            "serving window behind it; move it outside "
+                            "the critical section")
+
+
+def _calls_in_scope(with_node: ast.With) -> List[ast.Call]:
+    """Calls lexically inside the with body, NOT descending into nested
+    function definitions (deferred execution is the call site's
+    problem, not the definition site's)."""
+    out: List[ast.Call] = []
+    stack: List[ast.AST] = []
+    for stmt in with_node.body:
+        stack.append(stmt)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out

@@ -1,0 +1,62 @@
+"""`native-warnings` — promote the C++ warning surface to an error gate.
+
+keydir.cpp and peerlink.cpp run the repo's sharpest concurrency (the
+TSAN harness in tests/test_tsan.py hammers the real thread disciplines);
+g++ has no clang `-Wthread-safety`, so the strongest always-on gate this
+toolchain offers is `-Wall -Wextra` promoted to errors. scripts/
+build_native.py compiles with the same set + `-Werror`, and this rule
+runs the cheap `-fsyntax-only` variant inside `make lint` so a new
+warning fails the lint gate even before anyone rebuilds the .so cache.
+
+Skips silently when g++ is absent (the lint gate must not invent an
+environment requirement tier-1 doesn't already have).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+import subprocess
+import sysconfig
+from typing import Iterable
+
+from gubernator_tpu.analysis.core import Finding, RepoIndex, Rule, register
+
+NATIVE_DIR = "gubernator_tpu/native"
+
+# `-Wall -Wextra` everywhere; build_native.py must carry the same set
+# (plus -Werror) so lint and the shipped .so agree on the surface
+WARN_FLAGS = ("-Wall", "-Wextra")
+
+_DIAG_RE = re.compile(r"^(?P<path>[^:]+):(?P<line>\d+):\d+:\s*"
+                      r"(?:warning|error):\s*(?P<msg>.*)$")
+
+
+@register
+class NativeWarningsRule(Rule):
+    id = "native-warnings"
+    doc = ("gubernator_tpu/native/*.cpp must compile clean under "
+           "-Wall -Wextra (promoted to -Werror in scripts/build_native.py)")
+
+    def check(self, repo: RepoIndex) -> Iterable[Finding]:
+        if shutil.which("g++") is None:
+            return
+        native = os.path.join(repo.root, NATIVE_DIR)
+        if not os.path.isdir(native):
+            return
+        pyinc = f"-I{sysconfig.get_paths()['include']}"
+        for name in sorted(os.listdir(native)):
+            if not name.endswith(".cpp"):
+                continue
+            src = os.path.join(native, name)
+            proc = subprocess.run(
+                ["g++", "-fsyntax-only", *WARN_FLAGS, "-std=c++17",
+                 pyinc, src],
+                capture_output=True, text=True, timeout=120)
+            relpath = f"{NATIVE_DIR}/{name}"
+            for raw in (proc.stderr or "").splitlines():
+                m = _DIAG_RE.match(raw.strip())
+                if m and os.path.basename(m.group("path")) == name:
+                    yield Finding(self.id, relpath, int(m.group("line")),
+                                  f"g++ diagnostic: {m.group('msg')}")

@@ -1,0 +1,283 @@
+"""`registry-drift` — generalize the PR 9 metric/doc lint to every
+name registry the operator surface depends on.
+
+tests/test_metrics_docs.py proved the pattern for Prometheus families:
+an undocumented name is a dashboard nobody builds, a documented-but-gone
+name is a dashboard that silently flatlines. The same failure mode
+exists for three more registries, and PR 11 demonstrated the drift is
+real (reshard.* flight-recorder events shipped without rows in the
+Flight recorder table):
+
+- flight-recorder event kinds: every `emit("x.y")` in code must appear
+  in docs/observability.md's "## Flight recorder" table, and every kind
+  the table promises must still be emitted somewhere;
+- fault-injection transports: service/faults.py TRANSPORTS must each be
+  documented as `transport=<name>` under docs/, and every literal passed
+  to `faults.on_call(peer, "<t>")` must be a registered transport;
+- /v1/debug/vars sections: every section `obs/introspect.py` can emit
+  must be declared in tests/test_debug_schema.py's ALWAYS/OPTIONAL sets
+  (the schema contract), and no declared section may be stale.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterable, List, Tuple
+
+from gubernator_tpu.analysis.core import Finding, RepoIndex, Rule, register
+
+OBS_DOC = "docs/observability.md"
+FAULTS = "gubernator_tpu/service/faults.py"
+INTROSPECT = "gubernator_tpu/obs/introspect.py"
+SCHEMA_TEST = "tests/test_debug_schema.py"
+
+_EMIT_FNS = frozenset({"emit", "_emit", "_record"})
+
+
+def _emitted_kinds(repo: RepoIndex
+                   ) -> Tuple[Dict[str, Tuple[str, int]],
+                              Dict[str, Tuple[str, int]]]:
+    """(exact kinds, glob prefixes) -> first emit site. A kind is a
+    dotted string literal first argument to emit/_emit/_record; an
+    f-string with a dotted constant head (`f"anomaly.{name}"`) is a
+    glob prefix covering everything under it."""
+    exact: Dict[str, Tuple[str, int]] = {}
+    globs: Dict[str, Tuple[str, int]] = {}
+    for relpath in repo.python_files():
+        sf = repo.get(relpath)
+        tree = sf.tree
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = fn.id if isinstance(fn, ast.Name) else (
+                fn.attr if isinstance(fn, ast.Attribute) else "")
+            if name not in _EMIT_FNS:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if "." in arg.value:
+                    exact.setdefault(arg.value, (relpath, node.lineno))
+            elif isinstance(arg, ast.JoinedStr) and arg.values:
+                head = arg.values[0]
+                if isinstance(head, ast.Constant) \
+                        and isinstance(head.value, str) \
+                        and head.value.endswith("."):
+                    globs.setdefault(head.value, (relpath, node.lineno))
+    return exact, globs
+
+
+def _documented_kinds(repo: RepoIndex
+                      ) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """Kinds from the '## Flight recorder' table's first column:
+    backticked dotted names; `foo.*` documents the whole prefix."""
+    sf = repo.get(OBS_DOC)
+    exact: Dict[str, int] = {}
+    globs: Dict[str, int] = {}
+    if sf is None:
+        return exact, globs
+    in_section = False
+    for i, line in enumerate(sf.lines, 1):
+        if line.startswith("## "):
+            in_section = line.strip() == "## Flight recorder"
+            continue
+        if not in_section or not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1]
+        for name in re.findall(r"`([a-z0-9_.*]+)`", first_cell):
+            if "." not in name:
+                continue
+            if name.endswith("*"):
+                globs.setdefault(name[:-1], i)
+            else:
+                exact.setdefault(name, i)
+    return exact, globs
+
+
+@register
+class RegistryDriftRule(Rule):
+    id = "registry-drift"
+    doc = ("flight-recorder kinds, fault transports, and /v1/debug/vars "
+           "sections must stay in sync with their documented registries")
+
+    def check(self, repo: RepoIndex) -> Iterable[Finding]:
+        yield from self._check_events(repo)
+        yield from self._check_faults(repo)
+        yield from self._check_debug_sections(repo)
+
+    # ---------------------------------------------------------- events
+
+    def _check_events(self, repo: RepoIndex) -> Iterable[Finding]:
+        if repo.get(OBS_DOC) is None:
+            return
+        em_exact, em_globs = _emitted_kinds(repo)
+        doc_exact, doc_globs = _documented_kinds(repo)
+        if not doc_exact and not doc_globs:
+            return  # corpus repo without the doc section
+
+        for kind, (path, line) in sorted(em_exact.items()):
+            if kind in doc_exact:
+                continue
+            if any(kind.startswith(g) for g in doc_globs):
+                continue
+            yield Finding(
+                self.id, path, line,
+                f"flight-recorder kind '{kind}' is emitted but missing "
+                f"from the {OBS_DOC} '## Flight recorder' table — an "
+                "undocumented event is invisible to the incident runbook")
+        for prefix, (path, line) in sorted(em_globs.items()):
+            if prefix in doc_globs:
+                continue
+            if any(k.startswith(prefix) for k in doc_exact):
+                continue
+            yield Finding(
+                self.id, path, line,
+                f"flight-recorder kind family '{prefix}*' is emitted but "
+                f"undocumented in the {OBS_DOC} '## Flight recorder' table")
+        for kind, line in sorted(doc_exact.items()):
+            if kind in em_exact:
+                continue
+            if any(kind.startswith(p) for p in em_globs):
+                continue
+            yield Finding(
+                self.id, OBS_DOC, line,
+                f"flight-recorder kind '{kind}' is documented but nothing "
+                "emits it — the runbook promises an event that will never "
+                "appear")
+        for prefix, line in sorted(doc_globs.items()):
+            if prefix in em_globs:
+                continue
+            if any(k.startswith(prefix) for k in em_exact):
+                continue
+            yield Finding(
+                self.id, OBS_DOC, line,
+                f"flight-recorder family '{prefix}*' is documented but "
+                "nothing emits under it")
+
+    # ---------------------------------------------------------- faults
+
+    def _check_faults(self, repo: RepoIndex) -> Iterable[Finding]:
+        sf = repo.get(FAULTS)
+        if sf is None or sf.tree is None:
+            return
+        transports: List[Tuple[str, int]] = []
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name) and t.id == "TRANSPORTS"
+                            for t in node.targets) \
+                    and isinstance(node.value, ast.Tuple):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant):
+                        transports.append((elt.value, node.lineno))
+        if not transports:
+            return
+        docs_text = "\n".join(
+            repo.get(doc).text for doc in repo.walk("docs", ".md"))
+        for name, line in transports:
+            if f"transport={name}" not in docs_text:
+                yield Finding(
+                    self.id, FAULTS, line,
+                    f"fault transport '{name}' is registered in TRANSPORTS "
+                    "but docs/ never shows `transport="
+                    f"{name}` — operators can't discover a choke point "
+                    "the docs don't name")
+        registered = {n for n, _ in transports}
+        for relpath in repo.python_files():
+            tsf = repo.get(relpath)
+            if tsf.tree is None:
+                continue
+            for node in ast.walk(tsf.tree):
+                if isinstance(node, ast.Call) and len(node.args) >= 2:
+                    fn = node.func
+                    fname = fn.id if isinstance(fn, ast.Name) else (
+                        fn.attr if isinstance(fn, ast.Attribute) else "")
+                    if fname != "on_call":
+                        continue
+                    arg = node.args[1]
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str) \
+                            and arg.value not in registered:
+                        yield Finding(
+                            self.id, relpath, node.lineno,
+                            f"faults.on_call transport '{arg.value}' is "
+                            "not in service/faults.py TRANSPORTS — an "
+                            "unregistered choke point is unreachable "
+                            "from any GUBER_FAULT_SPEC plan")
+
+    # --------------------------------------------------- debug sections
+
+    def _check_debug_sections(self, repo: RepoIndex) -> Iterable[Finding]:
+        isf = repo.get(INTROSPECT)
+        tsf = repo.get(SCHEMA_TEST)
+        if isf is None or tsf is None \
+                or isf.tree is None or tsf.tree is None:
+            return
+        fn = next((n for n in ast.walk(isf.tree)
+                   if isinstance(n, ast.FunctionDef)
+                   and n.name == "debug_vars"), None)
+        if fn is None:
+            return
+        emitted = _toplevel_sections(fn)
+
+        declared: Dict[str, int] = {}
+        for node in ast.walk(tsf.tree):
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id in ("ALWAYS", "OPTIONAL")
+                            for t in node.targets) \
+                    and isinstance(node.value, ast.Set):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant):
+                        declared.setdefault(elt.value, node.lineno)
+        if not declared:
+            return
+        for name, line in sorted(emitted.items()):
+            if name not in declared:
+                yield Finding(
+                    self.id, INTROSPECT, line,
+                    f"/v1/debug/vars section '{name}' is emitted by "
+                    f"debug_vars() but not declared in {SCHEMA_TEST} "
+                    "ALWAYS/OPTIONAL — the schema contract no longer "
+                    "covers it")
+        for name, line in sorted(declared.items()):
+            if name not in emitted:
+                yield Finding(
+                    self.id, SCHEMA_TEST, line,
+                    f"/v1/debug/vars section '{name}' is declared in "
+                    f"ALWAYS/OPTIONAL but debug_vars() never emits it — "
+                    "a stale schema promise")
+
+
+def _toplevel_sections(fn: ast.FunctionDef) -> Dict[str, int]:
+    """Top-level /v1/debug/vars section names debug_vars() can emit:
+    keys of the `out`/`out: dict` initializer literal plus every
+    `out["name"] = ...` assignment. Nested dict literals (per-peer
+    entries etc.) are not sections and are not collected."""
+    sections: Dict[str, int] = {}
+    for node in ast.walk(fn):
+        init = None
+        if isinstance(node, ast.AnnAssign) \
+                and isinstance(node.target, ast.Name) \
+                and node.target.id == "out":
+            init = node.value
+        elif isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name) and t.id == "out"
+                        for t in node.targets):
+            init = node.value
+        if isinstance(init, ast.Dict):
+            for key in init.keys:
+                if isinstance(key, ast.Constant) \
+                        and isinstance(key.value, str):
+                    sections.setdefault(key.value, key.lineno)
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "out" \
+                        and isinstance(tgt.slice, ast.Constant) \
+                        and isinstance(tgt.slice.value, str):
+                    sections.setdefault(tgt.slice.value, tgt.lineno)
+    return sections

@@ -158,6 +158,8 @@ class DevDirEngine(Engine):
 
     def _apply_round(self, round_work, now_ms, responses,
                      skip_store: bool = False, resolved=None) -> None:
+        """Probe/retry dispatch of one window. Caller holds the engine
+        lock (fps/touch/state are donated and rebound each step)."""
         import time as _time
 
         stage = self.stats.stage_ns

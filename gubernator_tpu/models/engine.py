@@ -313,7 +313,8 @@ class Engine:
         """Dispatch one wide-format i64[9, W] window, shipping it lean
         (4 B/lane — the hits==1, few-configs serving shape) when eligible,
         compact (20 B/lane) otherwise, wide as the last resort. Returns an
-        opaque handle for _fetch_staged."""
+        opaque handle for _fetch_staged. Caller holds the engine lock
+        (self.state is donated and rebound here)."""
         ht = self.hot_tracker
         if ht is not None:
             # the staged rows are already host numpy: two bulk adds per
@@ -341,7 +342,7 @@ class Engine:
     def _dispatch_scan_staged(self, stacked: np.ndarray, now_ms):
         """decide_scan dispatch of a wide i64[K, 9, W] stack, shipped
         lean/compact when eligible. Handle contract matches
-        _dispatch_staged."""
+        _dispatch_staged. Caller holds the engine lock."""
         ht = self.hot_tracker
         if ht is not None:
             ht.feed_slots(stacked[:, 0, :], stacked[:, 1, :])
@@ -1493,7 +1494,8 @@ class Engine:
         """One window, one dispatch. `skip_store` marks a tail singleton
         inside _apply_windows_scanned, whose batched read/write-through
         already covers these keys; `resolved` carries that pass's
-        (slots, fresh) so no re-lookup clears a fresh flag."""
+        (slots, fresh) so no re-lookup clears a fresh flag. Caller holds
+        the engine lock."""
         stage = self.stats.stage_ns
         n = len(round_work)
         t = time.perf_counter_ns()
@@ -1541,7 +1543,7 @@ class Engine:
 
     def _store_read_through(self, round_work, keys, slots, fresh, now_ms):
         """Consult the store for rows the table can't serve
-        (reference: algorithms.go:26-33)."""
+        (reference: algorithms.go:26-33). Caller holds the engine lock."""
         slot_arr = jnp.asarray(slots, I32)
         algo_c, _, _, _, _, exp_c, _ = (np.asarray(c) for c in
                                         self._gather(self.state, slot_arr))
@@ -1588,7 +1590,8 @@ class Engine:
 
     def _store_write_through(self, round_work, keys, slots, now_ms):
         """Report post-decision rows (reference: algorithms.go:64-68,175-177);
-        discarded buckets get `remove` (reference: algorithms.go:37-39,57-59)."""
+        discarded buckets get `remove` (reference: algorithms.go:37-39,57-59).
+        Caller holds the engine lock."""
         slot_arr = jnp.asarray(slots, I32)
         cols = [np.asarray(c) for c in self._gather(self.state, slot_arr)]
         for j, (i, r, _ge, _gi) in enumerate(round_work):

@@ -748,6 +748,7 @@ class NativeKeyDirectory:
 
 def make_key_directory(capacity: int, prefer_native: bool = True):
     """Factory: native directory when buildable, python fallback otherwise."""
+    # guberlint: disable=knob-drift -- dev/bench escape: forces the python fallback without a config cycle; not an operator surface
     if prefer_native and not os.environ.get("GUBER_NO_NATIVE"):
         try:
             return NativeKeyDirectory(capacity)

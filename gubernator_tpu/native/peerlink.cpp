@@ -876,7 +876,7 @@ void close_conn(Server* s, Conn* c) {
 
 void arm(Server* s, Conn* c) {
   epoll_event ev{};
-  ev.events = EPOLLIN | (c->want_write ? EPOLLOUT : 0);
+  ev.events = EPOLLIN | (c->want_write ? (uint32_t)EPOLLOUT : 0u);
   ev.data.u64 = c->token;
   epoll_ctl(s->epoll_fd, EPOLL_CTL_MOD, c->fd, &ev);
 }
@@ -993,6 +993,7 @@ void h2_emit_data(Conn* c, uint32_t sid, const std::string& payload,
 // send() per connection. Caller holds s->mu.
 void h2_append_response(Server* s, Conn* c, uint32_t sid,
                         const std::string& pb, std::string* acc) {
+  (void)s;
   std::string hb = h2_resp_headers_block();
   h2_frame_hdr(acc, (uint32_t)hb.size(), H2_HEADERS, H2F_END_HEADERS, sid);
   *acc += hb;
@@ -1028,6 +1029,7 @@ void h2_append_response(Server* s, Conn* c, uint32_t sid,
 // Drain blocked responses as far as the current windows allow. Caller
 // holds s->mu; emitted bytes append to *out.
 void h2_flush_blocked(Server* s, Conn* c, std::string* out) {
+  (void)s;
   for (auto it = c->blocked.begin(); it != c->blocked.end();) {
     if (c->send_window <= 0) break;
     const size_t rem = it->payload.size() - it->off;

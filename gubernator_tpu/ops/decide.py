@@ -714,6 +714,7 @@ def staging_policy() -> str:
     (e.g. to rule the switch out while debugging)."""
     import os
 
+    # guberlint: disable=knob-drift -- kernel-debug pin read at engine build, before a DaemonConfig exists; not an operator surface
     s = os.environ.get("GUBER_STAGING", "auto")
     if s not in ("auto", "wide"):
         raise ValueError(

@@ -27,14 +27,20 @@ sys.path.insert(0, ROOT)
 NATIVE = os.path.join(ROOT, "gubernator_tpu", "native")
 PYINC = f"-I{sysconfig.get_paths()['include']}"
 
+# warnings are errors for the native tier: the sources must stay clean
+# under the same -Wall -Wextra sweep guberlint's native-warnings rule
+# runs (gubernator_tpu/analysis/rules/native.py) — keep both flag sets
+# in lockstep
+WARN = ["-Wall", "-Wextra", "-Werror"]
+
 # (source, cache prefix, extra flags) for each build flavor
 BUILDS = [
-    ("keydir.cpp", "_keydir_", ["-O2", PYINC]),
-    ("peerlink.cpp", "_peerlink_", ["-O2"]),
+    ("keydir.cpp", "_keydir_", [*WARN, "-O2", PYINC]),
+    ("peerlink.cpp", "_peerlink_", [*WARN, "-O2"]),
     ("keydir.cpp", "_tsan_keydir_",
-     ["-O1", "-g", "-fsanitize=thread", "-pthread", PYINC]),
+     [*WARN, "-O1", "-g", "-fsanitize=thread", "-pthread", PYINC]),
     ("peerlink.cpp", "_tsan_peerlink_",
-     ["-O1", "-g", "-fsanitize=thread", "-pthread"]),
+     [*WARN, "-O1", "-g", "-fsanitize=thread", "-pthread"]),
 ]
 
 

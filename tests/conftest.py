@@ -68,6 +68,11 @@ with open(_sentinel, "w") as _f:
 _jax.config.update("jax_compilation_cache_dir", _cache_dir)
 _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+# tests/lint_corpus/ holds miniature FAKE repos for the guberlint golden
+# tests (test_lint_corpus.py) — some deliberately mirror real test-file
+# names (test_debug_schema.py), so pytest must never collect in there
+collect_ignore = ["lint_corpus"]
+
 
 # ---------------------------------------------------------------------------
 # Exit watchdog: the suite's RESULT is what matters; interpreter teardown is

@@ -1,0 +1,28 @@
+"""Corpus: blocking calls under a lock scope, plus the exemptions."""
+
+import threading
+import time
+
+_lock = threading.Lock()
+
+
+def bad():
+    with _lock:
+        time.sleep(0.1)  # VIOLATION: blocking under the lock
+
+
+def waived():
+    with _lock:
+        time.sleep(0.1)  # guberlint: disable=blocking-under-lock -- corpus: proves the inline waiver suppresses
+
+
+def deferred_ok():
+    with _lock:
+        def later():
+            time.sleep(0.1)  # ok: definition is not execution
+        return later
+
+
+def io_lock_ok(sock, wlock):
+    with wlock:
+        sock.sendall(b"x")  # ok: IO locks exist to serialize socket writes

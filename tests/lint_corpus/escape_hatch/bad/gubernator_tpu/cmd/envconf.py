@@ -1,0 +1,6 @@
+"""Corpus envconf: two fake hatches (finding anchor sites)."""
+
+import os
+
+HATCH = os.environ.get("GUBER_CORPUS_HATCH", "")
+GHOST = os.environ.get("GUBER_CORPUS_GHOST", "")

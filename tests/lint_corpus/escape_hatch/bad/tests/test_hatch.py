@@ -1,0 +1,10 @@
+"""Corpus: references corpus_hatch but never proves equivalence (no
+marker word from the rule's vocabulary may appear in this file).
+
+(The second fake hatch must not be named anywhere under this root's
+tests/ — its finding is the has-no-test-at-all variant.)
+"""
+
+
+def test_toggle():
+    assert "corpus_hatch"  # toggled, never proven equivalent

@@ -1,0 +1,7 @@
+"""Corpus: differential coverage for both fake hatches — this file
+references corpus_hatch and corpus_ghost and asserts the outputs are
+bit-identical with the hatch on and off."""
+
+
+def test_hatch_differential():
+    assert "corpus_hatch" and "corpus_ghost"

@@ -1,0 +1,6 @@
+"""Corpus envconf: untested hatches, waived at their anchor sites."""
+
+import os
+
+HATCH = os.environ.get("GUBER_CORPUS_HATCH", "")  # guberlint: disable=escape-hatch -- corpus: equivalence proven out-of-tree
+GHOST = os.environ.get("GUBER_CORPUS_GHOST", "")  # guberlint: disable=escape-hatch -- corpus: second hatch, same waiver path

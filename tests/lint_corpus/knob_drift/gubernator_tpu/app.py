@@ -1,0 +1,8 @@
+"""Corpus code reads: one covered knob, one orphan, one waived."""
+
+import os
+
+GOOD = os.environ.get("GUBER_GOOD")  # in envconf + conf + docs: ok
+ORPHAN = os.environ.get("GUBER_ORPHAN")  # VIOLATION: nowhere else
+# guberlint: disable=knob-drift -- corpus: dev-only import-time switch, proves the waiver suppresses
+SECRET = os.environ.get("GUBER_SECRET_DEV")

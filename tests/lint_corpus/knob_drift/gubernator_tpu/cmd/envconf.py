@@ -1,0 +1,5 @@
+"""Corpus envconf: resolves GUBER_GOOD and nothing else."""
+
+import os
+
+GOOD = os.environ.get("GUBER_GOOD", "1")

@@ -1,0 +1,33 @@
+"""Corpus: one unlocked donated-array read, one waived, several OK."""
+
+import threading
+
+
+def _array(n):
+    return list(range(n))
+
+
+class Engine:
+    """A donated-array holder: `self.state` is assigned from a call."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = _array(8)  # construction scope: ok
+
+    def snapshot_locked(self):
+        return self.state[:]  # `_locked` suffix declares the contract: ok
+
+    def apply(self):
+        """Rebind under the lock. Caller holds the engine lock."""
+        return self.state[:]  # docstring declares the contract: ok
+
+    def good(self):
+        with self._lock:
+            return self.state[:]  # inside the lock scope: ok
+
+    def bad(self):
+        return self.state[:]  # VIOLATION: unlocked donated read
+
+    def waived(self):
+        # guberlint: disable=lock-discipline -- corpus: proves the inline waiver suppresses
+        return self.state[:]

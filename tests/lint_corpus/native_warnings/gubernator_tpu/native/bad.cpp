@@ -1,0 +1,1 @@
+int corpus_bad(int unused_arg) { return 7; }

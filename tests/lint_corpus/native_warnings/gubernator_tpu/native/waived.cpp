@@ -1,0 +1,2 @@
+// guberlint: disable=native-warnings -- corpus: proves the C++ waiver comment suppresses
+int corpus_waived(int unused_arg) { return 9; }

@@ -1,0 +1,15 @@
+"""Corpus: flight-recorder emits and fault choke points."""
+
+from gubernator_tpu.service import faults
+
+
+def emit(kind, **fields):
+    del kind, fields
+
+
+def serve(peer):
+    emit("widget.stop")  # documented in the table: ok
+    emit("widget.spin")  # VIOLATION: missing from the doc table
+    emit("widget.secret")  # guberlint: disable=registry-drift -- corpus: proves the inline waiver suppresses
+    faults.on_call(peer, "grpc")  # registered transport: ok
+    faults.on_call(peer, "carrier")  # VIOLATION: not in TRANSPORTS

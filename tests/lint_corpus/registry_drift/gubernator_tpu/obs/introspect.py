@@ -1,0 +1,7 @@
+"""Corpus debug plane: the `extra` section is not in the schema test."""
+
+
+def debug_vars(engine):
+    out = {"engine": repr(engine)}
+    out["extra"] = 1  # VIOLATION: not declared in ALWAYS/OPTIONAL
+    return out

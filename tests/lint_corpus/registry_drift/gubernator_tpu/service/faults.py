@@ -1,0 +1,7 @@
+"""Corpus fault registry: ghostlink is registered but undocumented."""
+
+TRANSPORTS = ("grpc", "ghostlink")
+
+
+def on_call(peer, transport):
+    del peer, transport

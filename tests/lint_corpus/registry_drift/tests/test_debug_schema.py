@@ -1,0 +1,4 @@
+"""Corpus schema contract: `ghost` is promised but never emitted."""
+
+ALWAYS = {"engine"}
+OPTIONAL = {"ghost"}

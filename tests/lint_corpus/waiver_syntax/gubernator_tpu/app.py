@@ -1,0 +1,5 @@
+"""Corpus: malformed waivers are findings, not silent no-ops."""
+
+X = 1  # guberlint: disable=knob-drift
+# guberlint: disable
+Y = 2

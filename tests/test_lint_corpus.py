@@ -1,0 +1,185 @@
+"""Golden-violation corpus for guberlint (tests/lint_corpus/).
+
+Each corpus subdirectory is a miniature fake repo holding one deliberate
+violation per rule plus a waived twin. These tests prove the two halves
+of the analyzer's contract: every rule FIRES on the bug class it was
+built for, and every waiver SUPPRESSES with its justification intact —
+so a refactor that silently lobotomizes a rule (or breaks waiver
+parsing) fails here even while the real tree stays green.
+
+pytest never collects inside lint_corpus/ (conftest collect_ignore: the
+fake repos deliberately mirror real file names like
+tests/test_debug_schema.py), and the real repo scan prunes the directory
+(RepoIndex.walk), so the corpus findings can never leak into the
+zero-findings gate in test_lint.py.
+"""
+
+import os
+import shutil
+
+import pytest
+
+from gubernator_tpu.analysis import core
+from gubernator_tpu.analysis.rules.hatches import EscapeHatchRule
+
+CORPUS = os.path.join(os.path.dirname(__file__), "lint_corpus")
+
+
+def _run(name, rule_id):
+    root = os.path.join(CORPUS, name)
+    assert os.path.isdir(root), f"corpus root missing: {root}"
+    return core.run(root, only=[rule_id])
+
+
+def _justified(suppressed):
+    return all(w.justification.strip() for _, w in suppressed)
+
+
+def test_lock_discipline_fires_and_waives():
+    findings, suppressed = _run("lock_discipline", "lock-discipline")
+    assert len(findings) == 1, [f.render() for f in findings]
+    f = findings[0]
+    assert f.rule == "lock-discipline"
+    assert f.path.endswith("models/bad.py")
+    assert "outside a lock scope" in f.message
+    # inline waiver (1) + file-scoped waiver covering two reads (2)
+    assert len(suppressed) == 3
+    assert _justified(suppressed)
+
+
+def test_blocking_under_lock_fires_and_waives():
+    findings, suppressed = _run("blocking_under_lock", "blocking-under-lock")
+    assert len(findings) == 1, [f.render() for f in findings]
+    f = findings[0]
+    assert f.rule == "blocking-under-lock"
+    assert "time.sleep" in f.message
+    # the deferred closure and the IO-lock sendall must NOT have fired
+    assert len(suppressed) == 1
+    assert _justified(suppressed)
+
+
+def test_knob_drift_fires_and_waives():
+    findings, suppressed = _run("knob_drift", "knob-drift")
+    assert len(findings) == 2, [f.render() for f in findings]
+    by_knob = {f.message.split()[0]: f for f in findings}
+    assert set(by_knob) == {"GUBER_ORPHAN", "GUBER_DEAD"}
+    orphan = by_knob["GUBER_ORPHAN"]
+    assert "cmd/envconf.py" in orphan.message
+    assert "example.conf" in orphan.message
+    assert "docs/" in orphan.message
+    dead = by_knob["GUBER_DEAD"]
+    assert dead.path == "example.conf"
+    assert "no code" in dead.message
+    # GUBER_SECRET_DEV: waived at its read site
+    assert len(suppressed) == 1
+    assert suppressed[0][0].message.startswith("GUBER_SECRET_DEV")
+    assert _justified(suppressed)
+
+
+# --------------------------------------------------------- escape hatch
+
+class _CorpusHatchRule(EscapeHatchRule):
+    """Same rule logic, pointed at fake hatches the corpus defines (the
+    real HATCHES table would drag the whole repo's tests into scope)."""
+
+    hatches = (
+        ("GUBER_CORPUS_HATCH", ("corpus_hatch",)),
+        ("GUBER_CORPUS_GHOST", ("corpus_ghost",)),
+    )
+
+
+def _run_hatch(sub):
+    """core.run() only knows registered rules; replicate its waiver
+    filtering for the unregistered corpus subclass."""
+    repo = core.RepoIndex(os.path.join(CORPUS, "escape_hatch", sub))
+    findings, suppressed = [], []
+    for f in _CorpusHatchRule().check(repo):
+        sf = repo.get(f.path)
+        w = sf.waived(f.rule, f.line) if sf is not None else None
+        if w is not None:
+            suppressed.append((f, w))
+        else:
+            findings.append(f)
+    return findings, suppressed
+
+
+def test_escape_hatch_fires_on_missing_and_unmarked_tests():
+    findings, suppressed = _run_hatch("bad")
+    assert not suppressed
+    msgs = sorted(f.message for f in findings)
+    assert len(msgs) == 2, msgs
+    # GUBER_CORPUS_GHOST: no test references it at all
+    assert "GUBER_CORPUS_GHOST has no test" in msgs[0]
+    # GUBER_CORPUS_HATCH: referenced, but no differential marker
+    assert "GUBER_CORPUS_HATCH is referenced" in msgs[1]
+    assert "differential marker" in msgs[1]
+    # findings anchor at the envconf parse site
+    assert all(f.path.endswith("cmd/envconf.py") for f in findings)
+
+
+def test_escape_hatch_clean_with_differential_marker():
+    findings, suppressed = _run_hatch("good")
+    assert not findings, [f.render() for f in findings]
+    assert not suppressed
+
+
+def test_escape_hatch_waived_at_anchor():
+    findings, suppressed = _run_hatch("waived")
+    assert not findings, [f.render() for f in findings]
+    assert len(suppressed) == 2
+    assert _justified(suppressed)
+
+
+def test_registry_drift_fires_on_all_three_registries():
+    findings, suppressed = _run("registry_drift", "registry-drift")
+    msgs = [f.render() for f in findings]
+    assert len(findings) == 5, msgs
+
+    def one(substr):
+        hits = [f for f in findings if substr in f.message]
+        assert len(hits) == 1, (substr, msgs)
+        return hits[0]
+
+    spin = one("'widget.spin' is emitted but missing")
+    assert spin.path.endswith("gubernator_tpu/app.py")
+    ghostlink = one("'ghostlink' is registered in TRANSPORTS")
+    assert ghostlink.path.endswith("service/faults.py")
+    carrier = one("'carrier' is not in service/faults.py TRANSPORTS")
+    assert carrier.path.endswith("gubernator_tpu/app.py")
+    extra = one("'extra' is emitted by debug_vars()")
+    assert extra.path.endswith("obs/introspect.py")
+    ghost = one("'ghost' is declared in")
+    assert ghost.path.endswith("tests/test_debug_schema.py")
+    # the documented-and-emitted pair (widget.stop, engine, grpc) is clean
+    assert not any("widget.stop" in m or "'engine'" in m or "'grpc'" in m
+                   for m in msgs)
+    # emit("widget.secret") carries an inline waiver
+    assert len(suppressed) == 1
+    assert "widget.secret" in suppressed[0][0].message
+    assert _justified(suppressed)
+
+
+@pytest.mark.skipif(shutil.which("g++") is None,
+                    reason="native-warnings rule self-skips without g++")
+def test_native_warnings_fires_and_waives():
+    findings, suppressed = _run("native_warnings", "native-warnings")
+    assert len(findings) == 1, [f.render() for f in findings]
+    f = findings[0]
+    assert f.rule == "native-warnings"
+    assert f.path.endswith("native/bad.cpp")
+    assert "unused" in f.message  # -Wunused-parameter under -Wextra
+    # waived.cpp has the same warning behind a `//` waiver comment
+    assert len(suppressed) == 1
+    assert suppressed[0][0].path.endswith("native/waived.cpp")
+    assert _justified(suppressed)
+
+
+def test_malformed_waivers_are_findings():
+    # run any file-loading rule; waiver-syntax findings surface regardless
+    findings, suppressed = _run("waiver_syntax", "knob-drift")
+    assert not suppressed
+    msgs = sorted(f.message for f in findings)
+    assert len(msgs) == 2, msgs
+    assert all(f.rule == "waiver-syntax" for f in findings)
+    assert "without a justification" in msgs[0]
+    assert "unparseable guberlint waiver" in msgs[1]

@@ -68,6 +68,29 @@ def _find_libtsan():
 
 LIBTSAN = _find_libtsan()
 
+
+def _libtsan_gcc_major() -> int:
+    """gcc major version of the discovered libtsan (its parent directory
+    on the /usr/lib/gcc/<triple>/<ver>/ layout), 0 when unknown."""
+    if LIBTSAN is None:
+        return 0
+    try:
+        return int(os.path.basename(os.path.dirname(LIBTSAN)).split(".")[0])
+    except ValueError:
+        return 0
+
+
+# gcc-10's libtsan runtime misreports the peerlink stop path: its race
+# report shows BOTH stacks (the pls_stop flag write and the CV-wait
+# predicate read in pls_next_batch) already holding the same mutex M
+# ("(mutexes: write M122)" on each side), plus a bogus "double lock of a
+# mutex" on the same run — i.e. the runtime's lock tracking, not the
+# code, is wrong. gcc-11+ libtsan analyzes the identical binary clean.
+_OLD_LIBTSAN = pytest.mark.skipif(
+    0 < _libtsan_gcc_major() < 11,
+    reason="gcc-10 libtsan false positive: stop-path report shows both "
+           "threads holding the same mutex (fixed in gcc-11 libtsan)")
+
 _PEERLINK_STRESS = textwrap.dedent("""
     import ctypes, socket, struct, sys, threading, time
     lib = ctypes.CDLL(sys.argv[1])
@@ -331,8 +354,9 @@ _GRPC_FRONT_FUZZ = textwrap.dedent("""
 
 @pytest.mark.skipif(LIBTSAN is None, reason="libtsan not installed")
 @pytest.mark.parametrize("name,src,prefix,extra,script,sentinel", [
-    ("peerlink", "peerlink.cpp", "_tsan_peerlink_", (),
-     _PEERLINK_STRESS, "PEERLINK_STRESS_OK"),
+    pytest.param("peerlink", "peerlink.cpp", "_tsan_peerlink_", (),
+                 _PEERLINK_STRESS, "PEERLINK_STRESS_OK",
+                 marks=_OLD_LIBTSAN),
     ("keydir", "keydir.cpp", "_tsan_keydir_",
      ("-I" + __import__("sysconfig").get_paths()["include"],),
      _KEYDIR_STRESS, "KEYDIR_STRESS_OK"),
