@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast lint bench bench-skew bench-wire bench-reshard bench-suite bench-check capacity-report soak chaos proto docker clean native
+.PHONY: test test-fast lint bench bench-skew bench-wire bench-reshard bench-suite bench-check capacity-report profile-report soak chaos proto docker clean native
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -47,6 +47,12 @@ bench-check:
 # (docs/OPERATIONS.md "Capacity planning"); ADDR defaults to 127.0.0.1:80
 capacity-report:
 	python scripts/capacity_report.py $(ADDR)
+
+# serving-cycle decomposition, lock-wait sites and kernel cost table
+# from a running node's /v1/debug/{profile,kernels} endpoints
+# (docs/OPERATIONS.md "Performance triage"); ADDR defaults to 127.0.0.1:80
+profile-report:
+	python scripts/profile_report.py $(ADDR)
 
 # 30s fault-injection soak: kill/restart chaos under load, invariant-judged
 soak:

@@ -371,6 +371,109 @@ def _cartography_bench(n_calls: int = 1200, batch: int = 64,
         inst.close()
 
 
+def _profile_bench(n_calls: int = 1500, batch: int = 64, reps: int = 3) -> dict:
+    """Profiling-plane overhead on the serving path: the SAME single-node
+    Instance serving identical batch streams with the serving-cycle
+    profiler enabled vs the GUBER_PROFILE=0 hatch (which turns every
+    observe()/lock_wait() into one attribute test before the clock is
+    even read). The flag alternates every CHUNK calls within one pass,
+    same drift-regime rationale as _obs_bench. Budget <= 2%; target 0.5%
+    — the profiler is ~10 perf_counter_ns reads + histogram increments
+    per engine window group, amortized over a whole batch.
+
+    A directly-timed per-observe cost and the /v1/debug/profile body
+    render time ride along informationally."""
+    from gubernator_tpu.models.engine import Engine
+    from gubernator_tpu.service.config import InstanceConfig
+    from gubernator_tpu.service.instance import Instance
+    from gubernator_tpu.types import PeerInfo, RateLimitReq
+
+    inst = Instance(InstanceConfig(backend=Engine(capacity=262_144)),
+                    advertise_address="127.0.0.1:1")
+    inst.set_peers([PeerInfo(address="127.0.0.1:1")])  # self-owned: no RPC
+    prof = inst.profiler
+    frames = [
+        [RateLimitReq(name="profbench", unique_key=f"k{(i * batch + j) % 4096}",
+                      hits=1, limit=1 << 30, duration=3_600_000)
+         for j in range(batch)]
+        for i in range(n_calls)
+    ]
+    try:
+        for f in frames[:100]:  # compile + warm the width bucket
+            inst.get_rate_limits(f)
+
+        import gc
+        import statistics
+
+        CHUNK = 25
+        elapsed = {True: 0.0, False: 0.0}
+        calls = {True: 0, False: 0}
+        pair_overheads = []  # median over adjacent on/off pairs
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            for rep in range(reps):
+                i = 0
+                while i + 2 * CHUNK <= n_calls:
+                    first = len(pair_overheads) % 2 == 0
+                    rate = {}
+                    for enabled in (first, not first):
+                        prof.enabled = enabled
+                        chunk = frames[i:i + CHUNK]
+                        i += CHUNK
+                        t0 = time.perf_counter()
+                        for f in chunk:
+                            inst.get_rate_limits(f)
+                        dt = time.perf_counter() - t0
+                        elapsed[enabled] += dt
+                        calls[enabled] += CHUNK
+                        rate[enabled] = CHUNK * batch / dt
+                    pair_overheads.append(
+                        (rate[False] - rate[True]) / rate[False])
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        prof.enabled = True
+        on = calls[True] * batch / elapsed[True]
+        off = calls[False] * batch / elapsed[False]
+        overhead_pct = statistics.median(pair_overheads) * 100.0
+
+        # per-observe cost, timed directly (informational)
+        t0 = time.perf_counter()
+        N_OBS = 20_000
+        for j in range(N_OBS):
+            prof.observe("prep", 1000 + j)
+        observe_ns = (time.perf_counter() - t0) / N_OBS * 1e9
+        # endpoint render cost (off the serving path, but a dashboard
+        # polling it every second should know what it costs the node)
+        t0 = time.perf_counter()
+        for _ in range(50):
+            body = prof.endpoint_body()
+        endpoint_us = (time.perf_counter() - t0) / 50 * 1e6
+
+        return {
+            "profiler": {
+                "profiler_on_decisions_per_sec": round(on, 1),
+                "profiler_off_decisions_per_sec": round(off, 1),
+                # positive = the enabled profiler costs throughput;
+                # median over on/off chunk pairs, hiccup-robust.
+                # budget <= 2%, target 0.5%
+                "overhead_pct": round(overhead_pct, 2),
+                "observe_ns": round(observe_ns, 1),
+                "endpoint_body_us": round(endpoint_us, 1),
+                "phases_observed": sorted(
+                    p for p, t in prof.totals().items() if t["n"]),
+                "lock_sites": sorted(body["lock_sites"]),
+                "chunk_pairs": len(pair_overheads),
+                "reps": reps,
+                "batch": batch,
+                "calls_per_rep": n_calls,
+            }
+        }
+    finally:
+        inst.close()
+
+
 def _product_combiner_bench(eng, threads: int = 12, scan: int = 8,
                             subs_per_thread: int = 24) -> dict:
     """Serving throughput through the PRODUCT combiner path — not a
@@ -1440,8 +1543,18 @@ def main() -> None:
                 lns[d] = lane
             return iwk
 
+        # the live Profiler meters this offline loop too, so the emitted
+        # serving_decomposition below is the SAME derivation the
+        # /v1/debug/profile endpoint serves (obs/profile.py) — one source
+        # of truth, pinned by tests/test_profile_plane.py
+        from gubernator_tpu.obs.profile import Profiler, serving_decomposition
+        prof = Profiler(enabled=True)
+
         def drain(out2, buf, w, limit_col):
+            t0 = time.perf_counter_ns()
             packed = np.asarray(out2)  # the one readback fetch
+            prof.observe("readback", time.perf_counter_ns() - t0)
+            t0 = time.perf_counter_ns()
             for d in range(K_SERVE):  # demux scatter per window
                 lane = lanes[buf][d]
                 w0 = packed[d, 0]
@@ -1450,6 +1563,7 @@ def main() -> None:
                 re[lane] = w0 & 0x7FFFFFFF
                 rs[lane] = np.where(delta < 0, 0, (now + w) + delta)
                 li[lane] = limit_col
+            prof.observe("demux", time.perf_counter_ns() - t0)
             return packed
 
         limit_col = np.int64(1 << 30)
@@ -1520,10 +1634,16 @@ def main() -> None:
                 if istate.n_cfg != n_cfg0:  # new config pairs: re-ship 4 KB
                     cfg_dev = jnp.asarray(istate.cfg)
                     n_cfg0 = istate.n_cfg
+                dt = time.perf_counter() - t0
+                prof.observe("prep", int(dt * 1e9))
                 if prep_s is not None:
-                    prep_s.append(time.perf_counter() - t0)
+                    prep_s.append(dt)
+                t0 = time.perf_counter_ns()
                 state, out2 = step2(state, jnp.asarray(iw), cfg_dev, now + w)
+                prof.observe("dispatch", time.perf_counter_ns() - t0)
+                t0 = time.perf_counter_ns()
                 q.put((out2, c % N_BUF, w))
+                prof.observe("queue_wait", time.perf_counter_ns() - t0)
                 w += K_SERVE
             q.put(None)
             q.join()
@@ -1562,6 +1682,7 @@ def main() -> None:
         seg_rates = []
         seg_elapsed = []
         prep_s = []
+        totals_before = prof.totals()  # exclude warmup/probe cycles
         link_up, link_down = probe_link_MBps()  # same-run link weather
         for _seg in range(N_SEG):
             t0 = time.perf_counter()
@@ -1576,16 +1697,16 @@ def main() -> None:
         cycles = N_SEG * seg_cycles
         serving_elapsed = sum(seg_elapsed)  # measured, not back-computed
 
-        # Latency decomposition (VERDICT r3 item 8): split a serving cycle
-        # into host prep (measured), on-device kernel time (the kernel
-        # tier's completion-forced rate over the same scan body), and link
-        # transfer (the remainder; wire bytes are exact). On locally
-        # attached hardware the link term collapses to PCIe-class
-        # microseconds — see BENCH_SUITE.md "TPU-attached latency".
+        # Latency decomposition (VERDICT r3 item 8, re-derived r14): two
+        # Profiler totals() snapshots around the measured segments feed
+        # obs/profile.serving_decomposition() — the SAME arithmetic the
+        # live /v1/debug/profile endpoint uses, so offline and live
+        # numbers cannot drift apart. readback is measured in the drainer
+        # (device + link jointly on a tunnel rig; on attached hardware it
+        # collapses toward pure device time), link_s_est is the residual.
+        totals_after = prof.totals()
         dec_per_cycle = K_SERVE * BATCH_WIDTH
-        device_s = dec_per_cycle / max(decisions_per_sec, 1.0)
         host_s = float(np.mean(prep_s)) if prep_s else 0.0
-        cycle_s = serving_elapsed / cycles
         # Link-normalized figure (VERDICT r4 item 2): what the same-run
         # measured link bandwidth predicts for a link-bound pipeline at
         # 4 B/decision up + 8 B/decision down, capped by the measured
@@ -1619,17 +1740,19 @@ def main() -> None:
                 "down_after": round(link_down2, 2),
             },
             "link_normalized_decisions_per_sec": round(norm_rate, 1),
+            # the ~4 KB config table ships once per config change, not
+            # per cycle — excluded from the steady-state byte figures.
+            # derivation_version 2 = profiler-derived (bench_check only
+            # gates decomposition keys between same-version rounds).
             "serving_decomposition": {
-                "cycle_s": round(cycle_s, 4),
-                "host_prep_s": round(host_s, 4),
-                "device_s_est": round(device_s, 4),
-                "link_s_est": round(
-                    max(cycle_s - max(host_s, device_s), 0.0), 4),
-                # the ~4 KB config table ships once per config change,
-                # not per cycle — excluded from the steady-state figure
-                "upload_bytes_per_cycle": dec_per_cycle * 4,
-                "download_bytes_per_cycle": dec_per_cycle * 8,
-                "decisions_per_cycle": dec_per_cycle,
+                **{k: round(v, 4) if isinstance(v, float) else v
+                   for k, v in serving_decomposition(
+                       totals_before, totals_after, cycles,
+                       serving_elapsed,
+                       upload_bytes=dec_per_cycle * 4 * cycles,
+                       download_bytes=dec_per_cycle * 8 * cycles,
+                       decisions=dec_per_cycle * cycles).items()},
+                "derivation_version": 2,
             },
         }
 
@@ -1720,6 +1843,15 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — report, don't die
         carto_row = {"cartography": {"error": str(e)}}
 
+    # ---- profiling plane: serving-cycle profiler on vs GUBER_PROFILE=0 ----
+    # Single-node serving with the cycle profiler enabled vs the escape
+    # hatch on the same Instance; BENCH_r14 records the overhead
+    # (acceptance <= 2%, target 0.5%) plus per-observe and endpoint costs.
+    try:
+        profile_row = _profile_bench()
+    except Exception as e:  # noqa: BLE001 — report, don't die
+        profile_row = {"profiler": {"error": str(e)}}
+
     # trace-derived serving-stack phase split (never fails the bench)
     try:
         phases = phase_breakdown()
@@ -1740,6 +1872,7 @@ def main() -> None:
                 **reshard_row,
                 **obs_row,
                 **carto_row,
+                **profile_row,
                 **_multichip_section(),
                 "phase_breakdown_ms": phases,
                 "unit": UNIT,

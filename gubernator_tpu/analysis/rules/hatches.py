@@ -27,6 +27,7 @@ HATCHES: Sequence[Tuple[str, Tuple[str, ...]]] = (
     ("GUBER_RESHARD", ("reshard",)),
     ("GUBER_PIPELINE_DEPTH", ("pipeline_depth",)),
     ("GUBER_DEVICE_DIRECTORY", ("device_directory", "DevDirEngine")),
+    ("GUBER_PROFILE", ("profile_enabled",)),
 )
 
 DIFF_RE = re.compile(

@@ -16,7 +16,12 @@ Flight recorder table):
   to `faults.on_call(peer, "<t>")` must be a registered transport;
 - /v1/debug/vars sections: every section `obs/introspect.py` can emit
   must be declared in tests/test_debug_schema.py's ALWAYS/OPTIONAL sets
-  (the schema contract), and no declared section may be stale.
+  (the schema contract), and no declared section may be stale;
+- debug endpoints: every `/v1/debug/<name>` route the HTTP gateway
+  serves must have a row in docs/observability.md's "## Debug
+  endpoints" table, and every row must name a route the gateway still
+  dispatches (PR 13 motivation: /v1/debug/profile and /v1/debug/kernels
+  must not become the next undocumented surface).
 """
 
 from __future__ import annotations
@@ -31,6 +36,7 @@ OBS_DOC = "docs/observability.md"
 FAULTS = "gubernator_tpu/service/faults.py"
 INTROSPECT = "gubernator_tpu/obs/introspect.py"
 SCHEMA_TEST = "tests/test_debug_schema.py"
+GATEWAY = "gubernator_tpu/service/http_gateway.py"
 
 _EMIT_FNS = frozenset({"emit", "_emit", "_record"})
 
@@ -107,6 +113,7 @@ class RegistryDriftRule(Rule):
         yield from self._check_events(repo)
         yield from self._check_faults(repo)
         yield from self._check_debug_sections(repo)
+        yield from self._check_debug_endpoints(repo)
 
     # ---------------------------------------------------------- events
 
@@ -249,6 +256,60 @@ class RegistryDriftRule(Rule):
                     f"/v1/debug/vars section '{name}' is declared in "
                     f"ALWAYS/OPTIONAL but debug_vars() never emits it — "
                     "a stale schema promise")
+
+
+    # -------------------------------------------------- debug endpoints
+
+    def _check_debug_endpoints(self, repo: RepoIndex) -> Iterable[Finding]:
+        gsf = repo.get(GATEWAY)
+        if gsf is None or gsf.tree is None:
+            return
+        served: Dict[str, int] = {}
+        for node in ast.walk(gsf.tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and node.value.startswith("/v1/debug/") \
+                    and len(node.value) > len("/v1/debug/"):
+                served.setdefault(node.value, node.lineno)
+        documented = _documented_endpoints(repo)
+        if not served or not documented:
+            return  # corpus repo without the gateway or the doc table
+        for route, line in sorted(served.items()):
+            if route not in documented:
+                yield Finding(
+                    self.id, GATEWAY, line,
+                    f"debug endpoint '{route}' is served by the gateway "
+                    f"but missing from the {OBS_DOC} '## Debug endpoints' "
+                    "table — an undocumented endpoint is a surface "
+                    "operators never find")
+        for route, line in sorted(documented.items()):
+            if route not in served:
+                yield Finding(
+                    self.id, OBS_DOC, line,
+                    f"debug endpoint '{route}' is documented but the "
+                    "gateway never dispatches it — the runbook promises "
+                    "a surface that 404s")
+
+
+def _documented_endpoints(repo: RepoIndex) -> Dict[str, int]:
+    """Routes from the '## Debug endpoints' table's first column:
+    backticked `/v1/debug/<name>` paths (query-string examples after
+    `?` are ignored)."""
+    sf = repo.get(OBS_DOC)
+    out: Dict[str, int] = {}
+    if sf is None:
+        return out
+    in_section = False
+    for i, line in enumerate(sf.lines, 1):
+        if line.startswith("## "):
+            in_section = line.strip() == "## Debug endpoints"
+            continue
+        if not in_section or not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1]
+        for name in re.findall(r"`(/v1/debug/[a-z0-9_]+)", first_cell):
+            out.setdefault(name, i)
+    return out
 
 
 def _toplevel_sections(fn: ast.FunctionDef) -> Dict[str, int]:

@@ -11,6 +11,7 @@ kernel warmup before serving.
 from __future__ import annotations
 
 import logging
+import os
 import signal
 import sys
 import threading
@@ -339,6 +340,8 @@ def main(argv=None) -> int:
             keyspace_interval_s=conf.keyspace_interval_s,
             keyspace_top_k=conf.keyspace_top_k,
             capacity_horizon_s=conf.capacity_horizon_s,
+            profile_enabled=conf.profile_enabled,
+            profile_capture_s=conf.profile_capture_s,
             pipeline_depth=conf.pipeline_depth or None,  # 0 -> env/auto
             pipeline_scan=conf.pipeline_scan,
         ),
@@ -353,6 +356,22 @@ def main(argv=None) -> int:
         log.info("anomaly diagnostic bundles -> %s (keep %d, min %.0fs "
                  "apart)", conf.bundle_dir, conf.bundle_keep,
                  conf.bundle_interval_s)
+        # kernel recompile check: fingerprint the canonical decide
+        # programs and compare against the last boot's record — an HLO
+        # change (new jaxlib, flag drift, shape change) is exactly the
+        # event a profile regression investigation wants pinned in the
+        # flight recorder (obs/profile.py check_recompile)
+        fps_fn = getattr(backend, "kernel_fingerprints", None)
+        if callable(fps_fn):
+            from gubernator_tpu.obs.profile import check_recompile
+
+            rc = check_recompile(
+                fps_fn(),
+                os.path.join(conf.bundle_dir, "kernel_fingerprints.json"),
+                recorder=recorder)
+            if rc.get("changed"):
+                log.warning("kernel HLO fingerprints changed since last "
+                            "boot: %s", sorted(rc["changed"]))
     # background detector sweep; in-process/test clusters instead ride
     # the maybe_check() piggyback on health probes and metric scrapes
     instance.anomaly.start()
@@ -373,6 +392,11 @@ def main(argv=None) -> int:
                  conf.keyspace_top_k)
     else:
         log.info("keyspace scan OFF (GUBER_KEYSPACE_SCAN=0)")
+    if conf.profile_enabled:
+        log.info("serving-cycle profiler on: capture >=%.0fs apart "
+                 "(/v1/debug/profile)", conf.profile_capture_s)
+    else:
+        log.info("serving-cycle profiler OFF (GUBER_PROFILE=0)")
     columnar_pipe = (conf.columnar_pipeline and conf.pipeline_depth != 1
                      and getattr(backend, "supports_columnar",
                                  lambda: False)())

@@ -215,6 +215,13 @@ class DaemonConfig:
     keyspace_interval_s: float = 60.0
     keyspace_top_k: int = 20
     capacity_horizon_s: float = 1800.0
+    # continuous profiling plane (obs/profile.py): profile_enabled is the
+    # always-on serving-cycle meter (=0 is the escape hatch — every
+    # observation site degrades to one attribute test and the serving
+    # path is bit-identical to profiling removed); profile_capture_s
+    # rate-limits on-demand deep captures (/v1/debug/profile?capture=1)
+    profile_enabled: bool = True
+    profile_capture_s: float = 60.0
     # GLOBAL-sync collective implementation for the sharded backend:
     # "psum" (XLA, default) or "ring" (Pallas ICI ring — TPU-compiled only,
     # single-region meshes; see ops/ring.py)
@@ -371,6 +378,9 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         keyspace_interval_s=_env_dur("GUBER_KEYSPACE_INTERVAL", 60.0),
         keyspace_top_k=_env_int("GUBER_KEYSPACE_TOP_K", 20),
         capacity_horizon_s=_env_dur("GUBER_CAPACITY_HORIZON", 1800.0),
+        profile_enabled=_env_str("GUBER_PROFILE", "1") not in
+        ("0", "f", "false", "no", "off"),
+        profile_capture_s=_env_dur("GUBER_PROFILE_CAPTURE_S", 60.0),
         collectives=_env_str("GUBER_COLLECTIVES", "psum"),
         coordinator_address=_env_str("GUBER_COORDINATOR_ADDRESS"),
         num_hosts=_env_int("GUBER_NUM_HOSTS", 1),
@@ -467,6 +477,10 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
     if conf.capacity_horizon_s <= 0:
         raise ValueError(
             f"'GUBER_CAPACITY_HORIZON={conf.capacity_horizon_s}' is "
+            "invalid; must be a positive duration")
+    if conf.profile_capture_s <= 0:
+        raise ValueError(
+            f"'GUBER_PROFILE_CAPTURE_S={conf.profile_capture_s}' is "
             "invalid; must be a positive duration")
     if conf.fault_spec:
         # a typo'd chaos plan must fail the boot loudly, not inject nothing

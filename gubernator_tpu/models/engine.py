@@ -57,6 +57,7 @@ from gubernator_tpu.ops.decide import (
     widen_compact_out,
 )
 from gubernator_tpu.native import PREP_OVERCOMMIT
+from gubernator_tpu.obs.profile import Profiler
 from gubernator_tpu.store import BucketSnapshot, Loader, Store
 from gubernator_tpu.types import (
     SLOW_PATH_BEHAVIOR_MASK as _NATIVE_SINGLE_SLOW_MASK,
@@ -218,6 +219,11 @@ class Engine:
         # contract as metrics, so the staging dispatchers stay untouched
         # when the lease tier is disabled
         self.hot_tracker = None
+        # continuous cycle profiler (obs/profile.py): lock-wait, prep,
+        # dispatch, readback and demux streaming histograms feeding
+        # /v1/debug/profile. Always constructed; GUBER_PROFILE=0 turns
+        # every observation site into a single attribute test
+        self.profiler = Profiler()
         self._lock = threading.Lock()
         if donate is None:
             from gubernator_tpu.utils.platform import donation_supported
@@ -325,18 +331,39 @@ class Engine:
             if self._lean_ok:
                 ln = lean_window(packed, self.capacity)
                 if ln is not None:
-                    kernel_telemetry.note("packed_lean", w)
+                    lanes = jnp.asarray(ln[1])
+                    if kernel_telemetry.needs_probe("packed_lean", w):
+                        kernel_telemetry.offer_probe(
+                            "packed_lean", w, self._decide_packed_lean,
+                            (self.state, ln[0], lanes, now_ms))
+                    t = time.perf_counter_ns()
                     self.state, out = self._decide_packed_lean(
-                        self.state, ln[0], jnp.asarray(ln[1]), now_ms)
+                        self.state, ln[0], lanes, now_ms)
+                    kernel_telemetry.note(
+                        "packed_lean", w,
+                        dur_ns=time.perf_counter_ns() - t)
                     return out, now_ms
             c = compact_window(packed)
             if c is not None:
-                kernel_telemetry.note("packed_compact", w)
+                if kernel_telemetry.needs_probe("packed_compact", w):
+                    kernel_telemetry.offer_probe(
+                        "packed_compact", w, self._decide_packed_compact,
+                        (self.state, c, now_ms))
+                t = time.perf_counter_ns()
                 self.state, out = self._decide_packed_compact(
                     self.state, c, now_ms)
+                kernel_telemetry.note(
+                    "packed_compact", w,
+                    dur_ns=time.perf_counter_ns() - t)
                 return out, now_ms
-        kernel_telemetry.note("packed_wide", w)
+        if kernel_telemetry.needs_probe("packed_wide", w):
+            kernel_telemetry.offer_probe(
+                "packed_wide", w, self._decide_packed,
+                (self.state, packed, now_ms))
+        t = time.perf_counter_ns()
         self.state, out = self._decide_packed(self.state, packed, now_ms)
+        kernel_telemetry.note("packed_wide", w,
+                              dur_ns=time.perf_counter_ns() - t)
         return out, None
 
     def _dispatch_scan_staged(self, stacked: np.ndarray, now_ms):
@@ -351,18 +378,39 @@ class Engine:
             if self._lean_ok:
                 ln = lean_window(stacked, self.capacity)
                 if ln is not None:
-                    kernel_telemetry.note("scan_lean", w, depth=k)
+                    lanes = jnp.asarray(ln[1])
+                    if kernel_telemetry.needs_probe("scan_lean", w):
+                        kernel_telemetry.offer_probe(
+                            "scan_lean", w, self._decide_scan_lean,
+                            (self.state, ln[0], lanes, now_ms))
+                    t = time.perf_counter_ns()
                     self.state, out = self._decide_scan_lean(
-                        self.state, ln[0], jnp.asarray(ln[1]), now_ms)
+                        self.state, ln[0], lanes, now_ms)
+                    kernel_telemetry.note(
+                        "scan_lean", w, depth=k,
+                        dur_ns=time.perf_counter_ns() - t)
                     return out, now_ms
             c = compact_window(stacked)
             if c is not None:
-                kernel_telemetry.note("scan_compact", w, depth=k)
+                if kernel_telemetry.needs_probe("scan_compact", w):
+                    kernel_telemetry.offer_probe(
+                        "scan_compact", w, self._decide_scan_compact,
+                        (self.state, c, now_ms))
+                t = time.perf_counter_ns()
                 self.state, out = self._decide_scan_compact(
                     self.state, c, now_ms)
+                kernel_telemetry.note(
+                    "scan_compact", w, depth=k,
+                    dur_ns=time.perf_counter_ns() - t)
                 return out, now_ms
-        kernel_telemetry.note("scan_wide", w, depth=k)
+        if kernel_telemetry.needs_probe("scan_wide", w):
+            kernel_telemetry.offer_probe(
+                "scan_wide", w, self._decide_scan,
+                (self.state, stacked, now_ms))
+        t = time.perf_counter_ns()
         self.state, out = self._decide_scan(self.state, stacked, now_ms)
+        kernel_telemetry.note("scan_wide", w, depth=k,
+                              dur_ns=time.perf_counter_ns() - t)
         return out, None
 
     def _obs_device(self, ns: int, lanes: int) -> None:
@@ -378,6 +426,34 @@ class Engine:
         """Live key-table occupancy (the cache_size /
         engine_key_table_size gauge source)."""
         return len(self.directory)
+
+    def kernel_fingerprints(self) -> Dict[str, str]:
+        """HLO fingerprints of the canonical decision programs: the wide
+        per-window kernel and the depth-2 scan at min_width. Every
+        staging variant lowers from the same decide body, so any kernel
+        change — a jax/libtpu bump, a decide.py edit, an XLA flag drift
+        — shows here. Boot-time introspection only (cmd/daemon.py
+        compares across boots and emits profile.recompile on drift);
+        lowering traces but never compiles."""
+        from gubernator_tpu.obs.profile import hlo_fingerprint
+
+        with self._lock:
+            state_aval = jax.ShapeDtypeStruct(self.state.shape,
+                                              self.state.dtype)
+        w = self.min_width
+        out: Dict[str, str] = {}
+        try:
+            packed = jax.ShapeDtypeStruct((9, w), I64)
+            out[f"packed_wide@{w}"] = hlo_fingerprint(
+                self._decide_packed.lower(
+                    state_aval, packed, 0).as_text())
+            stacked = jax.ShapeDtypeStruct((2, 9, w), I64)
+            out[f"scan_wide@{w}"] = hlo_fingerprint(
+                self._decide_scan.lower(
+                    state_aval, stacked, 0).as_text())
+        except Exception:  # noqa: BLE001 — introspection must not break boot
+            pass
+        return out
 
     @staticmethod
     def _fetch_staged(handle) -> np.ndarray:
@@ -410,8 +486,13 @@ class Engine:
         t0 = time.perf_counter_ns()
         responses, rounds, n_errors = preprocess(requests, now_ms)
         prep_ns = time.perf_counter_ns() - t0  # excludes the lock wait below
+        prof = self.profiler
+        prof.observe("prep", prep_ns)
 
+        tq = time.perf_counter_ns() if prof.enabled else 0
         with self._lock:
+            if tq:
+                prof.lock_wait("slow_window", time.perf_counter_ns() - tq)
             self.stats.stage_ns["prep"] += prep_ns
             self.stats.requests += len(requests)
             self.stats.batches += 1 if count_batch else 0
@@ -443,8 +524,12 @@ class Engine:
         (nothing mutated)."""
         w = _bucket_width(len(requests), self.min_width, self.max_width)
         packed = np.zeros((9, w), np.int64)
+        prof = self.profiler
+        tq = time.perf_counter_ns() if prof.enabled else 0
         with self._lock:
             t0 = time.perf_counter_ns()  # excludes the lock wait
+            if tq:
+                prof.lock_wait("fast_window", t0 - tq)
             n0, lane_item, leftover, inject = self._prep_fast(
                 self.directory, requests, packed, _GREG_MASK)
             if n0 == PREP_OVERCOMMIT:
@@ -460,17 +545,21 @@ class Engine:
             stage = self.stats.stage_ns
             t1 = time.perf_counter_ns()
             stage["prep"] += t1 - t0
+            prof.observe("prep", t1 - t0)
             self.stats.requests += n0
             self.stats.batches += 1
             self._apply_inject_rows(inject)
             responses: List[Optional[RateLimitResp]] = [None] * len(requests)
             if n0:
                 self.stats.rounds += 1
-                out = self._fetch_staged(
-                    self._dispatch_staged(packed, now_ms))
+                staged = self._dispatch_staged(packed, now_ms)
+                td = time.perf_counter_ns()
+                out = self._fetch_staged(staged)
                 t2 = time.perf_counter_ns()
                 stage["device"] += t2 - t1
                 self._obs_device(t2 - t1, n0)
+                prof.observe("dispatch", td - t1)
+                prof.observe("readback", t2 - td)
                 status, limit, remaining, reset = out[:, :n0].tolist()
                 over = 0
                 for j, i in enumerate(lane_item.tolist()):
@@ -481,7 +570,9 @@ class Engine:
                         status=st, limit=limit[j], remaining=remaining[j],
                         reset_time=reset[j])
                 self.stats.over_limit += over
-                stage["demux"] += time.perf_counter_ns() - t2
+                demux_ns = time.perf_counter_ns() - t2
+                stage["demux"] += demux_ns
+                prof.observe("demux", demux_ns)
         if len(leftover):
             idxs = leftover.tolist()
             tail = self._slow_window(
@@ -555,11 +646,15 @@ class Engine:
         meta: List[Optional[tuple]] = [None] * k_req
         tails: List[Optional[list]] = [None] * k_req
         segments = []  # (staged, k_start, m, scanned) in launch order
+        prof = self.profiler
         k = 0
         while k < k_req:
             seg_start = k
+            tq = time.perf_counter_ns() if prof.enabled else 0
             with self._lock:
                 t0 = time.perf_counter_ns()  # excludes the lock wait
+                if tq:
+                    prof.lock_wait("launch_windows", t0 - tq)
                 total = 0
                 rounds = 0
                 cut = False
@@ -593,6 +688,7 @@ class Engine:
                 m = k - seg_start
                 t1 = time.perf_counter_ns()
                 self.stats.stage_ns["prep"] += t1 - t0
+                prof.observe("prep", t1 - t0)
                 self.stats.requests += total
                 self.stats.batches += m
                 self.stats.rounds += rounds
@@ -615,7 +711,9 @@ class Engine:
                         stack[m:, 0, :] = -1
                     staged = self._dispatch_scan_staged(stack, now_ms)
                     scanned = True
-                self.stats.stage_ns["device"] += time.perf_counter_ns() - t1
+                td = time.perf_counter_ns()
+                self.stats.stage_ns["device"] += td - t1
+                prof.observe("dispatch", td - t1)
             segments.append((staged, seg_start, m, scanned))
             # Leftover tails retire NOW — after this segment's dispatch,
             # before any later window preps — preserving per-key
@@ -675,6 +773,9 @@ class Engine:
                 results[k] = responses
         t2 = time.perf_counter_ns()
         self._obs_device(t_fetch, lanes)
+        prof = self.profiler
+        prof.observe("readback", t_fetch)
+        prof.observe("demux", t2 - t0 - t_fetch)
         with self._lock:  # concurrent completers: counters stay exact
             self.stats.over_limit += over
             self.stats.stage_ns["device"] += t_fetch
@@ -753,8 +854,12 @@ class Engine:
 
         w = _bucket_width(n, self.min_width, self.max_width)
         packed = np.zeros((9, w), np.int64)
+        prof = self.profiler
+        tq = time.perf_counter_ns() if prof.enabled else 0
         with self._lock:
-            t0 = time.perf_counter_ns()
+            t0 = time.perf_counter_ns()  # excludes the lock wait
+            if tq:
+                prof.lock_wait("submit_columnar", t0 - tq)
             n0, lane_item, leftover, inject = native.prep_pack_columnar(
                 self.directory, n, keys, key_off, name_len, hits, limit,
                 duration, algorithm, behavior, slow_mask, packed)
@@ -767,6 +872,7 @@ class Engine:
                 return None
             t1 = time.perf_counter_ns()
             self.stats.stage_ns["prep"] += t1 - t0
+            prof.observe("prep", t1 - t0)
             self.stats.requests += n0
             self.stats.batches += 1
             self._apply_inject_rows(inject)
@@ -774,8 +880,9 @@ class Engine:
             if n0:
                 self.stats.rounds += 1
                 handle = self._dispatch_staged(packed, now_ms)
-                self.stats.stage_ns["device"] += \
-                    time.perf_counter_ns() - t1
+                td = time.perf_counter_ns()
+                self.stats.stage_ns["device"] += td - t1
+                prof.observe("dispatch", td - t1)
         return (handle, lane_item, leftover, n0)
 
     def complete_columnar(self, handle, out_status, out_limit,
@@ -796,6 +903,9 @@ class Engine:
             over = int(np.count_nonzero(rows[0, :n0] == 1))
             t2 = time.perf_counter_ns()
             self._obs_device(t1 - t0, n0)
+            prof = self.profiler
+            prof.observe("readback", t1 - t0)
+            prof.observe("demux", t2 - t1)
             with self._lock:  # concurrent completers: counters stay exact
                 self.stats.over_limit += over
                 self.stats.stage_ns["device"] += t1 - t0
@@ -860,8 +970,12 @@ class Engine:
             buf.fill(0)  # the prep contract: zeroed staging rows
         metas: List[tuple] = []
         failed = None
+        prof = self.profiler
+        tq = time.perf_counter_ns() if prof.enabled else 0
         with self._lock:
             t0 = time.perf_counter_ns()  # excludes the lock wait
+            if tq:
+                prof.lock_wait("launch_columnar_windows", t0 - tq)
             total = 0
             rounds = 0
             for k, wc in enumerate(windows):
@@ -902,6 +1016,7 @@ class Engine:
             m = len(metas)
             t1 = time.perf_counter_ns()
             self.stats.stage_ns["prep"] += t1 - t0
+            prof.observe("prep", t1 - t0)
             self.stats.requests += total
             self.stats.batches += m
             self.stats.rounds += rounds
@@ -917,7 +1032,9 @@ class Engine:
                         stack[kk][0, :] = -1  # unprepped rows: all padding
                     staged = self._dispatch_scan_staged(stack, now_ms)
                     scanned = True
-                self.stats.stage_ns["device"] += time.perf_counter_ns() - t1
+                td = time.perf_counter_ns()
+                self.stats.stage_ns["device"] += td - t1
+                prof.observe("dispatch", td - t1)
         return (metas, failed, staged, scanned)
 
     def collect_columnar_windows(self, handle, outs):
@@ -950,6 +1067,9 @@ class Engine:
         t2 = time.perf_counter_ns()
         if lanes:
             self._obs_device(t1 - t0, lanes)
+        prof = self.profiler
+        prof.observe("readback", t1 - t0)
+        prof.observe("demux", t2 - t1)
         with self._lock:  # concurrent completers: counters stay exact
             self.stats.over_limit += over
             self.stats.stage_ns["device"] += t1 - t0
@@ -1451,6 +1571,7 @@ class Engine:
             k = _bucket_pow2(len(group))
             stacked = np.zeros((k, 9, width), np.int64)
             stacked[:, 0, :] = -1  # pad windows are all padding lanes
+            host_ns = 0
             for gi, wk in enumerate(group):
                 t = time.perf_counter_ns()
                 if union is not None:
@@ -1464,13 +1585,20 @@ class Engine:
                 t2 = time.perf_counter_ns()
                 stage["lookup"] += t2 - t
                 pack_window(wk, slots, fresh, width, out=stacked[gi])
-                stage["pack"] += time.perf_counter_ns() - t2
+                t3 = time.perf_counter_ns()
+                stage["pack"] += t3 - t2
+                host_ns += t3 - t
+            prof = self.profiler
+            prof.observe("prep", host_ns)
             t = time.perf_counter_ns()
-            out = self._fetch_staged(
-                self._dispatch_scan_staged(stacked, now_ms))
+            staged = self._dispatch_scan_staged(stacked, now_ms)
+            td = time.perf_counter_ns()
+            out = self._fetch_staged(staged)
             t2 = time.perf_counter_ns()
             stage["device"] += t2 - t
             self._obs_device(t2 - t, sum(len(w) for w in group))
+            prof.observe("dispatch", td - t)
+            prof.observe("readback", t2 - td)
             for gi, wk in enumerate(group):
                 n = len(wk)
                 status, limit, remaining, reset = out[gi, :, :n].tolist()
@@ -1481,7 +1609,9 @@ class Engine:
                     responses[i] = RateLimitResp(
                         status=st, limit=limit[j],
                         remaining=remaining[j], reset_time=reset[j])
-            stage["demux"] += time.perf_counter_ns() - t2
+            demux_ns = time.perf_counter_ns() - t2
+            stage["demux"] += demux_ns
+            prof.observe("demux", demux_ns)
         if union is not None:
             # one batched write-through with each key's FINAL post-tail row
             uwork, ukeys, uslots = union
@@ -1497,6 +1627,7 @@ class Engine:
         (slots, fresh) so no re-lookup clears a fresh flag. Caller holds
         the engine lock."""
         stage = self.stats.stage_ns
+        prof = self.profiler
         n = len(round_work)
         t = time.perf_counter_ns()
         keys = [item[1].hash_key() for item in round_work]
@@ -1505,7 +1636,8 @@ class Engine:
         else:
             slots, fresh, inj = self.directory.lookup_inject(keys)
             self._apply_inject_rows(inj)
-        stage["lookup"] += time.perf_counter_ns() - t
+        lookup_ns = time.perf_counter_ns() - t
+        stage["lookup"] += lookup_ns
 
         use_store = self.store is not None and not skip_store
         if use_store:
@@ -1520,10 +1652,16 @@ class Engine:
         packed = pack_window(round_work, slots, fresh, w)
         t2 = time.perf_counter_ns()
         stage["pack"] += t2 - t
-        out = self._fetch_staged(self._dispatch_staged(packed, now_ms))
+        # lookup + pack are host prep in the profiler's cycle taxonomy
+        prof.observe("prep", lookup_ns + (t2 - t))
+        staged = self._dispatch_staged(packed, now_ms)
+        td = time.perf_counter_ns()
+        out = self._fetch_staged(staged)
         t3 = time.perf_counter_ns()
         stage["device"] += t3 - t2
         self._obs_device(t3 - t2, n)
+        prof.observe("dispatch", td - t2)
+        prof.observe("readback", t3 - td)
 
         # one C-level tolist beats four per-element int() casts per lane
         status, limit, remaining, reset = out[:, :n].tolist()
@@ -1534,7 +1672,9 @@ class Engine:
             responses[i] = RateLimitResp(
                 status=st, limit=limit[j], remaining=remaining[j],
                 reset_time=reset[j])
-        stage["demux"] += time.perf_counter_ns() - t3
+        demux_ns = time.perf_counter_ns() - t3
+        stage["demux"] += demux_ns
+        prof.observe("demux", demux_ns)
 
         if use_store:
             t = time.perf_counter_ns()

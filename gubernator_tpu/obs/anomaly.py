@@ -28,6 +28,14 @@ Detectors:
                          `capacity_horizon_s`, with the table already past
                          its occupancy floor — eviction amnesty is coming
                          and the operator should reshard or tier first
+- ``profile_shift``      the serving-cycle decomposition (obs/profile.py)
+                         moved: some phase's share of serial cycle time
+                         over the fast window differs from its slow-window
+                         baseline by more than `profile_shift_threshold`
+                         absolute, with enough cycles in both windows to
+                         trust the shares — a recompile, lock convoy, or
+                         host-side regression changed WHERE time goes
+                         even if total latency still looks fine
 
 Burn/rate windows are served from the node's metrics history ring
 (obs/history.py): the engine holds only the previous sweep's snapshot
@@ -53,7 +61,7 @@ log = logging.getLogger("gubernator_tpu.anomaly")
 
 DETECTORS = ("deadline_burst", "shed_spike", "circuit_open",
              "stall_regression", "lease_fail_close", "slo_burn",
-             "capacity")
+             "capacity", "profile_shift")
 
 
 class AnomalyEngine:
@@ -73,11 +81,15 @@ class AnomalyEngine:
                  stall_rate: float = 50.0,
                  fail_close_rate: float = 5.0,
                  history: Optional[MetricsHistory] = None,
-                 capacity_horizon_s: float = 1800.0):
+                 capacity_horizon_s: float = 1800.0,
+                 profile_shift_threshold: float = 0.15,
+                 profile_min_cycles: float = 50.0):
         self.instance = instance
         self.metrics = metrics
         self.recorder = recorder
         self.capacity_horizon_s = float(capacity_horizon_s)
+        self.profile_shift_threshold = float(profile_shift_threshold)
+        self.profile_min_cycles = float(profile_min_cycles)
         self.interval_s = max(float(interval_s), 0.05)
         self.slo_target_ms = float(slo_target_ms)
         self.slo_objective = float(slo_objective)
@@ -218,6 +230,10 @@ class AnomalyEngine:
         if cap_detail:
             found["capacity"] = True
             detail["capacity"] = cap_detail
+        shift_detail = self._profile_shift_signal(cur, fast_old, slow_old)
+        if shift_detail:
+            found["profile_shift"] = True
+            detail["profile_shift"] = shift_detail
 
         self._apply(found, detail)
         return found
@@ -249,6 +265,52 @@ class AnomalyEngine:
                 f"({fill:.0%} full"
                 + (f", eviction pressure in ~{ttp:.0f}s"
                    if ttp is not None else "") + ")")
+
+    def _profile_shift_signal(self, cur: Dict[str, float],
+                              fast_old: Dict[str, float],
+                              slow_old: Dict[str, float]) -> str:
+        """Decomposition drift: "" when quiet, else the firing detail.
+        Compares each serial phase's share of serial cycle time over the
+        fast window against the slow-window baseline — both derived by
+        diffing the ring's cumulative profile_* columns, so the signal
+        costs attribute reads and never touches the profiler itself."""
+        try:
+            from gubernator_tpu.obs.profile import SERIAL_PHASES
+        except Exception:  # noqa: BLE001 — detection must not break
+            return ""
+        if "profile_cycles" not in cur:
+            return ""
+        recent_cycles = cur.get("profile_cycles", 0.0) \
+            - fast_old.get("profile_cycles", 0.0)
+        base_cycles = fast_old.get("profile_cycles", 0.0) \
+            - slow_old.get("profile_cycles", 0.0)
+        # traffic guard: shares over a handful of cycles are noise
+        if recent_cycles < self.profile_min_cycles \
+                or base_cycles < self.profile_min_cycles:
+            return ""
+
+        def shares(new, old):
+            deltas = {p: max(new.get(f"profile_{p}_s", 0.0)
+                             - old.get(f"profile_{p}_s", 0.0), 0.0)
+                      for p in SERIAL_PHASES}
+            total = sum(deltas.values())
+            if total <= 0:
+                return None
+            return {p: d / total for p, d in deltas.items()}
+
+        recent = shares(cur, fast_old)
+        base = shares(fast_old, slow_old)
+        if recent is None or base is None:
+            return ""
+        worst, worst_p = 0.0, ""
+        for p in SERIAL_PHASES:
+            d = recent[p] - base[p]
+            if abs(d) > abs(worst):
+                worst, worst_p = d, p
+        if abs(worst) < self.profile_shift_threshold:
+            return ""
+        return (f"{worst_p} share {base[worst_p]:.0%} -> "
+                f"{recent[worst_p]:.0%} over fast window")
 
     def _apply(self, found: Dict[str, bool], detail: Dict[str, str]) -> None:
         for name in DETECTORS:

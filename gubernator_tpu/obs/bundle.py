@@ -100,6 +100,15 @@ def node_report(instance, max_events: int = 512) -> dict:
             report["capacity"] = carto.forecast()
         except Exception:  # noqa: BLE001 — cartography must not break
             pass           # the report
+    prof = getattr(instance, "profiler", None)
+    if prof is not None:
+        try:
+            # full endpoint body: phase/lock-site histograms, the live
+            # decomposition, and the last deep-capture path — the bundle
+            # link an operator follows to the trace artifact
+            report["profile"] = prof.endpoint_body()
+        except Exception:  # noqa: BLE001 — profiling must not break
+            pass           # the report
     tracer = getattr(instance, "tracer", None)
     if tracer is not None:
         report["traces"] = tracer.traces()
@@ -314,6 +323,27 @@ def cluster_view(instance, timeout_s: float = 5.0,
                             key=lambda e: e.get("xfer", "")),
     }
 
+    # profiling roll-up: every node's serial-phase shares side by side —
+    # a node whose decomposition diverges from the fleet's is the one to
+    # pull a /v1/debug/profile?capture=1 trace from
+    node_shares: Dict[str, dict] = {}
+    for addr, rep in nodes.items():
+        dec = (rep.get("profile") or {}).get("decomposition") or {}
+        shares = {p: d["share"] for p, d in dec.items()
+                  if isinstance(d, dict) and d.get("share") is not None}
+        if shares:
+            node_shares[addr] = shares
+    hottest = ""
+    if node_shares:
+        phase_means: Dict[str, float] = {}
+        for shares in node_shares.values():
+            for p, s in shares.items():
+                if p != "queue_wait":  # residency ratio, not a share
+                    phase_means[p] = phase_means.get(p, 0.0) + s
+        if phase_means:
+            hottest = max(phase_means, key=phase_means.get)
+    profile_roll = {"node_shares": node_shares, "hottest_phase": hottest}
+
     recent = sorted(
         spans_by_tid,
         key=lambda tid: max(s["start_ns"] for s in spans_by_tid[tid]),
@@ -337,6 +367,7 @@ def cluster_view(instance, timeout_s: float = 5.0,
         "keyspace": keyspace_roll,
         "capacity": capacity_roll,
         "reshard": reshard_roll,
+        "profile": profile_roll,
         "stitched_traces": stitched,
         "cross_node_traces": sorted(cross_node),
     }

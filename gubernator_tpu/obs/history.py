@@ -34,7 +34,11 @@ from typing import Dict, List, Optional
 
 log = logging.getLogger("gubernator_tpu.history")
 
-HISTORY_SCHEMA_VERSION = 1
+# v2: samples carry the profiling plane's cumulative columns
+# (profile_<phase>_s per serving-cycle phase, profile_lock_wait_s,
+# profile_cycles) — consumers diff them between samples like every
+# other counter column.
+HISTORY_SCHEMA_VERSION = 2
 
 # retention floor when the ring is disabled: the anomaly engine still
 # serves its burn windows (default slow window 600 s) from here
@@ -139,6 +143,21 @@ class MetricsHistory:
         sig["circuits_open"] = float(len(open_peers))
         if open_peers:  # per-peer state, only when non-trivial
             sig["circuit_peers"] = sorted(open_peers)  # type: ignore[assignment]
+
+        prof = getattr(inst, "profiler", None) \
+            or getattr(backend, "profiler", None)
+        if prof is not None:
+            totals = prof.totals()
+            for phase, t in totals.items():
+                sig[f"profile_{phase}_s"] = t["total_ns"] / 1e9
+            # cycle count proxy: every serving cycle feeds "prep" once
+            sig["profile_cycles"] = float(totals.get(
+                "prep", {"n": 0})["n"])
+        else:
+            from gubernator_tpu.obs.profile import PHASES
+            for phase in PHASES:
+                sig[f"profile_{phase}_s"] = 0.0
+            sig["profile_cycles"] = 0.0
 
         an = self.anomaly or getattr(inst, "anomaly", None)
         if an is not None and hasattr(an, "slo_snapshot"):

@@ -19,7 +19,11 @@ from typing import Optional
 # every Instance, not merely tolerated.
 # v3: always-present "reshard" section (live-resharding handoff plane) —
 # promised on every Instance; "enabled" inside it tracks GUBER_RESHARD.
-DEBUG_VARS_SCHEMA_VERSION = 3
+# v4: always-present "profile" section (continuous profiling plane,
+# obs/profile.py) — serving-cycle phase shares, lock-wait sites, and
+# capture accounting are promised on every Instance; "enabled" inside
+# it tracks GUBER_PROFILE.
+DEBUG_VARS_SCHEMA_VERSION = 4
 
 
 def _backend_vars(backend) -> dict:
@@ -144,6 +148,15 @@ def debug_vars(instance) -> dict:
                 if hasattr(p, "link_wire_version")
             }
         out["wire"] = wire
+
+    prof = getattr(instance, "profiler", None)
+    if prof is not None:
+        out["profile"] = prof.debug()
+    else:
+        # the section is promised (v4) even on stub wirings with no
+        # profiler — a disabled, empty shape keeps consumers branch-free
+        out["profile"] = {"enabled": False, "phases": {}, "shares": {},
+                          "lock_sites": 0, "captures": 0}
 
     tracer = getattr(instance, "tracer", None)
     if tracer is not None:

@@ -1,0 +1,443 @@
+"""Continuous profiling plane: live serving-cycle decomposition.
+
+ROADMAP item 3 says the decision cycle is the ceiling, but until this
+module the only evidence was `serving_decomposition` in a bench artifact
+— computed offline, once per round, on an idle rig. The profiler makes
+every node measure its own cycle continuously: monotonic stamps at the
+serving path's real seams — combiner queue wait, engine-lock acquire
+wait, host prep, device dispatch, readback wait, response demux — feed
+streaming log2 histograms per phase, cheap enough to stay on in
+production (bench.py "profiler" section holds the on/off delta ≤ 2%,
+target 0.5%).
+
+Consumers:
+
+- /v1/debug/profile (service/http_gateway.py): full per-phase
+  histograms, per-call-site lock-wait accounting, the live
+  decomposition, and the on-demand deep-capture trigger;
+- /v1/debug/vars "profile" section (obs/introspect.py): the compact
+  always-on summary;
+- profile_* columns in the metrics-history ring (obs/history.py), so
+  decomposition drift is visible over the retention window and the
+  anomaly engine's `profile_shift` detector can compare fast/slow
+  windows;
+- bench.py's offline `serving_decomposition`, re-derived from the same
+  totals through `serving_decomposition()` below — one source of truth
+  (tests/test_profile_plane.py pins live-vs-offline agreement).
+
+`GUBER_PROFILE=0` turns every observation site into a single attribute
+test; the off path is bit-identical (differential-tested) because the
+profiler only ever *reads* clocks.
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+PROFILE_SCHEMA_VERSION = 1
+KERNELS_SCHEMA_VERSION = 1
+
+# The serving-cycle phases, in cycle order. queue_wait (combiner/peerlink
+# residency before launch) overlaps the serial phases of OTHER windows,
+# so decomposition shares are computed over the serial set only;
+# queue_wait's "share" is reported against the same denominator as a
+# residency ratio (can exceed 1 under deep pipelining).
+PHASES = ("queue_wait", "lock_wait", "prep", "dispatch", "readback", "demux")
+SERIAL_PHASES = ("lock_wait", "prep", "dispatch", "readback", "demux")
+
+# log2-ns histogram: bucket i holds observations <= 2^(i+_SHIFT) ns.
+# _SHIFT=10 puts bucket 0 at ~1 us (finer resolution is clock noise on
+# these seams); 28 buckets reach ~137 s.
+_SHIFT = 10
+_NBUCKETS = 28
+
+
+def profile_enabled_default() -> bool:
+    """GUBER_PROFILE escape hatch (Go ParseBool values; default on — the
+    profiler is the always-on cycle meter, opting OUT is the deliberate
+    act)."""
+    raw = os.environ.get("GUBER_PROFILE", "").strip().lower()
+    if raw in ("0", "f", "false", "no", "off"):
+        return False
+    return True
+
+
+class PhaseHist:
+    """One streaming log2-ns histogram: O(1) observe under a lock, exact
+    count/total/max, bucket-resolution quantiles."""
+
+    __slots__ = ("_lock", "counts", "n", "total_ns", "max_ns")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counts = [0] * _NBUCKETS
+        self.n = 0
+        self.total_ns = 0
+        self.max_ns = 0
+
+    def observe(self, ns: int) -> None:
+        if ns < 0:
+            ns = 0
+        idx = ns.bit_length() - _SHIFT
+        if idx < 0:
+            idx = 0
+        elif idx >= _NBUCKETS:
+            idx = _NBUCKETS - 1
+        with self._lock:
+            self.counts[idx] += 1
+            self.n += 1
+            self.total_ns += ns
+            if ns > self.max_ns:
+                self.max_ns = ns
+
+    def totals(self) -> Tuple[int, int]:
+        with self._lock:
+            return self.n, self.total_ns
+
+    def _quantile_locked(self, q: float) -> int:
+        """Upper bucket bound holding quantile `q` (0 when empty)."""
+        if self.n == 0:
+            return 0
+        want = q * self.n
+        seen = 0
+        for i, c in enumerate(self.counts):
+            seen += c
+            if seen >= want:
+                return 1 << (i + _SHIFT)
+        return 1 << (_NBUCKETS - 1 + _SHIFT)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "n": self.n,
+                "total_ns": self.total_ns,
+                "max_ns": self.max_ns,
+                "p50_ns": self._quantile_locked(0.50),
+                "p99_ns": self._quantile_locked(0.99),
+            }
+
+
+class Profiler:
+    """The per-engine cycle profiler: phase histograms, per-call-site
+    lock-wait accounting, a snapshot ring for windowed views, and the
+    rate-limited deep capture."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 capture_min_interval_s: float = 60.0):
+        self.enabled = (profile_enabled_default()
+                        if enabled is None else bool(enabled))
+        self.capture_min_interval_s = float(capture_min_interval_s)
+        self._phases: Dict[str, PhaseHist] = {p: PhaseHist() for p in PHASES}
+        self._sites: Dict[str, PhaseHist] = {}
+        self._sites_lock = threading.Lock()
+        # windowed views (slow-request attachment, anomaly baselines that
+        # predate the history ring): totals snapshots every ~2 s, taken
+        # lazily from the observe path so idle engines cost nothing
+        self._ring: "collections.deque[tuple]" = collections.deque(maxlen=128)
+        self._ring_tick_s = 2.0
+        self._ring_last = 0.0
+        self._obs_since_tick = 0
+        # deep capture state
+        self._capture_lock = threading.Lock()
+        self._last_capture = 0.0
+        self._captures = 0
+        self._last_capture_path: Optional[str] = None
+        self._last_capture_mode: Optional[str] = None
+
+    # ------------------------------------------------------- observation
+
+    def observe(self, phase: str, ns: int) -> None:
+        """Record `ns` nanoseconds spent in `phase` for one window."""
+        if not self.enabled:
+            return
+        self._phases[phase].observe(ns)
+        self._obs_since_tick += 1
+        if self._obs_since_tick >= 256:
+            self._maybe_tick()
+
+    def lock_wait(self, site: str, ns: int) -> None:
+        """Record one engine-lock acquisition wait at `site` (feeds both
+        the lock_wait phase and the per-site histogram)."""
+        if not self.enabled:
+            return
+        self._phases["lock_wait"].observe(ns)
+        h = self._sites.get(site)
+        if h is None:
+            with self._sites_lock:
+                h = self._sites.setdefault(site, PhaseHist())
+        h.observe(ns)
+
+    def _maybe_tick(self) -> None:
+        self._obs_since_tick = 0
+        now = time.monotonic()
+        if now - self._ring_last < self._ring_tick_s:
+            return
+        self._ring_last = now
+        self._ring.append((now, self.totals()))
+
+    # ------------------------------------------------------------- views
+
+    def totals(self) -> Dict[str, dict]:
+        """Cumulative per-phase counters: {phase: {"n", "total_ns"}}.
+        Cheap — the delta source for history columns, bench, slow logs."""
+        out = {}
+        for p, h in self._phases.items():
+            n, total = h.totals()
+            out[p] = {"n": n, "total_ns": total}
+        return out
+
+    def site_totals(self) -> Dict[str, dict]:
+        with self._sites_lock:
+            sites = dict(self._sites)
+        return {s: {"n": h.totals()[0], "total_ns": h.totals()[1]}
+                for s, h in sites.items()}
+
+    def recent(self, window_s: float = 60.0) -> dict:
+        """Per-phase decomposition over roughly the last `window_s`
+        seconds (snapshot-ring resolution ~2 s). The slow-request log
+        attaches this so a slow request shows where its window's time
+        went without a separate capture."""
+        cur = self.totals()
+        now = time.monotonic()
+        base = None
+        base_t = None
+        for t, snap in self._ring:
+            if now - t <= window_s:
+                base = snap
+                base_t = t
+                break
+        if base is None:
+            base = {p: {"n": 0, "total_ns": 0} for p in PHASES}
+            base_t = None
+        phases = {}
+        for p in PHASES:
+            phases[p] = {
+                "n": cur[p]["n"] - base[p]["n"],
+                "total_ns": cur[p]["total_ns"] - base[p]["total_ns"],
+            }
+        serial = sum(phases[p]["total_ns"] for p in SERIAL_PHASES)
+        for p in PHASES:
+            phases[p]["share"] = (
+                round(phases[p]["total_ns"] / serial, 4) if serial else 0.0)
+        return {
+            "window_s": round(now - base_t, 1) if base_t else None,
+            "phases": phases,
+        }
+
+    def decomposition(self) -> dict:
+        """The live cycle decomposition from boot-cumulative totals:
+        per-phase total seconds, window count, mean, and share of the
+        serial cycle (see PHASES for the queue_wait caveat)."""
+        cur = self.totals()
+        serial = sum(cur[p]["total_ns"] for p in SERIAL_PHASES)
+        out = {}
+        for p in PHASES:
+            n = cur[p]["n"]
+            total = cur[p]["total_ns"]
+            out[p] = {
+                "count": n,
+                "total_s": round(total / 1e9, 6),
+                "avg_us": round(total / n / 1e3, 3) if n else 0.0,
+                "share": round(total / serial, 4) if serial else 0.0,
+            }
+        return out
+
+    def debug(self) -> dict:
+        """The /v1/debug/vars "profile" section: compact summary."""
+        cur = self.totals()
+        serial = sum(cur[p]["total_ns"] for p in SERIAL_PHASES)
+        return {
+            "enabled": self.enabled,
+            "phases": {p: {"n": cur[p]["n"],
+                           "total_s": round(cur[p]["total_ns"] / 1e9, 3)}
+                       for p in PHASES},
+            "shares": {p: (round(cur[p]["total_ns"] / serial, 4)
+                           if serial else 0.0) for p in SERIAL_PHASES},
+            "lock_sites": len(self._sites),
+            "captures": self._captures,
+        }
+
+    def endpoint_body(self) -> dict:
+        """The schema-pinned /v1/debug/profile body
+        (tests/test_debug_schema.py)."""
+        with self._sites_lock:
+            sites = dict(self._sites)
+        return {
+            "schema_version": PROFILE_SCHEMA_VERSION,
+            "enabled": self.enabled,
+            "phases": {p: h.snapshot() for p, h in self._phases.items()},
+            "lock_sites": {s: h.snapshot() for s, h in sorted(sites.items())},
+            "decomposition": self.decomposition(),
+            "recent": self.recent(),
+            "capture": {
+                "count": self._captures,
+                "min_interval_s": self.capture_min_interval_s,
+                "last_path": self._last_capture_path,
+                "last_mode": self._last_capture_mode,
+            },
+        }
+
+    # ------------------------------------------------------ deep capture
+
+    def capture(self, out_dir: str, seconds: float = 0.25,
+                mode: str = "auto") -> dict:
+        """On-demand deep capture, rate-limited to one per
+        `capture_min_interval_s`. `mode` "auto" tries `jax.profiler`
+        (device timeline) and falls back to the wall-clock stack sampler
+        (always works, CPU rigs included); "wall" forces the sampler.
+        Writes under `out_dir` (the bundle dir) and returns
+        {"ok", "path"/"error", "mode"}; never raises."""
+        now = time.monotonic()
+        with self._capture_lock:
+            since = now - self._last_capture
+            if self._captures and since < self.capture_min_interval_s:
+                return {"ok": False, "error": "rate_limited",
+                        "retry_in_s": round(
+                            self.capture_min_interval_s - since, 1)}
+            self._last_capture = now
+            self._captures += 1
+        seconds = min(max(float(seconds), 0.05), 10.0)
+        stamp = int(time.time())
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+        except OSError as e:
+            return {"ok": False, "error": f"capture dir: {e}"}
+        if mode == "auto":
+            try:
+                import jax
+
+                path = os.path.join(out_dir, f"profile_trace_{stamp}")
+                jax.profiler.start_trace(path)
+                time.sleep(seconds)
+                jax.profiler.stop_trace()
+                self._last_capture_path = path
+                self._last_capture_mode = "jax_trace"
+                return {"ok": True, "path": path, "mode": "jax_trace"}
+            except Exception:  # noqa: BLE001 — fall through to the sampler
+                pass
+        try:
+            path = self._wall_sample(out_dir, seconds, stamp)
+        except Exception as e:  # noqa: BLE001 — capture must not raise
+            return {"ok": False, "error": str(e)}
+        self._last_capture_path = path
+        self._last_capture_mode = "wall_sampler"
+        return {"ok": True, "path": path, "mode": "wall_sampler"}
+
+    @staticmethod
+    def _wall_sample(out_dir: str, seconds: float, stamp: int) -> str:
+        """Wall-clock stack sampler: collapse every thread's stack every
+        ~5 ms into flamegraph-style "frame;frame;frame" counts."""
+        interval = 0.005
+        stacks: Dict[str, int] = {}
+        samples = 0
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            for frames in sys._current_frames().values():  # noqa: SLF001
+                parts = []
+                f = frames
+                depth = 0
+                while f is not None and depth < 48:
+                    code = f.f_code
+                    parts.append(f"{os.path.basename(code.co_filename)}:"
+                                 f"{code.co_name}")
+                    f = f.f_back
+                    depth += 1
+                key = ";".join(reversed(parts))
+                stacks[key] = stacks.get(key, 0) + 1
+            samples += 1
+            time.sleep(interval)
+        top = sorted(stacks.items(), key=lambda kv: -kv[1])[:200]
+        path = os.path.join(out_dir, f"profile_sample_{stamp}.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump({"mode": "wall_sampler", "seconds": seconds,
+                       "interval_s": interval, "samples": samples,
+                       "stacks": dict(top)}, fh, indent=1)
+        return path
+
+
+# ------------------------------------------------------- shared derivations
+
+def serving_decomposition(totals_before: Dict[str, dict],
+                          totals_after: Dict[str, dict],
+                          cycles: int, elapsed_s: float,
+                          upload_bytes: int = 0, download_bytes: int = 0,
+                          decisions: int = 0) -> dict:
+    """Derive the offline serving_decomposition keys from two Profiler
+    totals() snapshots — the ONE derivation bench.py emits and the live
+    endpoint agrees with (tests/test_profile_plane.py pins them within
+    10% per phase)."""
+    cycles = max(int(cycles), 1)
+
+    def delta(p):
+        a = totals_after.get(p, {}).get("total_ns", 0)
+        b = totals_before.get(p, {}).get("total_ns", 0)
+        return max(a - b, 0)
+
+    cycle_s = elapsed_s / cycles
+    host_prep_s = delta("prep") / 1e9 / cycles
+    device_s = (delta("dispatch") + delta("readback")) / 1e9 / cycles
+    demux_s = delta("demux") / 1e9 / cycles
+    lock_s = delta("lock_wait") / 1e9 / cycles
+    accounted = host_prep_s + device_s + demux_s + lock_s
+    return {
+        "cycle_s": cycle_s,
+        "host_prep_s": host_prep_s,
+        "device_s_est": device_s,
+        "demux_s": demux_s,
+        "lock_wait_s": lock_s,
+        "link_s_est": max(cycle_s - accounted, 0.0),
+        "host_prep_share": host_prep_s / cycle_s if cycle_s else 0.0,
+        "device_share": device_s / cycle_s if cycle_s else 0.0,
+        "upload_bytes_per_cycle": upload_bytes / cycles,
+        "download_bytes_per_cycle": download_bytes / cycles,
+        "decisions_per_cycle": decisions / cycles,
+    }
+
+
+def check_recompile(fingerprints: Dict[str, str], state_path: str,
+                    recorder=None) -> dict:
+    """Compare this boot's kernel HLO fingerprints against the previous
+    boot's (persisted at `state_path` under the bundle dir) and persist
+    the new set. A changed fingerprint means XLA will compile a
+    DIFFERENT program for the same serving shape than last boot — a
+    jax/libtpu bump, a kernel edit, a flag drift — exactly the moment a
+    perf cliff sneaks in, so it lands in the flight recorder as
+    `profile.recompile`. Returns {"changed": {...}, "first_boot": bool};
+    never raises."""
+    prev: Dict[str, str] = {}
+    first_boot = True
+    try:
+        with open(state_path, encoding="utf-8") as fh:
+            prev = json.load(fh)
+        first_boot = False
+    except (OSError, ValueError):
+        prev = {}
+    changed = {k: {"was": prev[k], "now": v}
+               for k, v in fingerprints.items()
+               if k in prev and prev[k] != v}
+    try:
+        os.makedirs(os.path.dirname(state_path) or ".", exist_ok=True)
+        with open(state_path, "w", encoding="utf-8") as fh:
+            json.dump({**prev, **fingerprints}, fh, indent=1)
+    except OSError:
+        pass
+    if changed and recorder is not None:
+        try:
+            recorder.emit("profile.recompile",
+                          kernels=sorted(changed),
+                          detail={k: v for k, v in list(changed.items())[:8]})
+        except Exception:  # noqa: BLE001 — observability must not break boot
+            pass
+    return {"changed": changed, "first_boot": first_boot}
+
+
+def hlo_fingerprint(text: str) -> str:
+    """Stable short fingerprint of a lowered program's HLO text."""
+    return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()[:16]

@@ -172,6 +172,11 @@ class Tracer:
         self._rand = random.Random()
         self.stats = {"started": 0, "continued": 0, "spans": 0,
                       "slow_logged": 0}
+        # optional hook (wired by the Instance): zero-arg callable giving
+        # the profiler's recent serving-cycle decomposition, attached to
+        # slow-request log entries so "this request was slow" arrives with
+        # "and here is where the last minute's cycle time went"
+        self.profile_snapshot = None
 
     # ------------------------------------------------------------- sampling
 
@@ -268,7 +273,7 @@ class Tracer:
     def _log_slow(self, root: Span, dur_ms: float) -> None:
         self.stats["slow_logged"] += 1
         phases = self.traces(root.trace_id).get(root.trace_id, [])
-        slow_log.warning(json.dumps({
+        entry = {
             "event": "slow_request",
             "service": self.service,
             "trace_id": root.trace_id,
@@ -276,4 +281,11 @@ class Tracer:
             "duration_ms": round(dur_ms, 3),
             "threshold_ms": self.slow_ms,
             "spans": phases,
-        }, separators=(",", ":")))
+        }
+        snap = self.profile_snapshot
+        if snap is not None:
+            try:
+                entry["profile"] = snap()
+            except Exception:  # noqa: BLE001 — a slow log must still land
+                pass
+        slow_log.warning(json.dumps(entry, separators=(",", ":")))

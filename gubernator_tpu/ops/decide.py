@@ -44,7 +44,7 @@ from gubernator_tpu.types import Algorithm, Behavior, Status
 
 
 class KernelTelemetry:
-    """Process-wide kernel dispatch accounting.
+    """Process-wide kernel dispatch accounting + cost introspection.
 
     The engines report every device launch here — which kernel (wide /
     compact / lean, per-window / scan), at which width, at which scan
@@ -53,21 +53,124 @@ class KernelTelemetry:
     width churn here means warmup() and live traffic disagree). Totals are
     process-wide: in-process cluster harnesses share one registry, exactly
     like the shared jit caches they mirror. Exported in /v1/debug/vars
-    ("kernel") and as engine_kernel_dispatch_total{kernel,width}."""
+    ("kernel") and as engine_kernel_dispatch_total{kernel,width}.
+
+    The profiling plane (obs/profile.py) extends each (kernel, width)
+    with a live dispatch-time histogram (`dur_ns` on note) and a lazily
+    computed XLA cost record — flops, bytes accessed, HLO fingerprint —
+    from the abstract shapes of the first real dispatch (`offer_probe`;
+    the costs compile OFF the serving path, on first /v1/debug/kernels
+    access)."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self._counts: Dict[Tuple[str, int], int] = {}
         self._lanes = 0
+        self._hists: Dict[Tuple[str, int], "object"] = {}
+        self._probes: Dict[Tuple[str, int], tuple] = {}
+        self._costs: Dict[Tuple[str, int], dict] = {}
 
     def note(self, kernel: str, width: int, depth: int = 1,
-             lanes: int = 0) -> None:
+             lanes: int = 0, dur_ns: int = 0) -> None:
         """One dispatch of `kernel` at staging width `width` retiring
-        `depth` windows (scan kernels) and `lanes` live lanes."""
+        `depth` windows (scan kernels) and `lanes` live lanes; `dur_ns`,
+        when nonzero, is the dispatch-call wall time."""
+        key = (kernel, width)
         with self._lock:
-            key = (kernel, width)
             self._counts[key] = self._counts.get(key, 0) + depth
             self._lanes += lanes
+            hist = self._hists.get(key) if dur_ns else None
+            if dur_ns and hist is None:
+                from gubernator_tpu.obs.profile import PhaseHist
+
+                hist = self._hists.setdefault(key, PhaseHist())
+        if dur_ns and hist is not None:
+            hist.observe(dur_ns)
+
+    def needs_probe(self, kernel: str, width: int) -> bool:
+        """True until a cost probe is parked for (kernel, width) — a
+        single dict test, cheap enough for the dispatch hot path."""
+        return (kernel, width) not in self._probes
+
+    def offer_probe(self, kernel: str, width: int, fn, args) -> None:
+        """Park the abstract call shape of (kernel, width)'s first real
+        dispatch: `fn` is the jitted callable, `args` its concrete
+        arguments (captured BEFORE the call — donation invalidates them
+        after). Cost analysis lowers/compiles from these avals later,
+        off the serving path."""
+        avals = tuple(
+            jax.ShapeDtypeStruct(a.shape, a.dtype)
+            if hasattr(a, "shape") and hasattr(a, "dtype") else a
+            for a in args)
+        with self._lock:
+            self._probes.setdefault((kernel, width), (fn, avals))
+
+    def _compute_cost(self, fn, avals) -> dict:
+        """Lower + compile one probe and extract the cost record. Any
+        failure (backend without cost analysis, shape drift) degrades to
+        an error record — introspection must not break the endpoint."""
+        from gubernator_tpu.obs.profile import hlo_fingerprint
+
+        out: dict = {}
+        try:
+            lowered = fn.lower(*avals)
+            out["fingerprint"] = hlo_fingerprint(lowered.as_text())
+        except Exception as e:  # noqa: BLE001 — degrade, don't break
+            return {"error": f"lower: {e}"}
+        try:
+            ca = lowered.compile().cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            if ca:
+                out["flops"] = float(ca.get("flops", 0.0))
+                out["bytes_accessed"] = float(ca.get("bytes accessed", 0.0))
+        except Exception as e:  # noqa: BLE001 — degrade, don't break
+            out["cost_error"] = str(e)
+        return out
+
+    def kernel_costs(self) -> Dict[Tuple[str, int], dict]:
+        """Cost records for every probed (kernel, width), computing and
+        caching any not yet analyzed (first call after new shapes pays
+        the compiles; callers are debug endpoints, never serving)."""
+        with self._lock:
+            pending = {k: v for k, v in self._probes.items()
+                       if k not in self._costs}
+        for key, (fn, avals) in pending.items():
+            cost = self._compute_cost(fn, avals)
+            with self._lock:
+                self._costs[key] = cost
+        with self._lock:
+            return dict(self._costs)
+
+    def kernels_body(self) -> dict:
+        """The schema-pinned /v1/debug/kernels body
+        (tests/test_debug_schema.py)."""
+        from gubernator_tpu.obs.profile import KERNELS_SCHEMA_VERSION
+
+        costs = self.kernel_costs()
+        with self._lock:
+            counts = dict(self._counts)
+            hists = dict(self._hists)
+            lanes = self._lanes
+        kernels = {}
+        for (k, w), n in sorted(counts.items()):
+            hist = hists.get((k, w))
+            kernels[f"{k}@{w}"] = {
+                "windows": n,
+                "dispatch_ns": hist.snapshot() if hist is not None else None,
+                "cost": costs.get((k, w)),
+            }
+        return {
+            "schema_version": KERNELS_SCHEMA_VERSION,
+            "lanes_total": lanes,
+            "kernels": kernels,
+        }
+
+    def fingerprints(self) -> Dict[str, str]:
+        """{kernel@width: HLO fingerprint} for every analyzed probe."""
+        return {f"{k}@{w}": c["fingerprint"]
+                for (k, w), c in self.kernel_costs().items()
+                if "fingerprint" in c}
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -80,6 +183,13 @@ class KernelTelemetry:
     def counts(self) -> Dict[Tuple[str, int], int]:
         with self._lock:
             return dict(self._counts)
+
+    def dispatch_totals(self) -> Dict[Tuple[str, int], Tuple[int, int]]:
+        """{(kernel, width): (dispatches, total_ns)} — the cheap scrape
+        read behind engine_kernel_dispatch_seconds (no quantile math)."""
+        with self._lock:
+            hists = dict(self._hists)
+        return {key: hist.totals() for key, hist in hists.items()}
 
 
 kernel_telemetry = KernelTelemetry()

@@ -103,6 +103,9 @@ class BackendCombiner:
         self._metrics = metrics
         self._tracer = tracer
         self._recorder = recorder  # flight recorder (obs/events.py) or None
+        # cycle profiler (obs/profile.py): the combiner feeds each
+        # submission's enqueue->launch residency into the queue_wait phase
+        self._profiler = getattr(backend, "profiler", None)
         self._cond = threading.Condition()
         # pending entry: (reqs, now_ms, future, enqueue time_ns, span|None,
         # deadline|None)
@@ -387,6 +390,7 @@ class BackendCombiner:
     def _execute_serial(self, now_ms, entries) -> None:
         m = self._metrics
         tracer = self._tracer
+        prof = self._profiler
         self._windows += 1
         merged = len(entries) > 1
         if merged:
@@ -397,6 +401,8 @@ class BackendCombiner:
         for reqs, _, fut, t_enq, req_span, _dl in entries:
             spans.append((len(flat), len(reqs), fut))
             flat.extend(reqs)
+            if prof is not None:
+                prof.observe("queue_wait", t_launch - t_enq)
             if m is not None:
                 m.combiner_wait_ms.observe((t_launch - t_enq) / 1e6)
             if req_span is not None and tracer is not None:
@@ -475,6 +481,7 @@ class BackendCombiner:
             return
         m = self._metrics
         tracer = self._tracer
+        prof = self._profiler
         t_launch = time.time_ns()
         win_reqs: List[List[RateLimitReq]] = []
         for entries in group:
@@ -488,6 +495,8 @@ class BackendCombiner:
                     flat = list(reqs) if not isinstance(reqs, list) else reqs
                 else:
                     flat.extend(reqs)
+                if prof is not None:
+                    prof.observe("queue_wait", t_launch - t_enq)
                 if m is not None:
                     m.combiner_wait_ms.observe((t_launch - t_enq) / 1e6)
                 if req_span is not None and tracer is not None:

@@ -171,6 +171,16 @@ class InstanceConfig:
     keyspace_interval_s: float = 60.0
     keyspace_top_k: int = 20
     capacity_horizon_s: float = 1800.0
+    # continuous profiling plane (obs/profile.py): serving-cycle phase
+    # decomposition, per-site lock-wait histograms, kernel dispatch-time
+    # tracking, and on-demand deep capture. None defers to GUBER_PROFILE
+    # at wiring time; False turns every observation site into a single
+    # attribute test and the serving path bit-identical to profiling off.
+    profile_enabled: Optional[bool] = None
+    # GUBER_PROFILE_CAPTURE_S: minimum seconds between on-demand deep
+    # captures (/v1/debug/profile?capture=1) — the rate limiter that keeps
+    # a curious dashboard from turning the profiler into a DoS.
+    profile_capture_s: float = 60.0
 
     def validate(self) -> None:
         if self.behaviors.batch_limit > MAX_BATCH_SIZE:
@@ -225,3 +235,5 @@ class InstanceConfig:
             raise ValueError("keyspace_top_k must be >= 1")
         if self.capacity_horizon_s <= 0:
             raise ValueError("capacity_horizon_s must be positive")
+        if self.profile_capture_s <= 0:
+            raise ValueError("profile_capture_s must be positive")

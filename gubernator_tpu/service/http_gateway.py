@@ -23,6 +23,13 @@ reference daemon's expvar/pprof handlers):
   ?n=<count> limits the tail)
 - GET /v1/debug/keyspace — keyspace cartography + headroom forecast
   (obs/keyspace.py; ?refresh=1 forces a fresh harvest)
+- GET /v1/debug/profile — live serving-cycle decomposition: per-phase
+  histograms, per-call-site lock-wait accounting, windowed shares
+  (obs/profile.py; ?capture=1 triggers a rate-limited deep trace
+  capture, ?seconds=<s> bounds its duration)
+- GET /v1/debug/kernels — compiled kernel cost introspection: per
+  (kernel, width) dispatch counts, dispatch-time histograms, XLA cost
+  analysis + HLO fingerprints (ops/decide.py kernel_telemetry)
 """
 
 from __future__ import annotations
@@ -169,6 +176,22 @@ class HttpGateway:
                         if q.get("refresh", ["0"])[0] == "1":
                             carto.harvest()
                         body = carto.endpoint_body()
+                    elif url.path == "/v1/debug/profile":
+                        q = parse_qs(url.query)
+                        prof = getattr(gateway.instance, "profiler", None)
+                        if prof is None:
+                            self._reply_error(404, "profiler not wired")
+                            return
+                        body = prof.endpoint_body()
+                        if q.get("capture", ["0"])[0] == "1":
+                            seconds = float(
+                                q.get("seconds", ["0.25"])[0] or 0.25)
+                            body["capture"]["triggered"] = \
+                                gateway.instance.profile_capture(seconds)
+                    elif url.path == "/v1/debug/kernels":
+                        from gubernator_tpu.ops.decide import kernel_telemetry
+
+                        body = kernel_telemetry.kernels_body()
                     elif url.path == "/v1/debug/cluster":
                         from gubernator_tpu.obs.bundle import cluster_view
 

@@ -234,9 +234,25 @@ class Instance:
 
             conf.backend = Engine()
         self.backend = conf.backend
+        # continuous profiling plane (obs/profile.py): the Engine carries
+        # its own profiler; backends without one (sharded, stubs) get an
+        # Instance-level fallback so the endpoints and debug sections are
+        # wired on every deployment shape. conf.profile_enabled None
+        # defers to GUBER_PROFILE; an explicit bool overrides the env.
+        from gubernator_tpu.obs.profile import Profiler
+
+        self.profiler = getattr(self.backend, "profiler", None)
+        if self.profiler is None:
+            self.profiler = Profiler(enabled=conf.profile_enabled)
+        elif conf.profile_enabled is not None:
+            self.profiler.enabled = bool(conf.profile_enabled)
+        self.profiler.capture_min_interval_s = float(conf.profile_capture_s)
         # always present; sample 0 (the default) keeps every trace site a
         # guarded no-op — daemons wire GUBER_TRACE_SAMPLE through here
         self.tracer = conf.tracer or Tracer()
+        # slow-request log entries carry the last minute's cycle
+        # decomposition (obs/trace.py _log_slow)
+        self.tracer.profile_snapshot = self.profiler.recent
         # flight recorder (obs/events.py): always constructed so every
         # subsystem hook is one attribute test; GUBER_FLIGHT_RECORDER=0
         # turns each emit into a single bool read
@@ -346,6 +362,17 @@ class Instance:
         self._collective_group = (
             None if group_peers is None else frozenset(group_peers))
         self._recompute_collective_coverage()
+
+    def profile_capture(self, seconds: float = 0.25) -> dict:
+        """On-demand deep capture (/v1/debug/profile?capture=1): a
+        rate-limited jax.profiler trace (wall-clock sampler fallback off
+        TPU) written next to the diagnostic bundles when a bundle dir is
+        configured, else the system tempdir."""
+        import tempfile
+
+        writer = getattr(self, "bundle_writer", None)
+        out_dir = getattr(writer, "directory", None) or tempfile.gettempdir()
+        return self.profiler.capture(out_dir, seconds=seconds)
 
     def columnar_backend(self):
         """The backend when it offers the zero-object columnar serving

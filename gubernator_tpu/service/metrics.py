@@ -644,6 +644,41 @@ class Metrics:
                  "failed retry of a deferred window, or unroutable."),
             )
         }
+        # continuous profiling plane (obs/profile.py): cumulative phase
+        # time mirrors of the live per-phase histograms, refreshed at
+        # scrape — rate(profile_phase_seconds_total[1m]) /
+        # rate(profile_phase_windows_total[1m]) is the live mean
+        self.profile_phase_seconds = Counter(
+            "profile_phase_seconds_total",
+            "Serving-cycle time attributed to each profiler phase.",
+            ["phase"],
+            registry=self.registry,
+        )
+        self.profile_phase_windows = Counter(
+            "profile_phase_windows_total",
+            "Profiler observations per serving-cycle phase.",
+            ["phase"],
+            registry=self.registry,
+        )
+        self.engine_lock_wait_seconds = Counter(
+            "engine_lock_wait_seconds_total",
+            "Engine-lock acquire wait attributed to each call site.",
+            ["site"],
+            registry=self.registry,
+        )
+        self.engine_lock_waits = Counter(
+            "engine_lock_waits_total",
+            "Engine-lock acquisitions timed per call site.",
+            ["site"],
+            registry=self.registry,
+        )
+        self.engine_kernel_dispatch_seconds = Counter(
+            "engine_kernel_dispatch_seconds_total",
+            "Wall time inside jitted decide-kernel dispatch calls, per "
+            "compiled (kernel, width) program.",
+            ["kernel", "width"],
+            registry=self.registry,
+        )
 
     def set_native_front(self, hits_fn) -> None:
         """Register the native gRPC front's IO-thread decision counter
@@ -704,6 +739,29 @@ class Metrics:
             self._set_counter(
                 self.engine_kernel_dispatches.labels(
                     kernel=kernel, width=str(width)), n)
+        for (kernel, width), (n, total_ns) in \
+                kernel_telemetry.dispatch_totals().items():
+            self._set_counter(
+                self.engine_kernel_dispatch_seconds.labels(
+                    kernel=kernel, width=str(width)), total_ns / 1e9)
+        # profiling plane: phase + lock-site cumulative mirrors
+        prof = getattr(instance, "profiler", None) \
+            or getattr(instance.backend, "profiler", None)
+        if prof is not None:
+            for phase, t in prof.totals().items():
+                self._set_counter(
+                    self.profile_phase_seconds.labels(phase=phase),
+                    t["total_ns"] / 1e9)
+                self._set_counter(
+                    self.profile_phase_windows.labels(phase=phase),
+                    float(t["n"]))
+            for site, t in prof.site_totals().items():
+                self._set_counter(
+                    self.engine_lock_wait_seconds.labels(site=site),
+                    t["total_ns"] / 1e9)
+                self._set_counter(
+                    self.engine_lock_waits.labels(site=site),
+                    float(t["n"]))
         # live key-table occupancy: the engine directory IS the cache here,
         # so cache_size (reference: cache.go:87-95) reports it
         from gubernator_tpu.obs.introspect import key_table_size

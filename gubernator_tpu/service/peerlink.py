@@ -1487,15 +1487,24 @@ class PeerLinkService:
                     return True
             else:
                 # pipe full but the pull has more work: this drain IS the
-                # fill stall (the readback gates the next launch)
-                if len(inflight) >= self._col_depth:
+                # fill stall (the readback gates the next launch) — its
+                # duration is the wire path's queue residency, so it also
+                # feeds the profiler's queue_wait phase (obs/profile.py)
+                stalled = len(inflight) >= self._col_depth
+                if stalled:
                     self.stats["columnar_fill_stalls"] += 1
                     if mt is not None:
                         mt.peerlink_columnar_fill_stalls.inc()
                     if self._recorder is not None:
                         self._recorder.emit("peerlink.fill_stall",
                                             depth=self._col_depth)
+                tq = time.perf_counter_ns()
                 drain_one()
+                if stalled:
+                    prof = getattr(eng, "profiler", None)
+                    if prof is not None:
+                        prof.observe("queue_wait",
+                                     time.perf_counter_ns() - tq)
         return True
 
     def _columnar_chunk_v2(self, m: int, eng, j: int, k: int,

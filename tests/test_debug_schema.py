@@ -15,6 +15,11 @@ tickers are disabled), and pins the /v1/debug/history and
 
 v3 promises the "reshard" section on every Instance (the handoff plane
 is always constructed; its "enabled" flag tracks GUBER_RESHARD).
+
+v4 promises the "profile" section on every Instance (the serving-cycle
+profiler is always constructed; its "enabled" flag tracks
+GUBER_PROFILE), and pins the /v1/debug/profile and /v1/debug/kernels
+endpoint bodies.
 """
 
 import pytest
@@ -23,6 +28,8 @@ from gubernator_tpu.models.engine import Engine
 from gubernator_tpu.obs.history import HISTORY_SCHEMA_VERSION
 from gubernator_tpu.obs.introspect import DEBUG_VARS_SCHEMA_VERSION, debug_vars
 from gubernator_tpu.obs.keyspace import KEYSPACE_SCHEMA_VERSION
+from gubernator_tpu.obs.profile import (KERNELS_SCHEMA_VERSION,
+                                        PROFILE_SCHEMA_VERSION)
 from gubernator_tpu.service.config import InstanceConfig
 from gubernator_tpu.service.instance import Instance
 from gubernator_tpu.types import PeerInfo
@@ -30,7 +37,7 @@ from gubernator_tpu.types import PeerInfo
 # every section name the snapshot may carry, by wiring condition
 ALWAYS = {"schema_version", "advertise_address", "engine", "combiner",
           "kernel", "peers", "global", "flight_recorder", "anomaly",
-          "history", "keyspace", "reshard"}
+          "history", "keyspace", "reshard", "profile"}
 OPTIONAL = {"wire", "trace", "leases", "collective_global", "multiregion",
             "bundles", "deadline_expired"}
 SECTIONS = ALWAYS | OPTIONAL
@@ -47,7 +54,7 @@ def instance():
 
 def test_schema_version_pinned(instance):
     dv = debug_vars(instance)
-    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 3
+    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 4
 
 
 def test_always_sections_present(instance):
@@ -96,7 +103,7 @@ def test_history_and_keyspace_var_shapes(instance):
 
 def test_history_endpoint_schema_pinned(instance):
     body = instance.history.endpoint_body()
-    assert body["schema_version"] == HISTORY_SCHEMA_VERSION == 1
+    assert body["schema_version"] == HISTORY_SCHEMA_VERSION == 2
     assert set(body) == {"schema_version", "enabled", "tick_s",
                          "retention_s", "sample_count", "samples"}
     instance.history.tick()
@@ -108,7 +115,50 @@ def test_history_endpoint_schema_pinned(instance):
             "lease_fail_close", "lease_outstanding", "lease_held_keys",
             "key_count", "evictions", "global_hits_depth",
             "global_broadcast_depth", "circuits_open", "slo_total",
-            "slo_good", "slo_errors"} <= set(sample)
+            "slo_good", "slo_errors",
+            # v2: the profiling-plane columns profile_shift diffs
+            "profile_queue_wait_s", "profile_lock_wait_s",
+            "profile_prep_s", "profile_dispatch_s",
+            "profile_readback_s", "profile_demux_s",
+            "profile_cycles"} <= set(sample)
+
+
+def test_profile_var_shape(instance):
+    dv = debug_vars(instance)
+    prof = dv["profile"]
+    assert {"enabled", "phases", "shares", "lock_sites",
+            "captures"} <= set(prof)
+    assert prof["enabled"] is True  # GUBER_PROFILE unset => on
+
+
+def test_profile_endpoint_schema_pinned(instance):
+    body = instance.profiler.endpoint_body()
+    assert body["schema_version"] == PROFILE_SCHEMA_VERSION == 1
+    assert set(body) == {"schema_version", "enabled", "phases",
+                         "lock_sites", "decomposition", "recent",
+                         "capture"}
+    # the phase taxonomy dashboards key on; renaming a phase is a
+    # schema_version bump, not a silent drift
+    taxonomy = {"queue_wait", "lock_wait", "prep", "dispatch",
+                "readback", "demux"}
+    assert set(body["phases"]) == taxonomy
+    assert set(body["decomposition"]) == taxonomy
+    for snap in body["phases"].values():
+        assert {"n", "total_ns", "max_ns", "p50_ns", "p99_ns"} == set(snap)
+    for d in body["decomposition"].values():
+        assert {"count", "total_s", "avg_us", "share"} == set(d)
+    assert {"count", "min_interval_s", "last_path",
+            "last_mode"} <= set(body["capture"])
+
+
+def test_kernels_endpoint_schema_pinned(instance):
+    from gubernator_tpu.ops.decide import kernel_telemetry
+
+    body = kernel_telemetry.kernels_body()
+    assert body["schema_version"] == KERNELS_SCHEMA_VERSION == 1
+    assert set(body) == {"schema_version", "lanes_total", "kernels"}
+    for rec in body["kernels"].values():
+        assert {"windows", "dispatch_ns", "cost"} == set(rec)
 
 
 def test_keyspace_endpoint_schema_pinned(instance):
