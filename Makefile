@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast lint bench bench-skew bench-wire bench-reshard bench-suite bench-check capacity-report profile-report soak chaos proto docker clean native
+.PHONY: test test-fast lint bench bench-skew bench-wire bench-reshard bench-suite bench-check scenarios capacity-report profile-report soak chaos proto docker clean native
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -41,6 +41,13 @@ bench-suite:
 # throughput/latency key both rounds measured (see scripts/bench_check.py)
 bench-check:
 	python scripts/bench_check.py
+
+# scenario atlas: seeded workload drills against live 1-2 node clusters,
+# SLO verdicts written to the round's SCEN_r<NN>.json; exits 1 on any
+# FAIL (docs/OPERATIONS.md "Scenario drills"); PROFILE=full for the
+# real-length shapes
+scenarios:
+	python scripts/scenario_report.py --profile $(or $(PROFILE),short)
 
 # occupancy, headroom forecast, hit-mass concentration and top-K heavy
 # hitters from a running node's /v1/debug/{keyspace,history} endpoints

@@ -371,6 +371,141 @@ def _cartography_bench(n_calls: int = 1200, batch: int = 64,
         inst.close()
 
 
+def _capture_bench(n_calls: int = 800, batch: int = 64,
+                   reps: int = 3) -> dict:
+    """Traffic-shape capture cost against the 2% observability budget.
+    capture_trace() is a pure read of the history ring + cartographer +
+    recorder, normally triggered by an operator hitting
+    /v1/debug/capture — it is NOT on the serving path. Measured two
+    ways, mirroring _cartography_bench: an in-band capture once per
+    chunk (a stress ceiling ~orders beyond any real cadence) and the
+    direct per-capture cost duty-cycled at a one-capture-per-minute
+    operator cadence, which is the number judged against the budget."""
+    from gubernator_tpu.models.engine import Engine
+    from gubernator_tpu.obs.capture import capture_trace
+    from gubernator_tpu.service.config import InstanceConfig
+    from gubernator_tpu.service.instance import Instance
+    from gubernator_tpu.types import PeerInfo, RateLimitReq
+
+    CAPTURE_PROD_S = 60.0
+    inst = Instance(InstanceConfig(backend=Engine(capacity=262_144),
+                                   history_tick_s=1e-4,
+                                   keyspace_interval_s=3600.0),
+                    advertise_address="127.0.0.1:1")
+    inst.set_peers([PeerInfo(address="127.0.0.1:1")])  # self-owned: no RPC
+    frames = [
+        [RateLimitReq(name="capbench", unique_key=f"k{(i * batch + j) % 4096}",
+                      hits=1, limit=1 << 30, duration=3_600_000)
+         for j in range(batch)]
+        for i in range(n_calls)
+    ]
+    try:
+        t_ring = time.monotonic()
+        for f in frames[:100]:  # compile + warm the width bucket
+            inst.get_rate_limits(f)
+            # give the capture a real ring to read: the ring floors
+            # tick_s at 50 ms, so sub-ms warm frames must stamp
+            # synthetic tick times to land as distinct samples
+            t_ring += 0.1
+            inst.history.tick(now=t_ring)
+        inst.keyspace.harvest()
+
+        import gc
+        import statistics
+
+        CHUNK = 25
+        elapsed = {True: 0.0, False: 0.0}
+        calls = {True: 0, False: 0}
+        pair_overheads = []
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            for rep in range(reps):
+                i = 0
+                while i + 2 * CHUNK <= n_calls:
+                    first = len(pair_overheads) % 2 == 0
+                    rate = {}
+                    for capturing in (first, not first):
+                        chunk = frames[i:i + CHUNK]
+                        i += CHUNK
+                        t0 = time.perf_counter()
+                        for f in chunk:
+                            inst.get_rate_limits(f)
+                        if capturing:
+                            capture_trace(inst, n_events=64)
+                        dt = time.perf_counter() - t0
+                        elapsed[capturing] += dt
+                        calls[capturing] += CHUNK
+                        rate[capturing] = CHUNK * batch / dt
+                    pair_overheads.append(
+                        (rate[False] - rate[True]) / rate[False])
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        on = calls[True] * batch / elapsed[True]
+        off = calls[False] * batch / elapsed[False]
+        overhead_pct = statistics.median(pair_overheads) * 100.0
+
+        costs = []
+        trace = None
+        for _ in range(50):
+            t0 = time.perf_counter()
+            trace = capture_trace(inst, n_events=256)
+            costs.append(time.perf_counter() - t0)
+        capture_ms = statistics.median(costs) * 1e3
+        amortized_pct = 100.0 * capture_ms * 1e-3 / CAPTURE_PROD_S
+
+        return {
+            "capture": {
+                "capture_on_decisions_per_sec": round(on, 1),
+                "capture_off_decisions_per_sec": round(off, 1),
+                # one in-band capture per ~5 ms chunk: a stress ceiling
+                "overhead_pct": round(overhead_pct, 2),
+                # per-capture cost duty-cycled at one capture per minute
+                # — the number judged against the <= 2% budget
+                "amortized_overhead_pct": round(amortized_pct, 4),
+                "capture_ms": round(capture_ms, 3),
+                "trace_segments": len(trace["history"]["segments"]),
+                "trace_events": len(trace["events"]["tail"]),
+                "derived_mean_rate_rps": trace["derived"]["mean_rate_rps"],
+                "chunk_pairs": len(pair_overheads),
+                "reps": reps,
+                "batch": batch,
+                "calls_per_rep": n_calls,
+            }
+        }
+    finally:
+        inst.close()
+
+
+def _scenarios_bench(profile: str = "short") -> dict:
+    """The scenario atlas as a bench section: every named scenario runs
+    against its own fresh in-process cluster and records its verdict.
+    verdict_pass is the hard bench_check gate (a scenario flipping
+    PASS->FAIL across rounds is a regression, full stop); the latency
+    and goodput numbers ride along as operating-point context."""
+    from gubernator_tpu.scenarios import run_atlas
+
+    atlas = run_atlas(profile=profile)
+    out = {}
+    for name, v in atlas["scenarios"].items():
+        out[name] = {
+            "verdict_pass": int(v["passed"]),
+            "goodput": v["goodput"],
+            "over_limit_share": v["over_limit_share"],
+            "error_share": v["error_share"],
+            "p50_ms": v["stats"]["latency_ms"]["p50"],
+            "p99_ms": v["stats"]["latency_ms"]["p99"],
+            "offered": v["stats"]["offered"],
+            "detectors_tripped": sum(
+                v["stats"]["detectors_tripped"].values()),
+        }
+    out["passed_count"] = sum(
+        v["verdict_pass"] for v in out.values() if isinstance(v, dict))
+    out["total"] = len(atlas["scenarios"])
+    return {"scenarios": out}
+
+
 def _profile_bench(n_calls: int = 1500, batch: int = 64, reps: int = 3) -> dict:
     """Profiling-plane overhead on the serving path: the SAME single-node
     Instance serving identical batch streams with the serving-cycle
@@ -1843,6 +1978,26 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — report, don't die
         carto_row = {"cartography": {"error": str(e)}}
 
+    # ---- traffic-shape capture: /v1/debug/capture assembly cost -----------
+    # Same single-node Instance; one in-band capture per chunk (stress
+    # ceiling) plus the direct per-capture cost duty-cycled at a
+    # one-capture-per-minute operator cadence (acceptance: amortized <= 2%).
+    try:
+        capture_row = _capture_bench()
+    except Exception as e:  # noqa: BLE001 — report, don't die
+        capture_row = {"capture": {"error": str(e)}}
+
+    # ---- scenario atlas: seeded traffic shapes judged by the obs plane ----
+    # Every named scenario runs its short profile against a fresh
+    # in-process cluster; verdict_pass gates hard in bench_check
+    # (opt-in via --scenarios: six cluster boots cost ~a minute).
+    scenarios_row = {}
+    if "--scenarios" in sys.argv:
+        try:
+            scenarios_row = _scenarios_bench()
+        except Exception as e:  # noqa: BLE001 — report, don't die
+            scenarios_row = {"scenarios": {"error": str(e)}}
+
     # ---- profiling plane: serving-cycle profiler on vs GUBER_PROFILE=0 ----
     # Single-node serving with the cycle profiler enabled vs the escape
     # hatch on the same Instance; BENCH_r14 records the overhead
@@ -1872,6 +2027,8 @@ def main() -> None:
                 **reshard_row,
                 **obs_row,
                 **carto_row,
+                **capture_row,
+                **scenarios_row,
                 **profile_row,
                 **_multichip_section(),
                 "phase_breakdown_ms": phases,

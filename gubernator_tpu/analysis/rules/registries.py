@@ -21,7 +21,12 @@ Flight recorder table):
   serves must have a row in docs/observability.md's "## Debug
   endpoints" table, and every row must name a route the gateway still
   dispatches (PR 13 motivation: /v1/debug/profile and /v1/debug/kernels
-  must not become the next undocumented surface).
+  must not become the next undocumented surface);
+- named scenarios: every entry in scenarios/spec.py SCENARIO_NAMES must
+  have a row in docs/observability.md's "## Scenario atlas" table, and
+  every row must name a scenario the registry still builds — the atlas
+  is the operator's drill menu, and a drill the docs don't name (or
+  promise but the registry dropped) is a verdict nobody runs.
 """
 
 from __future__ import annotations
@@ -37,6 +42,7 @@ FAULTS = "gubernator_tpu/service/faults.py"
 INTROSPECT = "gubernator_tpu/obs/introspect.py"
 SCHEMA_TEST = "tests/test_debug_schema.py"
 GATEWAY = "gubernator_tpu/service/http_gateway.py"
+SCENARIOS = "gubernator_tpu/scenarios/spec.py"
 
 _EMIT_FNS = frozenset({"emit", "_emit", "_record"})
 
@@ -106,14 +112,16 @@ def _documented_kinds(repo: RepoIndex
 @register
 class RegistryDriftRule(Rule):
     id = "registry-drift"
-    doc = ("flight-recorder kinds, fault transports, and /v1/debug/vars "
-           "sections must stay in sync with their documented registries")
+    doc = ("flight-recorder kinds, fault transports, /v1/debug/vars "
+           "sections, debug endpoints, and named scenarios must stay in "
+           "sync with their documented registries")
 
     def check(self, repo: RepoIndex) -> Iterable[Finding]:
         yield from self._check_events(repo)
         yield from self._check_faults(repo)
         yield from self._check_debug_sections(repo)
         yield from self._check_debug_endpoints(repo)
+        yield from self._check_scenarios(repo)
 
     # ---------------------------------------------------------- events
 
@@ -258,6 +266,44 @@ class RegistryDriftRule(Rule):
                     "a stale schema promise")
 
 
+    # ------------------------------------------------------- scenarios
+
+    def _check_scenarios(self, repo: RepoIndex) -> Iterable[Finding]:
+        ssf = repo.get(SCENARIOS)
+        if ssf is None or ssf.tree is None:
+            return
+        registered: List[Tuple[str, int]] = []
+        for node in ast.walk(ssf.tree):
+            if isinstance(node, ast.Assign) \
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "SCENARIO_NAMES"
+                            for t in node.targets) \
+                    and isinstance(node.value, ast.Tuple):
+                for elt in node.value.elts:
+                    if isinstance(elt, ast.Constant) \
+                            and isinstance(elt.value, str):
+                        registered.append((elt.value, node.lineno))
+        documented = _documented_scenarios(repo)
+        if not registered or not documented:
+            return  # corpus repo without the atlas or the doc table
+        doc_names = set(documented)
+        for name, line in registered:
+            if name not in doc_names:
+                yield Finding(
+                    self.id, SCENARIOS, line,
+                    f"scenario '{name}' is registered in SCENARIO_NAMES "
+                    f"but missing from the {OBS_DOC} '## Scenario atlas' "
+                    "table — a drill the runbook doesn't name is a "
+                    "verdict nobody runs")
+        reg_names = {n for n, _ in registered}
+        for name, line in sorted(documented.items()):
+            if name not in reg_names:
+                yield Finding(
+                    self.id, OBS_DOC, line,
+                    f"scenario '{name}' is documented but the registry "
+                    "no longer builds it — the runbook promises a drill "
+                    "that raises KeyError")
+
     # -------------------------------------------------- debug endpoints
 
     def _check_debug_endpoints(self, repo: RepoIndex) -> Iterable[Finding]:
@@ -289,6 +335,26 @@ class RegistryDriftRule(Rule):
                     f"debug endpoint '{route}' is documented but the "
                     "gateway never dispatches it — the runbook promises "
                     "a surface that 404s")
+
+
+def _documented_scenarios(repo: RepoIndex) -> Dict[str, int]:
+    """Scenario names from the '## Scenario atlas' table's first
+    column: backticked hyphenated names."""
+    sf = repo.get(OBS_DOC)
+    out: Dict[str, int] = {}
+    if sf is None:
+        return out
+    in_section = False
+    for i, line in enumerate(sf.lines, 1):
+        if line.startswith("## "):
+            in_section = line.strip() == "## Scenario atlas"
+            continue
+        if not in_section or not line.startswith("|"):
+            continue
+        first_cell = line.split("|")[1]
+        for name in re.findall(r"`([a-z0-9-]+)`", first_cell):
+            out.setdefault(name, i)
+    return out
 
 
 def _documented_endpoints(repo: RepoIndex) -> Dict[str, int]:

@@ -1,0 +1,35 @@
+"""Scenario atlas: seeded workload generation, traffic capture/replay,
+and the SLO verdict engine (ROADMAP item 4 — the observability stack
+becomes the pass/fail judge for million-user traffic shapes).
+
+- spec.py       — the declarative scenario spec + the named registry
+- generator.py  — seeded, deterministic arrival-schedule generation
+- replay.py     — captured-trace -> replayable ScenarioSpec
+- runner.py     — drive a live cluster, judge with the anomaly engine
+
+The daemon-side capture endpoint lives in obs/capture.py (it reads the
+flight recorder, history ring, and keyspace cartography — all obs
+surfaces); this package is the client side that replays what capture
+recorded.
+"""
+
+from gubernator_tpu.scenarios.generator import WorkloadGenerator
+from gubernator_tpu.scenarios.replay import trace_to_spec
+from gubernator_tpu.scenarios.runner import run_atlas, run_scenario
+from gubernator_tpu.scenarios.spec import (
+    SCENARIO_NAMES,
+    ScenarioSpec,
+    get_scenario,
+    scenario_names,
+)
+
+__all__ = [
+    "SCENARIO_NAMES",
+    "ScenarioSpec",
+    "WorkloadGenerator",
+    "get_scenario",
+    "run_atlas",
+    "run_scenario",
+    "scenario_names",
+    "trace_to_spec",
+]

@@ -1,0 +1,103 @@
+"""Captured-trace -> replayable ScenarioSpec.
+
+The daemon-side capture (obs/capture.py) reduces what the obs plane
+saw to a `derived` section: piecewise decision-rate segments and a
+fitted key-popularity model. This module lifts that into a full
+`ScenarioSpec`, so a production shape replays through exactly the same
+generator/runner/verdict machinery as the hand-written atlas.
+
+Fidelity contract (pinned by tests/test_scenarios.py):
+
+- mean offered rate of the replayed schedule lands within ~25% of the
+  captured mean (Poisson draw noise + segment quantization), and
+- the replayed key skew reproduces the captured Zipf exponent within
+  ~0.4 when re-fitted by the same cartographer estimator (rank-head
+  slope fits are noisy at small key counts — the tolerance is the
+  estimator's, not the generator's).
+
+A replay is a *shape* reconstruction, not a log replay: per-request
+identity (exact keys, exact timestamps) is deliberately discarded —
+the obs plane stores curves, not requests, which is what keeps capture
+inside the 2% observability budget.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from gubernator_tpu.scenarios.spec import (
+    Envelope,
+    KeyModel,
+    Profile,
+    ScenarioSpec,
+    Segment,
+    Tenant,
+)
+
+# Replay compresses micro-segments below this span into their
+# neighbors: ring ticks are ~5s in production but can be subsecond in
+# tests, and a schedule of hundred-millisecond segments paces poorly.
+MIN_REPLAY_SEGMENT_S = 0.5
+
+
+def _coalesce(segments, min_span_s: float):
+    """Merge adjacent derived segments until each spans at least
+    min_span_s, rate-averaging by duration — the replayed schedule
+    keeps the curve's area (total offered requests) exact."""
+    out = []
+    acc_s, acc_req = 0.0, 0.0
+    for seg in segments:
+        acc_s += float(seg["duration_s"])
+        acc_req += float(seg["rate_rps"]) * float(seg["duration_s"])
+        if acc_s >= min_span_s:
+            out.append(Segment(acc_s, acc_req / acc_s))
+            acc_s, acc_req = 0.0, 0.0
+    if acc_s > 0 and acc_req > 0:
+        out.append(Segment(acc_s, acc_req / acc_s))
+    return out
+
+
+def trace_to_spec(trace: dict, name: str = "replay",
+                  seed: int = 1, nodes: int = 1,
+                  envelope: Optional[Envelope] = None,
+                  min_segment_s: float = MIN_REPLAY_SEGMENT_S,
+                  ) -> ScenarioSpec:
+    """Build a replayable spec from a capture-endpoint trace."""
+    derived = trace.get("derived") or {}
+    segments = _coalesce(derived.get("segments") or [], min_segment_s)
+    if not segments:
+        mean = float(derived.get("mean_rate_rps") or 0.0)
+        if mean <= 0:
+            raise ValueError(
+                "trace has no live rate segments to replay — capture a "
+                "window where the daemon actually served traffic")
+        segments = [Segment(10.0, mean)]
+
+    km = derived.get("key_model") or {}
+    key_model = KeyModel(
+        kind=km.get("kind", "zipf"),
+        n_keys=max(1, int(km.get("n_keys", 1024))),
+        exponent=float(km.get("exponent", 1.1)),
+        prefix="r",
+    )
+
+    over_share = float(derived.get("over_limit_share") or 0.0)
+    spec = ScenarioSpec(
+        name=name,
+        description=f"replay of {trace.get('node') or 'captured daemon'} "
+                    f"at {trace.get('captured_at', 0):.0f}",
+        seed=seed,
+        segments=segments,
+        tenants=[Tenant(name="replay", share=1.0, keys=key_model)],
+        envelope=envelope or Envelope(
+            # replay inherits the atlas default envelope, but an
+            # observed over-limit share means the captured tenant mix
+            # was being limited — don't fail the replay for matching it
+            min_over_limit_share=0.0,
+            max_error_share=0.0,
+        ),
+        nodes=max(1, int(nodes)),
+        profiles={"short": Profile(), "full": Profile()},
+    )
+    spec.validate()
+    return spec

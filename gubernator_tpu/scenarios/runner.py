@@ -1,0 +1,329 @@
+"""Drive a scenario against a live cluster and render the SLO verdict.
+
+The runner paces a generated schedule onto real in-process daemons
+(the same LocalCluster harness the drills use), fires the spec's
+timeline events (kills, restarts, membership syncs, fault specs) on a
+side thread so a multi-second node boot never stalls the arrival
+clock, and then judges the run: client-observed latency percentiles
+and goodput against the spec's envelope, plus the anomaly engine's
+detector rising edges — a forbidden detector tripping during the run
+fails the verdict, exactly as it would page an operator.
+
+`render_verdict` is a pure function of the spec and the run's
+aggregate stats, so tests can unit-drill the judgment (a forced SLO
+burn must FAIL) without booting a cluster.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+from gubernator_tpu.obs.anomaly import DETECTORS
+from gubernator_tpu.scenarios.generator import WorkloadGenerator, windowed
+from gubernator_tpu.scenarios.spec import (
+    SCENARIO_NAMES,
+    ScenarioSpec,
+    get_scenario,
+)
+
+VERDICT_SCHEMA_VERSION = 1
+
+# Pacing granularity: arrivals inside one window submit as one batch —
+# coarse enough to amortize the RPC, fine enough that a rate ramp is
+# visible in the history ring.
+BATCH_WINDOW_S = 0.05
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _cluster_behaviors(spec: ScenarioSpec):
+    from gubernator_tpu.cluster.harness import test_behaviors
+
+    beh = test_behaviors()
+    for field, value in spec.behaviors.items():
+        if not hasattr(beh, field):
+            raise ValueError(
+                f"scenario {spec.name}: unknown behavior field {field!r}")
+        setattr(beh, field, value)
+    return beh
+
+
+def _trips(instance) -> Dict[str, int]:
+    try:
+        return dict(instance.anomaly.trips)
+    except Exception:  # noqa: BLE001 — stub instances have no engine
+        return {}
+
+
+class _EventThread:
+    """Fires the spec's timeline on its own clock so a blocking action
+    (Engine boot on restart_node takes seconds) never stalls pacing.
+    Owns the liveness map the driver routes around."""
+
+    def __init__(self, cluster, spec: ScenarioSpec, behaviors,
+                 anchor: float):
+        self._cluster = cluster
+        self._spec = spec
+        self._behaviors = behaviors
+        self._anchor = anchor
+        self.lock = threading.Lock()
+        self.dead: set = set()  # instance indices the driver must skip
+        self.fired: List[dict] = []
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if not self._spec.events:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name="scenario-events", daemon=True)
+        self._thread.start()
+
+    def join(self, timeout_s: float = 30.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout=timeout_s)
+
+    def _run(self) -> None:
+        from gubernator_tpu.service import faults
+
+        for ev in sorted(self._spec.events, key=lambda e: e.at_s):
+            delay = self._anchor + ev.at_s - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            t_fire = time.monotonic() - self._anchor
+            try:
+                self._fire(ev, faults)
+                err = ""
+            except Exception as e:  # noqa: BLE001 — record, keep the timeline
+                err = repr(e)
+            self.fired.append({"action": ev.action, "node": ev.node,
+                               "at_s": round(ev.at_s, 3),
+                               "fired_at_s": round(t_fire, 3),
+                               "error": err})
+
+    def _fire(self, ev, faults) -> None:
+        cluster = self._cluster
+        if ev.action == "kill_node":
+            with self.lock:
+                self.dead.add(ev.node)
+            cluster.stop_instance_at(ev.node)
+        elif ev.action == "restart_node":
+            addr = cluster.instances[ev.node].address
+            port = int(addr.rsplit(":", 1)[1])
+            if ev.node not in self.dead:
+                with self.lock:
+                    self.dead.add(ev.node)
+                cluster.stop_instance_at(ev.node)
+            cluster.start_instance(
+                fixed_port=port,
+                behaviors=dataclasses.replace(self._behaviors))
+            cluster.sync_peers()
+            with self.lock:
+                self.dead.discard(ev.node)
+        elif ev.action == "add_node":
+            cluster.start_instance(
+                behaviors=dataclasses.replace(self._behaviors))
+            cluster.sync_peers()
+        elif ev.action == "sync_peers":
+            cluster.sync_peers()
+        elif ev.action == "inject_fault":
+            faults.install(ev.arg)
+        elif ev.action == "clear_faults":
+            faults.clear()
+
+    def live_instances(self):
+        with self.lock:
+            dead = set(self.dead)
+        return [ci.instance for i, ci in enumerate(self._cluster.instances)
+                if i not in dead]
+
+
+def run_scenario(spec: ScenarioSpec, cluster=None, profile: str = "short",
+                 window_s: float = BATCH_WINDOW_S) -> dict:
+    """Run one scenario and return its machine-readable verdict. Boots
+    (and tears down) a LocalCluster of spec.nodes when none is given;
+    a caller-provided cluster is reused and left running."""
+    scaled = spec.for_profile(profile)
+    scaled.validate()
+    schedule = WorkloadGenerator(scaled).schedule()
+
+    own_cluster = cluster is None
+    behaviors = _cluster_behaviors(scaled)
+    if own_cluster:
+        from gubernator_tpu.cluster.harness import LocalCluster
+
+        cluster = LocalCluster().start(
+            scaled.nodes, behaviors=dataclasses.replace(behaviors))
+        time.sleep(0.3)  # boot grace: first peer RPCs past JIT warmup
+    try:
+        trips_before = {ci.address: _trips(ci.instance)
+                        for ci in cluster.instances}
+        anchor = time.monotonic()
+        events = _EventThread(cluster, scaled, behaviors, anchor)
+        events.start()
+
+        latencies: List[float] = []
+        ok = over_limit = errors = 0
+        batches = 0
+        max_lag_s = 0.0
+        rr = 0
+        last_sweep = anchor
+        for start_s, arrivals in windowed(schedule, window_s):
+            delay = anchor + start_s - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                max_lag_s = max(max_lag_s, -delay)
+            live = events.live_instances()
+            if not live:
+                errors += len(arrivals)
+                continue
+            inst = live[rr % len(live)]
+            rr += 1
+            reqs = [a.to_request() for a in arrivals]
+            t0 = time.perf_counter()
+            try:
+                resps = inst.get_rate_limits(reqs)
+            except Exception:  # noqa: BLE001 — a dying node fails a batch
+                errors += len(reqs)
+                continue
+            latencies.append((time.perf_counter() - t0) * 1e3)
+            batches += 1
+            for resp in resps:
+                if resp.error:
+                    errors += 1
+                elif resp.status == 1:  # Status.OVER_LIMIT
+                    over_limit += 1
+                else:
+                    ok += 1
+            now = time.monotonic()
+            if now - last_sweep >= 0.25:
+                last_sweep = now
+                for li in events.live_instances():
+                    try:
+                        li.anomaly.check(now)
+                    except Exception:  # noqa: BLE001
+                        pass
+        events.join()
+        time.sleep(0.2)  # let in-flight async work land before the sweep
+        now = time.monotonic()
+        tripped: Dict[str, int] = {}
+        for ci in cluster.instances:
+            inst = ci.instance
+            try:
+                inst.anomaly.check(now)
+            except Exception:  # noqa: BLE001 — stopped instance
+                continue
+            before = trips_before.get(ci.address, {})
+            for det, n in _trips(inst).items():
+                delta = n - before.get(det, 0)
+                if delta > 0:
+                    tripped[det] = tripped.get(det, 0) + delta
+    finally:
+        if own_cluster:
+            from gubernator_tpu.service import faults
+
+            faults.clear()
+            cluster.stop()
+
+    latencies.sort()
+    offered = len(schedule)
+    stats = {
+        "offered": offered,
+        "ok": ok,
+        "over_limit": over_limit,
+        "errors": errors,
+        "batches": batches,
+        "max_lag_s": round(max_lag_s, 3),
+        "latency_ms": {
+            "p50": round(_percentile(latencies, 0.50), 3),
+            "p95": round(_percentile(latencies, 0.95), 3),
+            "p99": round(_percentile(latencies, 0.99), 3),
+            "max": round(max(latencies), 3) if latencies else 0.0,
+        },
+        "detectors_tripped": tripped,
+        "events": events.fired,
+    }
+    return render_verdict(scaled, stats, profile=profile)
+
+
+def render_verdict(spec: ScenarioSpec, stats: dict,
+                   profile: str = "") -> dict:
+    """Judge aggregate run stats against the spec's envelope. Pure —
+    the unit drills feed synthetic stats (a forced SLO burn, an
+    inflated p99) and assert the verdict flips to FAIL."""
+    env = spec.envelope
+    offered = max(1, int(stats.get("offered", 0)))
+    ok = int(stats.get("ok", 0))
+    over_limit = int(stats.get("over_limit", 0))
+    errors = int(stats.get("errors", 0))
+    decided = ok + over_limit
+    goodput = decided / offered
+    error_share = errors / offered
+    over_share = over_limit / decided if decided else 0.0
+    p99 = float(stats.get("latency_ms", {}).get("p99", 0.0))
+    tripped = dict(stats.get("detectors_tripped", {}))
+    forbidden = sorted(d for d in tripped
+                       if d in env.forbid_detectors)
+    allowed = sorted(d for d in tripped
+                     if d in env.allow_detectors)
+
+    checks = [
+        {"name": "p99_ms", "ok": p99 <= env.max_p99_ms,
+         "observed": p99, "threshold": env.max_p99_ms},
+        {"name": "goodput", "ok": goodput >= env.min_goodput,
+         "observed": round(goodput, 6), "threshold": env.min_goodput},
+        {"name": "error_share", "ok": error_share <= env.max_error_share,
+         "observed": round(error_share, 6),
+         "threshold": env.max_error_share},
+        {"name": "forbidden_detectors", "ok": not forbidden,
+         "observed": forbidden, "threshold": list(env.forbid_detectors)},
+    ]
+    if env.min_over_limit_share > 0:
+        checks.append(
+            {"name": "over_limit_share",
+             "ok": over_share >= env.min_over_limit_share,
+             "observed": round(over_share, 6),
+             "threshold": env.min_over_limit_share})
+    unknown = sorted(d for d in tripped if d not in DETECTORS)
+    if unknown:
+        checks.append({"name": "known_detectors", "ok": False,
+                       "observed": unknown, "threshold": list(DETECTORS)})
+
+    return {
+        "schema_version": VERDICT_SCHEMA_VERSION,
+        "scenario": spec.name,
+        "profile": profile,
+        "seed": spec.seed,
+        "duration_s": round(spec.duration_s(), 3),
+        "passed": all(c["ok"] for c in checks),
+        "checks": checks,
+        "goodput": round(goodput, 6),
+        "over_limit_share": round(over_share, 6),
+        "error_share": round(error_share, 6),
+        "allowed_detectors_seen": allowed,
+        "stats": stats,
+    }
+
+
+def run_atlas(names: Optional[Sequence[str]] = None,
+              profile: str = "short") -> dict:
+    """Run (a subset of) the atlas, one fresh cluster per scenario, and
+    return {"scenarios": {...}, "passed": bool}."""
+    names = list(names or SCENARIO_NAMES)
+    out: Dict[str, dict] = {}
+    for name in names:
+        out[name] = run_scenario(get_scenario(name), profile=profile)
+    return {
+        "schema_version": VERDICT_SCHEMA_VERSION,
+        "profile": profile,
+        "scenarios": out,
+        "passed": all(v["passed"] for v in out.values()),
+    }

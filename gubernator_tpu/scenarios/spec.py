@@ -1,0 +1,474 @@
+"""Declarative scenario spec + the named-scenario registry.
+
+A scenario is a complete, seeded description of a traffic shape and the
+envelope it must be served within: an arrival-rate schedule (piecewise
+segments, optionally ramping), per-tenant config mixes with their own
+key-popularity models, fault/membership events on a timeline, and the
+SLO envelope the verdict engine judges the run against. Everything is
+plain data — the generator (generator.py) turns a spec into a
+deterministic arrival schedule, the runner (runner.py) drives it
+against a live cluster and renders the verdict.
+
+The registry below is the operator-facing atlas: `SCENARIO_NAMES` is
+the authoritative name tuple (guberlint `registry-drift` keeps it in
+lock-step with the docs/observability.md "## Scenario atlas" table,
+both directions, the same way flight-recorder kinds are pinned).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Dict, List, Optional, Tuple
+
+# ---------------------------------------------------------------- data model
+
+
+@dataclasses.dataclass
+class KeyModel:
+    """Key-popularity model for one tenant's traffic.
+
+    kind "zipf": rank r drawn with weight 1/r^exponent over n_keys ranks
+    (exponent ~0 degrades to uniform); kind "uniform" is the explicit
+    uniform spelling. Keys render as f"{prefix}{rank:05d}" so rank 0 is
+    always the hottest key — stable across runs and readable in the
+    cartographer's top-K table.
+    """
+
+    kind: str = "zipf"
+    n_keys: int = 1024
+    exponent: float = 1.1
+    prefix: str = "k"
+
+    def validate(self) -> None:
+        if self.kind not in ("zipf", "uniform"):
+            raise ValueError(f"unknown key model kind {self.kind!r}")
+        if self.n_keys < 1:
+            raise ValueError("key model n_keys must be >= 1")
+        if self.kind == "zipf" and self.exponent < 0:
+            raise ValueError("zipf exponent cannot be negative")
+
+
+@dataclasses.dataclass
+class Tenant:
+    """One tenant's slice of the mix: its share of arrivals and the
+    rate-limit config its requests carry (the reference carries config in
+    every request precisely so tenants differ — PAPER.md §0)."""
+
+    name: str
+    share: float = 1.0
+    keys: KeyModel = dataclasses.field(default_factory=KeyModel)
+    hits: int = 1
+    limit: int = 1_000_000
+    duration_ms: int = 3_600_000
+    algorithm: int = 0  # TOKEN_BUCKET; 1 = LEAKY_BUCKET
+    behavior: int = 0  # BATCHING; pipelines stay off unless a spec opts in
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValueError("tenant name cannot be empty")
+        if self.share <= 0:
+            raise ValueError(f"tenant {self.name}: share must be positive")
+        if self.hits < 1 or self.limit < 1 or self.duration_ms < 1:
+            raise ValueError(
+                f"tenant {self.name}: hits/limit/duration must be >= 1")
+        self.keys.validate()
+
+
+@dataclasses.dataclass
+class Segment:
+    """One piece of the arrival-rate schedule. rate_rps holds for
+    duration_s; a non-None end_rate_rps ramps linearly across it."""
+
+    duration_s: float
+    rate_rps: float
+    end_rate_rps: Optional[float] = None
+
+    def validate(self) -> None:
+        if self.duration_s <= 0:
+            raise ValueError("segment duration_s must be positive")
+        if self.rate_rps < 0 or (self.end_rate_rps or 0) < 0:
+            raise ValueError("segment rates cannot be negative")
+
+
+@dataclasses.dataclass
+class TimelineEvent:
+    """A fault/membership event fired when the (scaled) clock crosses
+    at_s. Actions the runner knows: add_node, kill_node, restart_node,
+    sync_peers, inject_fault (arg = a GUBER_FAULT_SPEC string),
+    clear_faults. node is an index into the cluster's instance list."""
+
+    at_s: float
+    action: str
+    node: int = 0
+    arg: str = ""
+
+    ACTIONS = ("add_node", "kill_node", "restart_node", "sync_peers",
+               "inject_fault", "clear_faults")
+
+    def validate(self) -> None:
+        if self.action not in self.ACTIONS:
+            raise ValueError(f"unknown timeline action {self.action!r}; "
+                             f"choices are {list(self.ACTIONS)}")
+        if self.at_s < 0:
+            raise ValueError("event at_s cannot be negative")
+
+
+@dataclasses.dataclass
+class Envelope:
+    """The SLO envelope a run must land inside to PASS. Latencies are
+    client-observed per-batch decision latencies; goodput is decided
+    responses (OK or OVER_LIMIT — an over-limit answer is the limiter
+    WORKING) over offered requests. forbid_detectors are anomaly-engine
+    detectors whose rising edge during the run fails the verdict;
+    allow_detectors documents edges the scenario expects (a failover
+    drill EXPECTS circuit_open) so the report can show them without
+    failing. min_over_limit_share gives abuse scenarios teeth: a bot
+    storm that never sees OVER_LIMIT means the limiter did not limit."""
+
+    max_p99_ms: float = 250.0
+    min_goodput: float = 0.999
+    max_error_share: float = 0.0
+    min_over_limit_share: float = 0.0
+    forbid_detectors: Tuple[str, ...] = ("slo_burn", "capacity")
+    allow_detectors: Tuple[str, ...] = ()
+
+    def validate(self) -> None:
+        from gubernator_tpu.obs.anomaly import DETECTORS
+
+        if self.max_p99_ms <= 0:
+            raise ValueError("envelope max_p99_ms must be positive")
+        if not 0.0 <= self.min_goodput <= 1.0:
+            raise ValueError("envelope min_goodput must be in [0, 1]")
+        for det in self.forbid_detectors + self.allow_detectors:
+            if det not in DETECTORS:
+                raise ValueError(f"envelope names unknown detector {det!r}")
+        overlap = set(self.forbid_detectors) & set(self.allow_detectors)
+        if overlap:
+            raise ValueError(
+                f"detectors both forbidden and allowed: {sorted(overlap)}")
+
+
+@dataclasses.dataclass
+class Profile:
+    """How a named profile compresses the scenario: durations and event
+    times multiply by time_scale, rates by rate_scale."""
+
+    time_scale: float = 1.0
+    rate_scale: float = 1.0
+
+
+@dataclasses.dataclass
+class ScenarioSpec:
+    """The complete declarative scenario."""
+
+    name: str
+    description: str = ""
+    seed: int = 1
+    segments: List[Segment] = dataclasses.field(default_factory=list)
+    tenants: List[Tenant] = dataclasses.field(default_factory=list)
+    events: List[TimelineEvent] = dataclasses.field(default_factory=list)
+    envelope: Envelope = dataclasses.field(default_factory=Envelope)
+    nodes: int = 1  # cluster size the scenario wants (1 or 2 in-process)
+    behaviors: Dict[str, object] = dataclasses.field(default_factory=dict)
+    profiles: Dict[str, Profile] = dataclasses.field(default_factory=dict)
+
+    def validate(self) -> None:
+        if not self.name:
+            raise ValueError("scenario name cannot be empty")
+        if not self.segments:
+            raise ValueError(f"scenario {self.name}: no rate segments")
+        if not self.tenants:
+            raise ValueError(f"scenario {self.name}: no tenants")
+        if self.nodes < 1:
+            raise ValueError(f"scenario {self.name}: nodes must be >= 1")
+        for seg in self.segments:
+            seg.validate()
+        for t in self.tenants:
+            t.validate()
+        names = [t.name for t in self.tenants]
+        if len(set(names)) != len(names):
+            raise ValueError(f"scenario {self.name}: duplicate tenant names")
+        total = self.duration_s()
+        for ev in self.events:
+            ev.validate()
+            if ev.at_s > total:
+                raise ValueError(
+                    f"scenario {self.name}: event {ev.action} at "
+                    f"{ev.at_s}s lands past the {total}s schedule")
+        self.envelope.validate()
+
+    def duration_s(self) -> float:
+        return sum(seg.duration_s for seg in self.segments)
+
+    def scaled(self, time_scale: float = 1.0,
+               rate_scale: float = 1.0) -> "ScenarioSpec":
+        """A compressed copy: durations/event times x time_scale, rates
+        x rate_scale. The envelope is untouched — per-batch latency and
+        goodput targets do not change with compression."""
+        out = dataclasses.replace(
+            self,
+            segments=[Segment(s.duration_s * time_scale,
+                              s.rate_rps * rate_scale,
+                              None if s.end_rate_rps is None
+                              else s.end_rate_rps * rate_scale)
+                      for s in self.segments],
+            events=[dataclasses.replace(e, at_s=e.at_s * time_scale)
+                    for e in self.events],
+            tenants=[dataclasses.replace(
+                t, keys=dataclasses.replace(t.keys)) for t in self.tenants],
+            envelope=dataclasses.replace(self.envelope),
+            behaviors=dict(self.behaviors),
+            profiles=dict(self.profiles),
+        )
+        return out
+
+    def for_profile(self, profile: str) -> "ScenarioSpec":
+        p = self.profiles.get(profile, Profile())
+        return self.scaled(p.time_scale, p.rate_scale)
+
+
+# ------------------------------------------------------------- the atlas
+#
+# The authoritative name registry. guberlint `registry-drift` checks this
+# tuple against the docs/observability.md "## Scenario atlas" table in
+# both directions — a scenario without a doc row, or a doc row without a
+# builder, is a lint finding.
+
+SCENARIO_NAMES = (
+    "diurnal-tide",
+    "flash-crowd",
+    "bot-storm",
+    "multi-tenant-mix",
+    "regional-failover",
+    "rolling-restart",
+)
+
+
+def _diurnal_tide() -> ScenarioSpec:
+    # A compressed day: trough -> morning ramp -> plateau -> evening
+    # peak -> ramp down. Shape-only stress: the envelope expects clean
+    # serving end to end.
+    return ScenarioSpec(
+        name="diurnal-tide",
+        description="24h sine compressed: trough, ramp, plateau, peak, "
+                    "decay — the baseline 'normal day' shape",
+        seed=11,
+        segments=[
+            Segment(20.0, 150.0),
+            Segment(20.0, 150.0, 600.0),
+            Segment(40.0, 600.0),
+            Segment(20.0, 600.0, 900.0),
+            Segment(20.0, 900.0, 150.0),
+        ],
+        tenants=[
+            Tenant(name="api", share=0.8,
+                   keys=KeyModel("zipf", n_keys=2048, exponent=0.9),
+                   limit=1_000_000),
+            Tenant(name="web", share=0.2,
+                   keys=KeyModel("uniform", n_keys=512, prefix="w"),
+                   limit=500_000),
+        ],
+        envelope=Envelope(max_p99_ms=200.0, min_goodput=0.999,
+                          forbid_detectors=("slo_burn", "capacity",
+                                            "deadline_burst", "shed_spike")),
+        nodes=2,
+        profiles={"short": Profile(time_scale=0.035, rate_scale=0.8),
+                  "full": Profile()},
+    )
+
+
+def _flash_crowd() -> ScenarioSpec:
+    # Steady state, then an 8x spike concentrated on a hot Zipf head,
+    # then decay — the breaking-news shape the lease tier exists for.
+    return ScenarioSpec(
+        name="flash-crowd",
+        description="8x arrival spike on a hot Zipf head over a steady "
+                    "baseline, then decay",
+        seed=23,
+        segments=[
+            Segment(30.0, 200.0),
+            Segment(5.0, 200.0, 1600.0),
+            Segment(25.0, 1600.0),
+            Segment(20.0, 1600.0, 200.0),
+        ],
+        tenants=[
+            Tenant(name="crowd", share=0.9,
+                   keys=KeyModel("zipf", n_keys=512, exponent=1.3),
+                   limit=2_000_000),
+            Tenant(name="background", share=0.1,
+                   keys=KeyModel("uniform", n_keys=1024, prefix="b"),
+                   limit=1_000_000),
+        ],
+        envelope=Envelope(max_p99_ms=250.0, min_goodput=0.995,
+                          forbid_detectors=("slo_burn", "capacity")),
+        nodes=2,
+        profiles={"short": Profile(time_scale=0.045, rate_scale=0.6),
+                  "full": Profile()},
+    )
+
+
+def _bot_storm() -> ScenarioSpec:
+    # An abusive tenant hammers a tiny key set with big hit counts
+    # against a small limit: the limiter must answer OVER_LIMIT (that IS
+    # goodput here — min_over_limit_share proves it actually limited)
+    # while the well-behaved tenant stays clean.
+    return ScenarioSpec(
+        name="bot-storm",
+        description="abusive tenant hammers a tiny hot set into a small "
+                    "limit; the verdict demands OVER_LIMIT answers",
+        seed=37,
+        segments=[
+            Segment(10.0, 300.0),
+            Segment(40.0, 1200.0),
+            Segment(10.0, 300.0),
+        ],
+        tenants=[
+            Tenant(name="bots", share=0.7,
+                   keys=KeyModel("zipf", n_keys=24, exponent=1.5,
+                                 prefix="bot"),
+                   hits=5, limit=500, duration_ms=3_600_000),
+            Tenant(name="legit", share=0.3,
+                   keys=KeyModel("zipf", n_keys=1024, exponent=0.9),
+                   limit=1_000_000),
+        ],
+        envelope=Envelope(max_p99_ms=250.0, min_goodput=0.999,
+                          min_over_limit_share=0.3,
+                          forbid_detectors=("slo_burn", "capacity")),
+        nodes=1,
+        profiles={"short": Profile(time_scale=0.05, rate_scale=0.7),
+                  "full": Profile()},
+    )
+
+
+def _multi_tenant_mix() -> ScenarioSpec:
+    # Four tenants with different algorithms, limits, durations, and
+    # popularity models at once — the config-in-every-request property
+    # the reference was built around, as one steady mixed stream.
+    return ScenarioSpec(
+        name="multi-tenant-mix",
+        description="four tenants: token/leaky buckets, second-scale to "
+                    "hour-scale windows, uniform to heavy-skew keys",
+        seed=53,
+        segments=[Segment(60.0, 800.0)],
+        tenants=[
+            Tenant(name="checkout", share=0.15,
+                   keys=KeyModel("zipf", n_keys=256, exponent=1.1,
+                                 prefix="c"),
+                   limit=10_000, duration_ms=60_000, algorithm=0),
+            Tenant(name="search", share=0.45,
+                   keys=KeyModel("zipf", n_keys=4096, exponent=0.8,
+                                 prefix="s"),
+                   limit=1_000_000, duration_ms=3_600_000, algorithm=0),
+            Tenant(name="stream", share=0.25,
+                   keys=KeyModel("uniform", n_keys=512, prefix="v"),
+                   hits=3, limit=100_000, duration_ms=600_000, algorithm=1),
+            Tenant(name="admin", share=0.15,
+                   keys=KeyModel("uniform", n_keys=64, prefix="a"),
+                   limit=5_000, duration_ms=60_000, algorithm=1),
+        ],
+        envelope=Envelope(max_p99_ms=200.0, min_goodput=0.999,
+                          forbid_detectors=("slo_burn", "capacity",
+                                            "shed_spike")),
+        nodes=2,
+        profiles={"short": Profile(time_scale=0.05, rate_scale=0.6),
+                  "full": Profile()},
+    )
+
+
+def _regional_failover() -> ScenarioSpec:
+    # Kill the second node mid-run, serve through the survivor (circuit
+    # opens, degraded-local absorbs the dead owner's keys), then revive
+    # and rejoin. circuit_open is EXPECTED; the envelope tolerates the
+    # pre-open error window but demands the fleet keep deciding.
+    return ScenarioSpec(
+        name="regional-failover",
+        description="node killed under load, survivor degrades locally, "
+                    "node revived and rejoined — availability over "
+                    "strictness, bounded error window",
+        seed=71,
+        segments=[Segment(60.0, 500.0)],
+        tenants=[
+            Tenant(name="api", share=1.0,
+                   keys=KeyModel("zipf", n_keys=1024, exponent=1.0),
+                   limit=1_000_000),
+        ],
+        events=[
+            TimelineEvent(at_s=20.0, action="kill_node", node=1),
+            TimelineEvent(at_s=45.0, action="restart_node", node=1),
+        ],
+        envelope=Envelope(max_p99_ms=600.0, min_goodput=0.90,
+                          max_error_share=0.10,
+                          forbid_detectors=("slo_burn", "capacity"),
+                          allow_detectors=("circuit_open", "shed_spike",
+                                           "deadline_burst")),
+        nodes=2,
+        behaviors={"degraded_local": True, "circuit_threshold": 3,
+                   "circuit_open_s": 0.4},
+        profiles={"short": Profile(time_scale=0.06, rate_scale=0.5),
+                  "full": Profile()},
+    )
+
+
+def _rolling_restart() -> ScenarioSpec:
+    # The deploy shape: restart the non-driven node under load (stop,
+    # boot a replacement on the same port, rejoin). Without GUBER_RESHARD
+    # the restarted node's keys refill (documented amnesty) — the verdict
+    # judges serving health, not counter continuity (that is
+    # tests/test_reshard_drills.py's job).
+    return ScenarioSpec(
+        name="rolling-restart",
+        description="restart a node under load: stop, boot a replacement "
+                    "on the same port, rejoin — the deploy drill shape",
+        seed=89,
+        segments=[Segment(60.0, 400.0)],
+        tenants=[
+            Tenant(name="api", share=0.7,
+                   keys=KeyModel("zipf", n_keys=1024, exponent=1.0),
+                   limit=1_000_000),
+            Tenant(name="batch", share=0.3,
+                   keys=KeyModel("uniform", n_keys=256, prefix="j"),
+                   limit=500_000),
+        ],
+        events=[
+            TimelineEvent(at_s=25.0, action="restart_node", node=1),
+        ],
+        envelope=Envelope(max_p99_ms=600.0, min_goodput=0.95,
+                          max_error_share=0.05,
+                          forbid_detectors=("slo_burn", "capacity"),
+                          allow_detectors=("circuit_open", "shed_spike",
+                                           "deadline_burst")),
+        nodes=2,
+        behaviors={"degraded_local": True, "circuit_threshold": 3,
+                   "circuit_open_s": 0.4},
+        profiles={"short": Profile(time_scale=0.06, rate_scale=0.5),
+                  "full": Profile()},
+    )
+
+
+_BUILDERS: Dict[str, Callable[[], ScenarioSpec]] = {
+    "diurnal-tide": _diurnal_tide,
+    "flash-crowd": _flash_crowd,
+    "bot-storm": _bot_storm,
+    "multi-tenant-mix": _multi_tenant_mix,
+    "regional-failover": _regional_failover,
+    "rolling-restart": _rolling_restart,
+}
+
+assert set(_BUILDERS) == set(SCENARIO_NAMES), (
+    "SCENARIO_NAMES and the builder table drifted apart")
+
+
+def scenario_names() -> Tuple[str, ...]:
+    return SCENARIO_NAMES
+
+
+def get_scenario(name: str) -> ScenarioSpec:
+    """A fresh, validated spec for a named scenario."""
+    try:
+        builder = _BUILDERS[name]
+    except KeyError:
+        raise KeyError(f"unknown scenario {name!r}; the atlas has "
+                       f"{list(SCENARIO_NAMES)}") from None
+    spec = builder()
+    spec.validate()
+    return spec

@@ -30,6 +30,10 @@ reference daemon's expvar/pprof handlers):
 - GET /v1/debug/kernels — compiled kernel cost introspection: per
   (kernel, width) dispatch counts, dispatch-time histograms, XLA cost
   analysis + HLO fingerprints (ops/decide.py kernel_telemetry)
+- GET /v1/debug/capture — replayable traffic-shape trace assembled from
+  the history ring + keyspace cartography + flight recorder
+  (obs/capture.py; ?n=<samples> bounds the ring window, ?events=<count>
+  the recorder tail) — feed it to scenarios.replay.trace_to_spec
 """
 
 from __future__ import annotations
@@ -192,6 +196,15 @@ class HttpGateway:
                         from gubernator_tpu.ops.decide import kernel_telemetry
 
                         body = kernel_telemetry.kernels_body()
+                    elif url.path == "/v1/debug/capture":
+                        from gubernator_tpu.obs import capture
+
+                        q = parse_qs(url.query)
+                        body = capture.endpoint_body(
+                            gateway.instance,
+                            n_samples=int(q.get("n", ["0"])[0] or 0),
+                            n_events=int(q.get("events", ["256"])[0]
+                                         or 256))
                     elif url.path == "/v1/debug/cluster":
                         from gubernator_tpu.obs.bundle import cluster_view
 

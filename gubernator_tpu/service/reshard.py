@@ -54,7 +54,7 @@ import os
 import struct
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -854,6 +854,31 @@ class ReshardManager:
                     return ""
                 self._cond.wait(timeout=min(left, 0.05))
 
+    def _next_src(self, tried: set) -> Optional[Tuple[str, bool]]:
+        """Candidate previous owner for keys every consulted source has
+        disowned: an untried streaming session first, then the remaining
+        ring peers. A peer that is still PLANNING has no session here
+        yet — on a scale-up its begin can lose the race to the first
+        exporter's NOT_MINE answer, and only that peer knows the keys
+        are in its plan-to-be. Probing it returns CTL_PLANNING, which
+        the retry loop converges on instead of amnestying the keys with
+        a fresh bucket. Returns (address, is_probe) or None once every
+        live candidate has disowned the keys."""
+        with self._lock:
+            for s in self._imports_by_src.values():
+                if s.state == "streaming" and not s.expired() \
+                        and s.src not in tried:
+                    return s.src, False
+            dead = set(self._dead_srcs)
+        self_addr = self._self_addr()
+        inst = self.instance
+        with inst._peer_lock:  # noqa: SLF001 — manager lock NOT held here
+            addrs = sorted(p.info.address for p in inst.local_picker.peers())
+        for a in addrs:
+            if a and a != self_addr and a not in tried and a not in dead:
+                return a, True
+        return None
+
     def _proxy_to_src(self, src: str, reqs, now_ms, from_peer_rpc
                       ) -> List[RateLimitResp]:
         """Importer side: gained keys whose rows have not arrived are
@@ -871,6 +896,7 @@ class ReshardManager:
         responses: List[Optional[RateLimitResp]] = [None] * len(reqs)
         deadline = time.monotonic() + min(self.grace_s + self.ttl_s, 5.0)
         tried = {src}
+        probe = False  # src is a swept ring peer, not a live session
         while pending:
             try:
                 msg = self._rpc(src, encode_ctl({
@@ -883,11 +909,16 @@ class ReshardManager:
                 with self._lock:
                     self._dead_srcs.add(src)
                     self._recompute_active()
-                out = self._fresh([reqs[i] for i in pending], now_ms,
-                                  from_peer_rpc, "source_dead")
-                for i, resp in zip(pending, out):
-                    responses[i] = resp
-                return responses  # type: ignore[return-value]
+                if probe:
+                    # a dead swept candidate says nothing about the
+                    # keys — let the sweep move on to the next one
+                    items = [{"ctl": CTL_NOT_MINE}] * len(pending)
+                else:
+                    out = self._fresh([reqs[i] for i in pending], now_ms,
+                                      from_peer_rpc, "source_dead")
+                    for i, resp in zip(pending, out):
+                        responses[i] = resp
+                    return responses  # type: ignore[return-value]
             retry: List[int] = []
             waiters: List[int] = []
             unclaimed: List[int] = []
@@ -901,26 +932,30 @@ class ReshardManager:
                     waiters.append(i)
                 else:  # NOT_MINE: this source's plan does not cover the key
                     unclaimed.append(i)
-            if waiters:
-                out = [self._wait_then_apply(reqs[i], now_ms, from_peer_rpc)
-                       for i in waiters]
-                for i, resp in zip(waiters, out):
-                    responses[i] = resp
+            for i in waiters:
+                if self._await_resolution(reqs[i].hash_key(), src):
+                    responses[i] = self._apply_local(
+                        [reqs[i]], now_ms, from_peer_rpc)[0]
+                else:
+                    # the promising transfer ended without the row —
+                    # typically aborted by a superseding membership
+                    # change whose next generation re-covers the key.
+                    # Re-ask the source for current truth (it answers
+                    # CTL_PLANNING / a new cut / an authoritative local
+                    # apply) instead of amnestying a cut key.
+                    retry.append(i)
             if unclaimed:
                 # several exporters can stream to a (re)joining node at
                 # once; a key NOT_MINE at one may be another's to hand
                 # over — only once every live source disowns it is a
-                # fresh local serve actually continuous
-                nxt = None
-                with self._lock:
-                    for s2 in self._imports_by_src.values():
-                        if s2.state == "streaming" and not s2.expired() \
-                                and s2.src not in tried:
-                            nxt = s2.src
-                            break
-                if nxt is not None and time.monotonic() < deadline:
-                    tried.add(nxt)
-                    src = nxt
+                # fresh local serve actually continuous. The sweep also
+                # probes ring peers with no session yet: a still-planning
+                # exporter answers CTL_PLANNING, not NOT_MINE.
+                nxt = None if time.monotonic() >= deadline \
+                    else self._next_src(tried)
+                if nxt is not None:
+                    src, probe = nxt
+                    tried.add(src)
                     pending = retry + unclaimed
                     continue
                 out = self._apply_local([reqs[i] for i in unclaimed],
@@ -938,27 +973,40 @@ class ReshardManager:
                 time.sleep(self.PLANNING_RETRY_S)
         return responses  # type: ignore[return-value]
 
-    def _wait_then_apply(self, req: RateLimitReq, now_ms, from_peer_rpc
-                         ) -> RateLimitResp:
+    def _await_resolution(self, key: str, src: str = "") -> bool:
         """The key's chunk is in flight: wait for the injection (normally
-        one frame RTT), then serve locally from the transferred row."""
-        key = req.hash_key()
+        one frame RTT). True once the row lands in an import session;
+        False when the transfer that promised it ends without the row or
+        the cap expires. A CUT/STREAMED verdict means the exporter's
+        begin was already acked, so "no session streaming right now" is
+        a superseded/raced session, NOT disownment — only `src`'s own
+        session going terminal (or, src unknown, every session ending)
+        stops the wait early."""
         deadline = time.monotonic() + self.CUT_WAIT_CAP_S
         with self._cond:
             while time.monotonic() < deadline:
-                imp = None
                 for s in self._imports_by_src.values():
                     if key in s.resolved:
-                        imp = s
-                        break
-                if imp is not None or not any(
+                        return True
+                sess = self._imports_by_src.get(src) if src else None
+                if sess is not None:
+                    if sess.state != "streaming" or sess.expired():
+                        return False
+                elif not src and not any(
                         s.state == "streaming"
                         for s in self._imports_by_src.values()):
-                    break
+                    return False
                 self._cond.wait(timeout=0.02)
-            else:
-                self.stats["cut_wait_timeouts"] += 1
-                self._count("reshard_cut_wait_timeouts")
+        self.stats["cut_wait_timeouts"] += 1
+        self._count("reshard_cut_wait_timeouts")
+        return False
+
+    def _wait_then_apply(self, req: RateLimitReq, now_ms, from_peer_rpc,
+                         src: str = "") -> RateLimitResp:
+        """Redirect path (we are the new owner): wait for the in-flight
+        chunk, then serve locally — from the transferred row when it
+        landed, fresh only when the transfer actually died."""
+        self._await_resolution(req.hash_key(), src)
         return self._apply_local([req], now_ms, from_peer_rpc)[0]
 
     # --------------------------------------------------------- import side
@@ -1129,7 +1177,7 @@ class ReshardManager:
         if msg.get("origin") == "importer":
             items = self._answer_importer(msg["src"], reqs)
         else:
-            items = self._answer_exporter(reqs)
+            items = self._answer_exporter(reqs, msg.get("src", ""))
         return encode_ctl({"ok": True, "resps": items})
 
     def _answer_importer(self, src: str, reqs) -> List[dict]:
@@ -1165,11 +1213,11 @@ class ReshardManager:
                 items[i] = _resp_to_dict(resp)
         return items  # type: ignore[return-value]
 
-    def _answer_exporter(self, reqs) -> List[dict]:
+    def _answer_exporter(self, reqs, src: str = "") -> List[dict]:
         """We are the NEW owner: the previous owner redirected stale
         arrivals here. Wait briefly for in-flight chunks, then serve from
         the transferred rows (fresh only if the transfer died)."""
-        out = [self._wait_then_apply(r, None, True) for r in reqs]
+        out = [self._wait_then_apply(r, None, True, src) for r in reqs]
         return [_resp_to_dict(r) for r in out]
 
     # ------------------------------------------------------ operator plane

@@ -20,6 +20,13 @@ the numeric keys, and flag
   kernel-tier estimates to profiler-measured phases changed what the
   keys MEAN; cross-version deltas are printed informationally).
 
+Scenario-atlas keys are split: `scenarios.<name>.verdict_pass` is gated
+HARD with zero tolerance (a shape that passed its SLO envelope last
+round and fails it now is a regression regardless of rig weather),
+while the rest of `scenarios.*` (per-scenario latency/goodput numbers)
+is operating-point context — the envelope judgment already happened
+inside the verdict itself.
+
 Baseline keys (`serial_*`, `lockstep*`, `baseline_*`) are excluded — a
 slower comparison baseline is not a product regression. The whole
 `overload.*` section is excluded: each round offers load at 2x its OWN
@@ -109,6 +116,22 @@ def _is_decomposition(key):
     return leaf.endswith("_s") or leaf.endswith("_s_est")
 
 
+def _is_scenario_verdict(key):
+    """scenarios.<name>.verdict_pass — the atlas PASS/FAIL bit. Gated
+    hard with zero tolerance: a scenario flipping 1 -> 0 across rounds
+    means a traffic shape the last round served inside its SLO envelope
+    no longer does, which is a regression regardless of rig weather."""
+    return key.startswith("scenarios.") and key.endswith(".verdict_pass")
+
+
+def _is_scenario_envelope(key):
+    """Everything else under scenarios.* (latency percentiles, goodput,
+    offered counts): measured at each round's own pacing on a shared
+    rig, so cross-round deltas are operating-point context — the
+    binding judgment already happened inside the verdict."""
+    return key.startswith("scenarios.")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--base", help="older artifact (default: 2nd newest)")
@@ -153,6 +176,10 @@ def main(argv=None):
         verdict = ""
         if _is_baseline(key):
             verdict = "(baseline)"
+        elif _is_scenario_verdict(key):
+            verdict = "REGRESSION" if h < b else "(scenario-verdict)"
+        elif _is_scenario_envelope(key):
+            verdict = "(operating-point)"
         elif _is_operating_point(key):
             verdict = "(operating-point)"
         elif _is_decomposition(key):

@@ -1,0 +1,6 @@
+# Corpus scenario registry: "steady" is documented (clean pair),
+# "phantom-surge" has no doc row (registered-but-undocumented finding).
+SCENARIO_NAMES = (
+    "steady",
+    "phantom-surge",
+)

@@ -133,7 +133,7 @@ def test_escape_hatch_waived_at_anchor():
 def test_registry_drift_fires_on_all_three_registries():
     findings, suppressed = _run("registry_drift", "registry-drift")
     msgs = [f.render() for f in findings]
-    assert len(findings) == 5, msgs
+    assert len(findings) == 7, msgs
 
     def one(substr):
         hits = [f for f in findings if substr in f.message]
@@ -150,9 +150,14 @@ def test_registry_drift_fires_on_all_three_registries():
     assert extra.path.endswith("obs/introspect.py")
     ghost = one("'ghost' is declared in")
     assert ghost.path.endswith("tests/test_debug_schema.py")
-    # the documented-and-emitted pair (widget.stop, engine, grpc) is clean
+    surge = one("'phantom-surge' is registered in SCENARIO_NAMES")
+    assert surge.path.endswith("gubernator_tpu/scenarios/spec.py")
+    drill = one("'ghost-drill' is documented but the registry")
+    assert drill.path.endswith("docs/observability.md")
+    # the documented-and-emitted pairs (widget.stop, engine, grpc,
+    # steady) are clean
     assert not any("widget.stop" in m or "'engine'" in m or "'grpc'" in m
-                   for m in msgs)
+                   or "'steady'" in m for m in msgs)
     # emit("widget.secret") carries an inline waiver
     assert len(suppressed) == 1
     assert "widget.secret" in suppressed[0][0].message

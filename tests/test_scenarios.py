@@ -1,0 +1,471 @@
+"""Scenario atlas: generator determinism, capture -> replay fidelity,
+and the verdict engine's judgment.
+
+Three layers, cheapest first: pure-data tests (registry, spec
+validation, verdict drills on synthetic stats), seeded-generator tests
+(same seed -> identical schedule; rates and key skew match the spec),
+and live tests against real instances (capture endpoint schema, the
+documented replay tolerances, one end-to-end scenario). The full atlas
+sweep is slow-marked — it boots a fresh 1-2 node cluster per scenario.
+"""
+
+import dataclasses
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.obs import capture
+from gubernator_tpu.obs.anomaly import DETECTORS
+from gubernator_tpu.obs.keyspace import concentration
+from gubernator_tpu.scenarios import (
+    SCENARIO_NAMES,
+    ScenarioSpec,
+    WorkloadGenerator,
+    get_scenario,
+    run_atlas,
+    run_scenario,
+    trace_to_spec,
+)
+from gubernator_tpu.scenarios.generator import windowed
+from gubernator_tpu.scenarios.runner import render_verdict
+from gubernator_tpu.scenarios.spec import (
+    Envelope,
+    KeyModel,
+    Segment,
+    Tenant,
+    TimelineEvent,
+)
+
+# ------------------------------------------------------------- registry
+
+
+class TestAtlasRegistry:
+    def test_atlas_has_at_least_five_scenarios(self):
+        assert len(SCENARIO_NAMES) >= 5
+
+    @pytest.mark.parametrize("name", SCENARIO_NAMES)
+    def test_every_scenario_builds_and_validates(self, name):
+        spec = get_scenario(name)
+        assert spec.name == name
+        assert spec.duration_s() > 0
+        assert spec.tenants and spec.segments
+        spec.validate()  # idempotent on a fresh build
+
+    @pytest.mark.parametrize("name", SCENARIO_NAMES)
+    def test_short_profile_is_tier1_scale(self, name):
+        # `make scenarios` and the bench row run the short profile in
+        # CI; a scenario whose short profile creeps past ~10s of wall
+        # clock breaks that contract.
+        short = get_scenario(name).for_profile("short")
+        assert short.duration_s() <= 10.0
+        short.validate()
+
+    def test_unknown_scenario_raises(self):
+        with pytest.raises(KeyError, match="unknown scenario"):
+            get_scenario("does-not-exist")
+
+    def test_builders_return_fresh_specs(self):
+        a, b = get_scenario("bot-storm"), get_scenario("bot-storm")
+        a.segments[0].rate_rps = 1.0
+        assert b.segments[0].rate_rps != 1.0
+
+    def test_spec_validation_teeth(self):
+        base = get_scenario("flash-crowd")
+        with pytest.raises(ValueError, match="no rate segments"):
+            dataclasses.replace(base, segments=[]).validate()
+        with pytest.raises(ValueError, match="unknown timeline action"):
+            TimelineEvent(at_s=0.0, action="explode").validate()
+        with pytest.raises(ValueError, match="lands past"):
+            dataclasses.replace(
+                get_scenario("regional-failover"),
+                events=[TimelineEvent(at_s=1e9, action="sync_peers")],
+            ).validate()
+        with pytest.raises(ValueError, match="unknown detector"):
+            Envelope(forbid_detectors=("not_a_detector",)).validate()
+        with pytest.raises(ValueError, match="both forbidden and allowed"):
+            Envelope(forbid_detectors=("slo_burn",),
+                     allow_detectors=("slo_burn",)).validate()
+
+
+# ------------------------------------------------------------ generator
+
+
+def _flat_spec(duration_s=4.0, rate=500.0, end=None, seed=7,
+               keys=None) -> ScenarioSpec:
+    return ScenarioSpec(
+        name="unit", seed=seed,
+        segments=[Segment(duration_s, rate, end)],
+        tenants=[Tenant(name="t", keys=keys or KeyModel(
+            "uniform", n_keys=64))],
+    )
+
+
+class TestGeneratorDeterminism:
+    def test_same_seed_identical_schedule(self):
+        spec = get_scenario("flash-crowd").for_profile("short")
+        a = WorkloadGenerator(spec).schedule()
+        b = WorkloadGenerator(get_scenario(
+            "flash-crowd").for_profile("short")).schedule()
+        assert a == b  # dataclass equality: every t/tenant/key/config
+        assert len(a) > 100
+
+    def test_different_seed_different_schedule(self):
+        spec = _flat_spec()
+        a = WorkloadGenerator(spec, seed=1).schedule()
+        b = WorkloadGenerator(spec, seed=2).schedule()
+        assert a != b
+        # ... but the same SHAPE: Poisson totals within a few sigma
+        assert abs(len(a) - len(b)) < 0.25 * max(len(a), len(b))
+
+    def test_flat_rate_hits_target(self):
+        sched = WorkloadGenerator(_flat_spec(4.0, 500.0)).schedule()
+        assert 0.85 * 2000 < len(sched) < 1.15 * 2000
+        assert all(0 <= a.t <= 4.0 for a in sched)
+        assert sched == sorted(sched, key=lambda a: a.t)
+
+    def test_ramp_preserves_area(self):
+        # 100 -> 500 rps over 4s: expect ~ the trapezoid area 1200
+        sched = WorkloadGenerator(
+            _flat_spec(4.0, 100.0, end=500.0)).schedule()
+        assert 0.85 * 1200 < len(sched) < 1.15 * 1200
+        # the back half must be denser than the front half
+        front = sum(1 for a in sched if a.t < 2.0)
+        assert len(sched) - front > 1.5 * front
+
+    def test_tenant_shares_respected(self):
+        spec = ScenarioSpec(
+            name="unit", seed=5, segments=[Segment(4.0, 1000.0)],
+            tenants=[Tenant(name="big", share=0.75),
+                     Tenant(name="small", share=0.25,
+                            keys=KeyModel(prefix="s"))])
+        sched = WorkloadGenerator(spec).schedule()
+        big = sum(1 for a in sched if a.tenant == "big")
+        assert 0.70 < big / len(sched) < 0.80
+
+    def test_zipf_skew_vs_uniform(self):
+        zipf = WorkloadGenerator(_flat_spec(
+            4.0, 2000.0, keys=KeyModel("zipf", n_keys=64,
+                                       exponent=1.4))).schedule()
+        flat = WorkloadGenerator(_flat_spec(
+            4.0, 2000.0, keys=KeyModel("uniform", n_keys=64))).schedule()
+
+        def top_share(sched):
+            counts = {}
+            for a in sched:
+                counts[a.key] = counts.get(a.key, 0) + 1
+            return max(counts.values()) / len(sched)
+
+        assert top_share(zipf) > 3 * top_share(flat)
+        # rank 0 renders as the stable hottest key
+        assert any(a.key == "k00000" for a in zipf)
+
+    def test_windowed_partitions_schedule(self):
+        sched = WorkloadGenerator(_flat_spec(2.0, 400.0)).schedule()
+        seen = []
+        prev = -1.0
+        for start, group in windowed(sched, 0.05):
+            assert start > prev
+            prev = start
+            for a in group:
+                assert start <= a.t < start + 0.05 + 1e-9
+            seen.extend(group)
+        assert seen == sched
+
+    def test_request_carries_tenant_config(self):
+        spec = get_scenario("bot-storm")
+        sched = WorkloadGenerator(spec).schedule()
+        bots = next(a for a in sched if a.tenant == "bots")
+        req = bots.to_request()
+        assert req.hits == 5 and req.limit == 500
+        assert req.unique_key.startswith("bot")
+
+
+# ------------------------------------------------------- verdict drills
+
+
+def _healthy_stats(offered=1000, ok=None, over_limit=0, errors=0,
+                   p99=5.0, tripped=None):
+    ok = offered - over_limit - errors if ok is None else ok
+    return {
+        "offered": offered, "ok": ok, "over_limit": over_limit,
+        "errors": errors, "batches": 10, "max_lag_s": 0.0,
+        "latency_ms": {"p50": 1.0, "p95": 3.0, "p99": p99, "max": p99},
+        "detectors_tripped": dict(tripped or {}),
+        "events": [],
+    }
+
+
+class TestVerdictEngine:
+    def test_healthy_run_passes(self):
+        v = render_verdict(get_scenario("diurnal-tide"),
+                           _healthy_stats(), profile="short")
+        assert v["passed"] is True
+        assert all(c["ok"] for c in v["checks"])
+        assert v["goodput"] == 1.0 and v["error_share"] == 0.0
+
+    def test_forced_slo_burn_fails(self):
+        # the drill the issue demands: a forbidden detector's rising
+        # edge during the run must flip the verdict to FAIL
+        v = render_verdict(get_scenario("diurnal-tide"),
+                           _healthy_stats(tripped={"slo_burn": 1}))
+        assert v["passed"] is False
+        bad = next(c for c in v["checks"]
+                   if c["name"] == "forbidden_detectors")
+        assert bad["ok"] is False and bad["observed"] == ["slo_burn"]
+
+    def test_inflated_p99_fails(self):
+        v = render_verdict(get_scenario("diurnal-tide"),
+                           _healthy_stats(p99=10_000.0))
+        assert v["passed"] is False
+        assert not next(c for c in v["checks"]
+                        if c["name"] == "p99_ms")["ok"]
+
+    def test_error_share_and_goodput_fail(self):
+        v = render_verdict(get_scenario("diurnal-tide"),
+                           _healthy_stats(offered=1000, ok=500,
+                                          errors=500))
+        assert v["passed"] is False
+        names_bad = {c["name"] for c in v["checks"] if not c["ok"]}
+        assert {"goodput", "error_share"} <= names_bad
+
+    def test_bot_storm_requires_over_limit(self):
+        # a bot storm the limiter never limited is a FAIL even though
+        # every request was served cleanly
+        spec = get_scenario("bot-storm")
+        v = render_verdict(spec, _healthy_stats())
+        assert v["passed"] is False
+        bad = next(c for c in v["checks"]
+                   if c["name"] == "over_limit_share")
+        assert bad["ok"] is False
+        v2 = render_verdict(spec, _healthy_stats(over_limit=400))
+        assert v2["passed"] is True
+
+    def test_allowed_detector_reported_not_failed(self):
+        spec = get_scenario("regional-failover")
+        v = render_verdict(spec, _healthy_stats(
+            offered=1000, ok=950, errors=50,
+            tripped={"circuit_open": 2}))
+        assert v["passed"] is True
+        assert v["allowed_detectors_seen"] == ["circuit_open"]
+
+    def test_unknown_detector_name_fails(self):
+        v = render_verdict(get_scenario("diurnal-tide"),
+                           _healthy_stats(tripped={"zzz_detector": 1}))
+        assert v["passed"] is False
+        bad = next(c for c in v["checks"]
+                   if c["name"] == "known_detectors")
+        assert bad["observed"] == ["zzz_detector"]
+        assert set(bad["threshold"]) == set(DETECTORS)
+
+
+# ------------------------------------------------- capture and replay
+
+
+def _synthetic_trace(mean_rate=200.0, exponent=1.1, n_keys=512):
+    segs = [{"duration_s": 2.0, "rate_rps": mean_rate * f,
+             "over_limit_rps": 0.0}
+            for f in (0.5, 1.5, 1.0)]
+    total = sum(s["duration_s"] for s in segs)
+    decided = sum(s["rate_rps"] * s["duration_s"] for s in segs)
+    return {
+        "schema_version": capture.TRACE_SCHEMA_VERSION,
+        "captured_at": 0.0, "node": "synthetic", "capture_ms": 0.0,
+        "window": {"samples": 4, "span_s": total, "tick_s": 2.0},
+        "history": {"segments": segs},
+        "keyspace": {"report": None},
+        "events": {"tail": [], "counts": {}},
+        "derived": {
+            "segments": segs, "active_s": total,
+            "mean_rate_rps": decided / total,
+            "peak_rate_rps": max(s["rate_rps"] for s in segs),
+            "over_limit_share": 0.0,
+            "key_model": {"kind": "zipf", "n_keys": n_keys,
+                          "exponent": exponent, "source": "cartography"},
+        },
+    }
+
+
+class TestCaptureReplay:
+    def test_trace_to_spec_round_trip_rate_tolerance(self):
+        # the documented fidelity contract: replayed mean offered rate
+        # within ~25% of the captured mean
+        trace = _synthetic_trace(mean_rate=300.0)
+        spec = trace_to_spec(trace, seed=3)
+        sched = WorkloadGenerator(spec).schedule()
+        replayed_rate = len(sched) / spec.duration_s()
+        captured = trace["derived"]["mean_rate_rps"]
+        assert abs(replayed_rate - captured) / captured < 0.25
+        # curve area (total offered) is preserved by coalescing
+        assert abs(spec.duration_s() - trace["derived"]["active_s"]) < 1e-6
+
+    def test_trace_to_spec_round_trip_zipf_tolerance(self):
+        # the second documented bound: re-fitting the replayed key
+        # frequencies with the cartographer's own estimator lands
+        # within ~0.4 of the captured exponent
+        trace = _synthetic_trace(mean_rate=4000.0, exponent=1.2,
+                                 n_keys=256)
+        spec = trace_to_spec(trace, seed=9)
+        sched = WorkloadGenerator(spec).schedule()
+        counts = {}
+        for a in sched:
+            counts[a.key] = counts.get(a.key, 0) + 1
+        fit = concentration(np.array(sorted(counts.values()),
+                                     dtype=np.float64))
+        assert fit["zipf_exponent"] is not None
+        assert abs(fit["zipf_exponent"] - 1.2) < 0.4
+
+    def test_replay_micro_segments_coalesced(self):
+        segs = [{"duration_s": 0.1, "rate_rps": 100.0,
+                 "over_limit_rps": 0.0}] * 20
+        trace = _synthetic_trace()
+        trace["derived"]["segments"] = segs
+        spec = trace_to_spec(trace)
+        assert all(s.duration_s >= 0.5 - 1e-9 for s in spec.segments)
+        # area preserved: 20 * 0.1s * 100rps = 200 offered
+        offered = sum(s.duration_s * s.rate_rps for s in spec.segments)
+        assert abs(offered - 200.0) < 1e-6
+
+    def test_replay_key_model_and_prefix(self):
+        spec = trace_to_spec(_synthetic_trace(exponent=0.9, n_keys=128))
+        km = spec.tenants[0].keys
+        assert (km.kind, km.n_keys, km.exponent) == ("zipf", 128, 0.9)
+        assert km.prefix == "r"  # replay keys never collide with atlas
+
+    def test_empty_trace_refuses_replay(self):
+        trace = _synthetic_trace()
+        trace["derived"]["segments"] = []
+        trace["derived"]["mean_rate_rps"] = 0.0
+        with pytest.raises(ValueError, match="no live rate segments"):
+            trace_to_spec(trace)
+
+    def test_load_trace_rejects_future_schema(self, tmp_path):
+        path = tmp_path / "trace.json"
+        doc = _synthetic_trace()
+        doc["schema_version"] = 999
+        path.write_text(json.dumps(doc))
+        with pytest.raises(ValueError, match="schema_version"):
+            capture.load_trace(str(path))
+        doc["schema_version"] = capture.TRACE_SCHEMA_VERSION
+        capture.save_trace(doc, str(path))
+        assert capture.load_trace(
+            str(path))["derived"]["key_model"]["n_keys"] == 512
+
+    def test_capture_of_stub_instance_is_schema_valid(self):
+        class _Stub:
+            advertise_address = "stub:0"
+
+        trace = capture.capture_trace(_Stub())
+        assert trace["schema_version"] == capture.TRACE_SCHEMA_VERSION
+        assert trace["derived"]["segments"] == []
+        assert trace["derived"]["key_model"]["source"] == "default"
+        assert trace["capture_ms"] >= 0.0
+
+
+# ----------------------------------------------------- live instances
+
+
+@pytest.fixture(scope="module")
+def driven_instance():
+    """One real Instance with traffic through it and a populated
+    history ring + keyspace harvest — shared by the capture tests."""
+    from gubernator_tpu.models.engine import Engine
+    from gubernator_tpu.service.config import InstanceConfig
+    from gubernator_tpu.service.instance import Instance
+    from gubernator_tpu.types import PeerInfo, RateLimitReq
+
+    import time as _time
+
+    inst = Instance(InstanceConfig(backend=Engine(capacity=4096),
+                                   history_tick_s=0.05,
+                                   keyspace_interval_s=3600.0),
+                    advertise_address="127.0.0.1:1")
+    inst.set_peers([PeerInfo(address="127.0.0.1:1")])  # self-owned
+    # the ring floors tick_s at 50 ms; sub-ms test frames stamp
+    # synthetic tick times so each lands as its own sample
+    t_ring = _time.monotonic()
+    for i in range(60):
+        inst.get_rate_limits(
+            [RateLimitReq(name="cap", unique_key=f"ck{(i * 16 + j) % 97}",
+                          hits=1, limit=1 << 30, duration=3_600_000)
+             for j in range(16)])
+        t_ring += 0.1
+        inst.history.tick(now=t_ring)
+    inst.keyspace.harvest()
+    yield inst
+    inst.close()
+
+
+class TestLiveCapture:
+    def test_capture_trace_from_live_instance(self, driven_instance):
+        trace = capture.capture_trace(driven_instance, n_events=32)
+        assert trace["schema_version"] == capture.TRACE_SCHEMA_VERSION
+        assert trace["window"]["samples"] >= 2
+        d = trace["derived"]
+        assert d["segments"] and d["mean_rate_rps"] > 0
+        assert d["peak_rate_rps"] >= d["mean_rate_rps"] * 0.99
+        # ~960 decisions over ~97 keys: the cartographer harvest feeds
+        # a real fitted model, not the fallback
+        assert d["key_model"]["source"] == "cartography"
+        assert d["key_model"]["n_keys"] >= 90
+
+    def test_live_capture_replays(self, driven_instance):
+        trace = capture.capture_trace(driven_instance)
+        spec = trace_to_spec(trace, seed=5)
+        sched = WorkloadGenerator(spec).schedule()
+        replayed = len(sched) / spec.duration_s()
+        captured = trace["derived"]["mean_rate_rps"]
+        assert abs(replayed - captured) / captured < 0.25
+
+    def test_capture_http_endpoint(self, driven_instance):
+        from gubernator_tpu.service.http_gateway import HttpGateway
+
+        gw = HttpGateway(driven_instance, "127.0.0.1:0")
+        gw.start()
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://{gw.address}/v1/debug/capture?events=8",
+                timeout=10).read())
+            assert body["schema_version"] == capture.TRACE_SCHEMA_VERSION
+            assert len(body["events"]["tail"]) <= 8
+            # the curl'd body IS a replayable trace
+            trace_to_spec(body).validate()
+        finally:
+            gw.close()
+
+
+# --------------------------------------------------------- end to end
+
+
+class TestScenarioRuns:
+    def test_bot_storm_short_passes(self):
+        # the cheapest single-node scenario end to end: the limiter
+        # must answer OVER_LIMIT for the abusive tenant and stay fast
+        v = run_scenario(get_scenario("bot-storm"), profile="short")
+        assert v["passed"], v["checks"]
+        assert v["over_limit_share"] >= 0.3
+        assert v["stats"]["offered"] > 200
+        assert v["error_share"] == 0.0
+
+    @pytest.mark.slow
+    def test_full_atlas_short_profile(self):
+        res = run_atlas(profile="short")
+        assert set(res["scenarios"]) == set(SCENARIO_NAMES)
+        failed = {n: [c for c in v["checks"] if not c["ok"]]
+                  for n, v in res["scenarios"].items() if not v["passed"]}
+        assert res["passed"], failed
+        # the failover drill actually exercised its timeline
+        ev = res["scenarios"]["regional-failover"]["stats"]["events"]
+        assert [e["action"] for e in ev] == ["kill_node", "restart_node"]
+        assert all(e["error"] == "" for e in ev)
+
+    def test_profile_scaling(self):
+        spec = get_scenario("regional-failover")
+        short = spec.for_profile("short")
+        assert short.duration_s() < spec.duration_s()
+        # events compress with the clock and stay inside the schedule
+        assert short.events[0].at_s < spec.events[0].at_s
+        assert short.events[-1].at_s <= short.duration_s()
+        # an unknown profile is identity, not an error
+        assert spec.for_profile("nope").duration_s() == spec.duration_s()
