@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast lint bench bench-skew bench-wire bench-reshard bench-suite bench-check scenarios capacity-report profile-report soak chaos proto docker clean native
+.PHONY: test test-fast lint lockmap sanitize bench bench-skew bench-wire bench-reshard bench-suite bench-check scenarios capacity-report profile-report soak chaos proto docker clean native
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -13,8 +13,22 @@ test-fast: lint
 # guberlint: AST-driven invariant analyzer (docs/static-analysis.md).
 # Zero unwaived findings is a tier-1 gate (tests/test_lint.py runs the
 # same check in-process).
-lint:
+lint: lockmap
 	python -m gubernator_tpu.analysis
+
+# lock acquisition-order graph: drift-gate the built graph against the
+# committed lockmap.json in both directions and fail on any unwaived
+# lock-order/donation-flow finding (docs/static-analysis.md "Reading a
+# lockmap"); after a reviewed ordering change:
+# `python scripts/lockmap_report.py --write` and commit
+lockmap:
+	python scripts/lockmap_report.py --check
+
+# TSan/ASan/UBSan builds of native/*.cpp into the same mtime-keyed .so
+# cache `make native` uses; the TSan variants load under
+# TSAN_OPTIONS=suppressions=native/tsan.supp (tests/test_tsan.py)
+sanitize:
+	python scripts/build_native.py --sanitize
 
 bench:
 	python bench.py

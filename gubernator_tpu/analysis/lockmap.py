@@ -1,0 +1,997 @@
+"""lockmap: whole-repo static lock-order analysis (layer 1).
+
+guberlint's lexical rules (`lock-discipline`, `blocking-under-lock`)
+check what happens *inside* one lock scope; they cannot see the order in
+which two scopes nest across functions — the bug class behind the PR 14
+reshard NOT_MINE/PLANNING deflakes. This module builds the repo's
+acquisition-order digraph and proves it acyclic:
+
+1. **Harvest the lock identity model.** Every load-bearing lock is
+   constructed through `obs/witness.py`'s factories with a canonical
+   class-name literal (`witness.make_lock("engine")`); the harvest reads
+   those literals straight from the construction sites, so the static
+   graph and the runtime witness share node names by construction. Raw
+   `threading.Lock()` assignments that bypass the factories still get
+   auto-derived names (`<modstem>.<attr>`) so nothing hides from the
+   graph.
+
+2. **Resolve every acquisition site.** `with <expr>:` scopes and bare
+   `.acquire()` calls are canonicalized back to a lock class: `self.X`
+   through the enclosing class's construction sites, condition aliases
+   (`self._cond = threading.Condition(self._lock)`) through their
+   backing lock, other receivers through a repo-unique attribute match.
+   Lock-ish expressions that stay unresolvable are counted and surfaced
+   in the report — an unresolved lock is a hole in the proof, not a
+   silent pass.
+
+3. **Follow calls made while a lock is held.** A bounded interprocedural
+   walk (repo-own modules only, call depth ``MAX_CALL_DEPTH``) computes
+   for each function the set of lock classes it may transitively
+   acquire; every acquisition reachable under a held lock contributes an
+   edge `held -> acquired` with a `path:line` witness chain recording
+   the call hops.
+
+4. **Cycles are findings.** Any strongly-connected component (including
+   a non-reentrant class that can re-acquire itself through a call
+   chain) yields a `lock-order` finding anchored at the first witness
+   site, waivable with justification like every guberlint rule.
+
+The committed `lockmap.json` pins the graph in both directions (`make
+lockmap` / tests/test_lockmap.py): an edge the analysis no longer
+produces AND an edge the baseline doesn't carry both fail, the same
+two-direction discipline `registry-drift` applies to event kinds. The
+runtime witness (obs/witness.py) then checks real executions against the
+same committed edge set.
+
+This module also hosts the **donated-buffer lifetime dataflow** behind
+the `donation-flow` rule: within each function it tracks local names
+captured from a donated device-array attribute (`v = backend.state`),
+finds the donate-and-rebind dispatch (`X.state, r = f(X.state, ...)`),
+and flags any later read of the stale capture that is not preceded by a
+fresh re-read — the exact PR 10 cartographer bug class, found by
+dataflow instead of lexical matching.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from gubernator_tpu.analysis.core import RepoIndex
+
+# factory name -> lock kind (reentrant kinds may self-nest)
+_FACTORIES = {
+    "make_lock": "lock",
+    "make_rlock": "rlock",
+    "make_condition": "rcondition",
+}
+_REENTRANT_KINDS = frozenset({"rlock", "rcondition"})
+
+# bounded call-graph walk: a chain of more hops than this is treated as
+# not acquiring (under-approximation; the runtime witness is the
+# backstop for anything deeper)
+MAX_CALL_DEPTH = 4
+
+# expressions that *look* like synchronization but resolve to no class
+# are reported as holes; anything else (`with open(...)`) is ignored
+_LOCKISH_RE = re.compile(r"lock(?!map)|cond|mutex|_gate\b", re.IGNORECASE)
+
+# the witness IS the runtime half of this analysis: its internal mutex
+# guards pure dict bookkeeping and never calls out while held, and its
+# wrapper classes would read as lock constructions. Excluded wholesale.
+_SKIP_FILES = frozenset({"gubernator_tpu/obs/witness.py"})
+
+# the duck-typed call fallback (resolve a method by repo-unique name)
+# must never fire for names shared with builtin containers/stdlib
+# objects — `self._ring.clear()` is a deque, not EventRing.clear
+_COMMON_METHODS = frozenset({
+    "accept", "acquire", "add", "append", "appendleft", "bind", "cancel",
+    "clear", "close", "connect", "copy", "count", "debug", "decode",
+    "discard", "encode", "error", "exception", "extend", "flush", "format",
+    "get", "info", "items", "join", "keys", "listen", "notify",
+    "notify_all", "pop", "popleft", "put", "read", "recv", "release",
+    "remove", "result", "send", "set", "setdefault", "sort",
+    "split", "start", "strip", "submit", "update", "values", "wait",
+    "warning", "write",
+})
+
+# inheritance chains walked when resolving `self.X` / `self.m()` that
+# the class itself doesn't define
+_MAX_MRO_DEPTH = 5
+
+# attributes holding donated device arrays (same set as rules/locks.py)
+DONATED_ATTRS = frozenset({"state", "fps", "touch"})
+_ENGINEISH_RE = re.compile(r"(^|\.)_?(backend|engine|eng)$")
+
+
+@dataclasses.dataclass(frozen=True)
+class LockSite:
+    path: str
+    line: int
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}"
+
+
+@dataclasses.dataclass
+class LockClass:
+    name: str
+    kind: str  # lock | rlock | rcondition
+    sites: List[LockSite]
+    registered: bool  # True: witness factory; False: auto-named raw lock
+
+
+@dataclasses.dataclass(frozen=True)
+class Edge:
+    src: str
+    dst: str
+    # each witness is a chain of "path:line" hops: the outer acquisition
+    # site, then call sites, ending at the inner acquisition site
+    witness: Tuple[str, ...]
+
+
+class LockGraph:
+    """The built graph plus everything the report and rules need."""
+
+    def __init__(self):
+        self.classes: Dict[str, LockClass] = {}
+        self.edges: Dict[Tuple[str, str], List[Tuple[str, ...]]] = {}
+        self.unresolved: List[Tuple[str, int, str]] = []  # path, line, expr
+
+    def add_edge(self, src: str, dst: str, witness: Sequence[str]) -> None:
+        chains = self.edges.setdefault((src, dst), [])
+        w = tuple(witness)
+        if w not in chains and len(chains) < 5:  # cap per-edge provenance
+            chains.append(w)
+
+    def edge_pairs(self) -> List[Tuple[str, str]]:
+        return sorted(self.edges)
+
+    def cycles(self) -> List[List[str]]:
+        """Strongly-connected components with >1 node, plus self-loops
+        on non-reentrant classes, as sorted node lists."""
+        out: List[List[str]] = []
+        for comp in _tarjan_sccs(
+                sorted(self.classes),
+                {n: sorted({d for (s, d) in self.edges if s == n})
+                 for n in self.classes}):
+            if len(comp) > 1:
+                out.append(sorted(comp))
+        for (s, d) in self.edges:
+            if s == d and self.classes.get(s) is not None \
+                    and self.classes[s].kind not in _REENTRANT_KINDS:
+                out.append([s])
+        return sorted(out)
+
+    def cycle_edges(self, cycle: List[str]) -> List[Edge]:
+        """The edges internal to one cycle, each with its first witness."""
+        nodes = set(cycle)
+        out = []
+        for (s, d), chains in sorted(self.edges.items()):
+            if s in nodes and d in nodes and (len(cycle) > 1 or s == d):
+                out.append(Edge(s, d, chains[0]))
+        return out
+
+
+def _tarjan_sccs(nodes: List[str],
+                 succ: Dict[str, List[str]]) -> List[List[str]]:
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v: str) -> None:
+        # iterative Tarjan (the call graph walk already recurses; keep
+        # the SCC pass safe from deep graphs)
+        work = [(v, 0)]
+        while work:
+            node, pi = work[-1]
+            if pi == 0:
+                index[node] = low[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            recursed = False
+            children = succ.get(node, [])
+            while pi < len(children):
+                w = children[pi]
+                pi += 1
+                work[-1] = (node, pi)
+                if w not in index:
+                    work.append((w, 0))
+                    recursed = True
+                    break
+                if w in on_stack:
+                    low[node] = min(low[node], index[w])
+            if recursed:
+                continue
+            if low[node] == index[node]:
+                comp = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    comp.append(w)
+                    if w == node:
+                        break
+                sccs.append(comp)
+            work.pop()
+            if work:
+                parent = work[-1][0]
+                low[parent] = min(low[parent], low[node])
+
+    for v in nodes:
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+# ---------------------------------------------------------------- build
+
+
+def build(repo: RepoIndex) -> LockGraph:
+    """Build the acquisition-order graph for the checkout behind `repo`.
+
+    Memoized on the RepoIndex instance: the `lock-order` rule, the drift
+    check, and the report all share one build per run."""
+    cached = getattr(repo, "_lockmap_graph", None)
+    if cached is not None:
+        return cached
+    b = _Builder(repo)
+    graph = b.run()
+    repo._lockmap_graph = graph  # noqa: SLF001 - intentional memo slot
+    return graph
+
+
+class _FuncInfo:
+    __slots__ = ("key", "path", "node", "cls")
+
+    def __init__(self, key, path, node, cls):
+        self.key = key  # (path, classname_or_None, funcname)
+        self.path = path
+        self.node = node
+        self.cls = cls
+
+
+class _Builder:
+    def __init__(self, repo: RepoIndex):
+        self.repo = repo
+        self.graph = LockGraph()
+        # (path, classname_or_None, attr) -> lock class name
+        self.reg: Dict[Tuple[str, Optional[str], str], str] = {}
+        # condition aliases resolved after harvest:
+        # (path, cls, attr) -> (path, cls, backing_attr)
+        self.aliases: Dict[Tuple[str, Optional[str], str],
+                           Tuple[str, Optional[str], str]] = {}
+        # attr -> set of lock class names (repo-unique fallback)
+        self.by_attr: Dict[str, Set[str]] = {}
+        self.funcs: Dict[Tuple, _FuncInfo] = {}
+        self.methods_by_name: Dict[str, List[Tuple]] = {}
+        self.mod_funcs: Dict[str, Dict[str, Tuple]] = {}
+        # per-module import alias -> module relpath (module imports AND
+        # from-imports of classes, mapped to the defining module)
+        self.imports: Dict[str, Dict[str, str]] = {}
+        # (path, classname) -> list of base-expression strings
+        self.class_bases: Dict[Tuple[str, str], List[str]] = {}
+        # (path, class, attr) -> (path, class) of the repo type the
+        # attr is constructed as (`self._global_cache = LRUCache(...)`)
+        self.attr_types: Dict[Tuple[str, Optional[str], str],
+                              Tuple[str, str]] = {}
+        self._summaries: Dict[Tuple, Dict[str, Tuple[str, ...]]] = {}
+        self._in_progress: Set[Tuple] = set()
+        self._aliases_memo: Dict[Tuple, Dict[str, str]] = {}
+        self._cur_aliases: Dict[str, str] = {}
+
+    # ------------------------------------------------------------- run
+
+    def run(self) -> LockGraph:
+        files = self.repo.python_files()
+        trees = {}
+        for relpath in files:
+            if relpath in _SKIP_FILES:
+                continue
+            sf = self.repo.get(relpath)
+            if sf is not None and sf.tree is not None:
+                trees[relpath] = sf.tree
+        for relpath, tree in trees.items():
+            self._index_functions(relpath, tree)
+            self._index_imports(relpath, tree)
+        for relpath, tree in trees.items():
+            self._harvest(relpath, tree)
+        self._resolve_aliases()
+        for info in self.funcs.values():
+            self._walk_function(info)
+        return self.graph
+
+    # --------------------------------------------------------- harvest
+
+    def _register(self, key: Tuple[str, Optional[str], str], name: str,
+                  kind: str, site: LockSite, registered: bool,
+                  is_attr: bool = True) -> None:
+        self.reg[key] = name
+        cls = self.graph.classes.get(name)
+        if cls is None:
+            self.graph.classes[name] = LockClass(name, kind, [site],
+                                                 registered)
+        else:
+            if site not in cls.sites:
+                cls.sites.append(site)
+            if registered and not cls.registered:
+                cls.registered = True
+        # the attr-unique fallback map: auto-named bare-Name locks
+        # (function locals, script helpers) stay out of it — a local
+        # `lock = threading.Lock()` in a CLI must not shadow `self.lock`
+        # resolution elsewhere
+        if registered or is_attr:
+            self.by_attr.setdefault(key[2], set()).add(name)
+
+    def _harvest(self, relpath: str, tree: ast.Module) -> None:
+        """Find lock construction sites: witness factory calls (the
+        canonical registrations), raw threading primitives (auto-named),
+        and condition aliases over an existing lock attribute."""
+        for cls_name, node in _assignments(tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            target = node.targets[0]
+            attr = _simple_target(target)
+            if attr is None:
+                continue
+            key = (relpath, cls_name, attr)
+            is_attr = isinstance(target, ast.Attribute)
+            site = LockSite(relpath, node.lineno)
+            fac = _find_factory_call(node.value)
+            if fac is not None:
+                fname, lock_name = fac
+                self._register(key, lock_name, _FACTORIES[fname], site,
+                               registered=True, is_attr=is_attr)
+                continue
+            raw = _raw_threading_kind(node.value)
+            if raw is not None:
+                kind, backing = raw
+                if backing is not None:
+                    # threading.Condition(self._lock): alias to backing
+                    self.aliases[key] = (relpath, cls_name, backing)
+                    continue
+                auto = f"{_modstem(relpath)}.{attr.lstrip('_')}"
+                self._register(key, auto, kind, site, registered=False,
+                               is_attr=is_attr)
+                continue
+            # `self.X = RepoClass(...)`: type the attribute so
+            # `self.X.lock` resolves through RepoClass's registration
+            if is_attr and isinstance(node.value, ast.Call):
+                ctor = self._resolve_ctor(relpath, node.value.func)
+                if ctor is not None:
+                    self.attr_types[key] = ctor
+
+    def _resolve_ctor(self, path: str, fn: ast.AST
+                      ) -> Optional[Tuple[str, str]]:
+        if isinstance(fn, ast.Name):
+            if (path, fn.id) in self.class_bases:
+                return (path, fn.id)
+            mod_path = self.imports.get(path, {}).get(fn.id)
+            if mod_path is not None \
+                    and (mod_path, fn.id) in self.class_bases:
+                return (mod_path, fn.id)
+        elif isinstance(fn, ast.Attribute) \
+                and isinstance(fn.value, ast.Name):
+            mod_path = self.imports.get(path, {}).get(fn.value.id)
+            if mod_path is not None \
+                    and (mod_path, fn.attr) in self.class_bases:
+                return (mod_path, fn.attr)
+        return None
+
+    def _resolve_aliases(self) -> None:
+        for key, backing_key in self.aliases.items():
+            name = self.reg.get(backing_key)
+            if name is None and backing_key[1] is not None:
+                # backing lock assigned in another class/module: fall
+                # back to the attr-unique map
+                cands = self.by_attr.get(backing_key[2], set())
+                if len(cands) == 1:
+                    name = next(iter(cands))
+            if name is not None:
+                self.reg[key] = name
+                self.by_attr.setdefault(key[2], set()).add(name)
+
+    # ----------------------------------------------------------- index
+
+    def _index_functions(self, relpath: str, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = (relpath, None, node.name)
+                self.funcs[key] = _FuncInfo(key, relpath, node, None)
+                self.mod_funcs.setdefault(relpath, {})[node.name] = key
+            elif isinstance(node, ast.ClassDef):
+                self.class_bases[(relpath, node.name)] = [
+                    ast.unparse(b) for b in node.bases]
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        key = (relpath, node.name, sub.name)
+                        self.funcs[key] = _FuncInfo(key, relpath, sub,
+                                                    node.name)
+                        self.methods_by_name.setdefault(
+                            sub.name, []).append(key)
+
+    def _index_imports(self, relpath: str, tree: ast.Module) -> None:
+        amap = self.imports.setdefault(relpath, {})
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.startswith("gubernator_tpu"):
+                        amap[alias.asname or alias.name.split(".")[-1]] = \
+                            _mod_to_path(alias.name)
+            elif isinstance(node, ast.ImportFrom) and node.module \
+                    and node.module.startswith("gubernator_tpu"):
+                mod_path = _mod_to_path(node.module)
+                for alias in node.names:
+                    sub_path = _mod_to_path(f"{node.module}.{alias.name}")
+                    if self.repo.exists(sub_path):
+                        # `from pkg import module`
+                        amap[alias.asname or alias.name] = sub_path
+                    elif self.repo.exists(mod_path):
+                        # `from pkg.module import ClassOrFn`: the alias
+                        # names a symbol defined in mod_path
+                        amap[alias.asname or alias.name] = mod_path
+
+    # ----------------------------------------------------- class chains
+
+    def _resolve_base(self, path: str, base: str
+                      ) -> Optional[Tuple[str, str]]:
+        """Resolve a base-class expression string to (path, classname)."""
+        if "." in base:
+            alias, _, cls = base.rpartition(".")
+            mod_path = self.imports.get(path, {}).get(alias)
+            if mod_path is not None and (mod_path, cls) in self.class_bases:
+                return (mod_path, cls)
+            return None
+        if (path, base) in self.class_bases:
+            return (path, base)
+        mod_path = self.imports.get(path, {}).get(base)
+        if mod_path is not None and (mod_path, base) in self.class_bases:
+            return (mod_path, base)
+        return None
+
+    def _mro(self, path: str, cls: str) -> List[Tuple[str, str]]:
+        """(path, class) chain: the class itself then its bases, BFS,
+        depth-bounded and cycle-guarded."""
+        out = [(path, cls)]
+        seen = {(path, cls)}
+        frontier = [(path, cls)]
+        for _ in range(_MAX_MRO_DEPTH):
+            nxt = []
+            for p, c in frontier:
+                for base in self.class_bases.get((p, c), []):
+                    r = self._resolve_base(p, base)
+                    if r is not None and r not in seen:
+                        seen.add(r)
+                        out.append(r)
+                        nxt.append(r)
+            if not nxt:
+                break
+            frontier = nxt
+        return out
+
+    # --------------------------------------------- lock canonicalization
+
+    def canonicalize(self, expr: ast.AST, path: str, cls: Optional[str],
+                     aliases: Optional[Dict[str, str]] = None,
+                     ) -> Optional[str]:
+        """Map a lock expression at a use site to its canonical class
+        name, or None when unresolvable."""
+        if isinstance(expr, ast.Attribute):
+            recv = expr.value
+            # typed receiver: `self.X.lock` where self.X was constructed
+            # as a repo class that registers `lock`
+            if isinstance(recv, ast.Attribute) \
+                    and isinstance(recv.value, ast.Name) \
+                    and recv.value.id == "self" and cls is not None:
+                for p, c in self._mro(path, cls):
+                    t = self.attr_types.get((p, c, recv.attr))
+                    if t is None:
+                        continue
+                    for p2, c2 in self._mro(*t):
+                        name = self.reg.get((p2, c2, expr.attr))
+                        if name is not None:
+                            return name
+            recv_src = ast.unparse(recv)
+            return self._attr_class(path, cls, recv_src, expr.attr)
+        if isinstance(expr, ast.Name):
+            if aliases and expr.id in aliases:
+                return aliases[expr.id]
+            name = self.reg.get((path, None, expr.id))
+            if name is not None:
+                return name
+            cands = self.by_attr.get(expr.id, set())
+            if len(cands) == 1:
+                return next(iter(cands))
+        return None
+
+    def _attr_class(self, path: str, cls: Optional[str], recv_src: str,
+                    attr: str) -> Optional[str]:
+        if recv_src == "self" and cls is not None:
+            for p, c in self._mro(path, cls):
+                name = self.reg.get((p, c, attr))
+                if name is not None:
+                    return name
+        cands = self.by_attr.get(attr, set())
+        if len(cands) == 1:
+            return next(iter(cands))
+        # `backend._lock` / `eng._lock`: the duck-typed engine receiver
+        # the lexical rules already recognize — resolve to the engine
+        # class when it exists (the corpus repos may not have one)
+        if attr == "_lock" and _ENGINEISH_RE.search(recv_src) \
+                and "engine" in self.graph.classes:
+            return "engine"
+        return None
+
+    # ----------------------------------------------------- call resolve
+
+    def resolve_call(self, call: ast.Call, path: str,
+                     cls: Optional[str]) -> Optional[Tuple]:
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            # bare name: same-module function, else a from-imported one
+            key = self.mod_funcs.get(path, {}).get(fn.id)
+            if key is not None:
+                return key
+            mod_path = self.imports.get(path, {}).get(fn.id)
+            if mod_path is not None:
+                return self.mod_funcs.get(mod_path, {}).get(fn.id)
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        meth = fn.attr
+        recv = fn.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and cls is not None:
+                for p, c in self._mro(path, cls):
+                    key = (p, c, meth)
+                    if key in self.funcs:
+                        return key
+            mod_path = self.imports.get(path, {}).get(recv.id)
+            if mod_path is not None:
+                key = self.mod_funcs.get(mod_path, {}).get(meth)
+                if key is not None:
+                    return key
+        if isinstance(recv, ast.Call) and isinstance(recv.func, ast.Name) \
+                and recv.func.id == "super" and cls is not None:
+            for p, c in self._mro(path, cls)[1:]:
+                key = (p, c, meth)
+                if key in self.funcs:
+                    return key
+        # duck-typed receiver: resolve only when the method name is
+        # repo-unique AND not shared with a builtin container/stdlib
+        # protocol, else under-approximate
+        if meth in _COMMON_METHODS:
+            return None
+        cands = self.methods_by_name.get(meth, [])
+        if len(cands) == 1:
+            return cands[0]
+        return None
+
+    # ----------------------------------------------------- local aliases
+
+    def local_aliases(self, key: Tuple) -> Dict[str, str]:
+        """Function-local lock aliases: `lock = self._lock`,
+        `lock = getattr(backend, "_lock", None)` (the keyspace harvest
+        pattern). One pass per function, memoized. A name rebound to two
+        different classes in one function is dropped (ambiguous)."""
+        memo = self._aliases_memo.get(key)
+        if memo is not None:
+            return memo
+        info = self.funcs[key]
+        out: Dict[str, str] = {}
+        poisoned: Set[str] = set()
+        for node in ast.walk(info.node):
+            if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            tgt = node.targets[0].id
+            lock = None
+            v = node.value
+            if isinstance(v, ast.Attribute):
+                lock = self.canonicalize(v, info.path, info.cls)
+            else:
+                g = _getattr_parts(v)
+                if g is not None:
+                    lock = self._attr_class(info.path, info.cls, *g)
+            if lock is None:
+                if tgt in out:
+                    poisoned.add(tgt)
+                continue
+            if tgt in out and out[tgt] != lock:
+                poisoned.add(tgt)
+            out[tgt] = lock
+        for tgt in poisoned:
+            out.pop(tgt, None)
+        self._aliases_memo[key] = out
+        return out
+
+    # ------------------------------------------------ function summaries
+
+    def summary(self, key: Tuple, depth: int = MAX_CALL_DEPTH,
+                ) -> Dict[str, Tuple[str, ...]]:
+        """Lock classes function `key` may transitively acquire, each
+        with the shortest `path:line` witness chain found. Bounded by
+        `depth` call hops and cycle-guarded."""
+        memo = self._summaries.get(key)
+        if memo is not None:
+            return memo
+        if key in self._in_progress or depth <= 0:
+            return {}
+        self._in_progress.add(key)
+        info = self.funcs[key]
+        aliases = self.local_aliases(key)
+        out: Dict[str, Tuple[str, ...]] = {}
+
+        def note(name: str, chain: Tuple[str, ...]) -> None:
+            cur = out.get(name)
+            if cur is None or len(chain) < len(cur):
+                out[name] = chain
+
+        for node, kind in _sync_events(info.node):
+            if kind == "with" or kind == "acquire":
+                expr = node.context_expr if kind == "with" else \
+                    node.func.value
+                here = f"{info.path}:{expr.lineno}"
+                lock = self.canonicalize(expr, info.path, info.cls,
+                                         aliases)
+                if lock is not None:
+                    note(lock, (here,))
+            elif kind == "call":
+                callee = self.resolve_call(node, info.path, info.cls)
+                if callee is None or callee == key:
+                    continue
+                here = f"{info.path}:{node.lineno}"
+                for lock, chain in self.summary(callee, depth - 1).items():
+                    note(lock, (here,) + chain)
+        self._in_progress.discard(key)
+        self._summaries[key] = out
+        return out
+
+    # -------------------------------------------------- edge extraction
+
+    def _walk_function(self, info: _FuncInfo) -> None:
+        self._cur_aliases = self.local_aliases(info.key)
+        self._walk_nodes(info, info.node.body, ())
+
+    def _walk_nodes(self, info: _FuncInfo, nodes, held) -> None:
+        for node in nodes:
+            self._walk_node(info, node, held)
+
+    def _walk_node(self, info: _FuncInfo, node: ast.AST, held) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            # deferred execution: a closure defined under a lock runs at
+            # its call site, which is checked wherever that happens
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                lock = self.canonicalize(item.context_expr, info.path,
+                                         info.cls, self._cur_aliases)
+                here = f"{info.path}:{item.context_expr.lineno}"
+                if lock is None:
+                    src = ast.unparse(item.context_expr)
+                    if _LOCKISH_RE.search(src):
+                        self.graph.unresolved.append(
+                            (info.path, item.context_expr.lineno, src))
+                    continue
+                for h_name, h_site in new_held:
+                    self.graph.add_edge(h_name, lock, (h_site, here))
+                new_held = new_held + ((lock, here),)
+            self._walk_nodes(info, node.body, new_held)
+            return
+        if isinstance(node, ast.Call):
+            self._handle_call(info, node, held)
+            for child in ast.iter_child_nodes(node):
+                self._walk_node(info, child, held)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._walk_node(info, child, held)
+
+    def _handle_call(self, info: _FuncInfo, call: ast.Call, held) -> None:
+        fn = call.func
+        here = f"{info.path}:{call.lineno}"
+        # direct .acquire() on a resolvable lock expression
+        if isinstance(fn, ast.Attribute) and fn.attr == "acquire":
+            lock = self.canonicalize(fn.value, info.path, info.cls,
+                                     self._cur_aliases)
+            if lock is not None:
+                for h_name, h_site in held:
+                    self.graph.add_edge(h_name, lock, (h_site, here))
+                return
+        if not held:
+            return
+        callee = self.resolve_call(call, info.path, info.cls)
+        if callee is None:
+            return
+        for lock, chain in self.summary(callee).items():
+            for h_name, h_site in held:
+                self.graph.add_edge(h_name, lock, (h_site, here) + chain)
+
+
+# ------------------------------------------------------------ ast utils
+
+
+def _assignments(tree: ast.Module):
+    """Yield (enclosing class name or None, Assign node) pairs for every
+    assignment in the module, including inside methods."""
+    def visit(node, cls_name):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                yield from visit(child, child.name)
+            elif isinstance(child, ast.Assign):
+                yield cls_name, child
+                yield from visit(child, cls_name)
+            else:
+                yield from visit(child, cls_name)
+    yield from visit(tree, None)
+
+
+def _getattr_parts(value: ast.AST) -> Optional[Tuple[str, str]]:
+    """`getattr(X, "attr"[, default])` -> (receiver_src, attr)."""
+    if isinstance(value, ast.Call) and isinstance(value.func, ast.Name) \
+            and value.func.id == "getattr" and len(value.args) >= 2 \
+            and isinstance(value.args[1], ast.Constant) \
+            and isinstance(value.args[1].value, str):
+        return ast.unparse(value.args[0]), value.args[1].value
+    return None
+
+
+def _simple_target(target: ast.AST) -> Optional[str]:
+    """`self.X = ...` or module/function-level `X = ...` -> attr name."""
+    if isinstance(target, ast.Attribute) \
+            and isinstance(target.value, ast.Name) \
+            and target.value.id == "self":
+        return target.attr
+    if isinstance(target, ast.Name):
+        return target.id
+    return None
+
+
+def _find_factory_call(value: ast.AST) -> Optional[Tuple[str, str]]:
+    """First witness factory call anywhere in `value` (handles
+    `threading.Condition(witness.make_lock("x"))`)."""
+    for node in ast.walk(value):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        fname = fn.attr if isinstance(fn, ast.Attribute) else (
+            fn.id if isinstance(fn, ast.Name) else "")
+        if fname in _FACTORIES and node.args \
+                and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            return fname, node.args[0].value
+    return None
+
+
+def _raw_threading_kind(value: ast.AST
+                        ) -> Optional[Tuple[str, Optional[str]]]:
+    """Classify a raw threading primitive construction.
+
+    Returns (kind, backing_attr): backing_attr is set for
+    `threading.Condition(self.X)` aliases, else None."""
+    if not isinstance(value, ast.Call):
+        return None
+    src = ast.unparse(value.func)
+    if src == "threading.Lock":
+        return ("lock", None)
+    if src == "threading.RLock":
+        return ("rlock", None)
+    if src == "threading.Condition":
+        if value.args:
+            arg = value.args[0]
+            if isinstance(arg, ast.Attribute) \
+                    and isinstance(arg.value, ast.Name) \
+                    and arg.value.id == "self":
+                return ("rcondition", arg.attr)
+            if isinstance(arg, ast.Call) \
+                    and ast.unparse(arg.func) == "threading.Lock":
+                return ("lock", None)
+        return ("rcondition", None)
+    return None
+
+
+def _sync_events(fn: ast.AST):
+    """Yield (node, kind) for every with-item, .acquire() call, and
+    plain call in `fn`'s body, skipping nested function/class bodies.
+    kind: "with" yields the withitem, "acquire"/"call" yield Call."""
+    def visit(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            if isinstance(child, (ast.With, ast.AsyncWith)):
+                for item in child.items:
+                    yield item, "with"
+            if isinstance(child, ast.Call):
+                fn_ = child.func
+                if isinstance(fn_, ast.Attribute) and fn_.attr == "acquire":
+                    yield child, "acquire"
+                else:
+                    yield child, "call"
+            yield from visit(child)
+    yield from visit(fn)
+
+
+def _modstem(relpath: str) -> str:
+    stem = os.path.splitext(os.path.basename(relpath))[0]
+    return stem if stem != "__init__" else \
+        os.path.basename(os.path.dirname(relpath))
+
+
+def _mod_to_path(module: str) -> str:
+    path = module.replace(".", "/") + ".py"
+    return path
+
+
+# ------------------------------------------------- donated-buffer flow
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationFinding:
+    path: str
+    line: int
+    var: str
+    receiver: str
+    attr: str
+    donated_at: int
+
+
+def donation_findings(repo: RepoIndex) -> List[DonationFinding]:
+    """Per-function dataflow over donated device-array attributes.
+
+    A *capture* is `v = X.state` (X engine-ish, or `self` in a class the
+    lexical rule already recognizes as an array holder). A *donation* is
+    the donate-and-rebind assignment `X.state, ... = f(X.state, ...)` —
+    any Assign whose value is a Call and whose targets rebind the same
+    attribute. Any read of `v` after a donation that happened after the
+    capture, with no fresh re-read in between, is a stale donated
+    reference: by readback time XLA has deleted the buffer."""
+    from gubernator_tpu.analysis.rules.locks import _donated_classes
+
+    out: List[DonationFinding] = []
+    for relpath in repo.python_files():
+        if not relpath.startswith("gubernator_tpu/"):
+            continue
+        sf = repo.get(relpath)
+        tree = sf.tree if sf is not None else None
+        if tree is None:
+            continue
+        donated_classes = {c.name for c in _donated_classes(tree)}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(_scan_function(relpath, node, donated_classes,
+                                          tree))
+    return sorted(out, key=lambda f: (f.path, f.line, f.var))
+
+
+def _donated_attr(expr: ast.AST, donated_classes: Set[str],
+                  in_class: Optional[str]) -> Optional[Tuple[str, str]]:
+    """(receiver_src, attr) when `expr` reads a donated array attr."""
+    if not (isinstance(expr, ast.Attribute) and expr.attr in DONATED_ATTRS):
+        return None
+    recv = ast.unparse(expr.value)
+    if recv == "self":
+        if in_class in donated_classes:
+            return recv, expr.attr
+        return None
+    if _ENGINEISH_RE.search(recv):
+        return recv, expr.attr
+    return None
+
+
+def _scan_function(relpath: str, fn: ast.AST, donated_classes: Set[str],
+                   tree: ast.Module) -> List[DonationFinding]:
+    in_class = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and fn in ast.walk(node):
+            in_class = node.name
+            break
+
+    captures: Dict[str, List[Tuple[int, bool, str, str]]] = {}
+    donations: List[Tuple[int, str, str]] = []  # line, receiver, attr
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue
+        if isinstance(node, ast.Assign):
+            # donation: value is a Call, some target rebinds X.<attr>
+            if isinstance(node.value, ast.Call):
+                for tgt in node.targets:
+                    elts = tgt.elts if isinstance(tgt, ast.Tuple) else [tgt]
+                    for el in elts:
+                        d = _donated_attr(el, donated_classes, in_class)
+                        if d is not None:
+                            donations.append((node.lineno, d[0], d[1]))
+            # assignment events per simple-name target
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    d = _donated_attr(node.value, donated_classes, in_class)
+                    if d is not None:
+                        captures.setdefault(tgt.id, []).append(
+                            (node.lineno, True, d[0], d[1]))
+                    else:
+                        captures.setdefault(tgt.id, []).append(
+                            (node.lineno, False, "", ""))
+
+    if not donations:
+        return []
+
+    findings: List[DonationFinding] = []
+    reported: Set[Tuple[str, int]] = set()
+    for node in ast.walk(fn):
+        if not (isinstance(node, ast.Name)
+                and isinstance(node.ctx, ast.Load)):
+            continue
+        events = captures.get(node.id)
+        if not events:
+            continue
+        last = None
+        for ev in sorted(events):
+            if ev[0] < node.lineno:
+                last = ev
+        if last is None or not last[1]:
+            continue
+        cap_line, _, recv, attr = last
+        for d_line, d_recv, d_attr in donations:
+            if cap_line < d_line < node.lineno \
+                    and d_recv == recv and d_attr == attr:
+                key = (node.id, node.lineno)
+                if key not in reported:
+                    reported.add(key)
+                    findings.append(DonationFinding(
+                        relpath, node.lineno, node.id, recv, attr, d_line))
+                break
+    return findings
+
+
+# ------------------------------------------------------- baseline file
+
+
+def baseline_path(root: str) -> str:
+    return os.path.join(root, "lockmap.json")
+
+
+def load_baseline(root: str) -> Optional[dict]:
+    import json
+    try:
+        with open(baseline_path(root), encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def render_baseline(graph: LockGraph, prior: Optional[dict]) -> dict:
+    """The committed lockmap.json payload: static edge pairs pinned both
+    directions, runtime-observed extras carried over from the prior
+    baseline (they are maintained by hand, each with a `why`)."""
+    return {
+        "version": 1,
+        "classes": {
+            name: {
+                "kind": c.kind,
+                "registered": c.registered,
+                "sites": sorted(s.render() for s in c.sites),
+            }
+            for name, c in sorted(graph.classes.items())
+        },
+        "static_edges": [list(p) for p in graph.edge_pairs()],
+        "runtime_edges": (prior or {}).get("runtime_edges", []),
+    }
+
+
+def diff_baseline(graph: LockGraph, baseline: Optional[dict]
+                  ) -> Tuple[List[Tuple[str, str]], List[Tuple[str, str]]]:
+    """(missing_from_baseline, gone_from_analysis) — the two-direction
+    drift pin. Empty/empty means the committed lockmap is current."""
+    have = set(graph.edge_pairs())
+    pinned = {tuple(e) for e in (baseline or {}).get("static_edges", [])}
+    return sorted(have - pinned), sorted(pinned - have)

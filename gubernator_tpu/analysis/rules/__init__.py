@@ -3,6 +3,7 @@
 from gubernator_tpu.analysis.rules import (  # noqa: F401
     hatches,
     knobs,
+    lockorder,
     locks,
     native,
     registries,

@@ -28,6 +28,7 @@ HATCHES: Sequence[Tuple[str, Tuple[str, ...]]] = (
     ("GUBER_PIPELINE_DEPTH", ("pipeline_depth",)),
     ("GUBER_DEVICE_DIRECTORY", ("device_directory", "DevDirEngine")),
     ("GUBER_PROFILE", ("profile_enabled",)),
+    ("GUBER_LOCK_WITNESS", ("lock_witness", "witness_enabled")),
 )
 
 DIFF_RE = re.compile(

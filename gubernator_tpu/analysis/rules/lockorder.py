@@ -1,0 +1,84 @@
+"""lock-order and donation-flow: the lockmap-backed guberlint rules.
+
+Both rules are thin adapters over `analysis/lockmap.py` (the build is
+memoized on the RepoIndex, so `lock-order`, the drift gate, and
+`scripts/lockmap_report.py` share one interprocedural pass per run).
+
+`lock-order` — the acquisition-order digraph must be acyclic. A cycle
+means two threads can take the same pair of locks in opposite orders,
+which is a deadlock waiting for the right interleaving; the PR 14
+reshard NOT_MINE/PLANNING deflakes were this class (engine lock vs
+transfer-session lock taken in both orders across the import path and
+the drill killer thread). The finding is anchored at the first witness
+site of the lexicographically smallest edge in the cycle, and renders
+every edge with its `path:line` witness chain so the fix (or the waiver
+justification) can name the exact frames.
+
+`donation-flow` — a local captured from a donated device-array attribute
+(`rows = backend.state`) must not be read after a later donate-and-
+rebind dispatch (`backend.state, hits = decide(backend.state, ...)`)
+without a fresh re-read: XLA deletes the donated buffer at dispatch, so
+the stale capture is a use-after-free that surfaces as
+"Array has been deleted" only under the right thread timing — the PR 10
+cartographer harvest bug. `lock-discipline` (lexical) checks reads sit
+under the lock; this rule checks the *lifetime* ordering even inside a
+single function.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from gubernator_tpu.analysis import lockmap
+from gubernator_tpu.analysis.core import Finding, RepoIndex, Rule, register
+
+
+def _first_site(edge: lockmap.Edge) -> tuple:
+    path, _, line = edge.witness[0].rpartition(":")
+    return path, int(line)
+
+
+@register
+class LockOrderRule(Rule):
+    id = "lock-order"
+    doc = ("the whole-repo lock acquisition-order graph must be acyclic "
+           "(every cycle is a deadlock schedule; see `make lockmap`)")
+
+    def check(self, repo: RepoIndex) -> Iterable[Finding]:
+        graph = lockmap.build(repo)
+        for cycle in graph.cycles():
+            edges = graph.cycle_edges(cycle)
+            if not edges:
+                continue
+            anchor = _first_site(edges[0])
+            chains = "; ".join(
+                f"{e.src}->{e.dst} via {' -> '.join(e.witness)}"
+                for e in edges)
+            if len(cycle) == 1:
+                msg = (f"non-reentrant lock class `{cycle[0]}` can "
+                       f"re-acquire itself ({chains}) — self-deadlock; "
+                       "break the chain or make the class reentrant")
+            else:
+                msg = (f"lock-order cycle {' -> '.join(cycle)} — two "
+                       f"threads taking these in opposite orders "
+                       f"deadlock; edges: {chains}")
+            yield Finding(self.id, anchor[0], anchor[1], msg)
+
+
+@register
+class DonationFlowRule(Rule):
+    id = "donation-flow"
+    doc = ("a local captured from a donated array attr (.state/.fps/"
+           ".touch) must be re-read after any donate-and-rebind "
+           "dispatch, not used stale")
+
+    def check(self, repo: RepoIndex) -> Iterable[Finding]:
+        for f in lockmap.donation_findings(repo):
+            yield Finding(
+                self.id, f.path, f.line,
+                f"`{f.var}` (captured from `{f.receiver}.{f.attr}`) is "
+                f"read after the donate-and-rebind dispatch at line "
+                f"{f.donated_at} — the donated buffer is deleted at "
+                f"dispatch; re-read `{f.receiver}.{f.attr}` (under the "
+                "engine lock) after the rebind")

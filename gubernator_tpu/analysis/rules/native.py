@@ -29,6 +29,15 @@ NATIVE_DIR = "gubernator_tpu/native"
 # (plus -Werror) so lint and the shipped .so agree on the surface
 WARN_FLAGS = ("-Wall", "-Wextra")
 
+# a second pass under the sanitizer flag set `make sanitize` builds
+# with: -fsanitize changes the frontend's constant folding and
+# builtin expansion enough that some diagnostics fire only there, and
+# a source that stops compiling under instrumentation would silently
+# rot the TSan/ASan suites between rebuilds. (thread+undefined is the
+# combinable pair; address conflicts with thread and adds no extra
+# frontend diagnostics beyond this set.)
+SYNTAX_PASSES = ((), ("-fsanitize=thread,undefined", "-pthread"))
+
 _DIAG_RE = re.compile(r"^(?P<path>[^:]+):(?P<line>\d+):\d+:\s*"
                       r"(?:warning|error):\s*(?P<msg>.*)$")
 
@@ -50,13 +59,21 @@ class NativeWarningsRule(Rule):
             if not name.endswith(".cpp"):
                 continue
             src = os.path.join(native, name)
-            proc = subprocess.run(
-                ["g++", "-fsyntax-only", *WARN_FLAGS, "-std=c++17",
-                 pyinc, src],
-                capture_output=True, text=True, timeout=120)
             relpath = f"{NATIVE_DIR}/{name}"
-            for raw in (proc.stderr or "").splitlines():
-                m = _DIAG_RE.match(raw.strip())
-                if m and os.path.basename(m.group("path")) == name:
-                    yield Finding(self.id, relpath, int(m.group("line")),
-                                  f"g++ diagnostic: {m.group('msg')}")
+            seen = set()
+            for extra in SYNTAX_PASSES:
+                proc = subprocess.run(
+                    ["g++", "-fsyntax-only", *WARN_FLAGS, *extra,
+                     "-std=c++17", pyinc, src],
+                    capture_output=True, text=True, timeout=120)
+                tag = f" [{extra[0]}]" if extra else ""
+                for raw in (proc.stderr or "").splitlines():
+                    m = _DIAG_RE.match(raw.strip())
+                    if not m or os.path.basename(m.group("path")) != name:
+                        continue
+                    key = (int(m.group("line")), m.group("msg"))
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    yield Finding(self.id, relpath, key[0],
+                                  f"g++ diagnostic: {key[1]}{tag}")

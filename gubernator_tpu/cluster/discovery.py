@@ -35,6 +35,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.types import PeerInfo
 
 log = logging.getLogger("gubernator_tpu.discovery")
@@ -157,7 +158,7 @@ class GossipPool(Pool):
         # heartbeat from the member itself (it is alive after all, or
         # restarted) clears the tombstone early.
         self._tombstones: Dict[str, float] = {}
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("cluster.discovery")
         self._closed = threading.Event()
         self._last_pushed: Optional[List[PeerInfo]] = None
 

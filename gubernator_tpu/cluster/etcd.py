@@ -25,6 +25,7 @@ from typing import Callable, Dict, Iterator, List, Optional, Sequence
 
 import grpc
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.service.pb import etcd_pb2 as epb
 from gubernator_tpu.types import PeerInfo
 
@@ -269,8 +270,8 @@ class EtcdPool:
         self.timeout_s = timeout_s
 
         self._peers: Dict[str, None] = {}
-        self._peers_lock = threading.Lock()
-        self._conn_lock = threading.Lock()
+        self._peers_lock = witness.make_lock("etcd.peers")
+        self._conn_lock = witness.make_lock("etcd.conn")
         self._closed = threading.Event()
         self._lease_id = 0
         self._ka_feed: Optional[_StreamFeed] = None

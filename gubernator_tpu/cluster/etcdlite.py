@@ -28,6 +28,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import grpc
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.service.pb import etcd_pb2 as epb
 
 log = logging.getLogger("gubernator_tpu.etcdlite")
@@ -83,7 +84,7 @@ class EtcdLite:
         self._revision = 0
         self._next_lease = 1000
         self._next_watch = 1
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("etcdlite.store")
         self._closed = threading.Event()
         self.min_lease_ttl_s = min_lease_ttl_s
         # test hook: when set, keep-alive streams terminate immediately and

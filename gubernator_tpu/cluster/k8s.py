@@ -24,6 +24,7 @@ import urllib.parse
 import urllib.request
 from typing import Callable, Dict, List, Optional
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.types import PeerInfo
 
 log = logging.getLogger("gubernator_tpu.k8s")
@@ -85,7 +86,7 @@ class K8sPool:
 
         # informer store: "namespace/name" -> Endpoints object
         self._store: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("k8s.watch")
         self._closed = threading.Event()
         self._last_pushed: Optional[List[PeerInfo]] = None
 

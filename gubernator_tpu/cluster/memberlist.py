@@ -45,6 +45,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import msgpack
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.cluster import mlwire as wire
 from gubernator_tpu.cluster.discovery import Pool
 from gubernator_tpu.types import PeerInfo
@@ -125,7 +126,7 @@ class MemberlistPool(Pool):
         self._keyring: Optional[List[bytes]] = ring or None
         self._primary_key: Optional[bytes] = ring[0] if ring else None
 
-        self._lock = threading.RLock()
+        self._lock = witness.make_rlock("memberlist.state")
         self._closed = threading.Event()
         self._nodes: Dict[str, NodeState] = {}
         self._incarnation = 1
@@ -139,7 +140,7 @@ class MemberlistPool(Pool):
         # after its sockets are gone
         self._nack_timers: List[threading.Timer] = []
         self._probe_ring: List[str] = []
-        self._push_lock = threading.Lock()
+        self._push_lock = witness.make_lock("memberlist.push")
         self._last_pushed: Optional[List[PeerInfo]] = None
         self._leaving = False
 

@@ -17,6 +17,7 @@ import sys
 import threading
 
 from gubernator_tpu.cmd.envconf import DaemonConfig, build_picker, config_from_env
+from gubernator_tpu.obs import witness
 from gubernator_tpu.service.config import InstanceConfig
 from gubernator_tpu.service.http_gateway import HttpGateway
 from gubernator_tpu.service.instance import Instance
@@ -397,6 +398,10 @@ def main(argv=None) -> int:
                  "(/v1/debug/profile)", conf.profile_capture_s)
     else:
         log.info("serving-cycle profiler OFF (GUBER_PROFILE=0)")
+    if witness.witness_enabled():
+        log.warning("lock-order witness ARMED (GUBER_LOCK_WITNESS=1) — "
+                    "test-rig instrument; every lock carries order "
+                    "bookkeeping, do not run production traffic this way")
     columnar_pipe = (conf.columnar_pipeline and conf.pipeline_depth != 1
                      and getattr(backend, "supports_columnar",
                                  lambda: False)())

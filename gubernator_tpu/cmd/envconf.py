@@ -381,6 +381,12 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         profile_enabled=_env_str("GUBER_PROFILE", "1") not in
         ("0", "f", "false", "no", "off"),
         profile_capture_s=_env_dur("GUBER_PROFILE_CAPTURE_S", 60.0),
+        # GUBER_LOCK_WITNESS (default off) arms the runtime lock-order
+        # witness (obs/witness.py) — it is resolved there at
+        # lock-construction time, before any config object can exist,
+        # so it deliberately has no DaemonConfig field; it is listed
+        # here because this file is the knob inventory. daemon startup
+        # logs when a process is serving with the witness armed.
         collectives=_env_str("GUBER_COLLECTIVES", "psum"),
         coordinator_address=_env_str("GUBER_COORDINATOR_ADDRESS"),
         num_hosts=_env_int("GUBER_NUM_HOSTS", 1),

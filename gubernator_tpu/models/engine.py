@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.models.keyspace import KeyDirectory
 from gubernator_tpu.models.prep import (
     bucket_pow2 as _bucket_pow2,
@@ -224,7 +225,7 @@ class Engine:
         # /v1/debug/profile. Always constructed; GUBER_PROFILE=0 turns
         # every observation site into a single attribute test
         self.profiler = Profiler()
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("engine")
         if donate is None:
             from gubernator_tpu.utils.platform import donation_supported
 

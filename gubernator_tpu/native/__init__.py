@@ -16,9 +16,11 @@ from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from gubernator_tpu.obs import witness
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "keydir.cpp")
-_LIB_LOCK = threading.Lock()
+_LIB_LOCK = witness.make_lock("native.loader")
 _LIB: Optional[ctypes.CDLL] = None
 _LIB_ERR: Optional[str] = None
 

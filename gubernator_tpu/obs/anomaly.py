@@ -55,6 +55,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.obs.history import MetricsHistory
 
 log = logging.getLogger("gubernator_tpu.anomaly")
@@ -102,7 +103,7 @@ class AnomalyEngine:
                       "stall_regression": float(stall_rate),
                       "lease_fail_close": float(fail_close_rate)}
 
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("anomaly.engine")
         # SLO accounting fed by the serving path (Instance.get_rate_limits)
         self._slo_total = 0
         self._slo_good = 0

@@ -26,6 +26,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.obs.introspect import debug_vars
 
 log = logging.getLogger("gubernator_tpu.bundle")
@@ -156,7 +157,7 @@ class BundleWriter:
         self.directory = directory
         self.min_interval_s = float(min_interval_s)
         self.keep = int(keep)
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("bundle.limiter")
         self._last_write = 0.0
         self.stats = {"written": 0, "suppressed": 0, "errors": 0}
 

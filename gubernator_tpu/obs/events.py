@@ -25,6 +25,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.obs import trace
 
 DEFAULT_CAPACITY = 4096
@@ -55,7 +56,7 @@ class FlightRecorder:
         self.capacity = max(int(capacity), 16)
         self._ring: "collections.deque[dict]" = collections.deque(
             maxlen=self.capacity)
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("events.ring")
         self.counts: Dict[str, int] = {}
         self.dropped = 0  # events emitted past a full ring (evictions)
 

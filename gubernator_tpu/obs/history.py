@@ -32,6 +32,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from gubernator_tpu.obs import witness
+
 log = logging.getLogger("gubernator_tpu.history")
 
 # v2: samples carry the profiling plane's cumulative columns
@@ -61,7 +63,7 @@ class MetricsHistory:
         # the anomaly engine owning the SLO counters; backfilled by
         # AnomalyEngine.__init__ when the Instance wires a shared ring
         self.anomaly = anomaly
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("history.ring")
         maxlen = int(self.retention_s / self.tick_s) + 8
         self._samples: "deque[Dict[str, float]]" = deque(maxlen=maxlen)
         self.ticks = 0

@@ -43,6 +43,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.obs.introspect import (
     eviction_count,
     key_table_size,
@@ -234,7 +235,7 @@ class KeyspaceCartographer:
         self.top_k = max(int(top_k), 1)
         self.enabled = bool(enabled)
         self.pressure_fraction = float(pressure_fraction)
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("keyspace.cartographer")
         self._report: Optional[dict] = None
         self._last_harvest = 0.0
         self.harvests = 0

@@ -41,6 +41,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from gubernator_tpu.obs import witness
+
 PROFILE_SCHEMA_VERSION = 1
 KERNELS_SCHEMA_VERSION = 1
 
@@ -76,7 +78,7 @@ class PhaseHist:
     __slots__ = ("_lock", "counts", "n", "total_ns", "max_ns")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("profiler.hist")
         self.counts = [0] * _NBUCKETS
         self.n = 0
         self.total_ns = 0
@@ -136,7 +138,7 @@ class Profiler:
         self.capture_min_interval_s = float(capture_min_interval_s)
         self._phases: Dict[str, PhaseHist] = {p: PhaseHist() for p in PHASES}
         self._sites: Dict[str, PhaseHist] = {}
-        self._sites_lock = threading.Lock()
+        self._sites_lock = witness.make_lock("profiler.sites")
         # windowed views (slow-request attachment, anomaly baselines that
         # predate the history ring): totals snapshots every ~2 s, taken
         # lazily from the observe path so idle engines cost nothing
@@ -145,7 +147,7 @@ class Profiler:
         self._ring_last = 0.0
         self._obs_since_tick = 0
         # deep capture state
-        self._capture_lock = threading.Lock()
+        self._capture_lock = witness.make_lock("profiler.capture")
         self._last_capture = 0.0
         self._captures = 0
         self._last_capture_path: Optional[str] = None

@@ -38,6 +38,8 @@ import time
 from collections import deque
 from typing import Dict, List, Optional
 
+from gubernator_tpu.obs import witness
+
 slow_log = logging.getLogger("gubernator_tpu.slow")
 
 
@@ -168,7 +170,7 @@ class Tracer:
         self.slow_ms = float(slow_ms)
         self.service = service
         self._ring: "deque[Span]" = deque(maxlen=ring)
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("trace.ring")
         self._rand = random.Random()
         self.stats = {"started": 0, "continued": 0, "spans": 0,
                       "slow_logged": 0}

@@ -40,6 +40,7 @@ from typing import Dict, NamedTuple, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.types import Algorithm, Behavior, Status
 
 
@@ -63,7 +64,7 @@ class KernelTelemetry:
     access)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("kernel.telemetry")
         self._counts: Dict[Tuple[str, int], int] = {}
         self._lanes = 0
         self._hists: Dict[Tuple[str, int], "object"] = {}

@@ -35,6 +35,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.models.keyspace import KeyDirectory
 from gubernator_tpu.models.prep import (
     WorkItem,
@@ -338,7 +339,7 @@ class ShardedEngine:
         )
         self.min_width = min_width
         self.max_width = min(max_width, capacity_per_shard)
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("sharded.engine")
         self.loader = loader
 
         # ---- GLOBAL-behavior host state --------------------------------

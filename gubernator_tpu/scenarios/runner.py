@@ -21,6 +21,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.obs.anomaly import DETECTORS
 from gubernator_tpu.scenarios.generator import WorkloadGenerator, windowed
 from gubernator_tpu.scenarios.spec import (
@@ -74,7 +75,7 @@ class _EventThread:
         self._spec = spec
         self._behaviors = behaviors
         self._anchor = anchor
-        self.lock = threading.Lock()
+        self.lock = witness.make_lock("scenario.runner")
         self.dead: set = set()  # instance indices the driver must skip
         self.fired: List[dict] = []
         self._thread: Optional[threading.Thread] = None

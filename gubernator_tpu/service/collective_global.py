@@ -77,6 +77,7 @@ from typing import Callable, Dict, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.cluster.pickers import PickerEmptyError
 from gubernator_tpu.types import (
     Behavior,
@@ -159,7 +160,7 @@ class CollectiveGlobalSync:
         self._claim_secret = claim_secret
         self._keys: Dict[str, _CKey] = {}
         self._by_slot: Dict[int, str] = {}
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("collective.global")
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._tick_started: Optional[float] = None  # wall clock, stall watch

@@ -52,6 +52,7 @@ import time
 from concurrent.futures import Future
 from typing import List, Optional, Sequence
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.obs import trace
 from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.types import RateLimitReq, RateLimitResp
@@ -106,7 +107,7 @@ class BackendCombiner:
         # cycle profiler (obs/profile.py): the combiner feeds each
         # submission's enqueue->launch residency into the queue_wait phase
         self._profiler = getattr(backend, "profiler", None)
-        self._cond = threading.Condition()
+        self._cond = witness.make_condition("combiner.window")
         # pending entry: (reqs, now_ms, future, enqueue time_ns, span|None,
         # deadline|None)
         self._pending: List[tuple] = []
@@ -116,7 +117,7 @@ class BackendCombiner:
         # submit, decremented by each future's done callback — so it spans
         # queue wait AND in-flight device time, whatever path resolved it.
         self._backlog = 0
-        self._backlog_lock = threading.Lock()
+        self._backlog_lock = witness.make_lock("combiner.backlog")
         self._deadline_shed = 0
         # Counter state lives in the daemon's Prometheus registry when one
         # is attached (combiner_* families); these ints are the always-on
@@ -151,7 +152,7 @@ class BackendCombiner:
         self._slots = threading.Semaphore(self._depth)
         self._inflight: "_queue.Queue" = _queue.Queue()
         self._inflight_n = 0
-        self._n_lock = threading.Lock()
+        self._n_lock = witness.make_lock("combiner.counters")
         self._staging = [dict() for _ in range(self._depth + 2)]
         self._launch_seq = 0
         self._drainer: Optional[threading.Thread] = None

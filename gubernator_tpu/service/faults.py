@@ -43,6 +43,8 @@ import threading
 import time
 from typing import List, Optional, Sequence, Tuple
 
+from gubernator_tpu.obs import witness
+
 TRANSPORTS = ("grpc", "peerlink", "reshard")
 ACTIONS = ("error", "timeout", "drop", "delay")
 
@@ -148,7 +150,7 @@ class FaultPlan:
 
     def __init__(self, rules: Sequence[FaultRule]):
         self.rules = list(rules)
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("faults.injector")
         self._counts = {}
         self.injected: List[str] = []
 

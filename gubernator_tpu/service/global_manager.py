@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Dict, Optional
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.convert import resp_to_pb
 from gubernator_tpu.service.pb import peers_pb2 as peers_pb
@@ -58,7 +59,7 @@ class _Pipeline:
         self._flush_fn = flush_fn
         self._pending: Dict[str, RateLimitReq] = {}
         self._deadline: Optional[float] = None
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("global.manager")
         self._wake = threading.Event()
         self._closed = False
         self._thread = threading.Thread(

@@ -16,6 +16,7 @@ from collections import OrderedDict
 
 import grpc
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.pb import gubernator_pb2 as pb
 from gubernator_tpu.service.pb import peers_pb2 as peers_pb
@@ -164,7 +165,7 @@ class PeersV1Stub:
         )
 
 
-_channel_lock = threading.Lock()
+_channel_lock = witness.make_lock("grpc.channels")
 _channels: "OrderedDict[str, grpc.Channel]" = OrderedDict()
 _CHANNEL_CACHE_MAX = 64
 

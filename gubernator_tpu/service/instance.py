@@ -24,6 +24,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.cluster.pickers import (
     PickerEmptyError,
     RegionPicker,
@@ -276,14 +277,14 @@ class Instance:
         # unless explicitly configured.
         self.region_picker = conf.region_picker or RegionPicker(
             self.local_picker.new())
-        self._peer_lock = threading.RLock()
+        self._peer_lock = witness.make_rlock("instance.peers")
 
         # overload safety (service/deadline.py): in-flight forward count
         # feeds the admission controller's pending-work reading; the
         # controller itself gates ingress/forward/broadcast work against
         # GUBER_MAX_PENDING (0 disables — checks become one int read)
         self._forward_inflight = 0
-        self._forward_lock = threading.Lock()
+        self._forward_lock = witness.make_lock("instance.forward")
         self.admission = AdmissionController(self, metrics=conf.metrics)
         # last deadline budget observed per surface (debug/test witness;
         # the request_budget_ms histogram is the production view)

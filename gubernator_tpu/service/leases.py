@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.types import (
     Behavior,
     RateLimitReq,
@@ -95,7 +96,7 @@ class HotKeyTracker:
         self._resolver = resolver  # callable([slot]) -> {slot: hash_key}
         self._counts = np.zeros(self._capacity, dtype=np.int64)
         self._key_counts: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("leases.tracker")
         self._window_start = time.monotonic()
         self._hot: Dict[str, float] = {}  # hash_key -> observed rate (hits/s)
         self._has_hot = False
@@ -208,7 +209,7 @@ class LeaseManager:
 
     def __init__(self, instance):
         self.instance = instance
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("leases.manager")
         self._grants: Dict[str, List[_Grant]] = {}
         self._held: Dict[str, _Held] = {}
         self._seq = 0

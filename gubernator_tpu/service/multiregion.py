@@ -18,6 +18,7 @@ import logging
 import threading
 from typing import Dict
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.service.config import BehaviorConfig
 from gubernator_tpu.service.global_manager import _Pipeline
 from gubernator_tpu.service.peer_client import PeerNotReadyError
@@ -45,7 +46,7 @@ class MultiRegionManager:
         # refund into the shared pipeline would re-send to regions that
         # already received it (cross-region double count).
         self._deferred: Dict[str, Dict[str, RateLimitReq]] = {}
-        self._deferred_lock = threading.Lock()
+        self._deferred_lock = witness.make_lock("multiregion.deferred")
         self.stats = {"replicated": 0, "errors": 0,
                       "refunded_hits": 0, "dropped_hits": 0}
 

@@ -21,6 +21,7 @@ from typing import List, Optional, Sequence
 
 import grpc
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.service import faults
 from gubernator_tpu.service import deadline as deadline_mod
 from gubernator_tpu.service.config import BehaviorConfig
@@ -67,7 +68,7 @@ class CircuitBreaker:
         self.address = address
         self.metrics = metrics
         self.recorder = recorder  # flight recorder (obs/events.py) or None
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("peer.circuit")
         self._failures = 0
         self._state = CIRCUIT_CLOSED
         self._opened_at = 0.0
@@ -181,7 +182,7 @@ class PeerClient:
         self._channel: Optional[grpc.Channel] = None
         self._queue: "queue.Queue" = queue.Queue()
         self._closing = False
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("peer.client")
         self._thread: Optional[threading.Thread] = None
         self.last_errs = LRUCache(max_size=100)
         # native peer transport (service/peerlink.py); None until connected,

@@ -36,6 +36,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.service import faults
 from gubernator_tpu.types import (
     MAX_BATCH_SIZE,
@@ -361,9 +362,9 @@ class PeerLinkClient:
             (host or "127.0.0.1", int(port)), timeout=connect_timeout_s)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         self._sock.settimeout(None)
-        self._wlock = threading.Lock()
+        self._wlock = witness.make_lock("peerlink.write")
         self._futures: Dict[int, Future] = {}
-        self._flock = threading.Lock()
+        self._flock = witness.make_lock("peerlink.frames")
         self._rid = 0
         self._closed = False
         # wire contract v2: stay at v1 until the server's GREETING proves

@@ -58,6 +58,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.service import faults
 from gubernator_tpu.service.peerlink import (
     decode_reshard_frame,
@@ -321,7 +322,7 @@ class ReshardManager:
         # today's fresh behavior, one bounded wait per batch.
         self.active = self.enabled
 
-        self._lock = threading.RLock()
+        self._lock = witness.make_rlock("reshard.session")
         self._cond = threading.Condition(self._lock)
         self._tls = threading.local()
         self._generation = 0
@@ -343,7 +344,7 @@ class ReshardManager:
         # the apply gate: owner applies enter/exit; the exporter's settle
         # fences it (writer-preferring) so a cut is never concurrent with
         # an apply that already passed the intercept
-        self._gate = threading.Condition(threading.Lock())
+        self._gate = threading.Condition(witness.make_lock("reshard.gate"))
         self._appliers = 0
         self._fenced = False
 

@@ -17,6 +17,8 @@ import threading
 import time
 from typing import Optional
 
+from gubernator_tpu.obs import witness
+
 
 def millisecond_now() -> int:
     """Unix time in milliseconds (reference: client.go:62-65)."""
@@ -27,7 +29,7 @@ class Interval:
     def __init__(self, interval_s: float):
         self._interval = interval_s
         self._timer: Optional[threading.Timer] = None
-        self._lock = threading.Lock()
+        self._lock = witness.make_lock("interval.timer")
         #: fires () when an armed tick elapses; consume with `.get()`
         self.c: "queue.Queue[bool]" = queue.Queue()
         self._closed = False

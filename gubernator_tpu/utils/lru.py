@@ -22,6 +22,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Iterator, Optional
 
+from gubernator_tpu.obs import witness
 from gubernator_tpu.utils.interval import millisecond_now
 
 
@@ -45,7 +46,7 @@ class LRUCache:
     def __init__(self, max_size: int = 50_000):
         self._max = max_size if max_size > 0 else 50_000
         self._od: "OrderedDict[str, CacheItem]" = OrderedDict()
-        self.lock = threading.RLock()
+        self.lock = witness.make_rlock("lru.cache")
         # stats for metrics exposition (reference: cache.go:45-51)
         self.stat_hit = 0
         self.stat_miss = 0

@@ -11,6 +11,19 @@ one command restores a verifiable binary set:
     _tsan_keydir_<mtime>.so     g++ -O1 -g -fsanitize=thread
     _tsan_peerlink_<mtime>.so   g++ -O1 -g -fsanitize=thread
 
+`--sanitize` (`make sanitize`) builds the full sanitizer matrix instead:
+the TSan pair above (pre-warming the exact cache tests/test_tsan.py
+keys on) plus ASan and UBSan variants of both sources —
+
+    _asan_keydir_<mtime>.so     g++ -O1 -g -fsanitize=address
+    _asan_peerlink_<mtime>.so   g++ -O1 -g -fsanitize=address
+    _ubsan_keydir_<mtime>.so    g++ -O1 -g -fsanitize=undefined
+    _ubsan_peerlink_<mtime>.so  g++ -O1 -g -fsanitize=undefined
+
+(TSan and ASan are mutually exclusive instrumentation, hence separate
+.so flavors; all share the mtime cache keying so a rebuild is a no-op
+until the source changes.)
+
 tests/test_native_build.py is the matching drift check: it fails when a
 cached .so predates its source or misses the exported symbol surface.
 """
@@ -34,13 +47,35 @@ PYINC = f"-I{sysconfig.get_paths()['include']}"
 WARN = ["-Wall", "-Wextra", "-Werror"]
 
 # (source, cache prefix, extra flags) for each build flavor
-BUILDS = [
-    ("keydir.cpp", "_keydir_", [*WARN, "-O2", PYINC]),
-    ("peerlink.cpp", "_peerlink_", [*WARN, "-O2"]),
+TSAN_BUILDS = [
     ("keydir.cpp", "_tsan_keydir_",
      [*WARN, "-O1", "-g", "-fsanitize=thread", "-pthread", PYINC]),
     ("peerlink.cpp", "_tsan_peerlink_",
      [*WARN, "-O1", "-g", "-fsanitize=thread", "-pthread"]),
+]
+
+BUILDS = [
+    ("keydir.cpp", "_keydir_", [*WARN, "-O2", PYINC]),
+    ("peerlink.cpp", "_peerlink_", [*WARN, "-O2"]),
+    *TSAN_BUILDS,
+]
+
+# ASan catches what TSan structurally cannot (heap overflow,
+# use-after-free on the single-threaded paths); UBSan the arithmetic /
+# alignment traps in the frame codecs. -fno-omit-frame-pointer keeps
+# ASan stacks honest at -O1.
+SANITIZE_BUILDS = [
+    *TSAN_BUILDS,
+    ("keydir.cpp", "_asan_keydir_",
+     [*WARN, "-O1", "-g", "-fsanitize=address", "-fno-omit-frame-pointer",
+      "-pthread", PYINC]),
+    ("peerlink.cpp", "_asan_peerlink_",
+     [*WARN, "-O1", "-g", "-fsanitize=address", "-fno-omit-frame-pointer",
+      "-pthread"]),
+    ("keydir.cpp", "_ubsan_keydir_",
+     [*WARN, "-O1", "-g", "-fsanitize=undefined", "-pthread", PYINC]),
+    ("peerlink.cpp", "_ubsan_peerlink_",
+     [*WARN, "-O1", "-g", "-fsanitize=undefined", "-pthread"]),
 ]
 
 
@@ -64,8 +99,10 @@ def build(src_name: str, prefix: str, flags) -> str:
     return path
 
 
-def main() -> int:
-    for src, prefix, flags in BUILDS:
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    builds = SANITIZE_BUILDS if "--sanitize" in argv else BUILDS
+    for src, prefix, flags in builds:
         build(src, prefix, flags)
     return 0
 

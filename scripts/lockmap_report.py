@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""lockmap_report: render, pin, and drift-check the lock-order graph.
+
+Three modes (docs/static-analysis.md "Reading a lockmap"):
+
+  python scripts/lockmap_report.py            # render the graph
+  python scripts/lockmap_report.py --write    # (re)write lockmap.json
+  python scripts/lockmap_report.py --check    # drift gate: `make lockmap`
+
+`--check` is the CI face: it fails when the built graph and the
+committed lockmap.json disagree in EITHER direction (a new acquisition
+edge must be committed deliberately; a vanished edge must be removed
+deliberately — same two-direction discipline as `registry-drift`), and
+when any unwaived `lock-order` / `donation-flow` finding exists. The
+runtime witness (obs/witness.py) loads the same baseline and fails
+tier-1 on any order inversion or unknown edge observed live.
+
+`runtime_edges` in lockmap.json are edges only the runtime witness can
+see (through C callbacks, thread trampolines, or calls the bounded
+static walk under-approximates); they are added by hand, each with a
+`why`, and join the committed order the witness enforces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from gubernator_tpu.analysis import core, lockmap  # noqa: E402
+
+
+def render(graph: lockmap.LockGraph, verbose: bool) -> None:
+    reg = sum(1 for c in graph.classes.values() if c.registered)
+    print(f"lock classes: {len(graph.classes)} ({reg} witness-registered, "
+          f"{len(graph.classes) - reg} auto-named)")
+    for name, c in sorted(graph.classes.items()):
+        tag = "" if c.registered else "  [auto]"
+        print(f"  {name:28s} {c.kind:10s} {c.sites[0].render()}{tag}")
+    print(f"\nacquisition-order edges: {len(graph.edges)}")
+    for (src, dst), chains in sorted(graph.edges.items()):
+        print(f"  {src} -> {dst}")
+        shown = chains if verbose else chains[:1]
+        for chain in shown:
+            print(f"      {' -> '.join(chain)}")
+    if graph.unresolved:
+        print(f"\nunresolved lock-ish scopes: {len(graph.unresolved)} "
+              "(holes in the proof — the witness is the only cover here)")
+        for path, line, expr in graph.unresolved:
+            print(f"  {path}:{line}: with {expr}")
+    cycles = graph.cycles()
+    if cycles:
+        print(f"\nCYCLES: {cycles}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        "lockmap_report",
+        description="whole-repo lock acquisition-order graph")
+    parser.add_argument("--root", default=REPO_ROOT)
+    parser.add_argument("--write", action="store_true",
+                        help="write lockmap.json (preserves runtime_edges)")
+    parser.add_argument("--check", action="store_true",
+                        help="drift-gate against committed lockmap.json and "
+                             "fail on unwaived lock-order/donation-flow "
+                             "findings (make lockmap)")
+    parser.add_argument("-v", "--verbose", action="store_true",
+                        help="print every witness chain per edge")
+    opts = parser.parse_args(argv)
+
+    repo = core.RepoIndex(opts.root)
+    graph = lockmap.build(repo)
+
+    if opts.write:
+        prior = lockmap.load_baseline(opts.root)
+        payload = lockmap.render_baseline(graph, prior)
+        with open(lockmap.baseline_path(opts.root), "w",
+                  encoding="utf-8") as f:
+            json.dump(payload, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {lockmap.baseline_path(opts.root)}: "
+              f"{len(payload['classes'])} classes, "
+              f"{len(payload['static_edges'])} static edges, "
+              f"{len(payload['runtime_edges'])} runtime edges")
+        return 0
+
+    if not opts.check:
+        render(graph, opts.verbose)
+        return 0
+
+    rc = 0
+    baseline = lockmap.load_baseline(opts.root)
+    if baseline is None:
+        print("lockmap: no committed lockmap.json — run "
+              "`python scripts/lockmap_report.py --write` and commit it")
+        rc = 1
+    else:
+        new, gone = lockmap.diff_baseline(graph, baseline)
+        for src, dst in new:
+            chain = graph.edges[(src, dst)][0]
+            print(f"lockmap: NEW edge {src} -> {dst} not in committed "
+                  f"lockmap.json (via {' -> '.join(chain)}) — review the "
+                  "ordering, then --write and commit")
+            rc = 1
+        for src, dst in gone:
+            print(f"lockmap: committed edge {src} -> {dst} no longer "
+                  "produced by the analysis — --write and commit the "
+                  "removal")
+            rc = 1
+
+    findings, suppressed = core.run(opts.root,
+                                    only=["lock-order", "donation-flow"])
+    for f in findings:
+        print(f.render())
+        rc = 1
+    if rc == 0:
+        print(f"lockmap: clean — {len(graph.classes)} classes, "
+              f"{len(graph.edges)} edges pinned, acyclic "
+              f"({len(suppressed)} waived finding(s), "
+              f"{len(graph.unresolved)} unresolved scope(s))")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

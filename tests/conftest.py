@@ -68,6 +68,27 @@ with open(_sentinel, "w") as _f:
 _jax.config.update("jax_compilation_cache_dir", _cache_dir)
 _jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+# ---------------------------------------------------------------------------
+# Runtime lock-order witness (obs/witness.py): tier-1 runs the WHOLE suite
+# with every canonical lock order-checked against the committed
+# lockmap.json graph, so an acquisition inverting the committed order
+# fails the offending test with both stacks instead of deadlocking a CI
+# run. Must be set before any gubernator_tpu module constructs a lock —
+# i.e. here, before collection imports anything. setdefault so
+# GUBER_LOCK_WITNESS=0 still lets a developer bisect with the witness
+# out of the picture. The dump dir makes subprocess daemons (which
+# inherit this env) write their observations at exit, feeding the same
+# session-end gate as the in-process witness (pytest_sessionfinish).
+
+os.environ.setdefault("GUBER_LOCK_WITNESS", "1")
+
+_witness_dump = os.path.join(os.path.dirname(__file__), ".witness")
+if not os.environ.get("GUBER_LOCK_WITNESS_DUMP"):
+    import shutil as _shutil
+
+    _shutil.rmtree(_witness_dump, ignore_errors=True)
+    os.environ["GUBER_LOCK_WITNESS_DUMP"] = _witness_dump
+
 # tests/lint_corpus/ holds miniature FAKE repos for the guberlint golden
 # tests (test_lint_corpus.py) — some deliberately mirror real test-file
 # names (test_debug_schema.py), so pytest must never collect in there
@@ -98,7 +119,69 @@ def pytest_configure(config):
         "GUBER_CHAOS_SEED (printed for reproduction)")
 
 
+def _witness_violations():
+    """Session-end lock-witness gate: collect inversions and uncommitted
+    edges from the in-process witness AND every subprocess daemon's exit
+    dump. This is the runtime half of the lockmap two-direction pin: an
+    ordering the committed graph doesn't carry must be reviewed and
+    added to lockmap.json runtime_edges (with a `why`), not silently
+    blessed."""
+    from gubernator_tpu.obs import witness as _w
+
+    if not _w.witness_enabled():
+        return []
+    snaps = []
+    if _w._WITNESS is not None:  # don't instantiate just to read nothing
+        snaps.append(("pytest", _w._WITNESS.snapshot()))
+    dump_dir = os.environ.get("GUBER_LOCK_WITNESS_DUMP", "")
+    if dump_dir and os.path.isdir(dump_dir):
+        import glob
+        import json
+
+        for path in sorted(glob.glob(
+                os.path.join(dump_dir, "witness-*.json"))):
+            if path.endswith(f"witness-{os.getpid()}.json"):
+                continue  # own atexit dump (not written yet anyway)
+            try:
+                with open(path, encoding="utf-8") as f:
+                    snaps.append((os.path.basename(path), json.load(f)))
+            except (OSError, ValueError):
+                pass  # a torn dump from a killed daemon is not a verdict
+    problems = []
+    for origin, snap in snaps:
+        for inv in snap.get("inversions", []):
+            problems.append(
+                f"[{origin}] lock-order INVERSION {inv['src']} -> "
+                f"{inv['dst']} (the committed lockmap orders the "
+                "reverse)\n"
+                f"  stack holding `{inv['src']}`:\n{inv['held_stack']}"
+                f"  stack acquiring `{inv['dst']}`:\n"
+                f"{inv['acquire_stack']}")
+        for unk in snap.get("unknown", []):
+            problems.append(
+                f"[{origin}] uncommitted acquisition edge {unk['src']} "
+                f"-> {unk['dst']} — review the ordering, then add it to "
+                "lockmap.json runtime_edges with a `why` (docs/"
+                "static-analysis.md 'Reading a lockmap')\n"
+                f"  stack holding `{unk['src']}`:\n{unk['held_stack']}"
+                f"  stack acquiring `{unk['dst']}`:\n"
+                f"{unk['acquire_stack']}")
+    return problems
+
+
 def pytest_sessionfinish(session, exitstatus):
+    problems = _witness_violations()
+    if problems:
+        print("\n" + "=" * 70)
+        print("lock-witness session gate: ORDER VIOLATIONS "
+              f"({len(problems)})")
+        print("=" * 70)
+        for p in problems:
+            print(p)
+        if int(exitstatus) == 0:
+            # green tests + a dirty witness is still a failed session
+            # (wrap_session reads session.exitstatus after this hook)
+            session.exitstatus = exitstatus = 1
     _session_exit["code"] = int(exitstatus)
     # clean finish: retire the cache sentinel ONLY if this session still
     # owns it (a concurrent run may have replaced it after wiping)

@@ -1,0 +1,16 @@
+"""DELIBERATE donated-buffer lifetime bugs: `rows` is captured from
+`backend.state` BEFORE the donate-and-rebind dispatch and read after it
+— XLA deleted that buffer at dispatch (the PR 10 cartographer race)."""
+
+
+def harvest(backend):
+    rows = backend.state
+    backend.state, resp = backend.step(backend.state, 1)
+    return rows.sum(), resp  # stale donated capture
+
+
+def harvest_waived(backend):
+    rows = backend.state
+    backend.state, resp = backend.step(backend.state, 1)
+    # guberlint: disable=donation-flow -- corpus drill: stale read kept to prove waivers suppress
+    return rows.sum(), resp

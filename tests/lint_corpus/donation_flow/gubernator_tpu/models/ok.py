@@ -1,0 +1,18 @@
+"""Clean donated-buffer lifetimes: the capture is re-read under the
+engine lock after the donate-and-rebind dispatch, and a read that
+happens entirely BEFORE the dispatch is fine."""
+
+
+def harvest_reread(backend):
+    rows = backend.state
+    backend.state, resp = backend.step(backend.state, 1)
+    with backend._lock:
+        rows = backend.state  # fresh post-rebind reference
+    return rows.sum(), resp
+
+
+def read_before_dispatch(backend):
+    rows = backend.state
+    total = rows.sum()  # read precedes the donation — valid buffer
+    backend.state, resp = backend.step(backend.state, 1)
+    return total, resp

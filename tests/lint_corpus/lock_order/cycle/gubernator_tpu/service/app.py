@@ -1,0 +1,20 @@
+"""DELIBERATE lock-order cycle: alpha -> beta in forward(), beta ->
+alpha in backward() — two threads running these concurrently deadlock."""
+
+from gubernator_tpu.obs import witness
+
+
+class Pair:
+    def __init__(self):
+        self._alock = witness.make_lock("alpha")
+        self._block = witness.make_lock("beta")
+
+    def forward(self):
+        with self._alock:
+            with self._block:
+                return 1
+
+    def backward(self):
+        with self._block:
+            with self._alock:
+                return 2

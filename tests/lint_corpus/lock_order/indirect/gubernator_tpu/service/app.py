@@ -1,0 +1,28 @@
+"""DELIBERATE call-graph-indirect lock-order cycle: neither function
+nests two `with` statements lexically — forward() holds alpha and CALLS
+a method that takes beta; backward() holds beta and calls one that takes
+alpha. Only the interprocedural walk sees the cycle."""
+
+from gubernator_tpu.obs import witness
+
+
+class Indirect:
+    def __init__(self):
+        self._alock = witness.make_lock("alpha")
+        self._block = witness.make_lock("beta")
+
+    def take_alpha(self):
+        with self._alock:
+            return 1
+
+    def take_beta(self):
+        with self._block:
+            return 2
+
+    def forward(self):
+        with self._alock:
+            return self.take_beta()
+
+    def backward(self):
+        with self._block:
+            return self.take_alpha()

@@ -1,0 +1,20 @@
+"""Same deliberate cycle as lock_order/cycle, waived at the finding's
+anchor (the first witness site of the cycle's smallest edge)."""
+
+from gubernator_tpu.obs import witness
+
+
+class Pair:
+    def __init__(self):
+        self._alock = witness.make_lock("alpha")
+        self._block = witness.make_lock("beta")
+
+    def forward(self):
+        with self._alock:  # guberlint: disable=lock-order -- corpus drill: deliberate cycle proving waivers suppress
+            with self._block:
+                return 1
+
+    def backward(self):
+        with self._block:
+            with self._alock:
+                return 2

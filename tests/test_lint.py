@@ -24,6 +24,8 @@ EXPECTED_RULES = {
     "escape-hatch",
     "registry-drift",
     "native-warnings",
+    "lock-order",
+    "donation-flow",
 }
 
 

@@ -1,0 +1,82 @@
+"""Tier-1 gate: the committed lockmap.json matches the built graph.
+
+The two-direction pin (same discipline as `registry-drift`): an
+acquisition edge the analysis produces but the baseline doesn't carry
+fails — a new lock ordering must be committed deliberately via
+`python scripts/lockmap_report.py --write`; an edge the baseline pins
+but the analysis no longer produces also fails — stale order facts
+would let the runtime witness bless orderings nobody holds anymore.
+The runtime witness loads the SAME file (witness._committed_order), so
+this test is what keeps layers 1 and 2 speaking one graph.
+"""
+
+import os
+
+from gubernator_tpu.analysis import cli, core, lockmap
+from gubernator_tpu.obs import witness
+
+REPO_ROOT = cli.REPO_ROOT
+
+
+def _graph():
+    return lockmap.build(core.RepoIndex(REPO_ROOT))
+
+
+def test_baseline_committed():
+    assert os.path.exists(lockmap.baseline_path(REPO_ROOT)), (
+        "lockmap.json missing — python scripts/lockmap_report.py --write")
+
+
+def test_no_drift_in_either_direction():
+    graph = _graph()
+    baseline = lockmap.load_baseline(REPO_ROOT)
+    assert baseline is not None
+    new, gone = lockmap.diff_baseline(graph, baseline)
+    assert not new, (
+        "acquisition-order edges not in committed lockmap.json "
+        "(review the ordering, then scripts/lockmap_report.py --write): "
+        f"{new}")
+    assert not gone, (
+        "committed edges the analysis no longer produces (remove them "
+        f"via scripts/lockmap_report.py --write): {gone}")
+
+
+def test_graph_is_acyclic_on_head():
+    assert _graph().cycles() == []
+
+
+def test_no_unresolved_lock_scopes_on_head():
+    # an unresolved `with <lock-ish>` is a hole in the static proof;
+    # HEAD stays hole-free so new ones are a deliberate decision
+    graph = _graph()
+    assert graph.unresolved == [], graph.unresolved
+
+
+def test_every_load_bearing_class_is_witness_registered():
+    # auto-named raw locks are tolerated only for short-lived CLI/script
+    # helpers; everything under the serving tree goes through the
+    # witness factories so both layers share the identity model
+    graph = _graph()
+    unregistered_serving = [
+        (name, c.sites[0].render())
+        for name, c in graph.classes.items()
+        if not c.registered and not c.sites[0].path.startswith(
+            ("scripts/", "gubernator_tpu/cmd/"))
+    ]
+    assert not unregistered_serving, unregistered_serving
+
+
+def test_witness_loads_the_pinned_union():
+    baseline = lockmap.load_baseline(REPO_ROOT)
+    pinned = {tuple(e) for e in baseline["static_edges"]}
+    pinned |= {(e["src"], e["dst"])
+               for e in baseline.get("runtime_edges", [])}
+    assert witness._committed_order() == pinned
+
+
+def test_baseline_runtime_edges_carry_why():
+    baseline = lockmap.load_baseline(REPO_ROOT)
+    for e in baseline.get("runtime_edges", []):
+        assert e.get("why", "").strip(), (
+            "runtime_edges entries are hand-maintained and each needs a "
+            f"reviewable `why`: {e}")

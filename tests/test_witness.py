@@ -1,0 +1,194 @@
+"""Runtime lock-order witness (obs/witness.py) — lockdep layer tests.
+
+Covers the witness's four contracts: an order inversion against the
+committed lockmap raises BEFORE the thread blocks, with BOTH acquisition
+stacks attached; re-entrant RLock acquisition and Condition.wait keep
+the per-thread held-set honest; edges outside the committed set are
+collected (the session-end gate in conftest reports them); and the
+GUBER_LOCK_WITNESS=0 production path is bit-identical — the factories
+hand out the bare `threading` primitives and a differential run of the
+real Engine proves decision-for-decision equality witness-on vs
+witness-off.
+"""
+
+import threading
+
+import pytest
+
+from gubernator_tpu.models.engine import Engine
+from gubernator_tpu.obs import witness
+from gubernator_tpu.types import RateLimitReq, Status
+
+NOW = 2_000_000_000_000
+
+
+def _wlock(name, w):
+    return witness._WitnessLock(name, threading.Lock(), w)
+
+
+def _wrlock(name, w):
+    return witness._WitnessRLock(name, threading.RLock(), w)
+
+
+# ------------------------------------------------------------ inversion
+
+
+class TestInversion:
+    def test_inversion_raises_before_blocking_with_both_stacks(self):
+        w = witness.Witness(order={("alpha", "beta")})
+        alpha, beta = _wlock("alpha", w), _wlock("beta", w)
+
+        def committed_direction():
+            with alpha:
+                with beta:
+                    pass
+
+        committed_direction()
+        assert ("alpha", "beta") in w.observed
+
+        def inverted_direction():
+            with beta:
+                with alpha:  # contradicts committed alpha -> beta
+                    pass
+
+        with pytest.raises(witness.WitnessInversion) as exc:
+            inverted_direction()
+        err = exc.value
+        # both stacks attach, naming the functions that took each lock
+        assert "inverted_direction" in err.held_stack
+        assert "inverted_direction" in err.acquire_stack
+        assert "alpha" in str(err) and "beta" in str(err)
+        assert w.inversions and w.inversions[0]["src"] == "beta"
+        # the raise happened BEFORE acquiring alpha: no held residue
+        # beyond beta, and beta itself was released by the with-exit
+        assert w._held() == []
+
+    def test_inversion_fires_even_when_lock_is_free(self):
+        # lockdep semantics: the ORDER is the bug, not an actual
+        # collision — single-threaded inverted nesting still fails
+        w = witness.Witness(order={("a", "b")})
+        a, b = _wlock("a", w), _wlock("b", w)
+        with pytest.raises(witness.WitnessInversion):
+            with b:
+                a.acquire()
+
+
+# ----------------------------------------------------- held bookkeeping
+
+
+class TestHeldSet:
+    def test_unknown_edge_collected_not_raised(self):
+        w = witness.Witness(order=set())
+        a, b = _wlock("x", w), _wlock("y", w)
+        with a:
+            with b:
+                pass
+        assert ("x", "y") in w.unknown
+        snap = w.snapshot()
+        assert snap["unknown"][0]["src"] == "x"
+        assert snap["unknown"][0]["dst"] == "y"
+        assert "held_stack" in snap["unknown"][0]
+
+    def test_reentrant_rlock_adds_no_edges(self):
+        w = witness.Witness(order=set())
+        r = _wrlock("r", w)
+        with r:
+            with r:  # same instance: count bump, no self-edge
+                pass
+        assert not w.unknown and not w.inversions
+        assert w._held() == []
+
+    def test_same_class_two_instances_is_a_self_edge(self):
+        # two PeerClient._lock instances nested = ("peer", "peer") edge:
+        # can't invert, but must be committed like any edge
+        w = witness.Witness(order=set())
+        p1, p2 = _wlock("peer", w), _wlock("peer", w)
+        with p1:
+            with p2:
+                pass
+        assert ("peer", "peer") in w.unknown
+
+    def test_condition_wait_releases_held_entry(self):
+        w = witness.Witness(order=set())
+        cond = threading.Condition(_wrlock("c", w))
+        woke = threading.Event()
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=5)
+                woke.set()
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        # hand the notifier a clean held-set: if wait() failed to
+        # release_all, the notifier's acquire would see a stale entry
+        for _ in range(500):
+            with cond:
+                waiting = bool(getattr(cond, "_waiters", None))
+            if waiting:
+                break
+            t.join(0.01)
+        with cond:
+            cond.notify_all()
+        t.join(5)
+        assert woke.is_set()
+        assert not w.unknown and not w.inversions
+        assert w._held() == []
+
+    def test_release_out_of_order_is_tolerated(self):
+        # hand-over-hand unlocking (acquire a, acquire b, release a,
+        # release b) must keep the held list consistent
+        w = witness.Witness(order={("a", "b")})
+        a, b = _wlock("a", w), _wlock("b", w)
+        a.acquire()
+        b.acquire()
+        a.release()
+        assert [e.name for e in w._held()] == ["b"]
+        b.release()
+        assert w._held() == []
+
+
+# --------------------------------------------- off-path: bit-identical
+
+
+class TestOffPathDifferential:
+    def test_factories_hand_out_bare_primitives_when_off(self, monkeypatch):
+        monkeypatch.delenv("GUBER_LOCK_WITNESS", raising=False)
+        assert not witness.witness_enabled()
+        # bit-identical off path: the PRODUCTION types, not wrappers
+        assert type(witness.make_lock("x")) is type(threading.Lock())
+        assert type(witness.make_rlock("x")) is type(threading.RLock())
+        cond = witness.make_condition("x")
+        assert type(cond) is threading.Condition
+        # Condition() default is an RLock — semantics preserved exactly
+        assert type(cond._lock) is type(threading.RLock())
+
+    def test_engine_decisions_bit_identical_witness_on_vs_off(
+            self, monkeypatch):
+        """Differential test for the GUBER_LOCK_WITNESS escape hatch
+        (hatch table: analysis/rules/hatches.py): the same request
+        stream through a witness-on engine and a witness-off engine
+        must produce byte-identical decisions."""
+        reqs = [RateLimitReq(name="wd", unique_key=f"k{i % 7}", hits=1,
+                             limit=2, duration=60_000)
+                for i in range(24)]
+
+        def run(enabled):
+            if enabled:
+                monkeypatch.setenv("GUBER_LOCK_WITNESS", "1")
+            else:
+                monkeypatch.delenv("GUBER_LOCK_WITNESS", raising=False)
+            eng = Engine(capacity=128, min_width=8, max_width=32)
+            assert (type(eng._lock) is not type(threading.Lock())) \
+                == enabled
+            out = []
+            for i in range(0, len(reqs), 8):
+                for r in eng.get_rate_limits(reqs[i:i + 8],
+                                             now_ms=NOW + i):
+                    out.append((r.status, r.limit, r.remaining,
+                                r.reset_time, r.error))
+            return out
+
+        on, off = run(True), run(False)
+        assert on == off
+        assert any(s == Status.OVER_LIMIT for s, *_ in on)
