@@ -1,7 +1,7 @@
 # Parity with the reference's Makefile (Makefile:1-18): `test` runs the
 # whole suite with concurrency hygiene, plus this repo's bench/proto targets.
 
-.PHONY: test test-fast lint lockmap sanitize bench bench-skew bench-wire bench-reshard bench-suite bench-check scenarios capacity-report profile-report soak chaos proto docker clean native
+.PHONY: test test-fast lint lockmap sanitize bench bench-skew bench-wire bench-reshard bench-suite bench-check scenarios capacity-report profile-report ledger-report soak chaos proto docker clean native
 
 # the suite runs on a virtual 8-device CPU mesh (tests/conftest.py)
 test:
@@ -74,6 +74,12 @@ capacity-report:
 # (docs/OPERATIONS.md "Performance triage"); ADDR defaults to 127.0.0.1:80
 profile-report:
 	python scripts/profile_report.py $(ADDR)
+
+# decision-ledger conservation digest: admits-by-authority, minted lease
+# budget, over-admission distribution and the device ground-truth check
+# (docs/OPERATIONS.md "Over-admission triage"); ADDR defaults to 127.0.0.1:80
+ledger-report:
+	python scripts/ledger_report.py $(ADDR)
 
 # 30s fault-injection soak: kill/restart chaos under load, invariant-judged
 soak:

@@ -1486,6 +1486,128 @@ def _reshard_bench(n_resident: int = 1_000_000,
         c.stop()
 
 
+def _ledger_bench(n_calls: int = 1500, batch: int = 64, reps: int = 3) -> dict:
+    """Decision-ledger overhead on the serving path: the SAME single-node
+    Instance serving identical batch streams with the ledger attributing
+    every window vs the GUBER_LEDGER=0 hatch (which turns every engine
+    hook into one attribute test — every hook site reads `led.enabled`
+    live, so the flag flips on a running instance the way the profiler
+    hatch does). The flag alternates every CHUNK calls within one pass,
+    same drift-regime rationale as _obs_bench; acceptance is
+    overhead <= 2%.
+
+    The hot-path cost under test is the pending-ring parking: one numpy
+    column copy + ring append per engine window group (the audit itself
+    rides the harvest cadence, off the serving path). The per-audit
+    drain/fold/roll cost is timed directly and duty-cycled at the 60 s
+    harvest cadence (amortized_overhead_pct, informational)."""
+    from gubernator_tpu.models.engine import Engine
+    from gubernator_tpu.service.config import InstanceConfig
+    from gubernator_tpu.service.instance import Instance
+    from gubernator_tpu.types import PeerInfo, RateLimitReq
+
+    AUDIT_PROD_S = 60.0
+    inst = Instance(InstanceConfig(backend=Engine(capacity=262_144),
+                                   ledger_enabled=True),
+                    advertise_address="127.0.0.1:1")
+    inst.set_peers([PeerInfo(address="127.0.0.1:1")])  # self-owned: no RPC
+    led = inst.ledger
+    frames = [
+        [RateLimitReq(name="ledbench", unique_key=f"k{(i * batch + j) % 4096}",
+                      hits=1, limit=1 << 30, duration=3_600_000)
+         for j in range(batch)]
+        for i in range(n_calls)
+    ]
+    try:
+        for f in frames[:100]:  # compile + warm the width bucket
+            inst.get_rate_limits(f)
+
+        import gc
+        import statistics
+
+        CHUNK = 25
+        elapsed = {True: 0.0, False: 0.0}
+        calls = {True: 0, False: 0}
+        pair_overheads = []  # median over ABBA chunk quads
+        gc_was_enabled = gc.isenabled()
+        gc.disable()
+        try:
+            for rep in range(reps):
+                i = 0
+                while i + 4 * CHUNK <= n_calls:
+                    # ABBA within one quad: the second chunk of a pair
+                    # always rides warmer state than the first, so a
+                    # plain AB pairing measures the order effect (~1% on
+                    # this rig — larger than the cost under test). The
+                    # mirrored half cancels it and linear drift exactly.
+                    rate = {True: [], False: []}
+                    for enabled in (True, False, False, True):
+                        led.enabled = enabled
+                        chunk = frames[i:i + CHUNK]
+                        i += CHUNK
+                        t0 = time.perf_counter()
+                        for f in chunk:
+                            inst.get_rate_limits(f)
+                        dt = time.perf_counter() - t0
+                        elapsed[enabled] += dt
+                        calls[enabled] += CHUNK
+                        rate[enabled].append(CHUNK * batch / dt)
+                    r_on = sum(rate[True]) / 2
+                    r_off = sum(rate[False]) / 2
+                    pair_overheads.append((r_off - r_on) / r_off)
+                # drain the parked windows between reps so the pending
+                # ring never saturates mid-measurement (the audit is
+                # off-path; running it inside the quad loop perturbs the
+                # cache right before a timed chunk)
+                led.enabled = True
+                led.audit(inst.backend, force=True)
+        finally:
+            if gc_was_enabled:
+                gc.enable()
+        led.enabled = True
+        on = calls[True] * batch / elapsed[True]
+        off = calls[False] * batch / elapsed[False]
+        overhead_pct = statistics.median(pair_overheads) * 100.0
+
+        # per-audit cost, timed directly and duty-cycled at the 60 s
+        # harvest cadence (informational — the audit is off-path)
+        audit_costs = []
+        for _ in range(20):
+            for f in frames[:10]:  # park fresh windows to drain
+                inst.get_rate_limits(f)
+            t0 = time.perf_counter()
+            led.audit(inst.backend, force=True)
+            audit_costs.append(time.perf_counter() - t0)
+        audit_ms = statistics.median(audit_costs) * 1e3
+        amortized_pct = 100.0 * audit_ms * 1e-3 / AUDIT_PROD_S
+
+        lt = led.totals()
+        return {
+            "ledger": {
+                "ledger_on_decisions_per_sec": round(on, 1),
+                "ledger_off_decisions_per_sec": round(off, 1),
+                # positive = the armed ledger costs throughput; median
+                # over on/off chunk pairs, hiccup-robust. budget <= 2%
+                "overhead_pct": round(overhead_pct, 2),
+                # per-audit drain/fold cost duty-cycled at the 60 s
+                # harvest cadence — off the serving path
+                "amortized_audit_overhead_pct": round(amortized_pct, 4),
+                "audit_ms": round(audit_ms, 3),
+                "attempted_hits": lt["attempted"],
+                "windows_rolled": lt["windows_rolled"],
+                "violations": lt["violations"],
+                "keys_tracked": lt["keys_tracked"],
+                "pending_dropped": lt["pending_dropped"],
+                "chunk_quads": len(pair_overheads),
+                "reps": reps,
+                "batch": batch,
+                "calls_per_rep": n_calls,
+            }
+        }
+    finally:
+        inst.close()
+
+
 def _witness_bench(n_calls: int = 1200, batch: int = 64, reps: int = 3) -> dict:
     """Lock-witness overhead on the serving path: two otherwise identical
     single-node Instances, one constructed under GUBER_LOCK_WITNESS=1
@@ -2141,6 +2263,16 @@ def main() -> None:
     except Exception as e:  # noqa: BLE001 — report, don't die
         profile_row = {"profiler": {"error": str(e)}}
 
+    # ---- decision ledger: attribution hooks on vs GUBER_LEDGER=0 ----------
+    # Single-node serving with the ledger parking attribution columns vs
+    # the escape hatch on the same Instance; BENCH_r17 records the
+    # overhead (acceptance <= 2%) plus the off-path audit cost
+    # duty-cycled at the 60 s harvest cadence.
+    try:
+        ledger_row = _ledger_bench()
+    except Exception as e:  # noqa: BLE001 — report, don't die
+        ledger_row = {"ledger": {"error": str(e)}}
+
     # ---- lockmap runtime witness: armed vs production-default locks -------
     # Two identical single-node Instances (the witness wraps locks at
     # construction, so the hatch can't flip live); BENCH_r16 records the
@@ -2176,6 +2308,7 @@ def main() -> None:
                 **capture_row,
                 **scenarios_row,
                 **profile_row,
+                **ledger_row,
                 **witness_row,
                 **_multichip_section(),
                 "phase_breakdown_ms": phases,

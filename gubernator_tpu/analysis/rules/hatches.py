@@ -29,6 +29,7 @@ HATCHES: Sequence[Tuple[str, Tuple[str, ...]]] = (
     ("GUBER_DEVICE_DIRECTORY", ("device_directory", "DevDirEngine")),
     ("GUBER_PROFILE", ("profile_enabled",)),
     ("GUBER_LOCK_WITNESS", ("lock_witness", "witness_enabled")),
+    ("GUBER_LEDGER", ("ledger_enabled",)),
 )
 
 DIFF_RE = re.compile(

@@ -343,6 +343,7 @@ def main(argv=None) -> int:
             capacity_horizon_s=conf.capacity_horizon_s,
             profile_enabled=conf.profile_enabled,
             profile_capture_s=conf.profile_capture_s,
+            ledger_enabled=conf.ledger_enabled,
             pipeline_depth=conf.pipeline_depth or None,  # 0 -> env/auto
             pipeline_scan=conf.pipeline_scan,
         ),
@@ -398,6 +399,11 @@ def main(argv=None) -> int:
                  "(/v1/debug/profile)", conf.profile_capture_s)
     else:
         log.info("serving-cycle profiler OFF (GUBER_PROFILE=0)")
+    if conf.ledger_enabled:
+        log.info("decision ledger on: conservation audit rides harvest "
+                 "cadence (/v1/debug/ledger)")
+    else:
+        log.info("decision ledger OFF (GUBER_LEDGER=0)")
     if witness.witness_enabled():
         log.warning("lock-order witness ARMED (GUBER_LOCK_WITNESS=1) — "
                     "test-rig instrument; every lock carries order "

@@ -222,6 +222,12 @@ class DaemonConfig:
     # rate-limits on-demand deep captures (/v1/debug/profile?capture=1)
     profile_enabled: bool = True
     profile_capture_s: float = 60.0
+    # decision ledger & budget-conservation audit plane (obs/ledger.py):
+    # per-authority admit attribution on the hot path plus the
+    # off-serving-path conservation auditor (=0 is the escape hatch —
+    # every record site degrades to one attribute test and decisions
+    # are bit-identical to the ledger removed)
+    ledger_enabled: bool = True
     # GLOBAL-sync collective implementation for the sharded backend:
     # "psum" (XLA, default) or "ring" (Pallas ICI ring — TPU-compiled only,
     # single-region meshes; see ops/ring.py)
@@ -381,6 +387,8 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         profile_enabled=_env_str("GUBER_PROFILE", "1") not in
         ("0", "f", "false", "no", "off"),
         profile_capture_s=_env_dur("GUBER_PROFILE_CAPTURE_S", 60.0),
+        ledger_enabled=_env_str("GUBER_LEDGER", "1") not in
+        ("0", "f", "false", "no", "off"),
         # GUBER_LOCK_WITNESS (default off) arms the runtime lock-order
         # witness (obs/witness.py) — it is resolved there at
         # lock-construction time, before any config object can exist,

@@ -225,6 +225,10 @@ class Engine:
         # /v1/debug/profile. Always constructed; GUBER_PROFILE=0 turns
         # every observation site into a single attribute test
         self.profiler = Profiler()
+        # decision ledger (obs/ledger.py): per-window attribution columns
+        # for the conservation auditor; attached by the Instance, None
+        # (or a disabled ledger) keeps every window hook a no-op
+        self.ledger = None
         self._lock = witness.make_lock("engine")
         if donate is None:
             from gubernator_tpu.utils.platform import donation_supported
@@ -574,6 +578,9 @@ class Engine:
                 demux_ns = time.perf_counter_ns() - t2
                 stage["demux"] += demux_ns
                 prof.observe("demux", demux_ns)
+                led = self.ledger
+                if led is not None and led.enabled:
+                    led.note_slots(packed, out, n0)
         if len(leftover):
             idxs = leftover.tolist()
             tail = self._slow_window(
@@ -648,6 +655,10 @@ class Engine:
         tails: List[Optional[list]] = [None] * k_req
         segments = []  # (staged, k_start, m, scanned) in launch order
         prof = self.profiler
+        led = self.ledger
+        if led is not None and not led.enabled:
+            led = None
+        stashes: List[Optional[tuple]] = [None] * k_req
         k = 0
         while k < k_req:
             seg_start = k
@@ -715,6 +726,12 @@ class Engine:
                 td = time.perf_counter_ns()
                 self.stats.stage_ns["device"] += td - t1
                 prof.observe("dispatch", td - t1)
+                if led is not None:
+                    # the staging buffer is reused across launches; the
+                    # collect side pairs these copies with the readback
+                    for kk in range(seg_start, k):
+                        stashes[kk] = led.stash_columns(
+                            buf[kk], meta[kk][0])
             segments.append((staged, seg_start, m, scanned))
             # Leftover tails retire NOW — after this segment's dispatch,
             # before any later window preps — preserving per-key
@@ -727,14 +744,17 @@ class Engine:
                     tails[kk] = self._slow_window(
                         [windows[kk][i] for i in idxs], now_ms,
                         count_batch=False)
-        return (segments, windows, meta, tails)
+        return (segments, windows, meta, tails, stashes)
 
     def collect_windows(self, handle):
         """Block on a launched group's readbacks (in dispatch order) and
         demux: returns one response list per window, in launch order. Runs
         outside the engine lock — dispatch order is already fixed — so
         later launches proceed while this readback drains."""
-        segments, windows, meta, tails = handle
+        segments, windows, meta, tails, stashes = handle
+        led = self.ledger
+        if led is not None and not led.enabled:
+            led = None
         results: List[Optional[list]] = [None] * len(windows)
         over = 0
         lanes = 0
@@ -767,6 +787,8 @@ class Engine:
                             responses[i] = RateLimitResp(
                                 status[j], limit[j], remaining[j], reset[j])
                     lanes += n0
+                    if led is not None:
+                        led.note_slots_deferred(stashes[k], rows, n0)
                 tail = tails[k]
                 if tail is not None:
                     for i, resp in zip(leftover.tolist(), tail):
@@ -878,13 +900,17 @@ class Engine:
             self.stats.batches += 1
             self._apply_inject_rows(inject)
             handle = None
+            stash = None
             if n0:
                 self.stats.rounds += 1
                 handle = self._dispatch_staged(packed, now_ms)
                 td = time.perf_counter_ns()
                 self.stats.stage_ns["device"] += td - t1
                 prof.observe("dispatch", td - t1)
-        return (handle, lane_item, leftover, n0)
+                led = self.ledger
+                if led is not None and led.enabled:
+                    stash = led.stash_columns(packed, n0)
+        return (handle, lane_item, leftover, n0, stash)
 
     def complete_columnar(self, handle, out_status, out_limit,
                           out_remaining, out_reset) -> np.ndarray:
@@ -892,11 +918,14 @@ class Engine:
         into the caller's columns at the packed items' positions (runs
         outside the engine lock — dispatch order is already fixed).
         Returns the leftover item indices."""
-        staged, lane_item, leftover, n0 = handle
+        staged, lane_item, leftover, n0, stash = handle
         if n0:
             t0 = time.perf_counter_ns()
             rows = self._fetch_staged(staged)  # device sync for THIS window
             t1 = time.perf_counter_ns()
+            led = self.ledger
+            if led is not None and led.enabled:
+                led.note_slots_deferred(stash, rows, n0)
             out_status[lane_item] = rows[0, :n0]
             out_limit[lane_item] = rows[1, :n0]
             out_remaining[lane_item] = rows[2, :n0]
@@ -972,6 +1001,10 @@ class Engine:
         metas: List[tuple] = []
         failed = None
         prof = self.profiler
+        led = self.ledger
+        if led is not None and not led.enabled:
+            led = None
+        stashes: List[Optional[tuple]] = []
         tq = time.perf_counter_ns() if prof.enabled else 0
         with self._lock:
             t0 = time.perf_counter_ns()  # excludes the lock wait
@@ -1036,7 +1069,10 @@ class Engine:
                 td = time.perf_counter_ns()
                 self.stats.stage_ns["device"] += td - t1
                 prof.observe("dispatch", td - t1)
-        return (metas, failed, staged, scanned)
+                if led is not None:
+                    stashes = [led.stash_columns(buf[kk], metas[kk][0])
+                               for kk in range(m)]
+        return (metas, failed, staged, scanned, stashes)
 
     def collect_columnar_windows(self, handle, outs):
         """Block on a launched columnar group's readback (runs outside the
@@ -1046,7 +1082,10 @@ class Engine:
         CONSUMED window, each sized to that window's item count. Returns
         the per-window leftover index arrays — at most the LAST consumed
         window's is non-empty (the group-cut barrier)."""
-        metas, _failed, staged, scanned = handle
+        metas, _failed, staged, scanned, stashes = handle
+        led = self.ledger
+        if led is not None and not led.enabled:
+            led = None
         t0 = time.perf_counter_ns()
         rows_all = self._fetch_staged(staged) if staged is not None else None
         t1 = time.perf_counter_ns()
@@ -1064,6 +1103,8 @@ class Engine:
                 rs[lane_item] = rows[3, :n0]
                 over += int(np.count_nonzero(rows[0, :n0] == 1))
                 lanes += n0
+                if led is not None and k < len(stashes):
+                    led.note_slots_deferred(stashes[k], rows, n0)
             leftovers.append(leftover)
         t2 = time.perf_counter_ns()
         if lanes:
@@ -1129,6 +1170,12 @@ class Engine:
             # native decides bypass the staging dispatchers, so they feed
             # the detector by key instead of by slot row
             self.hot_tracker.feed_key(req.hash_key(), req.hits)
+        led = self.ledger
+        if led is not None and led.enabled:
+            # native decides bypass the staging buffers too: attribute by
+            # key directly (a lone request already pays a python wrapper)
+            led.record_key(req.hash_key(), req.hits, int(out[0]),
+                           int(out[1]), int(out[3]))
         return RateLimitResp(status=int(out[0]), limit=out[1],
                              remaining=out[2], reset_time=out[3])
 
@@ -1600,6 +1647,7 @@ class Engine:
             self._obs_device(t2 - t, sum(len(w) for w in group))
             prof.observe("dispatch", td - t)
             prof.observe("readback", t2 - td)
+            led = self.ledger
             for gi, wk in enumerate(group):
                 n = len(wk)
                 status, limit, remaining, reset = out[gi, :, :n].tolist()
@@ -1610,6 +1658,8 @@ class Engine:
                     responses[i] = RateLimitResp(
                         status=st, limit=limit[j],
                         remaining=remaining[j], reset_time=reset[j])
+                if led is not None and led.enabled:
+                    led.note_slots(stacked[gi], out[gi], n)
             demux_ns = time.perf_counter_ns() - t2
             stage["demux"] += demux_ns
             prof.observe("demux", demux_ns)
@@ -1676,6 +1726,9 @@ class Engine:
         demux_ns = time.perf_counter_ns() - t3
         stage["demux"] += demux_ns
         prof.observe("demux", demux_ns)
+        led = self.ledger
+        if led is not None and led.enabled:
+            led.note_slots(packed, out, n)
 
         if use_store:
             t = time.perf_counter_ns()

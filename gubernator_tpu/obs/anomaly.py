@@ -36,6 +36,14 @@ Detectors:
                          trust the shares — a recompile, lock convoy, or
                          host-side regression changed WHERE time goes
                          even if total latency still looks fine
+- ``over_admission``     the decision ledger's conservation audit
+                         (obs/ledger.py) found a key-window whose summed
+                         admits exceeded limit + installed lease budget +
+                         declared authority slack — budget was minted,
+                         the one thing every delegation tier promises
+                         never happens. The sweep drives the audit
+                         itself (maybe_audit, off the serving path), so
+                         detection needs no extra ticker
 
 Burn/rate windows are served from the node's metrics history ring
 (obs/history.py): the engine holds only the previous sweep's snapshot
@@ -62,7 +70,7 @@ log = logging.getLogger("gubernator_tpu.anomaly")
 
 DETECTORS = ("deadline_burst", "shed_spike", "circuit_open",
              "stall_regression", "lease_fail_close", "slo_burn",
-             "capacity", "profile_shift")
+             "capacity", "profile_shift", "over_admission")
 
 
 class AnomalyEngine:
@@ -126,6 +134,9 @@ class AnomalyEngine:
         self.burn_slow = 0.0
         self._last_check = 0.0
         self.checks = 0
+        # conservation-audit edge state: violations counted at the last
+        # sweep, so a sweep flags only NEW over-admission findings
+        self._prev_violations = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
@@ -235,6 +246,10 @@ class AnomalyEngine:
         if shift_detail:
             found["profile_shift"] = True
             detail["profile_shift"] = shift_detail
+        over_detail = self._over_admission_signal()
+        if over_detail:
+            found["over_admission"] = True
+            detail["over_admission"] = over_detail
 
         self._apply(found, detail)
         return found
@@ -312,6 +327,28 @@ class AnomalyEngine:
             return ""
         return (f"{worst_p} share {base[worst_p]:.0%} -> "
                 f"{recent[worst_p]:.0%} over fast window")
+
+    def _over_admission_signal(self) -> str:
+        """Conservation-audit check: "" when quiet, else the firing
+        detail. The sweep itself drives the ledger's off-path audit
+        (rate-limited inside maybe_audit), then flags NEW violations
+        since the previous sweep — edge semantics, so the rising edge
+        emits one event and captures one bundle per finding burst."""
+        led = getattr(self.instance, "ledger", None)
+        if led is None or not getattr(led, "enabled", False):
+            return ""
+        try:
+            led.maybe_audit(getattr(self.instance, "backend", None))
+            totals = led.totals()
+        except Exception:  # noqa: BLE001 — auditing must not break detection
+            log.exception("ledger audit failed")
+            return ""
+        v = int(totals.get("violations", 0))
+        prev, self._prev_violations = self._prev_violations, v
+        if v <= prev:
+            return ""
+        return (f"{v - prev} conservation violation(s), max overshoot "
+                f"{int(totals.get('max_overshoot', 0))} hits")
 
     def _apply(self, found: Dict[str, bool], detail: Dict[str, str]) -> None:
         for name in DETECTORS:

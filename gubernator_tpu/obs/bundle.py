@@ -110,6 +110,16 @@ def node_report(instance, max_events: int = 512) -> dict:
             report["profile"] = prof.endpoint_body()
         except Exception:  # noqa: BLE001 — profiling must not break
             pass           # the report
+    led = getattr(instance, "ledger", None)
+    if led is not None and getattr(led, "enabled", False):
+        try:
+            # full endpoint body: per-authority totals, the over-admission
+            # distribution, and the recent-violation ring — with the
+            # flight-recorder tail above, the causal spine of an
+            # over_admission anomaly rides in one artifact
+            report["ledger"] = led.endpoint_body()
+        except Exception:  # noqa: BLE001 — the audit must not break
+            pass           # the report
     tracer = getattr(instance, "tracer", None)
     if tracer is not None:
         report["traces"] = tracer.traces()
@@ -324,6 +334,40 @@ def cluster_view(instance, timeout_s: float = 5.0,
                             key=lambda e: e.get("xfer", "")),
     }
 
+    # conservation roll-up: the cluster-wide budget ledger — per-node
+    # violation/overshoot totals plus a fleet admit-by-authority sum.
+    # A violation anywhere is a cluster-level "minted budget" sighting,
+    # so the roll leads with the total and the guilty nodes.
+    ledger_nodes: Dict[str, dict] = {}
+    fleet_admits: Dict[str, int] = {}
+    fleet_violations = 0
+    fleet_overshoot = 0
+    for addr, rep in nodes.items():
+        lg = rep.get("ledger") or {}
+        t = lg.get("totals") or {}
+        if not lg.get("enabled"):
+            continue
+        ledger_nodes[addr] = {
+            "violations": int(t.get("violations", 0)),
+            "overshoot_hits": int(t.get("overshoot_hits", 0)),
+            "max_overshoot": int(t.get("max_overshoot", 0)),
+            "minted_budget": int(t.get("minted_budget", 0)),
+            "windows_rolled": int(t.get("windows_rolled", 0)),
+        }
+        fleet_violations += ledger_nodes[addr]["violations"]
+        fleet_overshoot += ledger_nodes[addr]["overshoot_hits"]
+        for a, n in (t.get("admits") or {}).items():
+            fleet_admits[a] = fleet_admits.get(a, 0) + int(n)
+    ledger_roll = {
+        "enabled_nodes": sorted(ledger_nodes),
+        "violations": fleet_violations,
+        "overshoot_hits": fleet_overshoot,
+        "admits_by_authority": fleet_admits,
+        "nodes": ledger_nodes,
+        "violating_nodes": sorted(
+            a for a, e in ledger_nodes.items() if e["violations"]),
+    }
+
     # profiling roll-up: every node's serial-phase shares side by side —
     # a node whose decomposition diverges from the fleet's is the one to
     # pull a /v1/debug/profile?capture=1 trace from
@@ -368,6 +412,7 @@ def cluster_view(instance, timeout_s: float = 5.0,
         "keyspace": keyspace_roll,
         "capacity": capacity_roll,
         "reshard": reshard_roll,
+        "ledger": ledger_roll,
         "profile": profile_roll,
         "stitched_traces": stitched,
         "cross_node_traces": sorted(cross_node),

@@ -40,7 +40,11 @@ log = logging.getLogger("gubernator_tpu.history")
 # (profile_<phase>_s per serving-cycle phase, profile_lock_wait_s,
 # profile_cycles) — consumers diff them between samples like every
 # other counter column.
-HISTORY_SCHEMA_VERSION = 2
+# v3: samples carry the decision-ledger columns (ledger_violations,
+# ledger_overshoot_hits, ledger_minted_budget — cumulative) so bundles
+# and the anomaly windows show the over-admission run-up, not just the
+# audited instant.
+HISTORY_SCHEMA_VERSION = 3
 
 # retention floor when the ring is disabled: the anomaly engine still
 # serves its burn windows (default slow window 600 s) from here
@@ -113,6 +117,18 @@ class MetricsHistory:
             sig["lease_fail_close"] = 0.0
             sig["lease_outstanding"] = 0.0
             sig["lease_held_keys"] = 0.0
+
+        led = getattr(inst, "ledger", None)
+        if led is not None and getattr(led, "enabled", False):
+            lt = led.totals()
+            sig["ledger_violations"] = float(lt.get("violations", 0))
+            sig["ledger_overshoot_hits"] = float(
+                lt.get("overshoot_hits", 0))
+            sig["ledger_minted_budget"] = float(lt.get("minted_budget", 0))
+        else:
+            sig["ledger_violations"] = 0.0
+            sig["ledger_overshoot_hits"] = 0.0
+            sig["ledger_minted_budget"] = 0.0
 
         from gubernator_tpu.obs.introspect import (
             eviction_count,

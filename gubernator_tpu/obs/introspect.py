@@ -23,7 +23,11 @@ from typing import Optional
 # obs/profile.py) — serving-cycle phase shares, lock-wait sites, and
 # capture accounting are promised on every Instance; "enabled" inside
 # it tracks GUBER_PROFILE.
-DEBUG_VARS_SCHEMA_VERSION = 4
+# v5: always-present "ledger" section (decision ledger & conservation
+# audit plane, obs/ledger.py) — per-authority admit totals, minted
+# budget, and violation counts are promised on every Instance;
+# "enabled" inside it tracks GUBER_LEDGER.
+DEBUG_VARS_SCHEMA_VERSION = 5
 
 
 def _backend_vars(backend) -> dict:
@@ -157,6 +161,18 @@ def debug_vars(instance) -> dict:
         # profiler — a disabled, empty shape keeps consumers branch-free
         out["profile"] = {"enabled": False, "phases": {}, "shares": {},
                           "lock_sites": 0, "captures": 0}
+
+    led = getattr(instance, "ledger", None)
+    if led is not None:
+        out["ledger"] = led.debug()
+    else:
+        # the section is promised (v5) even on stub wirings with no
+        # ledger — a disabled, empty shape keeps consumers branch-free
+        out["ledger"] = {"enabled": False, "authorities": [], "admits": {},
+                         "attempted": 0, "rejected": 0, "minted_budget": 0,
+                         "windows_rolled": 0, "violations": 0,
+                         "overshoot": {}, "keys_tracked": 0,
+                         "pending_windows": 0, "audits": 0}
 
     tracer = getattr(instance, "tracer", None)
     if tracer is not None:

@@ -1,0 +1,515 @@
+"""Decision ledger: live audit of the "budget is never minted" invariant.
+
+Every authority-delegating subsystem promises the same conservation
+property in prose — hot-key leases carve slices out of the owner's
+remaining budget, degraded-local serving admits against a local copy,
+reshard double-writes during the transfer window, GLOBAL answers from a
+local cache — and each bounds its worst-case over-admission by
+construction. Nothing measured whether the promise holds under real
+traffic. This module is the instrument: every admitted hit is
+attributed at decision time to its **source of authority**, and an
+off-serving-path auditor checks, per key-window,
+
+    Σ admits across authorities ≤ limit
+                                 + minted lease budget
+                                 + declared degraded/reshard/global slack
+
+rendering measured over-admission as a distribution (and a violation
+counter the `over_admission` anomaly detector gates on), not a hope.
+
+Hot-path contract (the PhaseHist rule from obs/profile.py): the engine's
+window paths pay O(1) per *window*, not per lane — each dispatch parks a
+handful of small numpy column copies (slot, hits, status, limit, reset)
+on a pending ring under a leaf lock. Key resolution (slot → hash-key via
+the directory arena walk), bucket folding, window rolling, and the
+conservation evaluation all run in `audit()`, off the serving path —
+riding the cartographer harvest / anomaly ticker cadence. Lone native
+decisions and the non-engine authorities (lease consume, GLOBAL cache,
+minted budget) record per key directly: they are already per-item paths.
+
+Authorities:
+
+- ``owner``        — decided against this node's authoritative window
+                     (the device table row), including drained lease /
+                     GLOBAL hits applied at the owner;
+- ``lease``        — served from a locally-held lease slice
+                     (service/leases.py try_consume), bounded by the
+                     minted budget the owner attached to the grant;
+- ``degraded``     — degraded-local fallback while the owner is
+                     unreachable (availability over strictness; slack is
+                     one window of `limit` per node by construction);
+- ``reshard``      — admitted inside a reshard transfer window
+                     (double-write / fresh-serve amnesty paths);
+- ``global_cache`` — answered from the GLOBAL behavior's local cache
+                     ahead of async reconciliation.
+
+The test-only ``mint`` authority has **zero** declared slack: recording
+through it manufactures budget from nowhere, which is exactly what the
+deliberate-violation drill uses to prove the detector fires.
+
+`GUBER_LEDGER=0` turns every observation site into a single attribute
+test; the off path is bit-identical (differential-tested) because the
+ledger only ever *reads* the staging/response columns.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from gubernator_tpu.obs import witness
+
+LEDGER_SCHEMA_VERSION = 1
+
+# Attribution taxonomy (docs/observability.md "## Decision ledger" pins
+# it; renaming an authority is a schema_version bump, not a drift).
+AUTHORITIES = ("owner", "lease", "degraded", "reshard", "global_cache")
+
+# Deliberate-violation drill only: admits with no declared slack.
+MINT_AUTHORITY = "mint"
+
+# Authorities whose admissions are covered by a declared slack of one
+# window of `limit` each (the documented worst case per subsystem:
+# leases.py:29 / reshard.py amnesty / GLOBAL staleness bound).
+_SLACK_AUTHORITIES = ("degraded", "reshard", "global_cache")
+
+# log2 over-admission histogram: bucket i holds overshoots <= 2^i hits.
+_NBUCKETS = 28
+
+_AUTHORITY: contextvars.ContextVar = contextvars.ContextVar(
+    "guber_ledger_authority", default="owner")
+
+
+def ledger_enabled_default() -> bool:
+    """GUBER_LEDGER escape hatch (Go ParseBool values; default on — the
+    conservation meter is the always-on invariant check, opting OUT is
+    the deliberate act)."""
+    raw = os.environ.get("GUBER_LEDGER", "").strip().lower()
+    if raw in ("0", "f", "false", "no", "off"):
+        return False
+    return True
+
+
+@contextlib.contextmanager
+def authority(name: str):
+    """Scope every decision recorded inside to `name` — the serving path
+    declares its source of authority (degraded-local wraps its engine
+    apply, the reshard amnesty path wraps its local apply) and the
+    engine hooks pick it up without any new plumbing through the call
+    stack."""
+    token = _AUTHORITY.set(name)
+    try:
+        yield
+    finally:
+        _AUTHORITY.reset(token)
+
+
+def current_authority() -> str:
+    return _AUTHORITY.get()
+
+
+class _Bucket:
+    """Per-key conservation state: the open window plus key-lifetime
+    attribution totals (lifetime survives window rolls so the auditor
+    can hold it against the device row's col-7 attempted counter)."""
+
+    __slots__ = ("window", "limit", "admits", "attempted", "rejected",
+                 "minted", "lifetime_attempted")
+
+    def __init__(self):
+        self.window = 0  # reset_time ms identifying the open window
+        self.limit = 0
+        self.admits: Dict[str, int] = {}
+        self.attempted = 0
+        self.rejected = 0
+        self.minted = 0
+        self.lifetime_attempted = 0
+
+
+class DecisionLedger:
+    """Per-node decision ledger + conservation auditor."""
+
+    def __init__(self, enabled: Optional[bool] = None,
+                 key_capacity: int = 8192, pending_cap: int = 4096,
+                 audit_min_interval_s: float = 2.0,
+                 emit: Optional[Callable] = None):
+        self.enabled = (ledger_enabled_default()
+                        if enabled is None else bool(enabled))
+        self.key_capacity = int(key_capacity)
+        self.pending_cap = int(pending_cap)
+        self.audit_min_interval_s = float(audit_min_interval_s)
+        # flight-recorder hook (Instance wires recorder.emit); None keeps
+        # the ledger standalone in engine-only tests
+        self._emit = emit
+        # hot path: window column copies park here — leaf lock, O(1) hold
+        self._pending_lock = witness.make_lock("ledger.pending")
+        self._pending: List[tuple] = []
+        # off-path state: key buckets, distribution, counters
+        self._lock = witness.make_lock("ledger.buckets")
+        self._buckets: Dict[str, _Bucket] = {}
+        self._admits_total: Dict[str, int] = {}
+        self._attempted_total = 0
+        self._rejected_total = 0
+        self._minted_total = 0
+        self._windows_rolled = 0
+        self._violations = 0
+        self._overshoot_hits = 0
+        self._max_overshoot = 0
+        self._over_counts = [0] * _NBUCKETS
+        self._over_n = 0
+        self._overflow = 0  # key-capacity evictions declined
+        self._pending_dropped = 0  # windows dropped at the ring cap
+        self._unattributed = 0  # hits on slots the directory lost
+        self._audits = 0
+        self._last_audit = 0.0
+        self._ground_truth = {"keys_checked": 0, "ledger_hits": 0,
+                              "device_hits": 0, "breaches": 0}
+        self._recent: List[dict] = []  # last few violation evaluations
+
+    # ------------------------------------------------------------ hot path
+
+    def note_slots(self, packed: np.ndarray, out: np.ndarray,
+                   n0: int) -> None:
+        """Park one dispatched window's attribution columns: slots+hits
+        from the staged wide buffer, status/limit/reset from the response
+        rows. O(1) per window — two small block copies and a list
+        append; resolution and folding happen in audit()."""
+        if not n0:
+            return
+        # two block copies: slot|hits are adjacent staging rows, the
+        # response is one 4-row block — a handful of ns each, vs ~µs for
+        # five per-row copies (the parking IS the hot-path cost)
+        rec = (packed[:2, :n0].copy(), out[:4, :n0].copy(),
+               _AUTHORITY.get())
+        with self._pending_lock:
+            if len(self._pending) >= self.pending_cap:
+                self._pending_dropped += 1
+                return
+            self._pending.append(rec)
+
+    def note_arrays(self, slots, hits, status, limit, reset) -> None:
+        """Generic per-array entry (tests, non-engine batch recorders):
+        builds the same (slots+hits, response-rows) record the engine
+        block paths park."""
+        n = len(slots)
+        sh = np.empty((2, n), np.int64)
+        sh[0] = slots
+        sh[1] = hits
+        resp = np.zeros((4, n), np.int64)
+        resp[0] = status
+        resp[1] = limit
+        resp[3] = reset
+        rec = (sh, resp, _AUTHORITY.get())
+        with self._pending_lock:
+            if len(self._pending) >= self.pending_cap:
+                self._pending_dropped += 1
+                return
+            self._pending.append(rec)
+
+    def stash_columns(self, packed: np.ndarray, n0: int):
+        """Copy the slot/hits columns of a window whose readback is
+        deferred (pipelined launch/collect, columnar submit/complete) —
+        the staging buffer may be refilled before the collect runs, so
+        the launch side parks copies and the collect side pairs them
+        with the response rows via note_slots_deferred."""
+        if not n0:
+            return None
+        return (packed[:2, :n0].copy(), _AUTHORITY.get())
+
+    def note_slots_deferred(self, stash, rows: np.ndarray,
+                            n0: int) -> None:
+        if stash is None or not n0:
+            return
+        slots_hits, auth = stash
+        rec = (slots_hits, rows[:4, :n0].copy(), auth)
+        with self._pending_lock:
+            if len(self._pending) >= self.pending_cap:
+                self._pending_dropped += 1
+                return
+            self._pending.append(rec)
+
+    # -------------------------------------------------- per-key recording
+
+    def record_key(self, key: str, hits: int, status: int, limit: int,
+                   reset: int, auth: Optional[str] = None) -> None:
+        """Attribute one decision by key — the native lone-request path
+        and every non-engine authority (lease consume, GLOBAL cache,
+        degraded singles) record here directly."""
+        if auth is None:
+            auth = _AUTHORITY.get()
+        with self._lock:
+            self._record_locked(key, int(hits), int(status), int(limit),
+                                int(reset), auth)
+
+    def record_minted(self, key: str, budget: int) -> None:
+        """A lease slice was installed for local consumption: `budget`
+        hits of the owner's window are now legitimately spendable here.
+        Grows the key's conservation bound for the open window."""
+        if budget <= 0:
+            return
+        with self._lock:
+            b = self._bucket_locked(key)
+            if b is not None:
+                b.minted += int(budget)
+            self._minted_total += int(budget)
+
+    # ------------------------------------------------------------ folding
+
+    def _bucket_locked(self, key: str) -> Optional[_Bucket]:
+        b = self._buckets.get(key)
+        if b is None:
+            if len(self._buckets) >= self.key_capacity:
+                self._overflow += 1
+                return None
+            b = _Bucket()
+            self._buckets[key] = b
+        return b
+
+    def _record_locked(self, key, hits, status, limit, reset, auth):
+        b = self._bucket_locked(key)
+        if b is None:
+            return
+        if reset and b.window and reset > b.window:
+            self._roll_locked(key, b)
+        if reset and not b.window:
+            b.window = reset
+        if limit:
+            b.limit = limit
+        b.attempted += hits
+        b.lifetime_attempted += hits
+        self._attempted_total += hits
+        if status == 1:
+            b.rejected += hits
+            self._rejected_total += hits
+        else:
+            b.admits[auth] = b.admits.get(auth, 0) + hits
+            self._admits_total[auth] = self._admits_total.get(auth, 0) + hits
+
+    def _roll_locked(self, key: str, b: _Bucket) -> None:
+        """Finalize one key-window: evaluate conservation, fold the
+        overshoot into the distribution, and open a fresh window (the
+        lifetime attempted counter survives)."""
+        total_admits = sum(b.admits.values())
+        if total_admits or b.attempted:
+            bound = b.limit + b.minted
+            # each exercised slack authority declares one window of
+            # `limit` as its documented worst case; an authority that
+            # admitted nothing this window contributes no slack
+            slack = b.limit * sum(1 for a in _SLACK_AUTHORITIES
+                                  if b.admits.get(a, 0))
+            overshoot = max(0, total_admits - bound)
+            self._windows_rolled += 1
+            if overshoot:
+                self._overshoot_hits += overshoot
+                if overshoot > self._max_overshoot:
+                    self._max_overshoot = overshoot
+                idx = min(overshoot.bit_length(), _NBUCKETS - 1)
+                self._over_counts[idx] += 1
+                self._over_n += 1
+            if overshoot > slack:
+                self._violations += 1
+                ev = {"key": key, "window": b.window, "limit": b.limit,
+                      "admits": dict(b.admits), "minted": b.minted,
+                      "overshoot": overshoot, "slack": slack}
+                self._recent.append(ev)
+                del self._recent[:-16]
+                if self._emit is not None:
+                    try:
+                        self._emit("ledger.violation", key=key,
+                                   overshoot=overshoot, slack=slack,
+                                   limit=b.limit, minted=b.minted,
+                                   authorities=",".join(sorted(b.admits)))
+                    except Exception:  # noqa: BLE001 — audit never raises
+                        pass
+        b.window = 0
+        b.admits = {}
+        b.attempted = 0
+        b.rejected = 0
+        b.minted = 0
+
+    # ------------------------------------------------------------ auditing
+
+    def maybe_audit(self, engine=None, now_ms: Optional[int] = None) -> bool:
+        """Rate-limited audit for tickers (the anomaly engine calls this
+        every check): no-op inside the min interval."""
+        now = time.monotonic()
+        if now - self._last_audit < self.audit_min_interval_s:
+            return False
+        self.audit(engine, now_ms=now_ms)
+        return True
+
+    def audit(self, engine=None, now_ms: Optional[int] = None,
+              force: bool = False) -> dict:
+        """The off-serving-path conservation pass: drain the pending
+        window ring, resolve slots to keys through the engine directory,
+        fold into key buckets, roll every window the clock has closed
+        (all of them under `force` — the scenario sweep wants the final
+        open windows judged too), and hold a sample of keys against the
+        device table's lifetime col-7 attempted counters as ground
+        truth. Returns the audit report also served by endpoint_body."""
+        self._last_audit = time.monotonic()
+        if now_ms is None:
+            now_ms = int(time.time() * 1000)
+        with self._pending_lock:
+            pending, self._pending = self._pending, []
+        resolved: Dict[int, str] = {}
+        if pending and engine is not None:
+            want = set()
+            for sh, _resp, _auth in pending:
+                want.update(int(s) for s in sh[0].tolist())
+            want.discard(-1)
+            try:
+                resolved = engine.resolve_slots(want)
+            except Exception:  # noqa: BLE001 — audit never raises
+                resolved = {}
+        with self._lock:
+            for sh, resp, auth in pending:
+                sl = sh[0].tolist()
+                hl = sh[1].tolist()
+                stl = resp[0].tolist()
+                ll = resp[1].tolist()
+                rl = resp[3].tolist()
+                for j, s in enumerate(sl):
+                    if s < 0:
+                        continue  # padding lane, not a lost key
+                    key = resolved.get(int(s))
+                    if key is None:
+                        self._unattributed += hl[j]
+                        continue
+                    self._record_locked(key, hl[j], stl[j], ll[j],
+                                        rl[j], auth)
+            for key, b in list(self._buckets.items()):
+                if b.window and (force or b.window <= now_ms):
+                    self._roll_locked(key, b)
+            self._audits += 1
+            report = self._report_locked()
+        if engine is not None:
+            self._ground_truth_check(engine)
+            with self._lock:
+                report["ground_truth"] = dict(self._ground_truth)
+        return report
+
+    def _ground_truth_check(self, engine, sample: int = 64) -> None:
+        """Hold the ledger's per-key lifetime attempted totals against
+        the device rows' col-7 counters. The device counter is the
+        durable on-accelerator truth for owner-resident keys; a key the
+        ledger saw MORE attempts for than the device row did (and the
+        row was never recycled: device >= ledger holds across expiry
+        only one way) is attribution the serving path manufactured."""
+        with self._lock:
+            keys = [k for k, b in self._buckets.items()
+                    if b.lifetime_attempted > 0][:sample]
+            ledger_hits = {k: self._buckets[k].lifetime_attempted
+                           for k in keys}
+        if not keys:
+            return
+        try:
+            device = engine.device_hit_counts(keys)
+        except Exception:  # noqa: BLE001 — audit never raises
+            return
+        checked = lh = dh = breaches = 0
+        for k in keys:
+            if k not in device:
+                continue  # not owner-resident here (leased/remote key)
+            checked += 1
+            lh += ledger_hits[k]
+            dh += device[k]
+            if ledger_hits[k] > device[k]:
+                breaches += 1
+        with self._lock:
+            g = self._ground_truth
+            g["keys_checked"] += checked
+            g["ledger_hits"] += lh
+            g["device_hits"] += dh
+            g["breaches"] += breaches
+
+    # ------------------------------------------------------------ surfaces
+
+    def totals(self) -> dict:
+        with self._lock:
+            return {
+                "admits": {a: self._admits_total.get(a, 0)
+                           for a in AUTHORITIES},
+                "admits_other": sum(
+                    v for a, v in self._admits_total.items()
+                    if a not in AUTHORITIES),
+                "attempted": self._attempted_total,
+                "rejected": self._rejected_total,
+                "minted_budget": self._minted_total,
+                "windows_rolled": self._windows_rolled,
+                "violations": self._violations,
+                "overshoot_hits": self._overshoot_hits,
+                "max_overshoot": self._max_overshoot,
+                "keys_tracked": len(self._buckets),
+                "key_overflow": self._overflow,
+                "pending_windows": len(self._pending),
+                "pending_dropped": self._pending_dropped,
+                "unattributed_hits": self._unattributed,
+                "audits": self._audits,
+            }
+
+    def _overshoot_locked(self) -> dict:
+        out = {"n": self._over_n, "total_hits": self._overshoot_hits,
+               "max_hits": self._max_overshoot, "p50_hits": 0,
+               "p99_hits": 0}
+        if self._over_n:
+            for q, field in ((0.50, "p50_hits"), (0.99, "p99_hits")):
+                want = q * self._over_n
+                seen = 0
+                for i, c in enumerate(self._over_counts):
+                    seen += c
+                    if seen >= want:
+                        out[field] = 1 << i
+                        break
+        return out
+
+    def _report_locked(self) -> dict:
+        return {
+            "windows_rolled": self._windows_rolled,
+            "violations": self._violations,
+            "overshoot": self._overshoot_locked(),
+            "recent_violations": list(self._recent),
+        }
+
+    def debug(self) -> dict:
+        """The compact /v1/debug/vars section."""
+        t = self.totals()
+        with self._lock:
+            over = self._overshoot_locked()
+        return {
+            "enabled": self.enabled,
+            "authorities": list(AUTHORITIES),
+            "admits": t["admits"],
+            "attempted": t["attempted"],
+            "rejected": t["rejected"],
+            "minted_budget": t["minted_budget"],
+            "windows_rolled": t["windows_rolled"],
+            "violations": t["violations"],
+            "overshoot": over,
+            "keys_tracked": t["keys_tracked"],
+            "pending_windows": t["pending_windows"],
+            "audits": t["audits"],
+        }
+
+    def endpoint_body(self) -> dict:
+        """The /v1/debug/ledger body (schema pinned by
+        tests/test_debug_schema.py)."""
+        t = self.totals()
+        with self._lock:
+            over = self._overshoot_locked()
+            recent = list(self._recent)
+            ground = dict(self._ground_truth)
+        return {
+            "schema_version": LEDGER_SCHEMA_VERSION,
+            "enabled": self.enabled,
+            "authorities": list(AUTHORITIES),
+            "totals": t,
+            "overshoot": over,
+            "recent_violations": recent,
+            "ground_truth": ground,
+        }

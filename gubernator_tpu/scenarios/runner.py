@@ -216,8 +216,30 @@ def run_scenario(spec: ScenarioSpec, cluster=None, profile: str = "short",
         time.sleep(0.2)  # let in-flight async work land before the sweep
         now = time.monotonic()
         tripped: Dict[str, int] = {}
+        conservation: Dict[str, int] = {
+            "nodes_audited": 0, "windows_audited": 0, "violations": 0,
+            "overshoot_hits": 0, "max_overshoot": 0}
         for ci in cluster.instances:
             inst = ci.instance
+            # budget-conservation sweep: force-audit every node's decision
+            # ledger (open windows included) so the verdict judges the
+            # whole run's admits, not just windows that happened to close
+            led = getattr(inst, "ledger", None)
+            if led is not None and getattr(led, "enabled", False):
+                try:
+                    led.audit(getattr(inst, "backend", None), force=True)
+                    t = led.totals()
+                    conservation["nodes_audited"] += 1
+                    conservation["windows_audited"] += \
+                        int(t.get("windows_rolled", 0))
+                    conservation["violations"] += int(t.get("violations", 0))
+                    conservation["overshoot_hits"] += \
+                        int(t.get("overshoot_hits", 0))
+                    conservation["max_overshoot"] = max(
+                        conservation["max_overshoot"],
+                        int(t.get("max_overshoot", 0)))
+                except Exception:  # noqa: BLE001 — stopped instance
+                    pass
             try:
                 inst.anomaly.check(now)
             except Exception:  # noqa: BLE001 — stopped instance
@@ -250,6 +272,7 @@ def run_scenario(spec: ScenarioSpec, cluster=None, profile: str = "short",
             "max": round(max(latencies), 3) if latencies else 0.0,
         },
         "detectors_tripped": tripped,
+        "conservation": conservation,
         "events": events.fired,
     }
     return render_verdict(scaled, stats, profile=profile)
@@ -293,6 +316,14 @@ def render_verdict(spec: ScenarioSpec, stats: dict,
              "ok": over_share >= env.min_over_limit_share,
              "observed": round(over_share, 6),
              "threshold": env.min_over_limit_share})
+    if env.max_over_admission is not None:
+        cons = stats.get("conservation") or {}
+        violations = int(cons.get("violations", 0))
+        checks.append(
+            {"name": "over_admission",
+             "ok": violations <= env.max_over_admission,
+             "observed": violations,
+             "threshold": env.max_over_admission})
     unknown = sorted(d for d in tripped if d not in DETECTORS)
     if unknown:
         checks.append({"name": "known_detectors", "ok": False,

@@ -123,12 +123,18 @@ class Envelope:
     allow_detectors documents edges the scenario expects (a failover
     drill EXPECTS circuit_open) so the report can show them without
     failing. min_over_limit_share gives abuse scenarios teeth: a bot
-    storm that never sees OVER_LIMIT means the limiter did not limit."""
+    storm that never sees OVER_LIMIT means the limiter did not limit.
+    max_over_admission arms the budget-conservation gate: a non-None
+    bound makes the runner sweep every node's decision ledger after the
+    run and fail the verdict when audited conservation violations
+    (admits beyond limit + minted lease budget + declared degraded/
+    reshard slack) exceed it — 0 is the 'never mint budget' spelling."""
 
     max_p99_ms: float = 250.0
     min_goodput: float = 0.999
     max_error_share: float = 0.0
     min_over_limit_share: float = 0.0
+    max_over_admission: Optional[int] = None
     forbid_detectors: Tuple[str, ...] = ("slo_burn", "capacity")
     allow_detectors: Tuple[str, ...] = ()
 
@@ -139,6 +145,10 @@ class Envelope:
             raise ValueError("envelope max_p99_ms must be positive")
         if not 0.0 <= self.min_goodput <= 1.0:
             raise ValueError("envelope min_goodput must be in [0, 1]")
+        if self.max_over_admission is not None \
+                and self.max_over_admission < 0:
+            raise ValueError(
+                "envelope max_over_admission cannot be negative")
         for det in self.forbid_detectors + self.allow_detectors:
             if det not in DETECTORS:
                 raise ValueError(f"envelope names unknown detector {det!r}")
@@ -333,8 +343,13 @@ def _bot_storm() -> ScenarioSpec:
         ],
         envelope=Envelope(max_p99_ms=250.0, min_goodput=0.999,
                           min_over_limit_share=0.3,
+                          max_over_admission=0,
                           forbid_detectors=("slo_burn", "capacity")),
         nodes=1,
+        # leases armed: the hot bot keys are exactly the shape the lease
+        # tier serves, and the conservation gate proves the slices it
+        # mints never exceed the owner's declared budget
+        behaviors={"hot_leases": True},
         profiles={"short": Profile(time_scale=0.05, rate_scale=0.7),
                   "full": Profile()},
     )
@@ -398,6 +413,7 @@ def _regional_failover() -> ScenarioSpec:
         ],
         envelope=Envelope(max_p99_ms=600.0, min_goodput=0.90,
                           max_error_share=0.10,
+                          max_over_admission=0,
                           forbid_detectors=("slo_burn", "capacity"),
                           allow_detectors=("circuit_open", "shed_spike",
                                            "deadline_burst")),
@@ -434,6 +450,7 @@ def _rolling_restart() -> ScenarioSpec:
         ],
         envelope=Envelope(max_p99_ms=600.0, min_goodput=0.95,
                           max_error_share=0.05,
+                          max_over_admission=0,
                           forbid_detectors=("slo_burn", "capacity"),
                           allow_detectors=("circuit_open", "shed_spike",
                                            "deadline_burst")),

@@ -177,6 +177,12 @@ class InstanceConfig:
     # at wiring time; False turns every observation site into a single
     # attribute test and the serving path bit-identical to profiling off.
     profile_enabled: Optional[bool] = None
+    # decision ledger & conservation auditor (obs/ledger.py): per-authority
+    # admit attribution plus the off-path "never mint budget" audit. None
+    # defers to GUBER_LEDGER at wiring time (default ON); False turns every
+    # hook into a single attribute/bool test and the serving path
+    # bit-identical to ledger off (tests/test_ledger.py differential).
+    ledger_enabled: Optional[bool] = None
     # GUBER_PROFILE_CAPTURE_S: minimum seconds between on-demand deep
     # captures (/v1/debug/profile?capture=1) — the rate limiter that keeps
     # a curious dashboard from turning the profiler into a DoS.

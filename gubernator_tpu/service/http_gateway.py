@@ -34,6 +34,10 @@ reference daemon's expvar/pprof handlers):
   the history ring + keyspace cartography + flight recorder
   (obs/capture.py; ?n=<samples> bounds the ring window, ?events=<count>
   the recorder tail) — feed it to scenarios.replay.trace_to_spec
+- GET /v1/debug/ledger — decision ledger & budget-conservation audit:
+  per-authority admit totals, minted lease budget, over-admission
+  distribution, recent violations (obs/ledger.py; ?audit=1 forces an
+  immediate conservation audit before serving)
 """
 
 from __future__ import annotations
@@ -205,6 +209,17 @@ class HttpGateway:
                             n_samples=int(q.get("n", ["0"])[0] or 0),
                             n_events=int(q.get("events", ["256"])[0]
                                          or 256))
+                    elif url.path == "/v1/debug/ledger":
+                        q = parse_qs(url.query)
+                        led = getattr(gateway.instance, "ledger", None)
+                        if led is None:
+                            self._reply_error(404, "ledger not wired")
+                            return
+                        if q.get("audit", ["0"])[0] == "1":
+                            led.audit(
+                                getattr(gateway.instance, "backend", None),
+                                force=True)
+                        body = led.endpoint_body()
                     elif url.path == "/v1/debug/cluster":
                         from gubernator_tpu.obs.bundle import cluster_view
 

@@ -30,6 +30,7 @@ from gubernator_tpu.cluster.pickers import (
     RegionPicker,
     ReplicatedConsistentHashPicker,
 )
+from gubernator_tpu.obs import ledger as ledger_mod
 from gubernator_tpu.obs import trace
 from gubernator_tpu.obs.anomaly import AnomalyEngine
 from gubernator_tpu.obs.events import FlightRecorder
@@ -258,6 +259,20 @@ class Instance:
         # subsystem hook is one attribute test; GUBER_FLIGHT_RECORDER=0
         # turns each emit into a single bool read
         self.recorder = conf.recorder or FlightRecorder()
+        # decision ledger (obs/ledger.py): every admitted hit attributed
+        # at decision time to its source of authority; the conservation
+        # auditor runs off the serving path (anomaly ticker / scenario
+        # sweeps force it). conf.ledger_enabled None defers to
+        # GUBER_LEDGER; an explicit bool overrides the env.
+        self.ledger = ledger_mod.DecisionLedger(
+            enabled=conf.ledger_enabled, emit=self.recorder.emit)
+        try:
+            # the engine's window hooks read this attribute (one None
+            # test per window when off); stub backends without the slot
+            # simply never feed the window path
+            self.backend.ledger = self.ledger
+        except Exception:  # noqa: BLE001 — observability must not break wiring
+            pass
         # concurrent callers merge into pipelined kernel launches: up to
         # GUBER_PIPELINE_DEPTH window groups ride the link/device while
         # further windows pool up and pack (service/combiner.py)
@@ -852,6 +867,20 @@ class Instance:
             # get_peer_rate_limits): shed at saturation only — owner work
             # goes last in the brownout order
             self.admission.check_ingress(priority="peer")
+        return self._apply_owner_direct(requests, now_ms=now_ms,
+                                        from_peer_rpc=from_peer_rpc)
+
+    def _apply_owner_direct(
+        self, requests: List[RateLimitReq], now_ms: Optional[int] = None,
+        from_peer_rpc: bool = False,
+    ) -> List[RateLimitResp]:
+        """The combiner-free owner apply: the backend call runs on THIS
+        thread (the engine lock serializes concurrent windows), so
+        calling-thread context — the ledger's authority scope in
+        particular — reaches the engine's staging hooks. Used by the
+        peerlink workers (via apply_owner_batch_direct, which adds the
+        admission gate) and by the degraded/reshard serve paths, which
+        are already inside admitted work."""
         rm = self.reshard
         if not rm.active:
             return self.backend.get_rate_limits(
@@ -1083,7 +1112,18 @@ class Instance:
                  for r in reqs]
         dtoken = deadline_mod.use(dl) if dl is not None else None
         try:
-            resps = self.apply_owner_batch(local)
+            if dl is not None and dl.expired():
+                # mirror the combiner's dequeue-time shed: a dead budget
+                # must not occupy a device window
+                self._count_expired(deadline_mod.STAGE_QUEUE)
+                raise DeadlineExceededError(
+                    f"request budget ({dl.budget_ms:.0f} ms) expired "
+                    "before the degraded-local window")
+            # same-thread apply so the ledger attributes these windows to
+            # the degraded-local authority (the combiner hop would lose
+            # the calling thread's authority scope)
+            with ledger_mod.authority("degraded"):
+                resps = self._apply_owner_direct(local)
         except DeadlineExceededError as e:
             # the budget died before the degraded window ran: same
             # per-request error shape as every other forward failure
@@ -1110,6 +1150,7 @@ class Instance:
         first touch to the real owner (deviation: the reference processes a
         miss locally as-if-owner, double-counting its hits,
         gubernator.go:226-247)."""
+        cached: Optional[RateLimitResp] = None
         with self._global_cache.lock:
             item = self._global_cache.get_item(req.hash_key())
             if item is not None:
@@ -1130,13 +1171,22 @@ class Instance:
                             owner_peer.info.address) or \
                         not cg.queue_hit(req):
                     self.global_manager.queue_hit(req)
-                return RateLimitResp(
+                cached = RateLimitResp(
                     status=status,
                     limit=st.limit,
                     remaining=st.remaining,
                     reset_time=st.reset_time,
                     metadata={"owner": owner_peer.info.address},
                 )
+        if cached is not None:
+            led = self.ledger
+            if led is not None and led.enabled and req.hits > 0:
+                # attribution OUTSIDE the cache lock: the ledger's bucket
+                # lock is a leaf and must not nest under the LRU lock
+                led.record_key(req.hash_key(), req.hits, int(cached.status),
+                               int(cached.limit), int(cached.reset_time),
+                               auth="global_cache")
+            return cached
         # first touch: relay synchronously to the owner (its response will
         # also come back to us via the broadcast pipeline)
         try:

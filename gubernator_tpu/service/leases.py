@@ -259,6 +259,25 @@ class LeaseManager:
         if rec is not None:
             rec.emit(kind, **fields)
 
+    def _refresh_outstanding_gauge(self) -> None:
+        """Push the owner's unexpired granted budget into the
+        lease_outstanding_budget gauge at grant/expiry/revoke transitions.
+        The scrape-time refresh (metrics.py observe_instance) only samples
+        the value; the anomaly ticker, history ring, and bundles need the
+        intra-scrape edges — a lease spike that grants and expires between
+        two scrapes is exactly the over-admission run-up worth keeping.
+        Called OUTSIDE the lease lock (outstanding() re-acquires it)."""
+        m = self._metrics
+        if m is None:
+            return
+        gauge = getattr(m, "lease_outstanding_budget", None)
+        if gauge is None:
+            return
+        try:
+            gauge.set(self.outstanding())
+        except Exception:  # noqa: BLE001 — metrics must not break serving
+            pass
+
     def arm(self) -> None:
         """Build the hot-key detector and attach it to the backend.
 
@@ -323,35 +342,40 @@ class LeaseManager:
             ttl_ms = min(ttl_ms, left)
         fraction = float(getattr(b, "hot_lease_fraction", 0.2))
         now = time.monotonic()
-        with self._lock:
-            grants = self._grants.get(key)
-            if grants:
-                live = [g for g in grants if g.expires > now]
-                self.stats["expired_grants"] += len(grants) - len(live)
-                if live:
-                    self._grants[key] = live
-                else:
-                    del self._grants[key]
-                grants = live
-            if grants and grants[-1].minted + ttl_ms / 2000.0 > now:
-                self.stats["denied_throttled"] += 1
-                self._emit("lease.deny", key=key, reason="throttled")
-                return None
-            outstanding = sum(g.budget for g in grants) if grants else 0
-            budget = int((int(remaining) - outstanding) * fraction)
-            if budget <= 0:
-                self.stats["denied_exhausted"] += 1
-                self._emit("lease.deny", key=key, reason="exhausted",
-                           remaining=int(remaining),
-                           outstanding=outstanding)
-                return None
-            self._seq += 1
-            seq = self._seq
-            self._grants.setdefault(key, []).append(
-                _Grant(budget=budget, minted=now,
-                       expires=now + ttl_ms / 1000.0, seq=seq))
-            self.stats["grants"] += 1
-            self.stats["granted_budget"] += budget
+        try:
+            with self._lock:
+                grants = self._grants.get(key)
+                if grants:
+                    live = [g for g in grants if g.expires > now]
+                    self.stats["expired_grants"] += len(grants) - len(live)
+                    if live:
+                        self._grants[key] = live
+                    else:
+                        del self._grants[key]
+                    grants = live
+                if grants and grants[-1].minted + ttl_ms / 2000.0 > now:
+                    self.stats["denied_throttled"] += 1
+                    self._emit("lease.deny", key=key, reason="throttled")
+                    return None
+                outstanding = sum(g.budget for g in grants) if grants else 0
+                budget = int((int(remaining) - outstanding) * fraction)
+                if budget <= 0:
+                    self.stats["denied_exhausted"] += 1
+                    self._emit("lease.deny", key=key, reason="exhausted",
+                               remaining=int(remaining),
+                               outstanding=outstanding)
+                    return None
+                self._seq += 1
+                seq = self._seq
+                self._grants.setdefault(key, []).append(
+                    _Grant(budget=budget, minted=now,
+                           expires=now + ttl_ms / 1000.0, seq=seq))
+                self.stats["grants"] += 1
+                self.stats["granted_budget"] += budget
+        finally:
+            # every exit changed (or lazily expired) outstanding budget;
+            # runs after the lock released — the gauge re-reads under it
+            self._refresh_outstanding_gauge()
         self._count("lease_grants")
         self._emit("lease.grant", key=key, budget=budget, ttl_ms=ttl_ms,
                    seq=seq)
@@ -412,6 +436,7 @@ class LeaseManager:
             else:
                 n = len(self._grants.pop(key, ()))
             self.stats["revoked"] += n
+        self._refresh_outstanding_gauge()
         return n
 
     # ------------------------------------------------------- non-owner side
@@ -438,6 +463,12 @@ class LeaseManager:
                 reset_ms=resp.reset_time)
             self.stats["renewals" if renewal else "installs"] += 1
         self._count("lease_installs")
+        led = getattr(self.instance, "ledger", None)
+        if led is not None and led.enabled:
+            # budget becomes consumable HERE (grant() only promises it):
+            # the conservation audit bounds this node's lease admits by
+            # the sum of budgets installed into the key's window
+            led.record_minted(key, budget)
 
     def install_from_responses(self, reqs: Sequence[RateLimitReq],
                                resps: Sequence[RateLimitResp],
@@ -498,6 +529,13 @@ class LeaseManager:
             self.stats["drained_hits"] += req.hits
         # drain OUTSIDE the lease lock: queue_hit takes the pipeline lock
         self.instance.global_manager.queue_hit(req)
+        led = getattr(self.instance, "ledger", None)
+        if led is not None and led.enabled:
+            # lease-authority admit: audited against the budget recorded
+            # at install time — a holder answers from installed budget,
+            # never from budget it minted itself
+            led.record_key(key, req.hits, int(Status.UNDER_LIMIT),
+                           resp.limit, resp.reset_time, auth="lease")
         self._count("lease_local_answers")
         self._count("lease_drained_hits", req.hits)
         return resp

@@ -679,6 +679,61 @@ class Metrics:
             ["kernel", "width"],
             registry=self.registry,
         )
+        # decision ledger & budget-conservation audit plane (obs/ledger.py;
+        # docs/observability.md "Decision ledger"). Cumulative mirrors of the
+        # ledger's lock-free totals, refreshed at scrape; the per-authority
+        # admit split is the "who let this hit through" attribution.
+        self.ledger_admits = Counter(
+            "ledger_admits_total",
+            "Admitted hits attributed at decision time to their source of "
+            "authority (owner = owner-window device decision, lease = held "
+            "lease slice, degraded = degraded-local as-if-owner, reshard = "
+            "handoff double-write/amnesty, global_cache = non-owner GLOBAL "
+            "broadcast cache, mint = test-only drill authority).",
+            ["authority"], registry=self.registry,
+        )
+        self.ledger_attempted_hits = Counter(
+            "ledger_attempted_hits_total",
+            "Hits attempted against windows the ledger observed "
+            "(admitted + rejected).",
+            registry=self.registry,
+        )
+        self.ledger_rejected_hits = Counter(
+            "ledger_rejected_hits_total",
+            "Hits the ledger observed being rejected (OVER_LIMIT).",
+            registry=self.registry,
+        )
+        self.ledger_minted_budget = Counter(
+            "ledger_minted_budget_total",
+            "Lease budget minted to this node by owners (recorded at "
+            "grant install/renewal) — the declared extra admission "
+            "headroom the conservation audit allows.",
+            registry=self.registry,
+        )
+        self.ledger_windows_audited = Counter(
+            "ledger_windows_audited_total",
+            "Closed key-windows rolled through the conservation audit.",
+            registry=self.registry,
+        )
+        self.ledger_violations = Counter(
+            "ledger_violations_total",
+            "Audited key-windows whose admitted hits exceeded "
+            "limit + minted budget + declared slack — the 'never mint "
+            "budget' invariant observed failing.",
+            registry=self.registry,
+        )
+        self.ledger_overshoot_hits = Counter(
+            "ledger_overshoot_hits_total",
+            "Total hits admitted beyond limit + minted budget across "
+            "audited windows (the over-admission mass, before slack).",
+            registry=self.registry,
+        )
+        self.ledger_keys_tracked = Gauge(
+            "ledger_keys_tracked",
+            "Distinct key-windows currently held by the ledger between "
+            "audits.",
+            registry=self.registry,
+        )
 
     def set_native_front(self, hits_fn) -> None:
         """Register the native gRPC front's IO-thread decision counter
@@ -897,6 +952,39 @@ class Metrics:
         rm = getattr(instance, "reshard", None)
         if rm is not None:
             self.reshard_active.set(1 if rm.poll_active() else 0)
+        led = getattr(instance, "ledger", None)
+        if led is not None and getattr(led, "enabled", False):
+            try:
+                # scrapes double as the audit tick for threadless
+                # deployments (same contract as anomaly.maybe_check)
+                led.maybe_audit(getattr(instance, "backend", None))
+            except Exception:  # noqa: BLE001 — the audit must not break
+                pass           # /metrics
+            lt = led.totals()
+            for auth, n in lt.get("admits", {}).items():
+                self._set_counter(
+                    self.ledger_admits.labels(authority=auth), float(n))
+            other = lt.get("admits_other", 0)
+            if other:  # mint-drill / unknown authorities, folded as "other"
+                self._set_counter(
+                    self.ledger_admits.labels(authority="other"),
+                    float(other))
+            self._set_counter(
+                self.ledger_attempted_hits, float(lt.get("attempted", 0)))
+            self._set_counter(
+                self.ledger_rejected_hits, float(lt.get("rejected", 0)))
+            self._set_counter(
+                self.ledger_minted_budget,
+                float(lt.get("minted_budget", 0)))
+            self._set_counter(
+                self.ledger_windows_audited,
+                float(lt.get("windows_rolled", 0)))
+            self._set_counter(
+                self.ledger_violations, float(lt.get("violations", 0)))
+            self._set_counter(
+                self.ledger_overshoot_hits,
+                float(lt.get("overshoot_hits", 0)))
+            self.ledger_keys_tracked.set(float(lt.get("keys_tracked", 0)))
         cache = getattr(instance, "_global_cache", None)
         if cache is not None:
             self.global_cache_size.set(len(cache))

@@ -58,6 +58,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from gubernator_tpu.obs import ledger as ledger_mod
 from gubernator_tpu.obs import witness
 from gubernator_tpu.service import faults
 from gubernator_tpu.service.peerlink import (
@@ -803,11 +804,16 @@ class ReshardManager:
     def _apply_local(self, reqs, now_ms, from_peer_rpc
                      ) -> List[RateLimitResp]:
         """Bypass apply: serve locally without re-entering the intercept
-        (the loop breaker for every degraded/resolved path)."""
+        (the loop breaker for every degraded/resolved path). Runs the
+        backend on THIS thread (instance._apply_owner_direct, not the
+        combiner) so the decision ledger attributes these windows to the
+        reshard transfer authority — the handoff window is exactly where
+        the counter-continuity promise needs per-authority accounting."""
         self._tls.bypass = True
         try:
-            return self.instance.apply_owner_batch(
-                reqs, now_ms=now_ms, from_peer_rpc=from_peer_rpc)
+            with ledger_mod.authority("reshard"):
+                return self.instance._apply_owner_direct(  # noqa: SLF001
+                    reqs, now_ms=now_ms, from_peer_rpc=from_peer_rpc)
         finally:
             self._tls.bypass = False
 

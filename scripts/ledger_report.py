@@ -1,0 +1,152 @@
+"""Render a node's decision ledger as a terminal report.
+
+Fetches /v1/debug/ledger from a running node's HTTP gateway (or reads a
+saved endpoint body / diagnostic bundle from a file) and prints the
+operator-facing digest: the admit-by-authority split, minted lease
+budget, the conservation audit's violation count and over-admission
+distribution, the recent-violation ring, and the device-counter ground
+truth comparison. This is the evidence the "Over-admission triage"
+runbook (docs/OPERATIONS.md) walks — the report exists so a human can
+see WHO admitted the traffic before (or after) the `over_admission`
+detector trips.
+
+Usage:
+    python scripts/ledger_report.py [host:port]     # default 127.0.0.1:80
+    python scripts/ledger_report.py --file body.json  # offline artifact
+    make ledger-report [ADDR=host:port]
+
+Rendering is a pure function over the endpoint body (render_report), so
+tests exercise it offline; only main() touches the network. Exit
+status: 0 rendered, 1 on fetch/shape failure.
+"""
+
+import json
+import sys
+import urllib.request
+
+
+def _bar(fraction, width=28):
+    fraction = min(max(float(fraction or 0.0), 0.0), 1.0)
+    filled = int(round(fraction * width))
+    return "[" + "#" * filled + "." * (width - filled) + "]"
+
+
+def render_report(body):
+    """Pure renderer: /v1/debug/ledger body in, report text out."""
+    lines = []
+    lines.append("decision ledger & budget-conservation audit")
+    lines.append("=" * 58)
+    if not body.get("enabled", True):
+        lines.append("ledger DISABLED (GUBER_LEDGER=0) — counters frozen "
+                     "at the values below")
+    totals = body.get("totals") or {}
+    admits = dict(totals.get("admits") or {})
+    other = int(totals.get("admits_other", 0))
+    if other:
+        admits["other"] = other
+    admitted = sum(admits.values())
+    attempted = int(totals.get("attempted", 0))
+    if not attempted and not admitted:
+        lines.append("no decisions observed yet")
+        return "\n".join(lines) + "\n"
+
+    lines.append("admits by authority (who let each hit through)")
+    lines.append("-" * 58)
+    for auth, n in sorted(admits.items(), key=lambda kv: -kv[1]):
+        share = n / admitted if admitted else 0.0
+        lines.append(f"{auth:<13} {_bar(share)} {share:>6.1%}  {n} hits")
+    lines.append(f"{'admitted':<13} {admitted} of {attempted} attempted "
+                 f"({int(totals.get('rejected', 0))} rejected)")
+    lines.append("")
+
+    lines.append("conservation audit")
+    lines.append("-" * 58)
+    lines.append(f"windows rolled   {int(totals.get('windows_rolled', 0))}"
+                 f"  (audits: {int(totals.get('audits', 0))}, keys live: "
+                 f"{int(totals.get('keys_tracked', 0))})")
+    lines.append(f"minted budget    {int(totals.get('minted_budget', 0))} "
+                 "hits (lease slices granted by owners)")
+    violations = int(totals.get("violations", 0))
+    verdict = "INVARIANT HELD" if violations == 0 else "BUDGET MINTED"
+    lines.append(f"violations       {violations}  -> {verdict}")
+    over = body.get("overshoot") or {}
+    if int(over.get("n", 0)):
+        lines.append(
+            f"over-admission   {int(over.get('n', 0))} windows overshot: "
+            f"p50 {int(over.get('p50_hits', 0))} / "
+            f"p99 {int(over.get('p99_hits', 0))} / "
+            f"max {int(over.get('max_hits', 0))} hits "
+            f"(total {int(over.get('total_hits', 0))})")
+    else:
+        lines.append("over-admission   none observed")
+    lines.append("")
+
+    recent = body.get("recent_violations") or []
+    if recent:
+        lines.append("recent violations (newest last)")
+        lines.append("-" * 58)
+        for v in recent:
+            auths = v.get("authorities") or {}
+            split = " ".join(f"{a}={n}" for a, n in sorted(auths.items()))
+            lines.append(
+                f"{v.get('key', '?'):<24} overshoot "
+                f"{int(v.get('overshoot', 0)):>6} beyond slack "
+                f"{int(v.get('slack', 0))} (limit "
+                f"{int(v.get('limit', 0))}, minted "
+                f"{int(v.get('minted', 0))})  {split}")
+        lines.append("")
+
+    gt = body.get("ground_truth") or {}
+    checked = int(gt.get("keys_checked", 0))
+    lines.append("device ground truth (table col-7 attempted-hit counters)")
+    lines.append("-" * 58)
+    if checked:
+        breaches = int(gt.get("breaches", 0))
+        lines.append(
+            f"{checked} owner-resident keys compared: ledger "
+            f"{int(gt.get('ledger_hits', 0))} vs device "
+            f"{int(gt.get('device_hits', 0))} hits, "
+            f"{breaches} breach(es)"
+            + ("" if breaches == 0 else
+               "  <- ledger counted hits the device never saw"))
+    else:
+        lines.append("(no owner-resident keys compared yet)")
+    return "\n".join(lines) + "\n"
+
+
+def _fetch(addr, path, timeout=5.0):
+    return json.loads(urllib.request.urlopen(
+        f"http://{addr}{path}", timeout=timeout).read())
+
+
+def main(argv):
+    if len(argv) > 2 and argv[1] == "--file":
+        try:
+            with open(argv[2], encoding="utf-8") as f:
+                body = json.load(f)
+            # a full diagnostic bundle carries the body under "ledger"
+            if "ledger" in body and "totals" not in body:
+                body = body["ledger"]
+        except Exception as e:  # noqa: BLE001 — operator tool
+            print(f"ledger_report: reading {argv[2]} failed: {e}",
+                  file=sys.stderr)
+            return 1
+    else:
+        addr = argv[1] if len(argv) > 1 else "127.0.0.1:80"
+        try:
+            body = _fetch(addr, "/v1/debug/ledger?audit=1")
+        except Exception as e:  # noqa: BLE001 — operator tool
+            print(f"ledger_report: fetch from {addr} failed: {e}",
+                  file=sys.stderr)
+            return 1
+    try:
+        sys.stdout.write(render_report(body))
+    except Exception as e:  # noqa: BLE001
+        print(f"ledger_report: unexpected endpoint shape: {e}",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))

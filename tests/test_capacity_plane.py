@@ -346,8 +346,8 @@ class TestEndpoints:
                     return json.loads(r.read())
 
             h = get("/v1/debug/history?n=10")
-            # v2 added the profile_* columns (tests/test_debug_schema.py)
-            assert h["schema_version"] == 2
+            # v3 added the ledger_* columns (tests/test_debug_schema.py)
+            assert h["schema_version"] == 3
             assert h["sample_count"] >= 1
             assert h["samples"][-1]["key_count"] == 2.0
             k = get("/v1/debug/keyspace?refresh=1")

@@ -20,6 +20,13 @@ v4 promises the "profile" section on every Instance (the serving-cycle
 profiler is always constructed; its "enabled" flag tracks
 GUBER_PROFILE), and pins the /v1/debug/profile and /v1/debug/kernels
 endpoint bodies.
+
+v5 promises the "ledger" section on every Instance (the decision ledger
+& conservation audit plane is always constructed; its "enabled" flag
+tracks GUBER_LEDGER), and pins the /v1/debug/ledger endpoint body.
+History moves to v3 alongside: samples carry the cumulative
+ledger_violations / ledger_overshoot_hits / ledger_minted_budget
+columns.
 """
 
 import pytest
@@ -28,6 +35,7 @@ from gubernator_tpu.models.engine import Engine
 from gubernator_tpu.obs.history import HISTORY_SCHEMA_VERSION
 from gubernator_tpu.obs.introspect import DEBUG_VARS_SCHEMA_VERSION, debug_vars
 from gubernator_tpu.obs.keyspace import KEYSPACE_SCHEMA_VERSION
+from gubernator_tpu.obs.ledger import LEDGER_SCHEMA_VERSION
 from gubernator_tpu.obs.profile import (KERNELS_SCHEMA_VERSION,
                                         PROFILE_SCHEMA_VERSION)
 from gubernator_tpu.service.config import InstanceConfig
@@ -37,7 +45,7 @@ from gubernator_tpu.types import PeerInfo
 # every section name the snapshot may carry, by wiring condition
 ALWAYS = {"schema_version", "advertise_address", "engine", "combiner",
           "kernel", "peers", "global", "flight_recorder", "anomaly",
-          "history", "keyspace", "reshard", "profile"}
+          "history", "keyspace", "reshard", "profile", "ledger"}
 OPTIONAL = {"wire", "trace", "leases", "collective_global", "multiregion",
             "bundles", "deadline_expired"}
 SECTIONS = ALWAYS | OPTIONAL
@@ -54,7 +62,7 @@ def instance():
 
 def test_schema_version_pinned(instance):
     dv = debug_vars(instance)
-    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 4
+    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 5
 
 
 def test_always_sections_present(instance):
@@ -103,7 +111,7 @@ def test_history_and_keyspace_var_shapes(instance):
 
 def test_history_endpoint_schema_pinned(instance):
     body = instance.history.endpoint_body()
-    assert body["schema_version"] == HISTORY_SCHEMA_VERSION == 2
+    assert body["schema_version"] == HISTORY_SCHEMA_VERSION == 3
     assert set(body) == {"schema_version", "enabled", "tick_s",
                          "retention_s", "sample_count", "samples"}
     instance.history.tick()
@@ -120,7 +128,38 @@ def test_history_endpoint_schema_pinned(instance):
             "profile_queue_wait_s", "profile_lock_wait_s",
             "profile_prep_s", "profile_dispatch_s",
             "profile_readback_s", "profile_demux_s",
-            "profile_cycles"} <= set(sample)
+            "profile_cycles",
+            # v3: the conservation-audit columns bundles diff
+            "ledger_violations", "ledger_overshoot_hits",
+            "ledger_minted_budget"} <= set(sample)
+
+
+def test_ledger_var_shape(instance):
+    dv = debug_vars(instance)
+    led = dv["ledger"]
+    assert {"enabled", "authorities", "admits", "attempted", "rejected",
+            "minted_budget", "windows_rolled", "violations", "overshoot",
+            "keys_tracked", "pending_windows", "audits"} <= set(led)
+    assert led["enabled"] is True  # GUBER_LEDGER unset => on
+    assert led["authorities"] == ["owner", "lease", "degraded", "reshard",
+                                  "global_cache"]
+
+
+def test_ledger_endpoint_schema_pinned(instance):
+    body = instance.ledger.endpoint_body()
+    assert body["schema_version"] == LEDGER_SCHEMA_VERSION == 1
+    assert set(body) == {"schema_version", "enabled", "authorities",
+                         "totals", "overshoot", "recent_violations",
+                         "ground_truth"}
+    assert set(body["totals"]) == {
+        "admits", "admits_other", "attempted", "rejected", "minted_budget",
+        "windows_rolled", "violations", "overshoot_hits", "max_overshoot",
+        "keys_tracked", "key_overflow", "pending_windows",
+        "pending_dropped", "unattributed_hits", "audits"}
+    assert set(body["overshoot"]) == {"n", "total_hits", "max_hits",
+                                      "p50_hits", "p99_hits"}
+    assert set(body["ground_truth"]) == {"keys_checked", "ledger_hits",
+                                         "device_hits", "breaches"}
 
 
 def test_profile_var_shape(instance):

@@ -1,0 +1,592 @@
+"""Decision ledger & budget-conservation audit plane (obs/ledger.py).
+
+Four layers, mirroring the subsystem's structure:
+
+- unit: attribution folding, window rolls, the conservation rule
+  (admits <= limit + minted + declared slack), the over-admission
+  distribution, and the pending-ring / key-table backpressure counters;
+- differential: the GUBER_LEDGER=0 escape hatch is bit-identical on the
+  serving path — the SAME request stream through a ledger-on and a
+  ledger-off instance produces byte-identical decisions, and the off
+  node's counters stay all-zero (the hatch removes the plane, it does
+  not merely silence it);
+- interleavings (chaos-marked): lease grant -> owner circuit cut ->
+  TTL fail-close converges to `owner remaining == limit - total admits`
+  with the ledger agreeing hit-for-hit, and the reshard kill-mid-transfer
+  amnesty never shows NEGATIVE over-admission (undershoot folds to zero,
+  not below);
+- drill: a test-only `mint` authority (zero slack by construction)
+  over-admits one window, the audit flags it, the `over_admission`
+  anomaly trips on the rising edge, and the captured bundle carries the
+  causal spine (ledger.violation -> anomaly.over_admission).
+
+The operator report (scripts/ledger_report.py) renders real endpoint
+bodies offline — main() only adds the fetch.
+"""
+
+import dataclasses
+import json
+import os
+import time
+
+import pytest
+
+from gubernator_tpu.cluster.harness import LocalCluster
+from gubernator_tpu.cluster.harness import test_behaviors as _behaviors
+from gubernator_tpu.models.engine import Engine
+from gubernator_tpu.obs.bundle import BundleWriter
+from gubernator_tpu.obs.ledger import (
+    AUTHORITIES,
+    MINT_AUTHORITY,
+    DecisionLedger,
+    authority,
+    current_authority,
+    ledger_enabled_default,
+)
+from gubernator_tpu.service import faults
+from gubernator_tpu.service.config import InstanceConfig
+from gubernator_tpu.service.instance import Instance
+from gubernator_tpu.service.leases import LEASED_METADATA_KEY
+from gubernator_tpu.types import (
+    Algorithm,
+    PeerInfo,
+    RateLimitReq,
+    Status,
+)
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.clear()
+
+
+def _rl(key, hits=1, limit=1000, duration=3_600_000, behavior=0,
+        name="led"):
+    return RateLimitReq(name=name, unique_key=key, hits=hits, limit=limit,
+                        duration=duration, behavior=behavior,
+                        algorithm=Algorithm.TOKEN_BUCKET)
+
+
+def _single(ledger_enabled=True, capacity=4096):
+    """A self-owned single instance: every request serves locally, no RPC."""
+    inst = Instance(InstanceConfig(backend=Engine(capacity=capacity),
+                                   ledger_enabled=ledger_enabled),
+                    advertise_address="127.0.0.1:1")
+    inst.set_peers([PeerInfo(address="127.0.0.1:1")])
+    return inst
+
+
+# --------------------------------------------------------------------- unit
+
+
+class TestConservationRule:
+    def test_within_limit_no_violation(self):
+        led = DecisionLedger(enabled=True)
+        for _ in range(10):
+            led.record_key("a", 1, int(Status.UNDER_LIMIT), 100, 5000)
+        rep = led.audit(force=True)
+        assert rep["violations"] == 0
+        assert rep["windows_rolled"] == 1
+        t = led.totals()
+        assert t["admits"]["owner"] == 10
+        assert t["attempted"] == 10
+        assert t["rejected"] == 0
+
+    def test_rejections_never_count_as_admits(self):
+        led = DecisionLedger(enabled=True)
+        led.record_key("a", 80, int(Status.UNDER_LIMIT), 100, 5000)
+        led.record_key("a", 500, int(Status.OVER_LIMIT), 100, 5000)
+        led.audit(force=True)
+        t = led.totals()
+        assert t["admits"]["owner"] == 80
+        assert t["rejected"] == 500
+        assert t["attempted"] == 580
+        assert t["violations"] == 0  # rejected mass is not admitted mass
+
+    def test_reset_advance_rolls_the_window(self):
+        led = DecisionLedger(enabled=True)
+        led.record_key("a", 5, int(Status.UNDER_LIMIT), 100, 5000)
+        # the next reset is later: the previous window closed
+        led.record_key("a", 7, int(Status.UNDER_LIMIT), 100, 9000)
+        t = led.totals()
+        assert t["windows_rolled"] == 1
+        led.audit(force=True)  # force also rolls the still-open window
+        assert led.totals()["windows_rolled"] == 2
+        assert led.totals()["violations"] == 0
+
+    def test_owner_overshoot_is_a_violation(self):
+        seen = []
+        led = DecisionLedger(enabled=True,
+                             emit=lambda kind, **kw: seen.append((kind, kw)))
+        led.record_key("svc_hot", 150, int(Status.UNDER_LIMIT), 100, 5000)
+        rep = led.audit(force=True)
+        assert rep["violations"] == 1
+        t = led.totals()
+        assert t["max_overshoot"] == 50
+        assert t["overshoot_hits"] == 50
+        assert [k for k, _ in seen] == ["ledger.violation"]
+        assert seen[0][1]["key"] == "svc_hot"
+        assert seen[0][1]["overshoot"] == 50
+        v = rep["recent_violations"][-1]
+        assert v["key"] == "svc_hot" and v["slack"] == 0
+
+    def test_minted_budget_raises_the_bound(self):
+        led = DecisionLedger(enabled=True)
+        led.record_key("k", 100, int(Status.UNDER_LIMIT), 100, 5000,
+                       auth="lease")
+        led.record_minted("k", 60)
+        led.record_key("k", 50, int(Status.UNDER_LIMIT), 100, 5000,
+                       auth="lease")
+        rep = led.audit(force=True)
+        # 150 admits <= limit 100 + minted 60: paid-for budget, no mint
+        assert rep["violations"] == 0
+        assert led.totals()["minted_budget"] == 60
+
+    def test_slack_authority_declares_one_window(self):
+        led = DecisionLedger(enabled=True)
+        led.record_key("k", 150, int(Status.UNDER_LIMIT), 100, 5000,
+                       auth="degraded")
+        assert led.audit(force=True)["violations"] == 0  # 50 <= slack 100
+        led.record_key("k2", 250, int(Status.UNDER_LIMIT), 100, 5000,
+                       auth="degraded")
+        assert led.audit(force=True)["violations"] == 1  # 150 > slack 100
+        t = led.totals()
+        assert t["overshoot_hits"] == 50 + 150  # both folded into the dist
+
+    def test_unexercised_slack_contributes_nothing(self):
+        led = DecisionLedger(enabled=True)
+        # all owner-authority: the degraded/reshard slack never applies
+        led.record_key("k", 101, int(Status.UNDER_LIMIT), 100, 5000)
+        assert led.audit(force=True)["violations"] == 1
+
+    def test_overshoot_distribution_quantiles(self):
+        led = DecisionLedger(enabled=True)
+        led.record_key("k", 150, int(Status.UNDER_LIMIT), 100, 5000,
+                       auth=MINT_AUTHORITY)
+        led.audit(force=True)
+        over = led.endpoint_body()["overshoot"]
+        assert over["n"] == 1
+        assert over["max_hits"] == 50
+        # log2 buckets: 50 lands in the 2^6 bucket
+        assert over["p50_hits"] == 64 and over["p99_hits"] == 64
+
+    def test_key_capacity_declines_new_buckets(self):
+        led = DecisionLedger(enabled=True, key_capacity=2)
+        for i in range(4):
+            led.record_key(f"k{i}", 1, int(Status.UNDER_LIMIT), 100, 5000)
+        t = led.totals()
+        assert t["keys_tracked"] == 2
+        assert t["key_overflow"] == 2
+
+    def test_pending_ring_drops_at_cap(self):
+        led = DecisionLedger(enabled=True, pending_cap=1)
+        led.note_arrays([1], [5], [0], [100], [5000])
+        led.note_arrays([2], [5], [0], [100], [5000])
+        t = led.totals()
+        assert t["pending_windows"] == 1
+        assert t["pending_dropped"] == 1
+
+    def test_audit_resolves_slots_through_the_directory(self):
+        led = DecisionLedger(enabled=True)
+        led.note_arrays([3, 7, -1], [10, 4, 9], [0, 1, 0],
+                        [100, 50, 1], [5000, 5000, 1])
+
+        class _Dir:
+            def resolve_slots(self, want):
+                assert -1 not in want
+                return {3: "alpha"}  # slot 7 fell out of the directory
+
+        led.audit(engine=_Dir(), force=True)
+        t = led.totals()
+        assert t["admits"]["owner"] == 10
+        assert t["rejected"] == 0  # slot 7's rejection went unattributed too
+        assert t["unattributed_hits"] == 4
+        assert t["pending_windows"] == 0
+
+    def test_maybe_audit_is_rate_limited(self):
+        led = DecisionLedger(enabled=True, audit_min_interval_s=60.0)
+        assert led.maybe_audit() is True
+        assert led.maybe_audit() is False  # inside the min interval
+        assert led.totals()["audits"] == 1
+
+    def test_authority_scope_nests_and_resets(self):
+        assert current_authority() == "owner"
+        with authority("degraded"):
+            assert current_authority() == "degraded"
+            with authority("reshard"):
+                assert current_authority() == "reshard"
+            assert current_authority() == "degraded"
+        assert current_authority() == "owner"
+
+    def test_env_hatch_parses_go_bool(self, monkeypatch):
+        monkeypatch.setenv("GUBER_LEDGER", "false")
+        assert ledger_enabled_default() is False
+        assert DecisionLedger().enabled is False
+        monkeypatch.setenv("GUBER_LEDGER", "1")
+        assert ledger_enabled_default() is True
+        monkeypatch.delenv("GUBER_LEDGER")
+        assert ledger_enabled_default() is True  # default ON
+
+    def test_env_hatch_reaches_daemon_config(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+        monkeypatch.setenv("GUBER_LEDGER", "0")
+        assert config_from_env([]).ledger_enabled is False
+        monkeypatch.setenv("GUBER_LEDGER", "on")
+        assert config_from_env([]).ledger_enabled is True
+
+
+# ------------------------------------------------------------- differential
+
+
+class TestEscapeHatchDifferential:
+    """GUBER_LEDGER=0 must remove the plane, not degrade the data path."""
+
+    def test_decisions_bit_identical_ledger_on_vs_off(self):
+        """Differential: the same stream through ledger-on and ledger-off
+        instances yields bit-identical responses — status, remaining,
+        limit and reset agree on every single answer — and the off
+        node's ledger counters are ALL zero afterwards."""
+        on, off = _single(ledger_enabled=True), _single(ledger_enabled=False)
+        try:
+            frames = [
+                [_rl(f"k{j}", hits=1, limit=5) for j in range(16)]
+                for _ in range(12)
+            ]
+            for frame in frames:
+                ra = on.get_rate_limits(frame)
+                rb = off.get_rate_limits(frame)
+                for a, b in zip(ra, rb):
+                    assert (a.status, a.limit, a.remaining, a.error) == \
+                           (b.status, b.limit, b.remaining, b.error)
+                    # reset encodes each instance's window birth time;
+                    # the two instances booted milliseconds apart
+                    assert abs(a.reset_time - b.reset_time) < 5_000
+            # the stream crossed the limit: both rejected identically
+            assert any(r.status == Status.OVER_LIMIT
+                       for r in on.get_rate_limits(frames[0]))
+
+            on.ledger.audit(on.backend, force=True)
+            off.ledger.audit(off.backend, force=True)
+            t_on, t_off = on.ledger.totals(), off.ledger.totals()
+            assert t_on["attempted"] > 0
+            assert t_on["admits"]["owner"] == 16 * 5  # 5 admits per key
+            assert t_on["violations"] == 0
+            # hatch off: every counter stayed zero
+            assert t_off["attempted"] == 0
+            assert t_off["rejected"] == 0
+            assert sum(t_off["admits"].values()) == 0
+            assert t_off["windows_rolled"] == 0
+            assert t_off["pending_dropped"] == 0
+        finally:
+            on.close()
+            off.close()
+
+    def test_disabled_ledger_parks_nothing(self):
+        inst = _single(ledger_enabled=False)
+        try:
+            for _ in range(5):
+                inst.get_rate_limits([_rl(f"p{j}") for j in range(8)])
+            assert inst.ledger.totals()["pending_windows"] == 0
+        finally:
+            inst.close()
+
+
+# ------------------------------------------------------------ interleavings
+
+
+def _arm_leases(cluster, rate=20.0, window=0.1, ttl=0.8, fraction=0.5):
+    for ci in cluster.instances:
+        b = ci.instance.conf.behaviors
+        b.hot_leases = True
+        b.hot_lease_rate = rate
+        b.hot_lease_window_s = window
+        b.hot_lease_ttl_s = ttl
+        b.hot_lease_fraction = fraction
+        ci.instance.leases.arm()
+
+
+@pytest.mark.chaos
+class TestLeaseBrownoutInterleaving:
+    def test_grant_owner_cut_ttl_fail_close_conserves(self):
+        """The nastiest lease interleaving: budget minted (granted), the
+        owner browns out behind an open circuit, the lease dies at TTL
+        fail-close, the drain lands late. After settling, the owner's
+        window holds EXACTLY limit - total admits, and the ledger agrees:
+        every admitted hit is attributed (forwards + drained at the
+        owner, lease-authority locals at the holder), outstanding granted
+        budget returns to zero, and no node reports a violation."""
+        c = LocalCluster().start(2)
+        try:
+            _arm_leases(c, ttl=0.8)
+            for ci in c.instances:
+                ci.instance.conf.behaviors.circuit_threshold = 3
+                ci.instance.conf.behaviors.circuit_open_s = 2.0
+            req = _rl("cons", limit=10_000, name="lease")
+            owner = c.owner_of(req.hash_key())
+            nonowner = next(ci for ci in c.instances if ci is not owner)
+
+            admitted = leased = 0
+            for _ in range(150):
+                r = nonowner.instance.get_rate_limits([req])[0]
+                if not r.error and r.status == Status.UNDER_LIMIT:
+                    admitted += 1
+                if r.metadata.get(LEASED_METADATA_KEY):
+                    leased += 1
+                time.sleep(0.002)
+            assert leased > 0, "lease never engaged"
+
+            # cut the owner: renewal freezes, the lease dies at TTL and
+            # serving fails closed (strict forwards fail fast)
+            faults.install(f"peer={owner.address};action=error")
+            deadline = time.monotonic() + 1.6
+            while time.monotonic() < deadline:
+                r = nonowner.instance.get_rate_limits([req])[0]
+                if not r.error and r.status == Status.UNDER_LIMIT:
+                    admitted += 1
+                    if r.metadata.get(LEASED_METADATA_KEY):
+                        leased += 1
+                time.sleep(0.005)
+            assert nonowner.instance.leases.held_count() == 0
+
+            # partition heals: the queued drain lands, everything settles
+            faults.clear()
+            time.sleep(0.3)
+            nonowner.instance.global_manager.flush()
+            time.sleep(0.4)
+            peek = dataclasses.replace(req, hits=0)
+            final = owner.instance.get_rate_limits([peek])[0]
+
+            # conservation, cross-checked through the ledgers: the
+            # owner's ledger counts exactly what the device window
+            # absorbed (forwards synchronously, leased locals via the
+            # drain), so post-TTL the authoritative remaining is
+            # limit - total admits AS THE LEDGER COUNTED THEM
+            owner.instance.ledger.audit(owner.instance.backend, force=True)
+            nonowner.instance.ledger.audit(nonowner.instance.backend,
+                                           force=True)
+            t_owner = owner.instance.ledger.totals()
+            t_holder = nonowner.instance.ledger.totals()
+            assert final.remaining == 10_000 - t_owner["admits"]["owner"]
+            # fail-close means the device never absorbs MORE than the
+            # clients were admitted — drain flushes that died against the
+            # open circuit are LOST hits (reference global.go semantics),
+            # never minted ones
+            assert t_owner["admits"]["owner"] <= admitted
+            assert t_owner["admits"]["owner"] >= admitted - leased
+            assert t_holder["admits"]["lease"] == leased
+            # the holder spent only installed budget, never minted its own
+            assert t_holder["minted_budget"] >= leased
+            assert t_owner["violations"] == 0
+            assert t_holder["violations"] == 0
+            # satellite: the outstanding-budget gauge source drains to 0
+            # once every grant expired (TTL long gone by now)
+            assert owner.instance.leases.outstanding() == 0
+        finally:
+            faults.clear()
+            c.stop()
+
+
+@pytest.mark.chaos
+class TestReshardAmnestyInterleaving:
+    def test_kill_mid_transfer_amnesty_never_negative(self):
+        """Exporter frames die after `begin`; the importer's transfer
+        lease expires and the moved keys restart fresh (amnesty). The
+        ledger's over-admission is a max(0, ·) fold: amnesty UNDERSHOOT
+        (a window re-opened with spent budget forgotten) must never
+        surface as negative over-admission, and amnesty itself must not
+        read as minting."""
+        behaviors = dataclasses.replace(
+            _behaviors(), reshard=True, reshard_ttl_s=1.0,
+            reshard_grace_s=0.3)
+        cluster = LocalCluster().start(2, behaviors=behaviors)
+        try:
+            reqs = [_rl(f"amn-{i:03d}", hits=1, limit=100_000, name="amn")
+                    for i in range(120)]
+            via = cluster.instances[0].instance
+            for _ in range(3):
+                via.get_rate_limits(reqs)
+            # every reshard frame after the begin ack drops
+            faults.install("transport=reshard;calls=2-;action=error")
+            cluster.start_instance(behaviors=behaviors)
+            cluster.sync_peers()
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                busy = any(
+                    ci.instance.reshard.debug()["planning"]
+                    or any(s["state"] in ("streaming", "begin", "commit")
+                           for s in ci.instance.reshard.debug()["sessions"])
+                    for ci in cluster.instances)
+                if not busy:
+                    break
+                time.sleep(0.25)
+            faults.clear()
+            # traffic resumes across the healed topology: amnesty keys
+            # restart fresh on their new owner
+            for _ in range(3):
+                via.get_rate_limits(reqs)
+
+            for ci in cluster.instances:
+                rep = ci.instance.ledger.audit(ci.instance.backend,
+                                               force=True)
+                t = ci.instance.ledger.totals()
+                # no negative anywhere: the fold clamps undershoot at 0
+                over = rep["overshoot"]
+                assert over["n"] >= 0 and over["total_hits"] >= 0
+                assert over["max_hits"] >= 0 and over["p99_hits"] >= 0
+                assert all(v >= 0 for v in t["admits"].values())
+                assert t["overshoot_hits"] >= 0
+                # amnesty is forgetting, not minting: nothing to overshoot
+                # with per-key traffic far below the limit
+                assert t["violations"] == 0
+                assert sum(t["admits"].values()) <= t["attempted"]
+        finally:
+            faults.clear()
+            cluster.stop()
+
+
+# -------------------------------------------------------------------- drill
+
+
+class TestMintDrill:
+    def test_minted_budget_trips_over_admission_with_spine(self, tmp_path):
+        """The deliberate-violation drill: a test-only `mint` authority
+        (zero slack, not in the production taxonomy) over-admits one
+        window. The audit flags it, the over_admission detector trips on
+        the rising edge, and the captured bundle carries the full causal
+        spine — ledger.violation then anomaly.over_admission — plus the
+        ledger section naming the minting key."""
+        cluster = LocalCluster().start(1)
+        try:
+            inst = cluster.instances[0].instance
+            inst.bundle_writer = BundleWriter(str(tmp_path),
+                                              min_interval_s=0.0)
+            eng = inst.anomaly
+            led = inst.ledger
+            assert MINT_AUTHORITY not in AUTHORITIES
+            t0 = time.monotonic() + 100.0
+            eng.check(now=t0)  # quiet baseline sweep
+            assert not eng.active["over_admission"]
+
+            led.record_key("mint_drill", 150, int(Status.UNDER_LIMIT),
+                           100, 5000, auth=MINT_AUTHORITY)
+            led.audit(inst.backend, force=True)
+            assert led.totals()["violations"] == 1
+            assert led.totals()["admits_other"] == 150  # outside taxonomy
+
+            eng.check(now=t0 + 5.0)
+            assert eng.active["over_admission"]
+            assert eng.trips["over_admission"] == 1
+            assert "over_admission" in eng.health_note()
+            assert inst.recorder.count("ledger.violation") == 1
+            assert inst.recorder.count("anomaly.over_admission") == 1
+
+            files = list(tmp_path.glob("bundle-*over_admission.json"))
+            assert len(files) == 1
+            bundle = json.loads(files[0].read_text())
+            assert bundle["reason"] == "anomaly:over_admission"
+            assert bundle["ledger"]["totals"]["violations"] == 1
+            assert bundle["ledger"]["recent_violations"][-1]["key"] \
+                == "mint_drill"
+            kinds = [e["kind"] for e in bundle["flight_recorder"]]
+            assert "ledger.violation" in kinds
+            assert "anomaly.over_admission" in kinds
+            # causality reads in order inside the spine
+            assert kinds.index("ledger.violation") \
+                < kinds.index("anomaly.over_admission")
+
+            # steady violations -> falling edge clears the detector
+            eng.check(now=t0 + 10.0)
+            assert not eng.active["over_admission"]
+        finally:
+            cluster.stop()
+
+
+# ------------------------------------------------------------------ surface
+
+
+class TestLedgerSurfaces:
+    def test_metric_families_exposed(self):
+        cluster = LocalCluster().start(1)
+        try:
+            ci = cluster.instances[0]
+            ci.instance.get_rate_limits(
+                [_rl(f"m{i}", hits=2) for i in range(8)])
+            ci.instance.ledger.audit(ci.instance.backend, force=True)
+            text = ci.metrics.render(ci.instance).decode()
+            for family in (
+                "ledger_admits_total",
+                "ledger_attempted_hits_total",
+                "ledger_rejected_hits_total",
+                "ledger_minted_budget_total",
+                "ledger_windows_audited_total",
+                "ledger_violations_total",
+                "ledger_overshoot_hits_total",
+                "ledger_keys_tracked",
+                "lease_outstanding_budget",
+            ):
+                assert family in text, family
+            line = next(
+                ln for ln in text.splitlines()
+                if ln.startswith('ledger_admits_total{authority="owner"}'))
+            assert float(line.split()[1]) == 16.0
+        finally:
+            cluster.stop()
+
+
+class TestLedgerReport:
+    """scripts/ledger_report.py renders endpoint bodies offline."""
+
+    def _import(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "ledger_report",
+            os.path.join(os.path.dirname(__file__), os.pardir,
+                         "scripts", "ledger_report.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def _body(self, violate=False):
+        led = DecisionLedger(enabled=True)
+        led.record_key("svc_a", 40, int(Status.UNDER_LIMIT), 100, 5000)
+        led.record_key("svc_b", 10, int(Status.UNDER_LIMIT), 100, 5000,
+                       auth="lease")
+        led.record_key("svc_b", 25, int(Status.OVER_LIMIT), 100, 5000)
+        led.record_minted("svc_b", 30)
+        if violate:
+            led.record_key("svc_bad", 300, int(Status.UNDER_LIMIT),
+                           100, 5000, auth=MINT_AUTHORITY)
+        led.audit(force=True)
+        return led.endpoint_body()
+
+    def test_renders_held_invariant(self):
+        lr = self._import()
+        out = lr.render_report(self._body())
+        assert "INVARIANT HELD" in out
+        assert "owner" in out and "lease" in out
+        assert "minted budget    30" in out
+        assert "BUDGET MINTED" not in out
+
+    def test_renders_minted_verdict_with_culprit(self):
+        lr = self._import()
+        out = lr.render_report(self._body(violate=True))
+        assert "BUDGET MINTED" in out
+        assert "svc_bad" in out
+        assert "overshoot" in out
+
+    def test_renders_disabled_and_empty_bodies(self):
+        lr = self._import()
+        led = DecisionLedger(enabled=False)
+        out = lr.render_report(led.endpoint_body())
+        assert "DISABLED" in out
+        assert "no decisions observed yet" in out
+
+    def test_main_reads_bundle_file_offline(self, tmp_path, capsys):
+        lr = self._import()
+        wrapped = tmp_path / "bundle.json"
+        wrapped.write_text(json.dumps({"ledger": self._body(violate=True)}))
+        assert lr.main(["ledger_report.py", "--file", str(wrapped)]) == 0
+        assert "BUDGET MINTED" in capsys.readouterr().out
+        assert lr.main(["ledger_report.py", "--file",
+                        str(tmp_path / "missing.json")]) == 1
