@@ -478,17 +478,26 @@ def _capture_bench(n_calls: int = 800, batch: int = 64,
         inst.close()
 
 
-def _scenarios_bench(profile: str = "short") -> dict:
+def _scenarios_bench(profile: str = "short", autopilot: bool = True) -> dict:
     """The scenario atlas as a bench section: every named scenario runs
     against its own fresh in-process cluster and records its verdict.
     verdict_pass is the hard bench_check gate (a scenario flipping
     PASS->FAIL across rounds is a regression, full stop); the latency
-    and goodput numbers ride along as operating-point context."""
+    and goodput numbers ride along as operating-point context. Each
+    shape then re-runs GUBER_AUTOPILOT-armed on the same seed, keyed
+    `<name>@autopilot` — gated by bench_check at the SAME zero
+    tolerance (the closed-loop controllers are not allowed to be a
+    flakiness excuse)."""
     from gubernator_tpu.scenarios import run_atlas
 
     atlas = run_atlas(profile=profile)
+    rows = dict(atlas["scenarios"])
+    if autopilot:
+        armed = run_atlas(profile=profile, autopilot=True)
+        rows.update({f"{name}@autopilot": v
+                     for name, v in armed["scenarios"].items()})
     out = {}
-    for name, v in atlas["scenarios"].items():
+    for name, v in rows.items():
         out[name] = {
             "verdict_pass": int(v["passed"]),
             "goodput": v["goodput"],
@@ -502,7 +511,7 @@ def _scenarios_bench(profile: str = "short") -> dict:
         }
     out["passed_count"] = sum(
         v["verdict_pass"] for v in out.values() if isinstance(v, dict))
-    out["total"] = len(atlas["scenarios"])
+    out["total"] = len(rows)
     return {"scenarios": out}
 
 

@@ -1,6 +1,7 @@
 """Rule modules self-register on import (analysis/core.py register)."""
 
 from gubernator_tpu.analysis.rules import (  # noqa: F401
+    controllers,
     hatches,
     knobs,
     lockorder,

@@ -1,0 +1,160 @@
+"""`controller-bounds` — every autopilot-actuated knob declares bounds.
+
+The autopilot (service/autopilot.py) is only safe because every move is
+clamped inside a declared [floor, ceiling] band and paced by a bounded
+step — a controller wired to a knob with no declared band is an
+unbounded actuator, exactly what the subsystem promises not to be. And
+a knob the autopilot can move must be one an operator can find: its env
+name needs a row in the knob docs, or the first incident review reads a
+flight-recorder `autopilot.move` against a knob nobody documented.
+
+This rule pins both halves mechanically, from the module-level KNOBS /
+CONTROLLERS literals (they are literals BY CONTRACT so this parse stays
+a dumb AST walk):
+
+- every knob named in a CONTROLLERS entry has a KNOBS entry;
+- every KnobSpec declares numeric floor/ceiling/step, with
+  floor <= ceiling and step > 0;
+- every KNOBS entry's `env` knob appears in the operator docs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from gubernator_tpu.analysis.core import Finding, RepoIndex, Rule, register
+
+AUTOPILOT = "gubernator_tpu/service/autopilot.py"
+KNOB_DOCS = ("docs/OPERATIONS.md", "docs/observability.md")
+
+
+def _module_literal(tree: ast.AST, name: str) -> Optional[ast.expr]:
+    """The value expression of a module-level `NAME = ...` (plain or
+    annotated) assignment."""
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return node.value
+        elif isinstance(node, ast.AnnAssign):
+            if isinstance(node.target, ast.Name) \
+                    and node.target.id == name and node.value is not None:
+                return node.value
+    return None
+
+
+@register
+class ControllerBoundsRule(Rule):
+    id = "controller-bounds"
+    doc = ("every autopilot-actuated knob must declare floor/ceiling/"
+           "step in the KNOBS registry and its env knob must appear in "
+           "the operator docs")
+
+    # overridable for the corpus harness
+    autopilot_path = AUTOPILOT
+    knob_docs = KNOB_DOCS
+
+    def check(self, repo: RepoIndex) -> Iterable[Finding]:
+        sf = repo.get(self.autopilot_path)
+        if sf is None or sf.tree is None:
+            return  # tree has no autopilot module: nothing to bound
+        knobs = self._knob_specs(sf.tree)
+        for cname, knob, line in self._actuated(sf.tree):
+            if knob not in knobs:
+                yield Finding(
+                    self.id, self.autopilot_path, line,
+                    f"controller '{cname}' actuates knob '{knob}' with "
+                    "no KNOBS entry — every controller-movable knob "
+                    "must declare its floor/ceiling/step band in the "
+                    "central registry")
+        for kname, (kwargs, line) in knobs.items():
+            missing = [f for f in ("floor", "ceiling", "step")
+                       if f not in kwargs]
+            if missing:
+                yield Finding(
+                    self.id, self.autopilot_path, line,
+                    f"knob '{kname}' KnobSpec declares no "
+                    f"{'/'.join(missing)} — an actuator without a "
+                    "declared band/step is unbounded")
+                continue
+            floor, ceiling, step = (kwargs["floor"], kwargs["ceiling"],
+                                    kwargs["step"])
+            if not all(isinstance(v, (int, float))
+                       for v in (floor, ceiling, step)):
+                yield Finding(
+                    self.id, self.autopilot_path, line,
+                    f"knob '{kname}' floor/ceiling/step must be numeric "
+                    "literals (the band is a reviewed constant, not a "
+                    "computed value)")
+                continue
+            if floor > ceiling:
+                yield Finding(
+                    self.id, self.autopilot_path, line,
+                    f"knob '{kname}' declares floor {floor} > ceiling "
+                    f"{ceiling} — an empty band")
+            if step <= 0:
+                yield Finding(
+                    self.id, self.autopilot_path, line,
+                    f"knob '{kname}' declares step {step} — moves must "
+                    "be bounded by a positive step")
+            env = kwargs.get("env")
+            if isinstance(env, str) and not self._documented(repo, env):
+                yield Finding(
+                    self.id, self.autopilot_path, line,
+                    f"knob '{kname}' env {env} has no row in the knob "
+                    f"docs ({', '.join(self.knob_docs)}) — a knob the "
+                    "autopilot can move must be one an operator can "
+                    "find")
+
+    @staticmethod
+    def _knob_specs(tree: ast.AST
+                    ) -> Dict[str, Tuple[Dict[str, object], int]]:
+        """KNOBS entries: name -> (KnobSpec keyword literals, line)."""
+        out: Dict[str, Tuple[Dict[str, object], int]] = {}
+        val = _module_literal(tree, "KNOBS")
+        if not isinstance(val, ast.Dict):
+            return out
+        for key, spec in zip(val.keys, val.values):
+            if not (isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)):
+                continue
+            kwargs: Dict[str, object] = {}
+            if isinstance(spec, ast.Call):
+                for kw in spec.keywords:
+                    if kw.arg and isinstance(kw.value, ast.Constant):
+                        kwargs[kw.arg] = kw.value.value
+            out[key.value] = (kwargs, spec.lineno)
+        return out
+
+    @staticmethod
+    def _actuated(tree: ast.AST) -> List[Tuple[str, str, int]]:
+        """CONTROLLERS entries: (controller name, knob name, line)."""
+        out: List[Tuple[str, str, int]] = []
+        val = _module_literal(tree, "CONTROLLERS")
+        if not isinstance(val, (ast.Tuple, ast.List)):
+            return out
+        for elt in val.elts:
+            if not isinstance(elt, ast.Dict):
+                continue
+            fields: Dict[str, ast.expr] = {
+                k.value: v for k, v in zip(elt.keys, elt.values)
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)}
+            name_node = fields.get("name")
+            cname = name_node.value if (
+                isinstance(name_node, ast.Constant)
+                and isinstance(name_node.value, str)) else "?"
+            knobs_node = fields.get("knobs")
+            if isinstance(knobs_node, (ast.Tuple, ast.List)):
+                for kn in knobs_node.elts:
+                    if isinstance(kn, ast.Constant) \
+                            and isinstance(kn.value, str):
+                        out.append((cname, kn.value, kn.lineno))
+        return out
+
+    def _documented(self, repo: RepoIndex, env: str) -> bool:
+        for relpath in self.knob_docs:
+            sf = repo.get(relpath)
+            if sf is not None and env in sf.text:
+                return True
+        return False

@@ -30,6 +30,7 @@ HATCHES: Sequence[Tuple[str, Tuple[str, ...]]] = (
     ("GUBER_PROFILE", ("profile_enabled",)),
     ("GUBER_LOCK_WITNESS", ("lock_witness", "witness_enabled")),
     ("GUBER_LEDGER", ("ledger_enabled",)),
+    ("GUBER_AUTOPILOT", ("autopilot",)),
 )
 
 DIFF_RE = re.compile(

@@ -292,9 +292,11 @@ def main(argv=None) -> int:
             "on" if conf.behaviors.degraded_local else "off")
     if conf.behaviors.max_pending > 0:
         log.info(
-            "admission control: max_pending=%d (brownout at 75%%) "
+            "admission control: max_pending=%d (brownout at %.0f%%) "
             "default_deadline_ms=%.0f min_hop_budget_ms=%.1f",
-            conf.behaviors.max_pending, conf.behaviors.default_deadline_ms,
+            conf.behaviors.max_pending,
+            conf.behaviors.brownout_fraction * 100.0,
+            conf.behaviors.default_deadline_ms,
             conf.behaviors.min_hop_budget_ms)
     else:
         log.warning(
@@ -427,6 +429,16 @@ def main(argv=None) -> int:
     # autotune winner), so both protocols share one pipelining decision;
     # GUBER_COLUMNAR_PIPELINE=0 pins just the wire path lock-step
     columnar_depth = instance.combiner.depth if columnar_pipe else 1
+    # autopilot ticker AFTER autotune so the pipeline controller's
+    # baseline is the probed depth, not the pre-probe placeholder
+    if instance.autopilot.enabled:
+        instance.autopilot.start()
+        log.info("autopilot ON (GUBER_AUTOPILOT=1): interval=%.1fs "
+                 "dwell=%.1fs cooldown=%.1fs — bounded closed-loop "
+                 "control over max_pending / hot-lease / keyspace "
+                 "cadence / pipeline depth (docs/OPERATIONS.md Autopilot)",
+                 instance.autopilot.interval_s, instance.autopilot.dwell_s,
+                 instance.autopilot.cooldown_s)
     if multi_host:
         # cross-host GLOBAL aggregation rides the device fabric: one
         # lockstep collective per tick replaces the per-peer gRPC pipelines

@@ -296,6 +296,8 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
     b.min_hop_budget_ms = _env_float("GUBER_MIN_HOP_BUDGET_MS",
                                      b.min_hop_budget_ms)
     b.max_pending = _env_int("GUBER_MAX_PENDING", b.max_pending)
+    b.brownout_fraction = _env_float("GUBER_BROWNOUT_FRACTION",
+                                     b.brownout_fraction)
 
     # hot-key lease tier (service/leases.py)
     b.hot_leases = _env_bool("GUBER_HOT_LEASES")
@@ -312,6 +314,19 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
     b.reshard_chunk_rows = _env_int("GUBER_RESHARD_CHUNK_ROWS",
                                     b.reshard_chunk_rows)
     b.reshard_grace_s = _env_dur("GUBER_RESHARD_GRACE", b.reshard_grace_s)
+
+    # autopilot (service/autopilot.py): bounded closed-loop control.
+    # GUBER_AUTOPILOT resolved here (not left None) so the daemon and
+    # every harness-spawned node see one consistent answer.
+    b.autopilot = _env_bool("GUBER_AUTOPILOT")
+    b.autopilot_interval_s = _env_dur("GUBER_AUTOPILOT_INTERVAL",
+                                      b.autopilot_interval_s)
+    b.autopilot_dwell_s = _env_dur("GUBER_AUTOPILOT_DWELL",
+                                   b.autopilot_dwell_s)
+    b.autopilot_cooldown_s = _env_dur("GUBER_AUTOPILOT_COOLDOWN",
+                                      b.autopilot_cooldown_s)
+    b.autopilot_freeze_hold_s = _env_dur("GUBER_AUTOPILOT_FREEZE_HOLD",
+                                         b.autopilot_freeze_hold_s)
 
     conf = DaemonConfig(
         grpc_address=_env_str("GUBER_GRPC_ADDRESS", "0.0.0.0:81"),
@@ -444,6 +459,26 @@ def config_from_env(args: Optional[List[str]] = None) -> DaemonConfig:
         raise ValueError(
             f"'GUBER_MAX_PENDING={b.max_pending}' is invalid; "
             "must be >= 0 (0 disables admission control)")
+    if not 0.0 < b.brownout_fraction <= 1.0:
+        raise ValueError(
+            f"'GUBER_BROWNOUT_FRACTION={b.brownout_fraction}' is invalid; "
+            "must be a fraction in (0, 1]")
+    if b.autopilot_interval_s <= 0:
+        raise ValueError(
+            f"'GUBER_AUTOPILOT_INTERVAL={b.autopilot_interval_s}' is "
+            "invalid; must be a positive duration")
+    if b.autopilot_dwell_s <= 0:
+        raise ValueError(
+            f"'GUBER_AUTOPILOT_DWELL={b.autopilot_dwell_s}' is invalid; "
+            "must be a positive duration")
+    if b.autopilot_cooldown_s <= 0:
+        raise ValueError(
+            f"'GUBER_AUTOPILOT_COOLDOWN={b.autopilot_cooldown_s}' is "
+            "invalid; must be a positive duration")
+    if b.autopilot_freeze_hold_s < 0:
+        raise ValueError(
+            f"'GUBER_AUTOPILOT_FREEZE_HOLD={b.autopilot_freeze_hold_s}' is "
+            "invalid; must be >= 0 seconds")
     if conf.flight_recorder_capacity < 16:
         raise ValueError(
             f"'GUBER_FLIGHT_RECORDER_CAPACITY="

@@ -27,7 +27,11 @@ from typing import Optional
 # audit plane, obs/ledger.py) — per-authority admit totals, minted
 # budget, and violation counts are promised on every Instance;
 # "enabled" inside it tracks GUBER_LEDGER.
-DEBUG_VARS_SCHEMA_VERSION = 5
+# v6: always-present "autopilot" section (bounded closed-loop control
+# plane, service/autopilot.py) — per-controller engagement/dwell/freeze
+# state, knob bands, and the move/clamp/freeze counters are promised on
+# every Instance; "enabled" inside it tracks GUBER_AUTOPILOT.
+DEBUG_VARS_SCHEMA_VERSION = 6
 
 
 def _backend_vars(backend) -> dict:
@@ -173,6 +177,17 @@ def debug_vars(instance) -> dict:
                          "windows_rolled": 0, "violations": 0,
                          "overshoot": {}, "keys_tracked": 0,
                          "pending_windows": 0, "audits": 0}
+
+    ap = getattr(instance, "autopilot", None)
+    if ap is not None:
+        out["autopilot"] = ap.debug()
+    else:
+        # the section is promised (v6) even on stub wirings with no
+        # autopilot — a disabled, empty shape keeps consumers branch-free
+        out["autopilot"] = {"enabled": False, "frozen": False,
+                            "freeze_reason": None, "ticks": 0, "moves": 0,
+                            "clamps": 0, "freezes": 0, "frozen_drops": 0,
+                            "controllers": {}}
 
     tracer = getattr(instance, "tracer", None)
     if tracer is not None:

@@ -25,6 +25,7 @@ from gubernator_tpu.obs import witness
 from gubernator_tpu.obs.anomaly import DETECTORS
 from gubernator_tpu.scenarios.generator import WorkloadGenerator, windowed
 from gubernator_tpu.scenarios.spec import (
+    AUTOPILOT_PROFILES,
     SCENARIO_NAMES,
     ScenarioSpec,
     get_scenario,
@@ -146,17 +147,47 @@ class _EventThread:
                 if i not in dead]
 
 
+def _knob_sample(instance) -> dict:
+    """The controller-actuated knob values one node is serving with
+    right now — the per-segment trajectory SCEN_r*.json records so a
+    reviewer can see WHAT the autopilot did, not just the outcome."""
+    beh = instance.conf.behaviors
+    out = {
+        "max_pending": getattr(beh, "max_pending", None),
+        "brownout_fraction": getattr(beh, "brownout_fraction", None),
+        "hot_lease_fraction": getattr(beh, "hot_lease_fraction", None),
+        "hot_lease_ttl_s": getattr(beh, "hot_lease_ttl_s", None),
+        "keyspace_interval_s": getattr(
+            getattr(instance, "keyspace", None), "interval_s", None),
+        "pipeline_depth": getattr(
+            getattr(instance, "combiner", None), "depth", None),
+    }
+    ap = getattr(instance, "autopilot", None)
+    if ap is not None:
+        out["autopilot_moves"] = ap.moves
+        out["autopilot_frozen"] = ap.frozen
+    return out
+
+
 def run_scenario(spec: ScenarioSpec, cluster=None, profile: str = "short",
-                 window_s: float = BATCH_WINDOW_S) -> dict:
+                 window_s: float = BATCH_WINDOW_S,
+                 autopilot: bool = False) -> dict:
     """Run one scenario and return its machine-readable verdict. Boots
     (and tears down) a LocalCluster of spec.nodes when none is given;
-    a caller-provided cluster is reused and left running."""
+    a caller-provided cluster is reused and left running. `autopilot`
+    layers the profile's AUTOPILOT_PROFILES overlay onto the cluster
+    behaviors (arming the closed-loop controllers with dwell/cooldown
+    clocks compressed to match the profile's time scale)."""
     scaled = spec.for_profile(profile)
     scaled.validate()
     schedule = WorkloadGenerator(scaled).schedule()
 
     own_cluster = cluster is None
     behaviors = _cluster_behaviors(scaled)
+    if autopilot:
+        overlay = AUTOPILOT_PROFILES.get(profile, AUTOPILOT_PROFILES["full"])
+        for field, value in overlay.items():
+            setattr(behaviors, field, value)
     if own_cluster:
         from gubernator_tpu.cluster.harness import LocalCluster
 
@@ -176,6 +207,16 @@ def run_scenario(spec: ScenarioSpec, cluster=None, profile: str = "short",
         max_lag_s = 0.0
         rr = 0
         last_sweep = anchor
+        # per-segment knob trajectory: sampled at every segment boundary
+        # (plus a final sample) so SCEN_r*.json shows what the autopilot
+        # actually moved, window by window
+        seg_ends: List[float] = []
+        acc = 0.0
+        for seg in scaled.segments:
+            acc += seg.duration_s
+            seg_ends.append(acc)
+        seg_idx = 0
+        knob_trajectory: List[dict] = []
         for start_s, arrivals in windowed(schedule, window_s):
             delay = anchor + start_s - time.monotonic()
             if delay > 0:
@@ -183,6 +224,22 @@ def run_scenario(spec: ScenarioSpec, cluster=None, profile: str = "short",
             else:
                 max_lag_s = max(max_lag_s, -delay)
             live = events.live_instances()
+            if autopilot:
+                # controller sweeps ride the pacing clock the same way
+                # scrapes drive them in production (maybe_tick self-gates
+                # on the autopilot interval)
+                for li in live:
+                    try:
+                        li.autopilot.maybe_tick()
+                    except Exception:  # noqa: BLE001
+                        pass
+            while seg_idx < len(seg_ends) and start_s >= seg_ends[seg_idx]:
+                seg_idx += 1
+            if live and (not knob_trajectory
+                         or knob_trajectory[-1]["segment"] != seg_idx):
+                knob_trajectory.append({
+                    "segment": seg_idx, "at_s": round(start_s, 3),
+                    "knobs": _knob_sample(live[0])})
             if not live:
                 errors += len(arrivals)
                 continue
@@ -215,6 +272,13 @@ def run_scenario(spec: ScenarioSpec, cluster=None, profile: str = "short",
         events.join()
         time.sleep(0.2)  # let in-flight async work land before the sweep
         now = time.monotonic()
+        final_live = events.live_instances()
+        if final_live:
+            knob_trajectory.append({
+                "segment": seg_idx,
+                "at_s": round(now - anchor, 3),
+                "final": True,
+                "knobs": _knob_sample(final_live[0])})
         tripped: Dict[str, int] = {}
         conservation: Dict[str, int] = {
             "nodes_audited": 0, "windows_audited": 0, "violations": 0,
@@ -274,6 +338,8 @@ def run_scenario(spec: ScenarioSpec, cluster=None, profile: str = "short",
         "detectors_tripped": tripped,
         "conservation": conservation,
         "events": events.fired,
+        "autopilot": bool(autopilot),
+        "knob_trajectory": knob_trajectory,
     }
     return render_verdict(scaled, stats, profile=profile)
 
@@ -346,16 +412,18 @@ def render_verdict(spec: ScenarioSpec, stats: dict,
 
 
 def run_atlas(names: Optional[Sequence[str]] = None,
-              profile: str = "short") -> dict:
+              profile: str = "short", autopilot: bool = False) -> dict:
     """Run (a subset of) the atlas, one fresh cluster per scenario, and
     return {"scenarios": {...}, "passed": bool}."""
     names = list(names or SCENARIO_NAMES)
     out: Dict[str, dict] = {}
     for name in names:
-        out[name] = run_scenario(get_scenario(name), profile=profile)
+        out[name] = run_scenario(get_scenario(name), profile=profile,
+                                 autopilot=autopilot)
     return {
         "schema_version": VERDICT_SCHEMA_VERSION,
         "profile": profile,
+        "autopilot": bool(autopilot),
         "scenarios": out,
         "passed": all(v["passed"] for v in out.values()),
     }

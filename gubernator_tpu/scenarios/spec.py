@@ -253,6 +253,24 @@ SCENARIO_NAMES = (
     "rolling-restart",
 )
 
+# Autopilot overlays (service/autopilot.py): behavior fields layered on
+# top of a scenario's own behaviors when the runner is asked to drive
+# the shape with the autopilot armed. Compressed profiles need the
+# control clocks compressed the same way the workload is — the "short"
+# profile squeezes a minute-scale incident into ~2-4 s, so dwell and
+# cooldown shrink with it or no controller could ever engage in-run.
+AUTOPILOT_PROFILES: Dict[str, Dict[str, object]] = {
+    "short": {"autopilot": True, "autopilot_interval_s": 0.05,
+              "autopilot_dwell_s": 0.15, "autopilot_cooldown_s": 0.3,
+              "autopilot_freeze_hold_s": 0.5},
+    "medium": {"autopilot": True, "autopilot_interval_s": 0.25,
+               "autopilot_dwell_s": 1.0, "autopilot_cooldown_s": 2.0,
+               "autopilot_freeze_hold_s": 1.0},
+    "full": {"autopilot": True, "autopilot_interval_s": 1.0,
+             "autopilot_dwell_s": 5.0, "autopilot_cooldown_s": 10.0,
+             "autopilot_freeze_hold_s": 5.0},
+}
+
 
 def _diurnal_tide() -> ScenarioSpec:
     # A compressed day: trough -> morning ramp -> plateau -> evening

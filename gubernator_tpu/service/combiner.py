@@ -249,6 +249,32 @@ class BackendCombiner:
                  best_d, (best_t or 0) * 1e3)
         return best_d
 
+    def set_depth(self, depth: int) -> int:
+        """Runtime depth re-tune (service/autopilot.py pipeline
+        controller). Only honored while the pipeline is active AND the
+        depth was env 'auto' — a pinned depth is operator intent the
+        autopilot must not override. Safe with launches in flight:
+        every launch carries the semaphore it acquired inside its
+        handle tuple and the drainer releases THAT object, so swapping
+        self._slots/_staging here never double-frees a slot; the
+        in-flight bound is transiently old-depth + new-depth, and the
+        fresh staging dicts can never alias buffers still draining."""
+        d = max(1, int(depth))
+        if not self._pipelined or not self._depth_auto:
+            return self._depth
+        with self._cond:
+            if d == self._depth:
+                return d
+            self._depth = d
+            self._slots = threading.Semaphore(d)
+            self._staging = [dict() for _ in range(d + 2)]
+            self._cond.notify()
+        m = self._metrics
+        if m is not None and hasattr(m, "combiner_pipeline_depth"):
+            m.combiner_pipeline_depth.set(d)
+        log.info("pipeline depth re-tuned to %d", d)
+        return d
+
     def submit(
         self, reqs: Sequence[RateLimitReq], now_ms: Optional[int] = None
     ) -> List[RateLimitResp]:

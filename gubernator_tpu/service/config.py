@@ -79,6 +79,11 @@ class BehaviorConfig:
     # (RESOURCE_EXHAUSTED). 0 disables admission control entirely —
     # behavior is then bit-identical to the pre-admission code.
     max_pending: int = 8192
+    # GUBER_BROWNOUT_FRACTION: the fraction of max_pending at which the
+    # admission controller browns out (sheds non-owner forwards and
+    # GLOBAL broadcasts). Read live per check, so both operators and the
+    # autopilot's admission controller can tune it without a restart.
+    brownout_fraction: float = 0.75
 
     # hot-key lease tier (service/leases.py; docs/OPERATIONS.md
     # "Skew & leases"). GUBER_HOT_LEASES turns the whole tier on; off
@@ -120,6 +125,26 @@ class BehaviorConfig:
     # yet (it may still be planning); after it, gained keys without a
     # session serve fresh.
     reshard_grace_s: float = 1.0
+
+    # autopilot (service/autopilot.py; docs/OPERATIONS.md "Autopilot"):
+    # bounded closed-loop controllers that drive the serving knobs from
+    # live telemetry. None defers to GUBER_AUTOPILOT at wiring time
+    # (default OFF — every hook is then one attribute test and the
+    # decision stream bit-identical to static knobs,
+    # tests/test_autopilot.py differential).
+    autopilot: Optional[bool] = None
+    # GUBER_AUTOPILOT_INTERVAL: sweep cadence, seconds.
+    autopilot_interval_s: float = 1.0
+    # GUBER_AUTOPILOT_DWELL: minimum continuous time a signal must hold
+    # past a trip (or below a clear) threshold before a controller
+    # engages (or disengages) — the hysteresis dwell.
+    autopilot_dwell_s: float = 5.0
+    # GUBER_AUTOPILOT_COOLDOWN: minimum seconds between two moves of the
+    # same knob — the actuation rate limit.
+    autopilot_cooldown_s: float = 10.0
+    # GUBER_AUTOPILOT_FREEZE_HOLD: how long a membership flip freezes
+    # all actuation (reshard transfers freeze for their whole flight).
+    autopilot_freeze_hold_s: float = 5.0
 
 
 @dataclasses.dataclass
@@ -207,6 +232,20 @@ class InstanceConfig:
         if self.behaviors.max_pending < 0:
             raise ValueError("behaviors.max_pending cannot be negative "
                              "(0 disables admission control)")
+        if not 0.0 < self.behaviors.brownout_fraction <= 1.0:
+            raise ValueError(
+                "behaviors.brownout_fraction must be in (0, 1]")
+        if self.behaviors.autopilot_interval_s <= 0:
+            raise ValueError(
+                "behaviors.autopilot_interval_s must be positive")
+        if self.behaviors.autopilot_dwell_s <= 0:
+            raise ValueError("behaviors.autopilot_dwell_s must be positive")
+        if self.behaviors.autopilot_cooldown_s <= 0:
+            raise ValueError(
+                "behaviors.autopilot_cooldown_s must be positive")
+        if self.behaviors.autopilot_freeze_hold_s < 0:
+            raise ValueError(
+                "behaviors.autopilot_freeze_hold_s cannot be negative")
         if self.behaviors.hot_lease_rate <= 0:
             raise ValueError("behaviors.hot_lease_rate must be positive")
         if self.behaviors.hot_lease_window_s <= 0:

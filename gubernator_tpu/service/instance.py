@@ -38,6 +38,7 @@ from gubernator_tpu.obs.history import MetricsHistory
 from gubernator_tpu.obs.keyspace import KeyspaceCartographer
 from gubernator_tpu.obs.trace import Tracer
 from gubernator_tpu.service import deadline as deadline_mod
+from gubernator_tpu.service.autopilot import Autopilot
 from gubernator_tpu.service.combiner import BackendCombiner
 from gubernator_tpu.service.deadline import (
     AdmissionRejectedError,
@@ -119,6 +120,8 @@ class AdmissionController:
     future hot-reload can tune a running node."""
 
     ADMIT, BROWNOUT, SATURATED = 0, 1, 2
+    # fallback when the BehaviorConfig predates brownout_fraction; the
+    # live knob is GUBER_BROWNOUT_FRACTION (brownout_fraction property)
     BROWNOUT_FRACTION = 0.75
     RETRY_AFTER_S = 1.0
 
@@ -137,6 +140,15 @@ class AdmissionController:
     @property
     def max_pending(self) -> int:
         return getattr(self.instance.conf.behaviors, "max_pending", 0)
+
+    @property
+    def brownout_fraction(self) -> float:
+        """Live brownout threshold (GUBER_BROWNOUT_FRACTION): the
+        fraction of max_pending past which non-owner forwards and
+        GLOBAL broadcasts shed. Read per check so operators (and the
+        autopilot) can tune a running node."""
+        return getattr(self.instance.conf.behaviors, "brownout_fraction",
+                       self.BROWNOUT_FRACTION)
 
     @property
     def enabled(self) -> bool:
@@ -162,7 +174,7 @@ class AdmissionController:
         pending = self.pending()
         if pending >= cap:
             lvl = self.SATURATED
-        elif pending >= cap * self.BROWNOUT_FRACTION:
+        elif pending >= cap * self.brownout_fraction:
             lvl = self.BROWNOUT
         else:
             lvl = self.ADMIT
@@ -363,6 +375,13 @@ class Instance:
             slo_objective=conf.slo_objective,
             history=self.history,
             capacity_horizon_s=conf.capacity_horizon_s)
+        # autopilot (service/autopilot.py): bounded closed-loop
+        # controllers over the live knobs. Always constructed so every
+        # hook is one attribute test; GUBER_AUTOPILOT (or
+        # behaviors.autopilot) arms it — off, the decision stream is
+        # bit-identical to static knobs.
+        self.autopilot = Autopilot(
+            self, metrics=conf.metrics, recorder=self.recorder)
         self._closed = False
 
     def attach_collective(self, sync, group_peers=None) -> None:
@@ -750,6 +769,7 @@ class Instance:
         if self._closed:
             return
         self._closed = True
+        self.autopilot.stop()
         self.reshard.stop()
         self.anomaly.stop()
         self.history.stop()

@@ -542,6 +542,47 @@ class Metrics:
             "-1 = not projectable / not growing).",
             registry=self.registry,
         )
+        # autopilot (service/autopilot.py; docs/observability.md
+        # "Autopilot"). The scrape drives maybe_tick for threadless
+        # deployments (same contract as anomaly.maybe_check).
+        self.autopilot_moves = Counter(
+            "autopilot_moves_total",
+            "Knob moves the autopilot actually applied, by controller "
+            "and knob (every one is also an autopilot.move recorder "
+            "event with the triggering signal attached).",
+            ["controller", "knob"], registry=self.registry,
+        )
+        self.autopilot_clamps = Counter(
+            "autopilot_clamps_total",
+            "Autopilot move proposals limited by a knob's declared "
+            "[floor, ceiling] band or absolute validity range.",
+            ["controller", "knob"], registry=self.registry,
+        )
+        self.autopilot_freezes = Counter(
+            "autopilot_freezes_total",
+            "Actuation freeze windows entered (reshard transfer in "
+            "flight or membership flip); frozen intents are dropped.",
+            registry=self.registry,
+        )
+        self.autopilot_frozen = Gauge(
+            "autopilot_frozen",
+            "1 while the autopilot is holding all knobs still (reshard "
+            "transfer or membership-change hold window).",
+            registry=self.registry,
+        )
+        self.autopilot_engaged = Gauge(
+            "autopilot_engaged",
+            "Per-controller engagement state (1 = the controller's "
+            "signal tripped and held past the dwell; it is steering its "
+            "knobs toward the engaged side of the band).",
+            ["controller"], registry=self.registry,
+        )
+        self.autopilot_knob = Gauge(
+            "autopilot_knob",
+            "Live value of each controller-actuated knob (the same "
+            "value the serving path reads from conf.behaviors).",
+            ["knob"], registry=self.registry,
+        )
         self.request_budget_ms = Histogram(
             "request_budget_ms",
             "Deadline budget observed at capture, by surface (public = "
@@ -867,6 +908,15 @@ class Metrics:
                 d.get("burn_fast", 0.0))
             self.slo_burn_rate.labels(window="slow").set(
                 d.get("burn_slow", 0.0))
+        ap = getattr(instance, "autopilot", None)
+        if ap is not None and ap.enabled:
+            try:
+                # scrapes double as the controller tick for threadless
+                # deployments (same contract as anomaly.maybe_check);
+                # the tick itself refreshes the autopilot gauges
+                ap.maybe_tick()
+            except Exception:  # noqa: BLE001 — control must not break
+                pass           # /metrics
         bw = getattr(instance, "bundle_writer", None)
         if bw is not None:
             self._set_counter(

@@ -25,7 +25,9 @@ HARD with zero tolerance (a shape that passed its SLO envelope last
 round and fails it now is a regression regardless of rig weather),
 while the rest of `scenarios.*` (per-scenario latency/goodput numbers)
 is operating-point context — the envelope judgment already happened
-inside the verdict itself.
+inside the verdict itself. Autopilot-armed verdicts
+(`scenarios.<name>@autopilot.verdict_pass`) are gated at the SAME zero
+tolerance as the static-knob ones.
 
 Baseline keys (`serial_*`, `lockstep*`, `baseline_*`) are excluded — a
 slower comparison baseline is not a product regression. The whole
@@ -120,7 +122,13 @@ def _is_scenario_verdict(key):
     """scenarios.<name>.verdict_pass — the atlas PASS/FAIL bit. Gated
     hard with zero tolerance: a scenario flipping 1 -> 0 across rounds
     means a traffic shape the last round served inside its SLO envelope
-    no longer does, which is a regression regardless of rig weather."""
+    no longer does, which is a regression regardless of rig weather.
+    Autopilot-armed runs (`scenarios.<name>@autopilot.verdict_pass`,
+    scripts/scenario_report.py --autopilot both) match this same
+    pattern DELIBERATELY: a shape the closed-loop controllers served
+    inside its envelope last round gets exactly the zero tolerance the
+    static-knob verdicts get — the autopilot is not allowed to be a
+    flakiness excuse."""
     return key.startswith("scenarios.") and key.endswith(".verdict_pass")
 
 

@@ -12,9 +12,15 @@ Usage:
     python scripts/scenario_report.py                  # short atlas
     python scripts/scenario_report.py --profile full   # 870s-scale drills
     python scripts/scenario_report.py --scenario bot-storm --scenario ...
+    python scripts/scenario_report.py --autopilot both # off + on per shape
     python scripts/scenario_report.py --replay trace.json
     python scripts/scenario_report.py --list
     python scripts/scenario_report.py --out SCEN_r02.json
+
+With --autopilot both, each shape runs twice on the same seed — static
+knobs, then GUBER_AUTOPILOT-armed via the spec overlay — and the armed
+run is keyed "<name>@autopilot", which bench_check gates at the same
+zero tolerance as the plain verdicts.
 """
 
 import argparse
@@ -57,6 +63,12 @@ def main(argv=None) -> int:
     ap.add_argument("--replay", metavar="TRACE.json",
                     help="also replay a /v1/debug/capture trace file as "
                          "an extra scenario")
+    ap.add_argument("--autopilot", default="off",
+                    choices=("off", "on", "both"),
+                    help="arm the closed-loop controllers: on = every "
+                         "shape runs autopilot-armed; both = each shape "
+                         "runs off AND on (same seed), the armed verdict "
+                         "keyed '<name>@autopilot'")
     ap.add_argument("--out", help="artifact path (default: next SCEN_r<NN>)")
     ap.add_argument("--list", action="store_true",
                     help="print the atlas and exit")
@@ -72,10 +84,19 @@ def main(argv=None) -> int:
     names = args.scenario or list(SCENARIO_NAMES)
     verdicts = {}
     for name in names:
-        print(f"scenario {name} [{args.profile}] ...", flush=True)
-        v = run_scenario(get_scenario(name), profile=args.profile)
-        verdicts[name] = v
-        _print_verdict(v)
+        if args.autopilot in ("off", "both"):
+            print(f"scenario {name} [{args.profile}] ...", flush=True)
+            v = run_scenario(get_scenario(name), profile=args.profile)
+            verdicts[name] = v
+            _print_verdict(v)
+        if args.autopilot in ("on", "both"):
+            key = name if args.autopilot == "on" else f"{name}@autopilot"
+            print(f"scenario {key} [{args.profile}] autopilot ...",
+                  flush=True)
+            v = run_scenario(get_scenario(name), profile=args.profile,
+                             autopilot=True)
+            verdicts[key] = v
+            _print_verdict(v)
     if args.replay:
         from gubernator_tpu.obs.capture import load_trace
 
@@ -88,6 +109,7 @@ def main(argv=None) -> int:
     doc = {
         "schema_version": 1,
         "profile": args.profile,
+        "autopilot": args.autopilot,
         "scenarios": verdicts,
         "passed": all(v["passed"] for v in verdicts.values()),
     }

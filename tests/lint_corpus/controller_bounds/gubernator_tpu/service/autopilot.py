@@ -1,0 +1,41 @@
+"""Fake autopilot registry for the controller-bounds corpus.
+
+Mirrors the real module's contract: module-level KNOBS dict of KnobSpec
+literals plus a CONTROLLERS tuple of dicts. One good knob, three
+deliberate violations, one waived twin, and a controller wired to a
+knob the registry never declared.
+"""
+
+
+class KnobSpec:
+    def __init__(self, **kw):
+        self.kw = kw
+
+
+KNOBS = {
+    # clean: full band, positive step, documented env
+    "good_knob": KnobSpec(name="good_knob", env="GUBER_CORPUS_GOOD",
+                          floor=0.5, ceiling=2.0, step=0.25),
+    # bad: no step declared — unbounded move size
+    "stepless_knob": KnobSpec(name="stepless_knob",
+                              env="GUBER_CORPUS_GOOD",
+                              floor=0.5, ceiling=2.0),
+    # bad: floor above ceiling — empty band
+    "inverted_knob": KnobSpec(name="inverted_knob",
+                              env="GUBER_CORPUS_GOOD",
+                              floor=2.0, ceiling=0.5, step=0.25),
+    # bad: env knob no operator doc mentions
+    "ghost_env_knob": KnobSpec(name="ghost_env_knob",
+                               env="GUBER_CORPUS_GHOST",
+                               floor=0.5, ceiling=2.0, step=0.25),
+    # same stepless bug as above, behind a justified waiver
+    # guberlint: disable=controller-bounds -- corpus waived twin proving suppression
+    "waived_knob": KnobSpec(name="waived_knob", env="GUBER_CORPUS_GOOD",
+                            floor=0.5, ceiling=2.0),
+}
+
+CONTROLLERS = (
+    {"name": "corpus", "knobs": ("good_knob", "unregistered_knob"),
+     "side": "ceiling", "signal": "corpus.signal",
+     "trip": 0.5, "clear": 0.25},
+)

@@ -1,0 +1,539 @@
+"""Autopilot: bounded closed-loop controllers (service/autopilot.py).
+
+Four layers:
+
+- differential: GUBER_AUTOPILOT=0 (the default) is bit-identical on the
+  serving path — the SAME request stream through an autopilot-on and an
+  autopilot-off instance produces byte-identical decisions (the armed
+  instance ticking between frames), and the off node's counters stay
+  all-zero (the hatch removes the plane, it does not merely silence it);
+- hysteresis & bound proofs: a controller never moves a knob outside its
+  declared [floor, ceiling] band, never moves the same knob twice inside
+  one cooldown, and a signal flapping at the trip threshold accumulates
+  no dwell credit — zero engages, zero moves, however long it flaps;
+- audit trail: every `autopilot.move` flight-recorder event carries the
+  triggering signal, old -> new, and the clamp band; out-of-band values
+  step back under an `autopilot.clamp` event;
+- freeze drills (chaos-marked): no knob move lands between
+  `reshard.plan` and `reshard.committed` in the event stream, a
+  membership flip freezes actuation for the hold window, and intents
+  accumulated before a freeze are DROPPED — thawing never replays a
+  stale pre-freeze decision.
+
+Plus the satellite knobs: GUBER_BROWNOUT_FRACTION moves the live
+admission brownout threshold, the envconf surface parses/validates, and
+the scenario runner's knob trajectory records what the controllers did.
+"""
+
+import time
+
+import pytest
+
+from gubernator_tpu.models.engine import Engine
+from gubernator_tpu.service.autopilot import EV_CLAMP, EV_FREEZE, EV_MOVE
+from gubernator_tpu.service.config import BehaviorConfig, InstanceConfig
+from gubernator_tpu.service.instance import Instance
+from gubernator_tpu.types import (
+    Algorithm,
+    PeerInfo,
+    RateLimitReq,
+    Status,
+)
+
+
+def _rl(key, hits=1, limit=1000, duration=3_600_000):
+    return RateLimitReq(name="ap", unique_key=key, hits=hits, limit=limit,
+                        duration=duration,
+                        algorithm=Algorithm.TOKEN_BUCKET)
+
+
+def _single(**beh):
+    """A self-owned single instance: every request serves locally."""
+    beh.setdefault("autopilot", True)
+    # hold 0 so the boot set_peers doesn't freeze the synthetic clock
+    beh.setdefault("autopilot_freeze_hold_s", 0.0)
+    inst = Instance(InstanceConfig(backend=Engine(capacity=4096),
+                                   behaviors=BehaviorConfig(**beh)),
+                    advertise_address="127.0.0.1:1")
+    inst.set_peers([PeerInfo(address="127.0.0.1:1")])
+    return inst
+
+
+def _ctl(inst, name):
+    for c in inst.autopilot.controllers:
+        if c.name == name:
+            return c
+    raise AssertionError(name)
+
+
+def _moves(inst, knob=None):
+    evs = inst.recorder.tail(kind=EV_MOVE)
+    if knob:
+        evs = [e for e in evs if e["knob"] == knob]
+    return evs
+
+
+# --------------------------------------------------------- differential
+
+
+class TestEscapeHatchDifferential:
+    """GUBER_AUTOPILOT=0 must remove the plane, not degrade serving."""
+
+    def test_decisions_bit_identical_autopilot_on_vs_off(self):
+        """Differential: the same stream through an armed (and ticking)
+        and an unarmed instance yields bit-identical responses, and the
+        off node's autopilot counters are ALL zero afterwards."""
+        on, off = _single(autopilot=True), _single(autopilot=False)
+        try:
+            frames = [
+                [_rl(f"k{j}", hits=1, limit=5) for j in range(16)]
+                for _ in range(12)
+            ]
+            for frame in frames:
+                on.autopilot.tick()  # armed AND ticking between frames
+                ra = on.get_rate_limits(frame)
+                rb = off.get_rate_limits(frame)
+                for a, b in zip(ra, rb):
+                    assert (a.status, a.limit, a.remaining, a.error) == \
+                           (b.status, b.limit, b.remaining, b.error)
+                    # reset encodes each instance's window birth time;
+                    # the two instances booted milliseconds apart
+                    assert abs(a.reset_time - b.reset_time) < 5_000
+            # the stream crossed the limit: both rejected identically
+            assert any(r.status == Status.OVER_LIMIT
+                       for r in on.get_rate_limits(frames[0]))
+
+            # quiet signals: the armed plane ticked but moved nothing
+            assert on.autopilot.ticks >= len(frames)
+            assert on.autopilot.moves == 0
+            # hatch off: every counter stayed zero, every hook inert
+            s = off.autopilot.stats()
+            assert not off.autopilot.enabled
+            assert all(v == 0 for v in s.values()), s
+            off.autopilot.maybe_tick()
+            assert off.autopilot.ticks == 0
+            assert off.recorder.tail(kind="autopilot") == []
+        finally:
+            on.close()
+            off.close()
+
+
+# ------------------------------------------------- hysteresis & bounds
+
+
+class TestHysteresisAndBounds:
+    def test_flapping_signal_never_engages_never_moves(self):
+        """A signal oscillating across the trip threshold faster than
+        the dwell accumulates no credit: any dip below trip restarts
+        the clock, so an arbitrarily long flap yields zero engages."""
+        inst = _single(autopilot_dwell_s=1.0, autopilot_cooldown_s=0.1)
+        try:
+            ctl = _ctl(inst, "hotkey")
+            flap = {"hi": True}
+
+            def sense():
+                flap["hi"] = not flap["hi"]
+                return 0.9 if flap["hi"] else 0.0
+
+            ctl.sense = sense
+            base = time.monotonic() + 5.0
+            for i in range(100):  # 30 s of flapping at 0.3 s < 1 s dwell
+                inst.autopilot.tick(base + i * 0.3)
+            assert ctl.engages == 0
+            assert not ctl.engaged
+            assert inst.autopilot.moves == 0
+            assert _moves(inst) == []
+        finally:
+            inst.close()
+
+    def test_band_never_exceeded_and_step_bounded(self):
+        """Engaged admission controller walks max_pending up in bounded
+        steps and parks exactly at baseline*ceiling — never one unit
+        above, no matter how long the signal stays pinned."""
+        inst = _single(max_pending=100, autopilot_dwell_s=0.5,
+                       autopilot_cooldown_s=0.2)
+        try:
+            ctl = _ctl(inst, "admission")
+            ctl.sense = lambda: 1.0  # pinned over the brownout trip
+            beh = inst.conf.behaviors
+            base = time.monotonic() + 5.0
+            seen = []
+            for i in range(40):
+                prev = beh.max_pending
+                inst.autopilot.tick(base + i * 0.3)
+                seen.append(beh.max_pending)
+                # one bounded step: spec.step = 0.25 of the 100 baseline
+                assert beh.max_pending - prev <= 25
+            assert all(v <= 200 for v in seen), seen  # ceiling = 2.0x
+            assert seen[-1] == 200  # parked at the band edge
+            assert ctl.engaged
+        finally:
+            inst.close()
+
+    def test_no_two_moves_of_one_knob_within_cooldown(self):
+        inst = _single(max_pending=100, autopilot_dwell_s=0.2,
+                       autopilot_cooldown_s=2.0)
+        try:
+            _ctl(inst, "admission").sense = lambda: 1.0
+            ks = _ctl(inst, "admission").knobs["max_pending"]
+            base = time.monotonic() + 5.0
+            move_times = []
+            for i in range(60):  # tick every 0.1 s, far under cooldown
+                now = base + i * 0.1
+                before = ks.moves
+                inst.autopilot.tick(now)
+                if ks.moves > before:
+                    move_times.append(now)
+            assert len(move_times) >= 2  # the walk did happen
+            gaps = [b - a for a, b in zip(move_times, move_times[1:])]
+            assert all(g >= 2.0 - 1e-9 for g in gaps), gaps
+        finally:
+            inst.close()
+
+    def test_disengage_decays_back_to_baseline(self):
+        inst = _single(max_pending=100, autopilot_dwell_s=0.2,
+                       autopilot_cooldown_s=0.1)
+        try:
+            ctl = _ctl(inst, "admission")
+            level = {"v": 1.0}
+            ctl.sense = lambda: level["v"]
+            beh = inst.conf.behaviors
+            base = time.monotonic() + 5.0
+            for i in range(20):
+                inst.autopilot.tick(base + i * 0.3)
+            assert beh.max_pending == 200
+            level["v"] = 0.0  # below clear (brownout/2)
+            for i in range(20, 60):
+                inst.autopilot.tick(base + i * 0.3)
+            assert not ctl.engaged
+            assert beh.max_pending == 100  # decayed home, not past it
+        finally:
+            inst.close()
+
+    def test_capacity_pressure_accelerates_demotion_cadence(self):
+        """The capacity controller lowers the cartographer's harvest
+        interval toward its floor — demotion/eviction candidates surface
+        BEFORE eviction pressure hits, and the cadence recovers once the
+        forecast clears."""
+        inst = _single(autopilot_dwell_s=0.2, autopilot_cooldown_s=0.1)
+        try:
+            ctl = _ctl(inst, "capacity")
+            level = {"v": 2.0}  # past the pressure floor
+            ctl.sense = lambda: level["v"]
+            baseline = inst.keyspace.interval_s
+            base = time.monotonic() + 5.0
+            for i in range(20):
+                inst.autopilot.tick(base + i * 0.3)
+            assert ctl.engaged
+            assert inst.keyspace.interval_s == pytest.approx(
+                baseline * 0.25)  # the declared floor, reached not passed
+            level["v"] = 0.0
+            for i in range(20, 60):
+                inst.autopilot.tick(base + i * 0.3)
+            assert inst.keyspace.interval_s == pytest.approx(baseline)
+        finally:
+            inst.close()
+
+    def test_pinned_pipeline_depth_is_operator_intent(self):
+        """A depth the operator pinned (not auto-probed) is out of the
+        autopilot's reach: the sense reads None (clear), the knob read
+        refuses, and no pipeline_depth move can ever land — even with
+        the pressure signal pinned high."""
+        inst = _single(autopilot_dwell_s=0.1, autopilot_cooldown_s=0.1)
+        try:
+            inst.combiner._depth_auto = False  # operator-pinned depth
+            ctl = _ctl(inst, "pipeline")
+            base = time.monotonic() + 5.0
+            for i in range(10):
+                inst.autopilot.tick(base + i * 0.2)
+            assert ctl.value is None
+            assert not ctl.engaged
+            # even a forced-high signal cannot move a pinned depth
+            ctl.sense = lambda: 5.0
+            for i in range(10, 30):
+                inst.autopilot.tick(base + i * 0.2)
+            assert _moves(inst, "pipeline_depth") == []
+        finally:
+            inst.close()
+
+
+# ---------------------------------------------------------- audit trail
+
+
+class TestAuditTrail:
+    def test_every_move_carries_signal_and_band(self):
+        inst = _single(max_pending=100, autopilot_dwell_s=0.2,
+                       autopilot_cooldown_s=0.1)
+        try:
+            _ctl(inst, "admission").sense = lambda: 1.0
+            base = time.monotonic() + 5.0
+            for i in range(10):
+                inst.autopilot.tick(base + i * 0.3)
+            moves = _moves(inst, "max_pending")
+            assert moves, "engaged controller produced no move events"
+            for e in moves:
+                assert e["controller"] == "admission"
+                assert e["signal"] == "admission.pending_fraction"
+                assert e["value"] == 1.0
+                assert e["old"] != e["new"]
+                assert e["floor"] <= e["new"] <= e["ceiling"]
+                assert e["step"] == 0.25
+                assert e["engaged"] is True
+            assert inst.autopilot.stats()["moves"] == len(moves)
+        finally:
+            inst.close()
+
+    def test_out_of_band_value_steps_back_under_clamp_event(self):
+        """An operator (or bug) parking a knob outside its band: the
+        controller steps it back inside, and the cut lands in the
+        recorder as autopilot.clamp with proposed vs clamped."""
+        inst = _single(autopilot_dwell_s=0.2, autopilot_cooldown_s=0.1)
+        try:
+            ctl = _ctl(inst, "hotkey")
+            ctl.sense = lambda: 0.9
+            beh = inst.conf.behaviors
+            base = time.monotonic() + 5.0
+            inst.autopilot.tick(base)  # captures the 0.2 baseline
+            beh.hot_lease_fraction = 5.0  # way outside [0.2, 0.5]
+            for i in range(1, 10):
+                inst.autopilot.tick(base + i * 0.3)
+            clamps = [e for e in inst.recorder.tail(kind=EV_CLAMP)
+                      if e["knob"] == "hot_lease_fraction"]
+            assert clamps
+            e = clamps[0]
+            assert e["proposed"] > e["clamped"]
+            assert e["clamped"] == e["ceiling"]
+            # band ceiling: baseline 0.2 * 2.5 multiplier
+            assert beh.hot_lease_fraction == pytest.approx(0.5)
+            assert inst.autopilot.clamps == len(
+                inst.recorder.tail(kind=EV_CLAMP))
+        finally:
+            inst.close()
+
+    def test_hotkey_controller_raises_fraction_and_ttl_together(self):
+        inst = _single(autopilot_dwell_s=0.2, autopilot_cooldown_s=0.1)
+        try:
+            _ctl(inst, "hotkey").sense = lambda: 0.9
+            beh = inst.conf.behaviors
+            f0, t0 = beh.hot_lease_fraction, beh.hot_lease_ttl_s
+            base = time.monotonic() + 5.0
+            for i in range(20):
+                inst.autopilot.tick(base + i * 0.3)
+            assert beh.hot_lease_fraction > f0
+            assert beh.hot_lease_ttl_s > t0
+            assert beh.hot_lease_fraction <= f0 * 2.5 + 1e-9
+            assert beh.hot_lease_ttl_s <= t0 * 3.0 + 1e-9
+        finally:
+            inst.close()
+
+
+# -------------------------------------------------------- freeze drills
+
+
+class _ReshardStub:
+    """Stands in for the ReshardManager's freeze-relevant surface."""
+
+    def __init__(self):
+        self.enabled = False
+        self.active = False
+
+    def stop(self):
+        pass
+
+
+@pytest.mark.chaos
+class TestFreezeDrills:
+    def test_no_move_between_plan_and_committed(self):
+        """The reshard interlock, read off the event stream the way an
+        incident review would: between `reshard.plan` and
+        `reshard.committed` there is a freeze edge and NO autopilot.move;
+        accumulated dwell credit is dropped, so the first post-thaw tick
+        cannot move either — a move needs a fresh dwell."""
+        inst = _single(max_pending=100, autopilot_dwell_s=1.0,
+                       autopilot_cooldown_s=0.1)
+        try:
+            inst.reshard.stop()
+            inst.reshard = _ReshardStub()
+            ctl = _ctl(inst, "admission")
+            ctl.sense = lambda: 1.0
+            ap = inst.autopilot
+            base = time.monotonic() + 5.0
+            ap.tick(base)  # arms: dwell credit starts accumulating
+            assert ctl.trip_since is not None
+
+            inst.recorder.emit("reshard.plan", drill=True)
+            inst.reshard.enabled = inst.reshard.active = True
+            ap.tick(base + 0.5)  # frozen tick drops the intent
+            assert ap.frozen and ap.freeze_reason == "reshard"
+            assert ap.frozen_drops >= 1
+            ap.tick(base + 2.0)  # dwell long since elapsed — still still
+            assert ap.moves == 0
+            inst.reshard.active = False
+            inst.recorder.emit("reshard.committed", drill=True)
+
+            ap.tick(base + 2.1)  # thawed, but the intent was dropped:
+            assert ap.moves == 0  # fresh dwell required, no stale replay
+            assert not ctl.engaged
+            ap.tick(base + 3.2)  # fresh dwell satisfied -> first move
+            assert ap.moves >= 1
+
+            kinds = [e["kind"] for e in inst.recorder.tail()]
+            plan, committed = (kinds.index("reshard.plan"),
+                               kinds.index("reshard.committed"))
+            assert EV_FREEZE in kinds[plan:committed]
+            assert EV_MOVE not in kinds[plan:committed]
+            assert EV_MOVE in kinds[committed:]
+        finally:
+            inst.close()
+
+    def test_membership_flip_freezes_for_the_hold_window(self):
+        inst = _single(autopilot_freeze_hold_s=30.0)
+        try:
+            ap = inst.autopilot
+            inst.set_peers([PeerInfo(address="127.0.0.1:1"),
+                            PeerInfo(address="127.0.0.1:2")])
+            ap.tick()
+            assert ap.frozen and ap.freeze_reason == "membership"
+            freezes = inst.recorder.tail(kind=EV_FREEZE)
+            assert freezes and freezes[-1]["reason"] == "membership"
+            assert ap.stats()["freezes"] >= 1
+        finally:
+            inst.close()
+
+    def test_freeze_gauge_and_counter_track_edges(self):
+        inst = _single(max_pending=100)
+        try:
+            inst.reshard.stop()
+            inst.reshard = _ReshardStub()
+            ap = inst.autopilot
+            base = time.monotonic() + 5.0
+            inst.reshard.enabled = inst.reshard.active = True
+            ap.tick(base)
+            ap.tick(base + 0.1)  # still frozen: edge counted ONCE
+            assert ap.freezes == 1
+            inst.reshard.active = False
+            ap.tick(base + 0.2)
+            assert not ap.frozen
+            inst.reshard.active = True
+            ap.tick(base + 0.3)
+            assert ap.freezes == 2
+        finally:
+            inst.close()
+
+
+# ------------------------------------------------- brownout knob & env
+
+
+class TestBrownoutFraction:
+    def test_brownout_threshold_reads_live(self):
+        """GUBER_BROWNOUT_FRACTION moves the admission brownout edge on
+        a running instance — no restart, no re-wiring."""
+        inst = _single(autopilot=False, max_pending=100)
+        try:
+            adm = inst.admission
+            adm.pending = lambda: 60  # type: ignore[method-assign]
+            assert adm.brownout_fraction == pytest.approx(0.75)
+            assert adm.level() == adm.ADMIT  # 60 < 75
+            inst.conf.behaviors.brownout_fraction = 0.5
+            assert adm.level() == adm.BROWNOUT  # 60 >= 50, live
+            inst.conf.behaviors.brownout_fraction = 0.75
+            assert adm.level() == adm.ADMIT
+        finally:
+            inst.close()
+
+    def test_admission_autopilot_trip_tracks_brownout(self):
+        inst = _single(max_pending=100)
+        try:
+            ctl = _ctl(inst, "admission")
+            assert ctl.thresholds() == (0.75, 0.375)
+            inst.conf.behaviors.brownout_fraction = 0.6
+            assert ctl.thresholds() == (0.6, 0.3)
+        finally:
+            inst.close()
+
+
+class TestEnvConf:
+    def test_brownout_and_autopilot_knobs_parse(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv("GUBER_BROWNOUT_FRACTION", "0.6")
+        monkeypatch.setenv("GUBER_AUTOPILOT", "1")
+        monkeypatch.setenv("GUBER_AUTOPILOT_INTERVAL", "250ms")
+        monkeypatch.setenv("GUBER_AUTOPILOT_DWELL", "2s")
+        monkeypatch.setenv("GUBER_AUTOPILOT_COOLDOWN", "5s")
+        monkeypatch.setenv("GUBER_AUTOPILOT_FREEZE_HOLD", "0s")
+        b = config_from_env([]).behaviors
+        assert b.brownout_fraction == pytest.approx(0.6)
+        assert b.autopilot is True
+        assert b.autopilot_interval_s == pytest.approx(0.25)
+        assert b.autopilot_dwell_s == pytest.approx(2.0)
+        assert b.autopilot_cooldown_s == pytest.approx(5.0)
+        assert b.autopilot_freeze_hold_s == 0.0  # >= 0 is valid
+
+    def test_defaults_off_and_sane(self, monkeypatch):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        for var in ("GUBER_BROWNOUT_FRACTION", "GUBER_AUTOPILOT",
+                    "GUBER_AUTOPILOT_INTERVAL", "GUBER_AUTOPILOT_DWELL",
+                    "GUBER_AUTOPILOT_COOLDOWN",
+                    "GUBER_AUTOPILOT_FREEZE_HOLD"):
+            monkeypatch.delenv(var, raising=False)
+        b = config_from_env([]).behaviors
+        assert b.autopilot is False
+        assert b.brownout_fraction == pytest.approx(0.75)
+        assert b.autopilot_interval_s == pytest.approx(1.0)
+        assert b.autopilot_dwell_s == pytest.approx(5.0)
+        assert b.autopilot_cooldown_s == pytest.approx(10.0)
+        assert b.autopilot_freeze_hold_s == pytest.approx(5.0)
+
+    @pytest.mark.parametrize("var,val", [
+        ("GUBER_BROWNOUT_FRACTION", "0"),
+        ("GUBER_BROWNOUT_FRACTION", "1.5"),
+        ("GUBER_AUTOPILOT_INTERVAL", "0s"),
+        ("GUBER_AUTOPILOT_DWELL", "0s"),
+        ("GUBER_AUTOPILOT_COOLDOWN", "0s"),
+    ])
+    def test_invalid_values_refuse_boot(self, monkeypatch, var, val):
+        from gubernator_tpu.cmd.envconf import config_from_env
+
+        monkeypatch.setenv(var, val)
+        with pytest.raises(ValueError, match=var):
+            config_from_env([])
+
+    def test_negative_freeze_hold_refuses_validate(self):
+        # env parsing can't produce a negative duration; the validate()
+        # guard protects programmatic configs
+        with pytest.raises(ValueError, match="freeze_hold"):
+            InstanceConfig(behaviors=BehaviorConfig(
+                autopilot_freeze_hold_s=-1.0)).validate()
+
+
+# ------------------------------------------------ scenario integration
+
+
+class TestScenarioKnobTrajectory:
+    def test_short_run_records_per_segment_knob_values(self):
+        from gubernator_tpu.scenarios import get_scenario, run_scenario
+
+        v = run_scenario(get_scenario("bot-storm"), profile="short",
+                         autopilot=True)
+        stats = v["stats"]
+        assert stats["autopilot"] is True
+        traj = stats["knob_trajectory"]
+        assert traj, "autopilot run recorded no knob trajectory"
+        segs = {t["segment"] for t in traj}
+        assert len(segs) >= 1
+        assert traj[-1].get("final") is True
+        for point in traj:
+            knobs = point["knobs"]
+            assert {"max_pending", "brownout_fraction",
+                    "hot_lease_fraction", "hot_lease_ttl_s",
+                    "keyspace_interval_s", "pipeline_depth",
+                    "autopilot_moves",
+                    "autopilot_frozen"} <= set(knobs)
+
+    def test_static_run_stays_unarmed(self):
+        from gubernator_tpu.scenarios import get_scenario, run_scenario
+
+        v = run_scenario(get_scenario("bot-storm"), profile="short")
+        assert v["stats"]["autopilot"] is False

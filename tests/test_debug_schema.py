@@ -27,6 +27,11 @@ tracks GUBER_LEDGER), and pins the /v1/debug/ledger endpoint body.
 History moves to v3 alongside: samples carry the cumulative
 ledger_violations / ledger_overshoot_hits / ledger_minted_budget
 columns.
+
+v6 promises the "autopilot" section on every Instance (the bounded
+closed-loop control plane is always constructed; its "enabled" flag
+tracks GUBER_AUTOPILOT), with per-controller state (engaged/armed/
+dwelling, last move, knob bands) and the move/clamp/freeze counters.
 """
 
 import pytest
@@ -45,7 +50,8 @@ from gubernator_tpu.types import PeerInfo
 # every section name the snapshot may carry, by wiring condition
 ALWAYS = {"schema_version", "advertise_address", "engine", "combiner",
           "kernel", "peers", "global", "flight_recorder", "anomaly",
-          "history", "keyspace", "reshard", "profile", "ledger"}
+          "history", "keyspace", "reshard", "profile", "ledger",
+          "autopilot"}
 OPTIONAL = {"wire", "trace", "leases", "collective_global", "multiregion",
             "bundles", "deadline_expired"}
 SECTIONS = ALWAYS | OPTIONAL
@@ -62,7 +68,7 @@ def instance():
 
 def test_schema_version_pinned(instance):
     dv = debug_vars(instance)
-    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 5
+    assert dv["schema_version"] == DEBUG_VARS_SCHEMA_VERSION == 6
 
 
 def test_always_sections_present(instance):
@@ -160,6 +166,26 @@ def test_ledger_endpoint_schema_pinned(instance):
                                       "p50_hits", "p99_hits"}
     assert set(body["ground_truth"]) == {"keys_checked", "ledger_hits",
                                          "device_hits", "breaches"}
+
+
+def test_autopilot_var_shape(instance):
+    dv = debug_vars(instance)
+    ap = dv["autopilot"]
+    assert {"enabled", "frozen", "freeze_reason", "ticks", "moves",
+            "clamps", "freezes", "frozen_drops",
+            "controllers"} <= set(ap)
+    assert ap["enabled"] is False  # GUBER_AUTOPILOT unset => off
+    assert ap["frozen"] is False
+    # per-controller shape: the four controllers are always declared,
+    # each with its hysteresis state and per-knob bands
+    assert set(ap["controllers"]) == {"admission", "hotkey", "capacity",
+                                      "pipeline"}
+    for ctl in ap["controllers"].values():
+        assert {"engaged", "armed", "dwelling", "signal", "value",
+                "trip", "clear", "knobs", "last_move"} <= set(ctl)
+        for knob in ctl["knobs"].values():
+            assert {"baseline", "floor", "ceiling", "step",
+                    "moves"} <= set(knob)
 
 
 def test_profile_var_shape(instance):

@@ -26,6 +26,7 @@ EXPECTED_RULES = {
     "native-warnings",
     "lock-order",
     "donation-flow",
+    "controller-bounds",
 }
 
 

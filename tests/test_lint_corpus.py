@@ -130,6 +130,32 @@ def test_escape_hatch_waived_at_anchor():
     assert _justified(suppressed)
 
 
+def test_controller_bounds_fires_and_waives():
+    findings, suppressed = _run("controller_bounds", "controller-bounds")
+    msgs = [f.render() for f in findings]
+    assert len(findings) == 4, msgs
+
+    def one(substr):
+        hits = [f for f in findings if substr in f.message]
+        assert len(hits) == 1, (substr, msgs)
+        return hits[0]
+
+    unreg = one("'unregistered_knob' with no KNOBS entry")
+    assert "'corpus'" in unreg.message
+    stepless = one("'stepless_knob' KnobSpec declares no step")
+    assert "unbounded" in stepless.message
+    inverted = one("'inverted_knob' declares floor 2.0 > ceiling 0.5")
+    ghost = one("GUBER_CORPUS_GHOST has no row in the knob docs")
+    assert "docs/OPERATIONS.md" in ghost.message
+    assert all(f.path.endswith("service/autopilot.py") for f in findings)
+    assert inverted.line != ghost.line
+    # good_knob is clean; waived_knob's stepless twin is suppressed
+    assert not any("good_knob" in m for m in msgs)
+    assert len(suppressed) == 1
+    assert "waived_knob" in suppressed[0][0].message
+    assert _justified(suppressed)
+
+
 def test_registry_drift_fires_on_all_three_registries():
     findings, suppressed = _run("registry_drift", "registry-drift")
     msgs = [f.render() for f in findings]
